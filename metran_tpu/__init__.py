@@ -1,0 +1,34 @@
+"""metran_tpu — TPU-native dynamic factor modeling of multivariate time
+series (JAX/XLA).
+
+A ground-up rebuild of the capabilities of ``pastas/metran`` designed for
+TPU: the Kalman filter/smoother as ``lax.scan`` recursions compiled by XLA,
+exact autodiff gradients of the marginal likelihood, ``vmap`` over fleets of
+models, and device-mesh sharding for multi-chip scale.
+"""
+
+from . import config, data, ops, utils
+from .utils import show_versions
+from .version import __version__
+
+__all__ = [
+    "config",
+    "data",
+    "ops",
+    "utils",
+    "show_versions",
+    "__version__",
+]
+
+
+def __getattr__(name):
+    # Lazy imports keep `import metran_tpu` light and avoid import cycles.
+    if name in ("Metran", "FactorAnalysis"):
+        from . import models
+
+        return getattr(models, name)
+    if name in ("BaseSolver", "ScipySolve", "JaxSolve", "LmfitSolve"):
+        from .models import solver
+
+        return getattr(solver, name)
+    raise AttributeError(f"module 'metran_tpu' has no attribute {name!r}")

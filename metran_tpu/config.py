@@ -1,0 +1,31 @@
+"""Precision / platform policy.
+
+The reference computes everything in float64 (``metran/kalmanfilter.py:
+307-312``) and the parity bar is 1e-6 on the log-likelihood (BASELINE.md).
+On CPU we therefore enable JAX x64 and run the filter in float64.  On TPU,
+float64 is emulated and slow; the fleet/bench paths use float32 state with
+the same algorithms (validated against the f64 CPU path), so precision is a
+per-call dtype choice, not a global flag.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def enable_x64(enable: bool = True) -> None:
+    """Toggle float64 support process-wide (safe to call at any time)."""
+    jax.config.update("jax_enable_x64", bool(enable))
+
+
+def default_dtype():
+    """float64 when x64 is enabled (CPU/parity), else float32 (TPU)."""
+    import jax.numpy as jnp
+
+    return jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+
+if os.environ.get("METRAN_TPU_X64", "").lower() in ("1", "true", "yes"):
+    enable_x64(True)

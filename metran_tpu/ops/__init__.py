@@ -1,0 +1,31 @@
+"""Pure-JAX numerical kernels: state-space builders, Kalman filtering and
+smoothing, factor analysis."""
+
+from .kalman import (
+    FilterResult,
+    SmootherResult,
+    decompose_states,
+    deviance,
+    deviance_terms,
+    kalman_filter,
+    log_likelihood,
+    project,
+    rts_smoother,
+)
+from .statespace import StateSpace, ar1_decay, dfm_statespace, scale_observation_matrix
+
+__all__ = [
+    "FilterResult",
+    "SmootherResult",
+    "StateSpace",
+    "ar1_decay",
+    "decompose_states",
+    "deviance",
+    "deviance_terms",
+    "dfm_statespace",
+    "kalman_filter",
+    "log_likelihood",
+    "project",
+    "rts_smoother",
+    "scale_observation_matrix",
+]

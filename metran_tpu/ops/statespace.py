@@ -1,0 +1,109 @@
+"""State-space construction for the Metran dynamic factor model (DFM).
+
+The DFM decomposes ``n`` standardized observed series into ``n`` specific
+dynamic factors (one AR(1) latent state per series) and ``k`` common dynamic
+factors (AR(1) latent states shared through factor loadings).  The state-space
+form is
+
+    x_t = Phi x_{t-1} + w_t,   w_t ~ N(0, Q)
+    y_t = Z x_t + v_t,         v_t ~ N(0, diag(r))
+
+with diagonal ``Phi`` (``phi_i = exp(-dt / alpha_i)``), diagonal ``Q``
+(``q_sdf = (1 - phi^2) (1 - communality)``, ``q_cdf = 1 - phi^2``),
+``Z = [I_n | Gamma]`` and ``r = 0``.
+
+Parity: behavior of the matrix builders in the reference implementation
+(``metran/metran.py:246-416``: ``_phi``, ``get_transition_matrix``,
+``get_transition_covariance``, ``get_observation_matrix``,
+``get_observation_variance``, ``get_scaled_observation_matrix``), rebuilt here
+as pure jittable functions of the parameter vector so the whole model is
+differentiable and vmappable.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class StateSpace(NamedTuple):
+    """Matrices of a (diagonal-transition) linear-Gaussian state-space model.
+
+    Attributes
+    ----------
+    phi : (n_state,) diagonal of the transition matrix.
+    q : (n_state, n_state) transition (process noise) covariance.
+    z : (n_obs, n_state) observation matrix.
+    r : (n_obs,) diagonal observation noise variance.
+    """
+
+    phi: jnp.ndarray
+    q: jnp.ndarray
+    z: jnp.ndarray
+    r: jnp.ndarray
+
+    @property
+    def n_state(self) -> int:
+        return self.phi.shape[-1]
+
+    @property
+    def n_obs(self) -> int:
+        return self.z.shape[-2]
+
+
+def ar1_decay(alpha: jnp.ndarray, dt) -> jnp.ndarray:
+    """AR(1) decay ``phi = exp(-dt / alpha)`` for time step ``dt`` (days)."""
+    return jnp.exp(-dt / alpha)
+
+
+def dfm_statespace(
+    alpha_sdf: jnp.ndarray,
+    alpha_cdf: jnp.ndarray,
+    loadings: jnp.ndarray,
+    dt=1.0,
+) -> StateSpace:
+    """Build the Metran DFM state-space matrices from parameters.
+
+    Parameters
+    ----------
+    alpha_sdf : (n_series,) AR decay parameter per specific dynamic factor.
+    alpha_cdf : (n_factors,) AR decay parameter per common dynamic factor.
+    loadings : (n_series, n_factors) factor loadings from factor analysis.
+    dt : time step in days (scalar).
+
+    Returns
+    -------
+    StateSpace with state ordering ``[sdf_0..sdf_{n-1}, cdf_0..cdf_{k-1}]``.
+    """
+    alpha_sdf = jnp.asarray(alpha_sdf)
+    alpha_cdf = jnp.asarray(alpha_cdf)
+    loadings = jnp.atleast_2d(jnp.asarray(loadings))
+    dtype = jnp.result_type(alpha_sdf, alpha_cdf, loadings, jnp.zeros(0))
+    n_series = loadings.shape[0]
+
+    phi_sdf = ar1_decay(alpha_sdf.astype(dtype), dt)
+    phi_cdf = ar1_decay(alpha_cdf.astype(dtype), dt)
+    phi = jnp.concatenate([phi_sdf, phi_cdf])
+
+    communality = jnp.sum(jnp.square(loadings), axis=1)
+    q_sdf = (1.0 - phi_sdf**2) * (1.0 - communality)
+    q_cdf = 1.0 - phi_cdf**2
+    q = jnp.diag(jnp.concatenate([q_sdf, q_cdf]).astype(dtype))
+
+    z = jnp.concatenate(
+        [jnp.eye(n_series, dtype=dtype), loadings.astype(dtype)], axis=1
+    )
+    r = jnp.zeros(n_series, dtype=dtype)
+    return StateSpace(phi=phi, q=q, z=z, r=r)
+
+
+def scale_observation_matrix(z: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    """Scale the observation matrix by per-series standard deviations.
+
+    Equivalent in behavior to the reference's scaled observation matrix
+    (``metran/metran.py:944-961``): the identity block becomes ``diag(scale)``
+    and the loading columns are multiplied row-wise by ``scale``, so projected
+    states land in the unstandardized data units.
+    """
+    return z * scale[:, None]

@@ -1,0 +1,110 @@
+"""Small host-side utilities (logging, validation, versions).
+
+Local equivalents of the pastas helpers the reference imports
+(``pastas.utils.initialize_logger`` / ``validate_name`` /
+``frequency_is_supported``, ``pastas.plotting.plotutil._get_height_ratios``)
+so this framework has no pastas dependency (SURVEY.md section 2.4).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Sequence, Tuple
+
+from pandas import Timedelta
+from pandas.tseries.frequencies import to_offset
+
+
+def initialize_logger(logger=None, level=logging.INFO) -> None:
+    """Attach a stream handler to the metran_tpu logger hierarchy once."""
+    if logger is None:
+        logger = logging.getLogger("metran_tpu")
+    logger.setLevel(level)
+    if not logger.handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(logging.Formatter("%(levelname)s: %(message)s"))
+        logger.addHandler(handler)
+
+
+def get_logger(name: str) -> logging.Logger:
+    logger = logging.getLogger(name)
+    initialize_logger(logging.getLogger("metran_tpu"))
+    return logger
+
+
+ILLEGAL_NAME_CHARS = ["/", "\\", " "]
+
+
+def validate_name(name: str, raise_error: bool = False) -> str:
+    """Check a model/series name for characters that break file storage."""
+    name = str(name)
+    for char in ILLEGAL_NAME_CHARS:
+        if char in name:
+            msg = f"Name '{name}' contains illegal character '{char}'."
+            if raise_error:
+                raise ValueError(msg)
+            logging.getLogger("metran_tpu").warning(msg)
+    return name
+
+
+def frequency_is_supported(freq: str) -> str:
+    """Validate a pandas frequency string and normalize it.
+
+    Only fixed-length frequencies (multiples of D/h/min/s/ms/us/ns) are
+    meaningful for the AR(1) decay parameterization; anything `to_offset`
+    rejects or that has no fixed Timedelta raises ValueError.
+    """
+    try:
+        offset = to_offset(freq)
+        Timedelta(offset)
+    except Exception as e:
+        raise ValueError(f"Frequency {freq!r} is not supported: {e}") from e
+    # normalize "D" -> "1D" roundtrip stability
+    return freq
+
+
+def freq_to_days(freq: str) -> float:
+    """Length of one frequency step in days (the AR(1) ``dt``)."""
+    return Timedelta(to_offset(freq)) / Timedelta(1, "D")
+
+
+def get_height_ratios(ylims: Sequence[Tuple[float, float]]) -> List[float]:
+    """Relative subplot heights proportional to each panel's y-range."""
+    spans = [abs(y1 - y0) for (y0, y1) in ylims]
+    total = sum(spans)
+    if total == 0:
+        return [1.0] * len(ylims)
+    return [max(s / total, 0.05) for s in spans]
+
+
+def show_versions() -> None:
+    """Print versions of the numerical stack (reference: metran/utils.py)."""
+    from sys import version as py_version
+
+    import jax
+    import jaxlib
+    import matplotlib
+    import numpy
+    import pandas
+    import scipy
+
+    from ..version import __version__
+
+    msg = (
+        f"metran_tpu version: {__version__}\n"
+        f"Python version: {py_version}\n"
+        f"numpy version: {numpy.__version__}\n"
+        f"scipy version: {scipy.__version__}\n"
+        f"pandas version: {pandas.__version__}\n"
+        f"matplotlib version: {matplotlib.__version__}\n"
+        f"jax version: {jax.__version__}\n"
+        f"jaxlib version: {jaxlib.__version__}\n"
+        f"jax backend: {jax.default_backend()}"
+    )
+    try:
+        import optax
+
+        msg += f"\noptax version: {optax.__version__}"
+    except ModuleNotFoundError:
+        msg += "\noptax version: not installed"
+    print(msg)

@@ -1,0 +1,71 @@
+"""Test configuration: CPU backend with 8 virtual devices + float64.
+
+Multi-device sharding paths are exercised on a virtual CPU mesh (the
+TPU-native analog of testing multi-node without a cluster, SURVEY.md
+section 4); parity tests need float64 like the reference.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+from pathlib import Path  # noqa: E402
+
+import numpy as np  # noqa: E402
+import pandas as pd  # noqa: E402
+import pytest  # noqa: E402
+
+REFERENCE_DATA = Path("/root/reference/examples/data")
+
+
+@pytest.fixture(scope="session")
+def series_list():
+    """The five groundwater residual series used by the reference tests."""
+    if not REFERENCE_DATA.exists():
+        pytest.skip("reference example data not available")
+    series = []
+    for fi in sorted(REFERENCE_DATA.glob("*_res.csv")):
+        s = pd.read_csv(
+            fi,
+            header=0,
+            index_col=0,
+            parse_dates=True,
+            date_format="%Y-%m-%d",
+            names=[fi.stem.split("_")[0]],
+        ).squeeze()
+        series.append(s)
+    return series
+
+
+@pytest.fixture(scope="session")
+def corr():
+    return np.array([[1.0, 0.8], [0.8, 1.0]], dtype=float)
+
+
+def random_ssm(rng, n_series=5, n_factors=1, t=200, missing=0.3):
+    """A random DFM-shaped state-space model plus masked observations."""
+    from metran_tpu.ops import dfm_statespace
+
+    alpha_sdf = rng.uniform(5.0, 50.0, n_series)
+    alpha_cdf = rng.uniform(5.0, 50.0, n_factors)
+    loadings = rng.uniform(0.3, 0.9, (n_series, n_factors)) / np.sqrt(n_factors)
+    ss = dfm_statespace(alpha_sdf, alpha_cdf, loadings)
+    y = rng.normal(size=(t, n_series))
+    mask = rng.uniform(size=(t, n_series)) > missing
+    mask[0] = False  # exercise a no-observation leading timestep
+    y = np.where(mask, y, 0.0)
+    return ss, y, mask
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,90 @@
+"""Independent numpy oracle for the sequential-processing Kalman filter.
+
+A straightforward, loop-based implementation of the published algorithm
+(sequential processing of a diagonal-R state-space model, Koopman-style;
+the same math as the reference's numba kernel) used as a test oracle for
+the JAX `lax.scan` engines.  Written fresh for these tests.
+"""
+
+import numpy as np
+
+
+def np_filter(phi, q, z, r, y, mask):
+    """Sequential-processing Kalman filter, plain numpy loops.
+
+    Parameters
+    ----------
+    phi : (n,) diagonal transition.
+    q : (n, n) transition covariance.
+    z : (m, n) observation matrix.
+    r : (m,) observation variance.
+    y : (t, m) observations (NaN-free; masked entries ignored).
+    mask : (t, m) bool.
+
+    Returns dict with predicted/filtered means/covs, per-step sigma/detf,
+    and per-step observation flags.
+    """
+    t_steps, m = y.shape
+    n = phi.shape[0]
+    mean = np.zeros(n)
+    cov = np.eye(n)
+    out = {
+        "mean_p": np.zeros((t_steps, n)),
+        "cov_p": np.zeros((t_steps, n, n)),
+        "mean_f": np.zeros((t_steps, n)),
+        "cov_f": np.zeros((t_steps, n, n)),
+        "sigma": np.zeros(t_steps),
+        "detf": np.zeros(t_steps),
+        "has_obs": np.zeros(t_steps, bool),
+    }
+    for t in range(t_steps):
+        mean = phi * mean
+        cov = phi[:, None] * cov * phi[None, :] + q
+        out["mean_p"][t] = mean
+        out["cov_p"][t] = cov
+        sigma = 0.0
+        detf = 0.0
+        for i in range(m):
+            if not mask[t, i]:
+                continue
+            zi = z[i]
+            v = y[t, i] - zi @ mean
+            d = cov @ zi
+            f = zi @ d + r[i]
+            k = d / f
+            cov = cov - np.outer(k, k) * f
+            mean = mean + k * v
+            sigma += v * v / f
+            detf += np.log(f)
+        out["mean_f"][t] = mean
+        out["cov_f"][t] = cov
+        out["sigma"][t] = sigma
+        out["detf"][t] = detf
+        out["has_obs"][t] = mask[t].any()
+    return out
+
+
+def np_deviance(filt, mask, warmup=1):
+    """Reference get_mle semantics (metran/kalmanfilter.py:550-567):
+    sigma/detf skip the first `warmup` *observed* steps, nobs skips the
+    first `warmup` *grid* steps."""
+    sigma = filt["sigma"][filt["has_obs"]][warmup:]
+    detf = filt["detf"][filt["has_obs"]][warmup:]
+    nobs = mask[warmup:].sum()
+    return nobs * np.log(2 * np.pi) + detf.sum() + sigma.sum()
+
+
+def np_smoother(filt, phi):
+    """RTS smoother with explicit inverse (predicted covs are PD here)."""
+    mean_f, cov_f = filt["mean_f"], filt["cov_f"]
+    mean_p, cov_p = filt["mean_p"], filt["cov_p"]
+    t_steps, n = mean_f.shape
+    mean_s = np.zeros_like(mean_f)
+    cov_s = np.zeros_like(cov_f)
+    mean_s[-1] = mean_f[-1]
+    cov_s[-1] = cov_f[-1]
+    for t in reversed(range(t_steps - 1)):
+        g = cov_f[t] @ np.diag(phi) @ np.linalg.pinv(cov_p[t + 1])
+        mean_s[t] = mean_f[t] + g @ (mean_s[t + 1] - mean_p[t + 1])
+        cov_s[t] = cov_f[t] + g @ (cov_s[t + 1] - cov_p[t + 1]) @ g.T
+    return mean_s, cov_s
