@@ -2,22 +2,51 @@
 
 The reference computes everything in float64 (``metran/kalmanfilter.py:
 307-312``) and the parity bar is 1e-6 on the log-likelihood (BASELINE.md).
-On CPU we therefore enable JAX x64 and run the filter in float64.  On TPU,
-float64 is emulated and slow; the fleet/bench paths use float32 state with
-the same algorithms (validated against the f64 CPU path), so precision is a
-per-call dtype choice, not a global flag.
+Policy:
+
+- **CPU backend**: float64, enabled automatically the first time a model
+  is constructed (``ensure_precision``), matching the reference bit-for-bit
+  semantics.
+- **TPU backend**: float64 is emulated and slow, so the default stays
+  float32; the fleet/bench paths use float32 state with the same
+  algorithms, validated against the f64 CPU path.
+
+Set ``METRAN_TPU_X64=1`` to force x64 regardless of backend, or call
+``enable_x64(False)`` after import to opt out.
 """
 
 from __future__ import annotations
 
 import os
+from logging import getLogger
 
 import jax
+
+logger = getLogger(__name__)
+
+_precision_checked = False
 
 
 def enable_x64(enable: bool = True) -> None:
     """Toggle float64 support process-wide (safe to call at any time)."""
     jax.config.update("jax_enable_x64", bool(enable))
+
+
+def ensure_precision() -> None:
+    """Enable x64 on CPU backends (once); leave accelerators at f32.
+
+    Called by model construction so that plain `Metran(series).solve()`
+    on CPU reproduces the float64 reference to the documented parity bar
+    without any configuration.
+    """
+    global _precision_checked
+    if _precision_checked or jax.config.jax_enable_x64:
+        _precision_checked = True
+        return
+    _precision_checked = True
+    if jax.default_backend() == "cpu":
+        logger.info("CPU backend detected: enabling float64 (reference parity).")
+        enable_x64(True)
 
 
 def default_dtype():

@@ -1,0 +1,14 @@
+"""Model-level API: the Metran orchestrator, factor analysis, solvers."""
+
+from .factoranalysis import FactorAnalysis
+from .metran import Metran
+from .solver import BaseSolver, JaxSolve, LmfitSolve, ScipySolve
+
+__all__ = [
+    "BaseSolver",
+    "FactorAnalysis",
+    "JaxSolve",
+    "LmfitSolve",
+    "Metran",
+    "ScipySolve",
+]

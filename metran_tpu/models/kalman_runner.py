@@ -1,0 +1,100 @@
+"""Stateful shell around the pure JAX Kalman kernels.
+
+Plays the role of the reference's ``SPKalmanFilter`` object
+(``metran/kalmanfilter.py:479-778``): holds the packed observations, the
+currently-set state-space matrices and lazily-cached filter/smoother
+results, so model accessors can re-use a single filter pass.  All numerics
+happen in :mod:`metran_tpu.ops`.
+"""
+
+from __future__ import annotations
+
+from logging import getLogger
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..data import Panel
+from ..ops import (
+    FilterResult,
+    SmootherResult,
+    StateSpace,
+    decompose_states,
+    deviance_terms,
+    kalman_filter,
+    project,
+    rts_smoother,
+)
+
+logger = getLogger(__name__)
+
+
+class KalmanRunner:
+    """Caches filter/smoother products for the currently-set matrices."""
+
+    def __init__(self, panel: Panel, engine: str = "sequential"):
+        self.engine = engine
+        self.mask_active = False  # True while masked observations are set
+        self.set_observations(panel)
+        self.ss: Optional[StateSpace] = None
+        self.init_states()
+
+    # mirror of the reference's cache-invalidation entry point
+    def init_states(self) -> None:
+        self.filtered: Optional[FilterResult] = None
+        self.smoothed: Optional[SmootherResult] = None
+
+    def set_observations(self, panel: Panel) -> None:
+        self.panel = panel
+        self.y = jnp.asarray(panel.values)
+        self.mask = jnp.asarray(panel.mask)
+        self.init_states()
+
+    def set_matrices(self, ss: StateSpace) -> None:
+        self.ss = ss
+        self.init_states()
+
+    def run_filter(self) -> FilterResult:
+        if self.filtered is None:
+            if self.mask_active:
+                logger.info("Running Kalman filter with masked observations.")
+            self.filtered = kalman_filter(
+                self.ss, self.y, self.mask, engine=self.engine
+            )
+        return self.filtered
+
+    def run_smoother(self) -> SmootherResult:
+        if self.smoothed is None:
+            self.smoothed = rts_smoother(self.ss, self.run_filter())
+        return self.smoothed
+
+    def get_mle(self, warmup: int = 1) -> float:
+        res = self.run_filter()
+        return float(deviance_terms(res.sigma, res.detf, self.mask, warmup=warmup))
+
+    def _states(self, method: str):
+        if method == "filter":
+            res = self.run_filter()
+            return res.mean_f, res.cov_f
+        res = self.run_smoother()
+        return res.mean_s, res.cov_s
+
+    def state_means(self, method: str = "smoother") -> np.ndarray:
+        return np.asarray(self._states(method)[0])
+
+    def state_variances(self, method: str = "smoother") -> np.ndarray:
+        covs = self._states(method)[1]
+        return np.asarray(jnp.diagonal(covs, axis1=-2, axis2=-1))
+
+    def simulate(self, observation_matrix, method: str = "smoother"):
+        means, covs = self._states(method)
+        sim_means, sim_vars = project(jnp.asarray(observation_matrix), means, covs)
+        return np.asarray(sim_means), np.asarray(sim_vars)
+
+    def decompose(self, observation_matrix, method: str = "smoother"):
+        means, _ = self._states(method)
+        sdf, cdf = decompose_states(
+            jnp.asarray(observation_matrix), means, self.panel.n_series
+        )
+        return np.asarray(sdf), np.asarray(cdf)

@@ -1,0 +1,699 @@
+"""The Metran model class: user-facing shell over the TPU engine.
+
+API-compatible with the reference ``Metran`` (``metran/metran.py:31-1314``):
+same constructor, parameter table, accessors, masking workflow and reports.
+Internally the likelihood/filter/smoother run as jitted JAX computations on
+dense masked arrays; gradients of the likelihood are exact (autodiff).
+"""
+
+from __future__ import annotations
+
+import functools
+from logging import getLogger
+from os import getlogin
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from pandas import DataFrame, Series, Timestamp, concat
+from scipy.stats import norm
+
+from .. import data as _data
+from ..ops import dfm_statespace, deviance
+from ..utils import freq_to_days, frequency_is_supported, validate_name
+from .factoranalysis import FactorAnalysis
+from .kalman_runner import KalmanRunner
+from .solver import ScipySolve
+
+logger = getLogger(__name__)
+
+_ENGINE_ALIASES = {
+    "numba": "sequential",  # reference names accepted for drop-in use
+    "numpy": "sequential",
+    "sequential": "sequential",
+    "joint": "joint",
+}
+
+
+@functools.partial(jax.jit, static_argnames=("warmup", "engine"))
+def _dfm_deviance(p, y, mask, loadings, dt, warmup, engine):
+    n_series = loadings.shape[0]
+    ss = dfm_statespace(p[:n_series], p[n_series:], loadings, dt)
+    return deviance(ss, y, mask, warmup=warmup, engine=engine)
+
+
+_dfm_deviance_vg = jax.jit(
+    jax.value_and_grad(_dfm_deviance), static_argnames=("warmup", "engine")
+)
+
+
+class Metran:
+    """Multivariate time-series analysis using a dynamic factor model.
+
+    Parameters
+    ----------
+    oseries : pandas.DataFrame or list/tuple of pandas.Series/DataFrame
+        Series to be analyzed; index must be a DatetimeIndex.
+    name : str, optional
+        Model name (default "Cluster").
+    freq : str, optional
+        Simulation frequency (fixed-length pandas offsets like "D", "7D").
+    tmin, tmax : str, optional
+        Start/end of the analysis period.
+    engine : str, optional
+        Kalman update engine: "sequential" (default, parity with the
+        reference's sequential processing) or "joint" (batched Cholesky
+        update).  The reference's "numba"/"numpy" names are accepted
+        aliases of "sequential".
+    """
+
+    def __init__(
+        self,
+        oseries,
+        name: str = "Cluster",
+        freq: Optional[str] = None,
+        tmin=None,
+        tmax=None,
+        engine: str = "sequential",
+    ):
+        from ..config import ensure_precision
+
+        ensure_precision()
+        self.settings = {
+            "tmin": None,
+            "tmax": None,
+            "freq": "D",
+            "min_pairs": 20,
+            "solver": None,
+            "warmup": 1,
+        }
+        if tmin is not None:
+            self.settings["tmin"] = tmin
+        if tmax is not None:
+            self.settings["tmax"] = tmax
+        if freq is not None:
+            self.settings["freq"] = frequency_is_supported(freq)
+        self._engine = _ENGINE_ALIASES[engine]
+
+        self.nfactors = 0
+        self.factors: Optional[np.ndarray] = None
+        self.set_observations(oseries)
+        self.parameters = DataFrame(
+            columns=["initial", "pmin", "pmax", "vary", "name"]
+        )
+        self.set_init_parameters()
+
+        self.masked_observations = None
+        self.fit = None
+        self.kf: Optional[KalmanRunner] = None
+
+        self.name = validate_name(name)
+        self.file_info = self._get_file_info()
+
+        from .plots import MetranPlot
+
+        self.plots = MetranPlot(self)
+
+    # ------------------------------------------------------------------
+    # dimensions
+    # ------------------------------------------------------------------
+    @property
+    def nparam(self) -> int:
+        return self.parameters.index.size
+
+    @property
+    def nstate(self) -> int:
+        return self.nseries + self.nfactors
+
+    @property
+    def _dt(self) -> float:
+        return freq_to_days(self.settings["freq"])
+
+    # ------------------------------------------------------------------
+    # data handling
+    # ------------------------------------------------------------------
+    def set_observations(self, oseries) -> None:
+        """Ingest observations (reference: ``metran/metran.py:509-579``)."""
+        frame = _data.combine_series(oseries)
+        self.snames = [str(c) for c in frame.columns]
+        frame = _data.truncate(
+            frame, self.settings["tmin"], self.settings["tmax"]
+        )
+        import pandas as pd
+
+        if not isinstance(frame.index, pd.DatetimeIndex):
+            msg = "Index of series must be DatetimeIndex"
+            logger.error(msg)
+            raise TypeError(msg)
+        frame = frame.asfreq(self.settings["freq"])
+        self.nseries = frame.shape[1]
+        self.oseries_unstd = frame
+        self.oseries, self.oseries_std, self.oseries_mean = _data.standardize(frame)
+        self.test_cross_section()
+
+    def standardize(self, oseries):
+        standardized, self.oseries_std, self.oseries_mean = _data.standardize(oseries)
+        return standardized
+
+    def truncate(self, oseries):
+        return _data.truncate(oseries, self.settings["tmin"], self.settings["tmax"])
+
+    def test_cross_section(self, oseries=None, min_pairs: Optional[int] = None):
+        if oseries is None:
+            oseries = self.oseries
+        if min_pairs is None:
+            min_pairs = self.settings["min_pairs"]
+        _data.test_cross_section(oseries, min_pairs=min_pairs)
+
+    def get_observations(self, standardized: bool = False, masked: bool = False):
+        oseries = self.masked_observations if masked else self.oseries
+        if not standardized:
+            oseries = oseries * self.oseries_std + self.oseries_mean
+        return oseries
+
+    def _active_panel(self) -> _data.Panel:
+        frame = (
+            self.masked_observations
+            if self.masked_observations is not None
+            else self.oseries
+        )
+        return _data.pack_panel(
+            frame,
+            std=self.oseries_std,
+            mean=self.oseries_mean,
+            freq=self.settings["freq"],
+        )
+
+    # ------------------------------------------------------------------
+    # masking (counterfactual / outlier analysis)
+    # ------------------------------------------------------------------
+    def mask_observations(self, mask) -> None:
+        """Hide selected observations from the filter/smoother without
+        altering the stored data (reference: ``metran/metran.py:464-495``)."""
+        if mask.shape != self.oseries.shape:
+            logger.error(
+                "Dimensions of mask %s do not equal dimensions of series %s. "
+                "Mask cannot be applied.",
+                mask.shape,
+                self.oseries.shape,
+            )
+            return
+        self.masked_observations = self.oseries.mask(mask.astype(bool))
+        if self.kf is not None:
+            self.kf.set_observations(self._active_panel())
+            self.kf.mask_active = True
+
+    def unmask_observations(self) -> None:
+        self.masked_observations = None
+        if self.kf is not None:
+            self.kf.set_observations(self._active_panel())
+            self.kf.mask_active = False
+
+    # ------------------------------------------------------------------
+    # factor analysis
+    # ------------------------------------------------------------------
+    def get_factors(self, oseries=None) -> Optional[np.ndarray]:
+        if oseries is None:
+            oseries = self.oseries
+        fa = FactorAnalysis()
+        self.factors = fa.solve(oseries)
+        self.eigval = fa.eigval
+        if self.factors is not None:
+            self.nfactors = self.factors.shape[1]
+            self.fep = fa.fep
+        else:
+            self.nfactors = 0
+        return self.factors
+
+    def get_communality(self) -> np.ndarray:
+        return np.sum(np.square(self.factors), axis=1)
+
+    def get_specificity(self) -> np.ndarray:
+        return 1 - self.get_communality()
+
+    # ------------------------------------------------------------------
+    # parameters
+    # ------------------------------------------------------------------
+    def set_init_parameters(self) -> None:
+        pinit_alpha = 10.0
+        cols = ["initial", "pmin", "pmax", "vary", "name"]
+        for n in range(self.nfactors):
+            self.parameters.loc[f"cdf{n + 1}_alpha", cols] = (
+                pinit_alpha, 1e-5, None, True, "cdf",
+            )
+        for n in range(self.nseries):
+            self.parameters.loc[f"{self.snames[n]}_sdf_alpha", cols] = (
+                pinit_alpha, 1e-5, None, True, "sdf",
+            )
+
+    def get_parameters(self, initial: bool = False) -> Series:
+        if not initial and "optimal" in self.parameters:
+            return self.parameters["optimal"]
+        return self.parameters["initial"]
+
+    def _param_array(self, p) -> np.ndarray:
+        """Coerce parameters (array/Series/dict) to the canonical order
+        [sdf alphas..., cdf alphas...] used by the state-space builder."""
+        if isinstance(p, dict):
+            p = Series(p)
+        if isinstance(p, Series):
+            p = p.reindex(self.parameters.index).values
+        p = np.asarray(p, float)
+        kinds = self.parameters["name"].values
+        sdf_idx = np.flatnonzero(kinds == "sdf")
+        cdf_idx = np.flatnonzero(kinds == "cdf")
+        return np.concatenate([p[sdf_idx], p[cdf_idx]])
+
+    # ------------------------------------------------------------------
+    # state-space matrices (host-side views for reports/parity)
+    # ------------------------------------------------------------------
+    def _phi(self, alpha):
+        return np.exp(-self._dt / alpha)
+
+    def get_transition_matrix(self, p=None, initial=False) -> np.ndarray:
+        if p is None:
+            p = self.get_parameters(initial)
+        a = self._param_array(p)
+        return np.diag(self._phi(a))
+
+    def get_transition_covariance(self, p=None, initial=False) -> np.ndarray:
+        if p is None:
+            p = self.get_parameters(initial)
+        a = self._param_array(p)
+        phi = self._phi(a)
+        communality = np.sum(np.square(self.factors), axis=1)
+        q = 1 - phi**2
+        q[: self.nseries] *= 1 - communality
+        return np.diag(q)
+
+    def get_transition_variance(self, p=None, initial=False) -> np.ndarray:
+        return np.diag(self.get_transition_covariance(p, initial))
+
+    def get_observation_matrix(self, p=None, initial=False) -> np.ndarray:
+        return np.concatenate(
+            [np.eye(self.nseries), np.atleast_2d(self.factors)], axis=1
+        )
+
+    def get_observation_variance(self) -> np.ndarray:
+        return np.zeros(self.nseries)
+
+    def get_scaled_observation_matrix(self, p=None) -> np.ndarray:
+        from ..ops import scale_observation_matrix
+
+        return np.asarray(
+            scale_observation_matrix(self.get_observation_matrix(p), self.oseries_std)
+        )
+
+    def _get_matrices(self, p, initial=False):
+        return (
+            self.get_transition_matrix(p, initial),
+            self.get_transition_covariance(p, initial),
+            self.get_observation_matrix(p, initial),
+            self.get_observation_variance(),
+        )
+
+    def _statespace(self, p):
+        a = self._param_array(p)
+        return dfm_statespace(
+            a[: self.nseries], a[self.nseries:], jnp.asarray(self.factors), self._dt
+        )
+
+    # ------------------------------------------------------------------
+    # likelihood
+    # ------------------------------------------------------------------
+    def _init_kalmanfilter(self, oseries=None, engine: Optional[str] = None) -> None:
+        if engine is not None:
+            self._engine = _ENGINE_ALIASES[engine]
+        self.kf = KalmanRunner(self._active_panel(), engine=self._engine)
+
+    def _deviance_jax(self, p_canonical):
+        """Deviance of the canonical [sdf..., cdf...] parameter vector as a
+        traced JAX value (used by autodiff in the solvers)."""
+        return _dfm_deviance(
+            jnp.asarray(p_canonical),
+            self.kf.y,
+            self.kf.mask,
+            jnp.asarray(self.factors),
+            self._dt,
+            self.settings["warmup"],
+            self._engine,
+        )
+
+    def _deviance_value_and_grad(self, p_canonical):
+        return _dfm_deviance_vg(
+            jnp.asarray(p_canonical),
+            self.kf.y,
+            self.kf.mask,
+            jnp.asarray(self.factors),
+            self._dt,
+            self.settings["warmup"],
+            self._engine,
+        )
+
+    def get_mle(self, p) -> float:
+        """Deviance (-2 log L) at parameters ``p`` — the solver objective.
+
+        Note: like the reference (``metran/metran.py:605-622``), this leaves
+        the filter set to ``p``, and is the per-iteration hot path.
+        """
+        p_arr = self._param_array(p)
+        if self.kf is None:
+            self._init_kalmanfilter()
+        self.kf.set_matrices(self._statespace(p_arr))
+        return float(self._deviance_jax(p_arr))
+
+    # ------------------------------------------------------------------
+    # inference products
+    # ------------------------------------------------------------------
+    def _run_kalman(self, method: str = "smoother", p=None) -> None:
+        if self.kf is None:
+            self._init_kalmanfilter()
+        if p is not None:
+            self.kf.set_matrices(self._statespace(p))
+        elif self.kf.ss is None:
+            self.kf.set_matrices(self._statespace(self.get_parameters()))
+        if method == "filter":
+            self.kf.run_filter()
+        else:
+            self.kf.run_smoother()
+
+    def _state_columns(self):
+        return [f"{name}_sdf" for name in self.snames] + [
+            f"cdf{i + 1}" for i in range(self.nfactors)
+        ]
+
+    def get_state_means(self, p=None, method: str = "smoother") -> DataFrame:
+        self._run_kalman(method, p=p)
+        means = self.kf.state_means(method)
+        return DataFrame(means, index=self.oseries.index, columns=self._state_columns())
+
+    def get_state_variances(self, p=None, method: str = "smoother") -> DataFrame:
+        self._run_kalman(method, p=p)
+        variances = self.kf.state_variances(method)
+        return DataFrame(
+            variances, index=self.oseries.index, columns=self._state_columns()
+        )
+
+    def get_state(self, i: int, p=None, alpha: float = 0.05, method="smoother"):
+        if i < 0 or i >= self.nstate:
+            logger.error("Value of i must be >=0 and <%s", self.nstate)
+            return None
+        state = self.get_state_means(p=p, method=method).iloc[:, i]
+        if alpha is None:
+            return state
+        if not 0 < alpha < 1:
+            msg = "The value of alpha must be between 0 and 1."
+            logger.error(msg)
+            raise Exception(msg)
+        z = norm.ppf(1 - alpha / 2.0)
+        variances = self.get_state_variances(p=p, method=method).iloc[:, i]
+        iv = z * np.sqrt(variances)
+        state = concat([state, state - iv, state + iv], axis=1)
+        state.columns = ["mean", "lower", "upper"]
+        return state
+
+    def get_simulated_means(
+        self, p=None, standardized: bool = False, method: str = "smoother"
+    ) -> DataFrame:
+        self._run_kalman(method, p=p)
+        if standardized:
+            observation_matrix = self.get_observation_matrix(p=p)
+            observation_means = np.zeros(self.nseries)
+        else:
+            observation_matrix = self.get_scaled_observation_matrix(p=p)
+            observation_means = self.oseries_mean
+        means, _ = self.kf.simulate(observation_matrix, method=method)
+        return (
+            DataFrame(means, index=self.oseries.index, columns=self.oseries.columns)
+            + observation_means
+        )
+
+    def get_simulated_variances(
+        self, p=None, standardized: bool = False, method: str = "smoother"
+    ) -> DataFrame:
+        self._run_kalman(method, p=p)
+        if standardized:
+            observation_matrix = self.get_observation_matrix(p=p)
+        else:
+            observation_matrix = self.get_scaled_observation_matrix(p=p)
+        _, variances = self.kf.simulate(observation_matrix, method=method)
+        return DataFrame(
+            variances, index=self.oseries.index, columns=self.oseries.columns
+        )
+
+    def get_simulation(
+        self, name, p=None, alpha=0.05, standardized=False, method="smoother"
+    ):
+        means = self.get_simulated_means(p=p, standardized=standardized, method=method)
+        if name not in means.columns:
+            logger.error("Unknown name: %s", name)
+            return None
+        sim = means.loc[:, name]
+        if alpha is None:
+            return sim
+        if not 0 < alpha < 1:
+            msg = "The value of alpha must be between 0 and 1."
+            logger.error(msg)
+            raise Exception(msg)
+        z = norm.ppf(1 - alpha / 2.0)
+        variances = self.get_simulated_variances(
+            p=p, standardized=standardized, method=method
+        ).loc[:, name]
+        iv = z * np.sqrt(variances)
+        sim = concat([sim, sim - iv, sim + iv], axis=1)
+        sim.columns = ["mean", "lower", "upper"]
+        return sim
+
+    def decompose_simulation(
+        self, name, p=None, standardized: bool = False, method: str = "smoother"
+    ):
+        if name not in self.oseries.columns:
+            logger.error("Unknown name: %s", name)
+            return None
+        self._run_kalman(method, p=p)
+        if standardized:
+            observation_matrix = self.get_observation_matrix(p=p)
+            observation_means = np.zeros(self.nseries)
+        else:
+            observation_matrix = self.get_scaled_observation_matrix(p=p)
+            observation_means = self.oseries_mean
+        sdf, cdf = self.kf.decompose(observation_matrix, method=method)
+        col = list(self.oseries.columns).index(name)
+        parts = [
+            Series(sdf[:, col] + observation_means[col], index=self.oseries.index)
+        ]
+        cols = ["sdf"]
+        for k in range(self.nfactors):
+            parts.append(Series(cdf[k][:, col], index=self.oseries.index))
+            cols.append(f"cdf{k + 1}")
+        df = concat(parts, axis=1)
+        df.columns = cols
+        return df
+
+    # ------------------------------------------------------------------
+    # solve
+    # ------------------------------------------------------------------
+    def solve(
+        self, solver=None, report: bool = True, engine: Optional[str] = None, **kwargs
+    ) -> None:
+        """Estimate parameters by maximum likelihood.
+
+        Parameters
+        ----------
+        solver : solver class (not instance), optional
+            e.g. ``ScipySolve`` (default) or ``JaxSolve``.
+        report : bool, optional
+            Print fit and metran reports when done.
+        engine : str, optional
+            Kalman engine override ("sequential"/"joint"; the reference's
+            "numba"/"numpy" map to "sequential").
+        **kwargs
+            Passed through to the solver's minimize call.
+        """
+        factors = self.get_factors(self.oseries)
+        if factors is None:
+            return
+        self._init_kalmanfilter(engine=engine)
+        self.set_init_parameters()
+
+        if solver is None:
+            if self.fit is None:
+                self.fit = ScipySolve(mt=self)
+        elif self.fit is None or not isinstance(self.fit, solver):
+            self.fit = solver(mt=self)
+        self.settings["solver"] = self.fit._name
+
+        success, optimal, stderr = self.fit.solve(**kwargs)
+
+        # solver works in canonical [sdf..., cdf...] order == table order
+        self.parameters["optimal"] = optimal
+        self.parameters["stderr"] = stderr
+
+        if not success:
+            logger.warning("Model parameters could not be estimated well.")
+
+        if report:
+            output = report if isinstance(report, str) else "full"
+            print("\n" + self.fit_report(output=output))
+            print("\n" + self.metran_report())
+
+    # ------------------------------------------------------------------
+    # reports
+    # ------------------------------------------------------------------
+    def _get_file_info(self) -> dict:
+        file_info = getattr(self, "file_info", None) or {
+            "date_created": Timestamp.now()
+        }
+        file_info["date_modified"] = Timestamp.now()
+        from ..version import __version__
+
+        file_info["metran_tpu_version"] = __version__
+        try:
+            file_info["owner"] = getlogin()
+        except Exception:
+            file_info["owner"] = "Unknown"
+        return file_info
+
+    def fit_report(self, output: str = "full") -> str:
+        """Fit statistics + parameter table (+|rho|>0.5 correlations).
+
+        Same sections and layout as the reference (``metran/metran.py:
+        1079-1183``).
+        """
+        model = {
+            "tmin": str(self.settings["tmin"]),
+            "tmax": str(self.settings["tmax"]),
+            "freq": self.settings["freq"],
+            "solver": self.settings["solver"],
+        }
+        fit = {
+            "obj": f"{self.fit.obj_func:.2f}",
+            "nfev": self.fit.nfev,
+            "AIC": f"{self.fit.aic:.2f}",
+            "": "",
+        }
+        parameters = self.parameters.loc[:, ["optimal", "stderr", "initial", "vary"]].copy()
+        stderr_pct = parameters["stderr"] / parameters["optimal"]
+        parameters["stderr"] = "-"
+        parameters.loc[parameters["vary"].astype(bool), "stderr"] = (
+            stderr_pct.abs().apply("±{:.2%}".format)
+        )
+        parameters["initial"] = parameters["initial"].astype(str)
+        parameters.loc[~parameters["vary"].astype(bool), "initial"] = "-"
+
+        width = len(str(parameters).split("\n")[1])
+        w = max(width - 45, 0)
+        header = (
+            f"Fit report {self.name[:14]:<16}{'':>{w}}Fit Statistics\n"
+            + "=" * width
+            + "\n"
+        )
+        basic = ""
+        for (k1, v1), (k2, v2) in zip(model.items(), fit.items()):
+            basic += f"{k1:<8} {str(v1):<16} {'':>{w}} {k2:<7} {v2:>{max(w, 1)}}\n"
+
+        block = (
+            f"\nParameters ({int(parameters.vary.sum())} were optimized)\n"
+            + "=" * width
+            + f"\n{parameters}"
+        )
+
+        correlations = ""
+        if output == "full" and self.fit.pcor is not None:
+            cor = {}
+            pcor = self.fit.pcor
+            for idx in pcor.index:
+                for col in pcor.columns:
+                    if (
+                        abs(pcor.loc[idx, col]) > 0.5
+                        and idx != col
+                        and (col, idx) not in cor
+                    ):
+                        cor[(idx, col)] = round(pcor.loc[idx, col], 2)
+            body = (
+                DataFrame(cor.values(), index=cor.keys(), columns=["rho"]).to_string(
+                    header=False
+                )
+                if cor
+                else "None"
+            )
+            correlations = (
+                "\n\nParameter correlations |rho| > 0.5\n" + "=" * width + "\n" + body
+            )
+        return header + basic + block + correlations
+
+    def metran_report(self, output: str = "full") -> str:
+        """Factor analysis, communality, state/observation parameters
+        (+|rho|>0.5 state correlations); reference ``metran/metran.py:
+        1185-1314``."""
+        model = {
+            "tmin": str(self.settings["tmin"]),
+            "tmax": str(self.settings["tmax"]),
+            "freq": self.settings["freq"],
+        }
+        fit = {"nfct": str(self.nfactors), "fep": f"{self.fep:.2f}%", "": ""}
+
+        phi = np.diag(self.get_transition_matrix())
+        q = self.get_transition_variance()
+        names = self._state_columns()
+        transition = DataFrame(np.array([phi, q]).T, index=names, columns=["phi", "q"])
+        idx_width = max(len(n) for n in transition.index)
+
+        communality = Series(self.get_communality(), index=self.oseries.columns, name="")
+        communality.index = [str(i).ljust(idx_width) for i in communality.index]
+        communality = communality.apply("{:.2%}".format).to_frame()
+
+        observation = DataFrame(
+            self.factors,
+            index=self.oseries.columns,
+            columns=[f"gamma{i + 1}" for i in range(self.nfactors)],
+        )
+        observation.index = [str(i).ljust(idx_width) for i in observation.index]
+        observation["scale"] = self.oseries_std
+        observation["mean"] = self.oseries_mean
+
+        width = max(
+            len(str(transition).split("\n")[1]),
+            len(str(observation).split("\n")[1]),
+            44,
+        )
+        w = max(width - 43, 0)
+        header = (
+            f"Metran report {self.name[:14]:<14}{'':>{w}}Factor Analysis\n"
+            + "=" * width
+            + "\n"
+        )
+        factors = ""
+        for (k1, v1), (k2, v2) in zip(model.items(), fit.items()):
+            factors += f"{k1:<8} {str(v1):<19} {k2:<7} {str(v2):>{max(w, 1)}}\n"
+
+        blocks = (
+            "\nCommunality\n" + "=" * width + f"\n{communality}\n"
+            "\nState parameters\n" + "=" * width + f"\n{transition}\n"
+            "\nObservation parameters\n" + "=" * width + f"\n{observation}\n"
+        )
+
+        correlations = ""
+        if output == "full":
+            cor = {}
+            pcor = self.get_state_means().corr()
+            for idx in pcor.index:
+                for col in pcor.columns:
+                    if (
+                        abs(pcor.loc[idx, col]) > 0.5
+                        and idx != col
+                        and (col, idx) not in cor
+                    ):
+                        cor[(idx, col)] = round(pcor.loc[idx, col], 2)
+            body = (
+                DataFrame(cor.values(), index=cor.keys(), columns=["rho"]).to_string(
+                    header=False
+                )
+                if cor
+                else "None"
+            )
+            correlations = (
+                "\nState correlations |rho| > 0.5\n" + "=" * width + "\n" + body + "\n"
+            )
+        return header + factors + blocks + correlations

@@ -1,0 +1,194 @@
+"""Plotting helpers exposed as ``mt.plots`` (reference: ``metran/plots.py``).
+
+Same plot surface: scree plot, stacked state means, per-series simulation
+with observations and confidence band, and sdf/cdf decomposition (optionally
+split over axes with height ratios).
+"""
+
+from __future__ import annotations
+
+import matplotlib.pyplot as plt
+import numpy as np
+from pandas import Timestamp
+
+from ..utils import get_height_ratios
+
+
+class MetranPlot:
+    """Plots available directly from the Metran class."""
+
+    def __init__(self, mt):
+        self.mt = mt
+
+    def scree_plot(self):
+        """Eigenvalue scree plot of the factor analysis."""
+        n_ev = np.arange(self.mt.eigval.shape[0]) + 1
+        fig, ax = plt.subplots(1, 1, figsize=(10, 4))
+        ax.plot(n_ev, self.mt.eigval, marker="o", ms=7, mfc="none", c="C3")
+        ax.bar(n_ev, self.mt.eigval, facecolor="none", edgecolor="C0", linewidth=2)
+        ax.grid(visible=True)
+        ax.set_xticks(n_ev)
+        ax.set_ylabel("eigenvalue")
+        ax.set_xlabel("eigenvalue number")
+        fig.tight_layout()
+        return ax
+
+    def state_means(self, tmin=None, tmax=None, adjust_height=True):
+        """Stacked plots of all smoothed specific/common state means."""
+        states = self.mt.get_state_means()
+        tmin = states.index[0] if tmin is None else tmin
+        tmax = states.index[-1] if tmax is None else tmax
+
+        ylims = []
+        if adjust_height:
+            for s in states:
+                hs = states.loc[tmin:tmax, s]
+                ylims.append((float(hs.min()), float(hs.max())))
+            hrs = get_height_ratios(ylims)
+        else:
+            hrs = [1] * states.columns.size
+
+        fig = plt.figure(figsize=(10, states.columns.size * 2))
+        gs = fig.add_gridspec(ncols=1, nrows=states.columns.size, height_ratios=hrs)
+
+        ax0 = None
+        for i, col in enumerate(states.columns):
+            iax = fig.add_subplot(gs[i], sharex=ax0)
+            if ax0 is None:
+                ax0 = iax
+            if col.startswith("cdf"):
+                c, lbl = "C3", f"common dynamic factor {col[3:]}"
+            else:
+                c, lbl = "C0", f"specific dynamic factor {col.replace('_sdf', '')}"
+            states.loc[:, col].plot(ax=iax, label=lbl, color=c)
+            iax.legend(loc=(0, 1), ncol=3, frameon=False, numpoints=3)
+            iax.grid(visible=True)
+            if adjust_height:
+                iax.set_ylim(ylims[i])
+        iax.set_xlabel("")
+        fig.tight_layout()
+        return fig.axes
+
+    def simulation(self, name, alpha=0.05, tmin=None, tmax=None, ax=None):
+        """Simulated mean + observations (+ confidence band) for a series."""
+        sim = self.mt.get_simulation(name, alpha=alpha)
+        obs = self.mt.get_observations(
+            standardized=False, masked=self.mt.masked_observations is not None
+        ).loc[:, name]
+
+        tmin = sim.index[0] if tmin is None else Timestamp(tmin)
+        tmax = sim.index[-1] if tmax is None else Timestamp(tmax)
+
+        created_fig = None
+        if ax is None:
+            created_fig, ax = plt.subplots(1, 1, figsize=(10, 4))
+
+        if alpha is None:
+            ax.plot(sim.index, sim, label=f"simulation {name}")
+        else:
+            ax.plot(sim.index, sim["mean"], label=f"simulation {name}")
+            ax.fill_between(
+                sim.index,
+                sim["lower"],
+                sim["upper"],
+                color="gray",
+                alpha=0.5,
+                label=f"{1 - alpha:.0%}-confidence interval",
+            )
+        ax.plot(
+            obs.index, obs, marker=".", ms=3, color="k", ls="none", label="observations"
+        )
+        ax.legend(loc=(0, 1), ncol=3, frameon=False, numpoints=3)
+        ax.grid(visible=True)
+        ax.set_xlim(tmin, tmax)
+        if created_fig is not None:
+            created_fig.tight_layout()
+        return ax
+
+    def simulations(self, alpha=0.05, tmin=None, tmax=None):
+        """Simulation plot per observed series, shared axes."""
+        nrows = len(self.mt.snames)
+        fig, axes = plt.subplots(
+            nrows, 1, sharex=True, sharey=True, figsize=(10, nrows * 2)
+        )
+        for i, name in enumerate(self.mt.snames):
+            self.simulation(name, alpha=alpha, tmin=tmin, tmax=tmax, ax=axes.flat[i])
+        fig.tight_layout()
+        return axes
+
+    def decomposition(
+        self,
+        name,
+        tmin=None,
+        tmax=None,
+        ax=None,
+        split=False,
+        adjust_height=True,
+        **kwargs,
+    ):
+        """Plot the sdf + cdf decomposition of a simulated series."""
+        decomposition = self.mt.decompose_simulation(name, **kwargs)
+        tmin = decomposition.index[0] if tmin is None else tmin
+        tmax = decomposition.index[-1] if tmax is None else tmax
+
+        fig = None
+        if ax is None:
+            if adjust_height and split:
+                ylims = [
+                    (
+                        float(decomposition.loc[tmin:tmax, s].min()),
+                        float(decomposition.loc[tmin:tmax, s].max()),
+                    )
+                    for s in decomposition
+                ]
+                hrs = get_height_ratios(ylims)
+            elif split:
+                ylims, hrs = None, [1] * decomposition.columns.size
+            else:
+                ylims, hrs = None, [1]
+            nrows = decomposition.columns.size if split else 1
+            fig = plt.figure(figsize=(10, 6 if split else 4))
+            gs = fig.add_gridspec(ncols=1, nrows=nrows, height_ratios=hrs)
+
+        cdfcount = 0
+        iax = ax
+        ax0 = None
+        for i, col in enumerate(decomposition.columns):
+            if fig is not None and (i == 0 or split):
+                iax = fig.add_subplot(gs[i], sharex=ax0)
+                if ax0 is None:
+                    ax0 = iax
+            if col.startswith("cdf"):
+                c = f"C{3 + cdfcount % 10}"
+                cdfcount += 1
+                zorder = 2
+            else:
+                c, zorder = "C0", 3
+            s = decomposition[col]
+            iax.plot(s.index, s, label=f"{col} {name}", color=c, zorder=zorder)
+            iax.grid(visible=True)
+            iax.legend(loc=(0, 1), ncol=3, frameon=False, numpoints=3)
+            if fig is not None and split and adjust_height and ylims is not None:
+                iax.set_ylim(ylims[i])
+        if fig is not None:
+            fig.tight_layout()
+        return iax.figure.axes
+
+    def decompositions(self, tmin=None, tmax=None, **kwargs):
+        """Decomposition plot per observed series, shared axes."""
+        nrows = len(self.mt.snames)
+        fig, axes = plt.subplots(
+            nrows, 1, sharex=True, sharey=True, figsize=(10, nrows * 2)
+        )
+        for i, name in enumerate(self.mt.snames):
+            self.decomposition(
+                name,
+                tmin=tmin,
+                tmax=tmax,
+                ax=axes.flat[i],
+                split=False,
+                adjust_height=False,
+                **kwargs,
+            )
+        fig.tight_layout()
+        return axes

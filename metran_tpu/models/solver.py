@@ -1,0 +1,375 @@
+"""Solvers for maximum-likelihood estimation of Metran models.
+
+Same plugin boundary as the reference (``metran/solver.py``): a solver class
+is handed the model, reads its parameter table, minimizes
+``mt.get_mle(p)`` (the deviance, -2 log L) and returns
+``(success, optimal, stderr)``.  Differences, by design:
+
+- the objective and its **exact gradient** are computed on-device by JAX
+  autodiff (the reference uses finite differences through scipy);
+- the parameter covariance for standard errors comes from the **exact
+  autodiff Hessian** at the optimum (reference: numerical Hessian with an
+  epsilon-escalation repair loop, ``solver.py:65-140``), with the same
+  nearest-PSD repair as a fallback;
+- ``JaxSolve`` runs L-BFGS fully on-device (optax) under ``jit`` with a
+  bound-preserving reparameterization, so fleets of models can be solved
+  with ``vmap``/``pjit`` without host round-trips.
+"""
+
+from __future__ import annotations
+
+from logging import getLogger
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+from pandas import DataFrame
+
+logger = getLogger(__name__)
+
+
+def near_psd(a: np.ndarray, epsilon: float = 0.0) -> np.ndarray:
+    """Nearest positive semi-definite matrix by eigenvalue clipping.
+
+    Same scaling construction as the reference's ``_nearPSD``
+    (``metran/solver.py:167-192``).
+    """
+    n = a.shape[0]
+    eigval, eigvec = np.linalg.eig(a)
+    val = np.maximum(eigval, epsilon)
+    vec = np.asarray(eigvec)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        t = 1.0 / (vec**2 @ val.T)
+        t = np.sqrt(np.diag(np.asarray(t).reshape(n)))
+        b = t @ vec * np.diag(np.sqrt(np.asarray(val).reshape(n)))
+    return b @ b.T
+
+
+class BaseSolver:
+    """Shared machinery: objective plumbing, covariance, correlations."""
+
+    _name = "BaseSolver"
+
+    def __init__(self, mt, **kwargs):
+        self.mt = mt
+        self.pcov: Optional[DataFrame] = None
+        self.pcor: Optional[DataFrame] = None
+        self.nfev: Optional[int] = None
+        self.result = None
+        self.obj_func: Optional[float] = None
+        self.aic: Optional[float] = None
+
+    # -- objective ------------------------------------------------------
+    def objfunction(self, p, callback: Optional[Callable] = None) -> float:
+        if callback is not None:
+            p = callback(p)
+        return float(self.mt.get_mle(p))
+
+    def _full_params(self, x: np.ndarray) -> np.ndarray:
+        """Embed varying parameters into the full parameter vector."""
+        par = self.initial.copy()
+        par[self.vary] = x
+        return par
+
+    def _setup(self):
+        self.vary = self.mt.parameters.vary.values.astype(bool)
+        self.initial = self.mt.parameters.initial.values.astype(float).copy()
+        self.names = self.mt.parameters.index[self.vary]
+        pmin = self.mt.parameters.pmin.values[self.vary]
+        pmax = self.mt.parameters.pmax.values[self.vary]
+        self.bounds = [
+            (
+                None if b is None or (isinstance(b, float) and np.isnan(b)) else b,
+                None if u is None or (isinstance(u, float) and np.isnan(u)) else u,
+            )
+            for b, u in zip(pmin, pmax)
+        ]
+
+    # -- covariance / stderr -------------------------------------------
+    def _get_covariance(self, x: np.ndarray) -> np.ndarray:
+        """Parameter covariance from the exact autodiff Hessian of the
+        deviance over the varying parameters, with nearest-PSD repair."""
+        import jax
+
+        def dev_vary(xv):
+            import jax.numpy as jnp
+
+            full = jnp.asarray(self.initial).at[np.flatnonzero(self.vary)].set(xv)
+            return self.mt._deviance_jax(full)
+
+        hessian = np.asarray(jax.hessian(dev_vary)(np.asarray(x, float)))
+        cov = np.linalg.pinv(hessian)
+        if np.amin(np.diag(cov)) <= 0:
+            try:
+                cov = np.linalg.pinv(near_psd(hessian))
+            except Exception as e:
+                logger.debug("Could not repair covariance: %s", e)
+        return cov
+
+    @staticmethod
+    def _get_correlations(pcov: DataFrame) -> DataFrame:
+        d = np.sqrt(np.diag(pcov.values))
+        with np.errstate(divide="ignore", invalid="ignore"):
+            corr = pcov.values / np.outer(d, d)
+        return DataFrame(corr, index=pcov.index, columns=pcov.columns)
+
+    def _finalize(self, x, fun, nfev, success, pcov=None):
+        """Common post-optimization bookkeeping shared by solvers."""
+        if pcov is None:
+            pcov = self._get_covariance(x)
+        _stderr = np.sqrt(np.diag(pcov))
+        optimal = self._full_params(np.asarray(x, float))
+        stderr = np.full(len(optimal), np.nan)
+        stderr[self.vary] = _stderr
+        self.pcov = DataFrame(pcov, index=self.names, columns=self.names)
+        self.pcor = self._get_correlations(self.pcov)
+        self.nfev = int(nfev)
+        self.obj_func = float(fun)
+        self.aic = 2 * int(self.vary.sum()) + self.obj_func
+        return bool(success), optimal, stderr
+
+
+class ScipySolve(BaseSolver):
+    """scipy.optimize.minimize driving the on-device objective.
+
+    Drop-in equivalent of the reference's default solver
+    (``metran/solver.py:195-305``), with the gradient supplied by JAX
+    autodiff (``use_grad=False`` recovers the reference's gradient-free
+    finite-difference behavior).
+    """
+
+    _name = "ScipySolve"
+
+    def solve(self, method: str = "l-bfgs-b", use_grad: bool = True, **kwargs):
+        from scipy.optimize import minimize
+
+        self._setup()
+        x0 = self.initial[self.vary]
+
+        if use_grad:
+            value_and_grad = self.mt._deviance_value_and_grad
+            idx = np.flatnonzero(self.vary)
+
+            def fun(x):
+                v, g = value_and_grad(self._full_params(x))
+                return float(v), np.asarray(g, float)[idx]
+
+            self.result = minimize(
+                fun=fun, x0=x0, method=method, jac=True, bounds=self.bounds, **kwargs
+            )
+        else:
+            self.result = minimize(
+                fun=self.objfunction,
+                x0=x0,
+                method=method,
+                bounds=self.bounds,
+                args=(self._full_params,),
+                **kwargs,
+            )
+
+        # stderr: L-BFGS-B inverse-Hessian approximation when available,
+        # exact autodiff Hessian otherwise (reference: solver.py:257-266)
+        pcov = None
+        if hasattr(self.result, "hess_inv"):
+            try:
+                pcov = np.asarray(self.result.hess_inv.todense())
+            except AttributeError:
+                pcov = np.asarray(self.result.hess_inv)
+            if np.isnan(np.sqrt(np.diag(pcov))).any():
+                pcov = None
+        if pcov is None:
+            pcov = self._get_covariance(self.result.x)
+
+        success = getattr(self.result, "success", True)
+        return self._finalize(
+            self.result.x, self.result.fun, self.result.nfev, success, pcov
+        )
+
+
+class JaxSolve(BaseSolver):
+    """Fully on-device L-BFGS (optax) with bound-preserving reparam.
+
+    The whole optimization loop — objective, gradient, line search, updates
+    — runs inside one ``jit``, so it can be ``vmap``-ed over fleets of
+    models (see ``metran_tpu.parallel``).  Bounds are enforced through
+    ``alpha = pmin + exp(theta)`` (upper bounds, when finite, via a scaled
+    sigmoid), matching the reference's L-BFGS-B box constraints.
+    """
+
+    _name = "JaxSolve"
+
+    def solve(self, maxiter: int = 200, tol: float = 1e-8, **kwargs):
+        import jax
+        import jax.numpy as jnp
+
+        self._setup()
+        idx = np.flatnonzero(self.vary)
+        lower = np.array(
+            [b[0] if b[0] is not None else -np.inf for b in self.bounds]
+        )
+        upper = np.array(
+            [b[1] if b[1] is not None else np.inf for b in self.bounds]
+        )
+
+        transform = BoxTransform(lower, upper)
+        dev_full = self.mt._deviance_jax
+
+        def objective(theta):
+            x = transform.forward(theta)
+            full = jnp.asarray(self.initial).at[idx].set(x)
+            return dev_full(full)
+
+        theta0 = transform.inverse(jnp.asarray(self.initial[self.vary]))
+        theta, value, nfev, converged = run_lbfgs(
+            objective, theta0, maxiter=maxiter, tol=tol
+        )
+        x = np.asarray(transform.forward(theta), float)
+
+        return self._finalize(x, float(value), int(nfev), bool(converged))
+
+
+class BoxTransform:
+    """Smooth bijection from unconstrained theta to box [lower, upper]."""
+
+    def __init__(self, lower: np.ndarray, upper: np.ndarray):
+        self.lower = np.asarray(lower, float)
+        self.upper = np.asarray(upper, float)
+
+    def forward(self, theta):
+        import jax.numpy as jnp
+
+        lo, up = self.lower, self.upper
+        both = np.isfinite(lo) & np.isfinite(up)
+        only_lo = np.isfinite(lo) & ~np.isfinite(up)
+        only_up = ~np.isfinite(lo) & np.isfinite(up)
+        # NaN-safe branch arithmetic: every branch is computed under AD even
+        # when unselected, so infinities must never enter any branch
+        lo_s = np.where(np.isfinite(lo), lo, 0.0)
+        up_s = np.where(np.isfinite(up), up, 1.0)
+        x = theta
+        x = jnp.where(only_lo, lo_s + jnp.exp(theta), x)
+        x = jnp.where(only_up, up_s - jnp.exp(-theta), x)
+        x = jnp.where(both, lo_s + (up_s - lo_s) * jax_sigmoid(theta), x)
+        return x
+
+    def inverse(self, x):
+        import jax.numpy as jnp
+
+        lo, up = self.lower, self.upper
+        both = np.isfinite(lo) & np.isfinite(up)
+        only_lo = np.isfinite(lo) & ~np.isfinite(up)
+        only_up = ~np.isfinite(lo) & np.isfinite(up)
+        theta = x
+        theta = jnp.where(only_lo, jnp.log(jnp.maximum(x - lo, 1e-12)), theta)
+        theta = jnp.where(only_up, -jnp.log(jnp.maximum(up - x, 1e-12)), theta)
+        frac = jnp.clip((x - lo) / jnp.where(both, up - lo, 1.0), 1e-9, 1 - 1e-9)
+        theta = jnp.where(both, jnp.log(frac) - jnp.log1p(-frac), theta)
+        return theta
+
+
+def jax_sigmoid(x):
+    import jax
+
+    return jax.nn.sigmoid(x)
+
+
+def run_lbfgs(objective, theta0, maxiter: int = 200, tol: float = 1e-8):
+    """Jitted optax L-BFGS loop; returns (theta, value, n_evals, converged).
+
+    Uses optax's zoom line search via ``value_and_grad_from_state`` so each
+    iteration reuses the line-search evaluations (optax docs pattern).
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+    import optax.tree_utils as otu
+
+    opt = optax.lbfgs()
+    value_and_grad = optax.value_and_grad_from_state(objective)
+
+    def step(carry):
+        theta, state = carry
+        value, grad = value_and_grad(theta, state=state)
+        updates, state = opt.update(
+            grad, state, theta, value=value, grad=grad, value_fn=objective
+        )
+        theta = optax.apply_updates(theta, updates)
+        return theta, state
+
+    def cond(carry):
+        _, state = carry
+        count = otu.tree_get(state, "count")
+        grad = otu.tree_get(state, "grad")
+        err = otu.tree_l2_norm(grad)
+        return (count == 0) | ((count < maxiter) & (err >= tol))
+
+    @jax.jit
+    def run(theta0):
+        init = (theta0, opt.init(theta0))
+        theta, state = jax.lax.while_loop(cond, step, init)
+        return (
+            theta,
+            otu.tree_get(state, "value"),
+            otu.tree_get(state, "count"),
+            otu.tree_l2_norm(otu.tree_get(state, "grad")) < tol,
+        )
+
+    return run(theta0)
+
+
+class LmfitSolve(BaseSolver):
+    """lmfit-backed solver for API parity with the reference.
+
+    lmfit is optional; constructing this class without it installed raises
+    ImportError, exactly like the reference (``metran/solver.py:333-341``).
+    """
+
+    _name = "LmfitSolve"
+
+    def __init__(self, mt, **kwargs):
+        try:
+            import lmfit  # noqa: F401
+        except ImportError as e:
+            msg = "lmfit not installed. Please install lmfit first."
+            logger.error(msg)
+            raise ImportError(msg) from e
+        super().__init__(mt, **kwargs)
+
+    def solve(self, method: str = "lbfgsb", **kwargs):
+        import lmfit
+
+        self._setup()
+        parameters = lmfit.Parameters()
+        table = self.mt.parameters
+        for name in table.index:
+            row = table.loc[name]
+            pmin = None if row.pmin is None or np.isnan(row.pmin) else row.pmin
+            pmax = None if row.pmax is None or (
+                isinstance(row.pmax, float) and np.isnan(row.pmax)
+            ) else row.pmax
+            if method == "lbfgsb":
+                parameters.add(name, value=row.initial, vary=bool(row.vary))
+            else:
+                parameters.add(
+                    name, value=row.initial, min=pmin, max=pmax, vary=bool(row.vary)
+                )
+        if method == "lbfgsb":
+            kwargs["bounds"] = [
+                (b if b is not None else -np.inf, u if u is not None else np.inf)
+                for (b, u) in self.bounds
+            ]
+
+        mini = lmfit.Minimizer(
+            userfcn=self.objfunction,
+            params=parameters,
+            scale_covar=False,
+            fcn_args=(lambda p: np.array([v.value for v in p.values()]),),
+            **kwargs,
+        )
+        self.result = mini.minimize(method=method)
+        optimal = np.array([p.value for p in self.result.params.values()])
+        x = optimal[self.vary]
+
+        pcov = getattr(self.result, "covar", None)
+        success = getattr(self.result, "success", True)
+        fun = self.objfunction(optimal)
+        return self._finalize(x, fun, self.result.nfev, success, pcov)

@@ -56,16 +56,15 @@ def frequency_is_supported(freq: str) -> str:
     """
     try:
         offset = to_offset(freq)
-        Timedelta(offset)
+        offset.nanos  # only Tick-like offsets have a fixed length
     except Exception as e:
         raise ValueError(f"Frequency {freq!r} is not supported: {e}") from e
-    # normalize "D" -> "1D" roundtrip stability
     return freq
 
 
 def freq_to_days(freq: str) -> float:
     """Length of one frequency step in days (the AR(1) ``dt``)."""
-    return Timedelta(to_offset(freq)) / Timedelta(1, "D")
+    return to_offset(freq).nanos / Timedelta(1, "D").value
 
 
 def get_height_ratios(ylims: Sequence[Tuple[float, float]]) -> List[float]:

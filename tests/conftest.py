@@ -7,7 +7,11 @@ section 4); parity tests need float64 like the reference.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the ambient environment may point JAX at a tunneled TPU
+# (JAX_PLATFORMS=axon); unit tests must run on the virtual CPU mesh.
+# Set METRAN_TPU_TEST_TPU=1 to run the @pytest.mark.tpu subset on hardware.
+if not os.environ.get("METRAN_TPU_TEST_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,6 +20,8 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
+if not os.environ.get("METRAN_TPU_TEST_TPU"):
+    jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 from pathlib import Path  # noqa: E402

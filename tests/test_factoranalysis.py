@@ -1,0 +1,92 @@
+"""Factor analysis tests: golden values from the reference + known answers."""
+
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from metran_tpu.models.factoranalysis import FactorAnalysis
+from metran_tpu.ops import fa as fa_ops
+
+GOLDEN = Path(__file__).parent / "golden" / "metran_example.json"
+
+
+@pytest.fixture(scope="module")
+def golden():
+    if not GOLDEN.exists():
+        pytest.skip("golden file not generated (tools/make_golden.py)")
+    return json.loads(GOLDEN.read_text())
+
+
+def test_fa_eigval(corr):
+    fa = FactorAnalysis()
+    eigval, _ = fa._get_eigval(corr)
+    assert np.allclose(eigval, np.array([1.8, 0.2]))
+
+
+def test_fa_maptest(corr):
+    fa = FactorAnalysis()
+    eigval, eigvec = fa._get_eigval(corr)
+    nfactors, _ = fa._maptest(corr, eigvec, eigval)
+    assert nfactors == 1
+
+
+def test_fa_eig_complex_guard():
+    nonsym = np.array([[0.0, 1.0], [-1.0, 0.0]])
+    with pytest.raises(Exception):
+        fa_ops.sorted_scaled_eig(nonsym)
+
+
+def test_fa_golden_eigval_and_factors(golden):
+    corr = np.array(golden["correlation"])
+    eigval, eigvec = fa_ops.sorted_scaled_eig(corr)
+    np.testing.assert_allclose(eigval, golden["eigval"], rtol=1e-12)
+
+    nf, nf4 = fa_ops.map_test(corr, eigvec)
+    assert [nf, nf4] == golden["maptest"]
+
+    result = fa_ops.factor_analysis(corr)
+    np.testing.assert_allclose(result.factors, golden["factors"], rtol=1e-8)
+    np.testing.assert_allclose(result.fep, golden["fep"], rtol=1e-10)
+
+    raw = fa_ops.minres(corr, result.nfactors)
+    np.testing.assert_allclose(raw, golden["minres_loadings_raw"], rtol=1e-8)
+
+
+def test_fa_solve_shape(series_list):
+    from metran_tpu.data import build_panel, panel_to_frame
+
+    panel = build_panel(series_list)
+    frame = panel_to_frame(panel, np.where(panel.mask, panel.values, np.nan))
+    fa = FactorAnalysis()
+    factors = fa.solve(frame)
+    assert factors.shape == (5, 1)
+    assert 0 < fa.fep <= 100
+
+
+def test_fa_textbook_mode(golden):
+    corr = np.array(golden["correlation"])
+    result = fa_ops.factor_analysis(corr, mode="textbook")
+    # same dominant structure; one factor, loadings close to reference's
+    assert result.nfactors == 1
+    np.testing.assert_allclose(
+        np.abs(result.factors), np.abs(np.array(golden["factors"])), atol=0.05
+    )
+
+
+def test_fa_no_factors_path():
+    # uncorrelated series: MAP finds 0, Kaiser finds eigval>1 count
+    corr = np.eye(3)
+    result = fa_ops.factor_analysis(corr)
+    assert result.factors is None or result.nfactors >= 0
+
+
+def test_varimax_orthogonal():
+    rng = np.random.default_rng(1)
+    phi = rng.normal(size=(6, 2))
+    rot = fa_ops.varimax(phi)
+    # rotation preserves row norms (orthogonal transform)
+    np.testing.assert_allclose(
+        np.sum(rot**2, axis=1), np.sum(phi**2, axis=1), rtol=1e-10
+    )

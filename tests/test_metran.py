@@ -1,0 +1,241 @@
+"""End-to-end Metran model tests, mirroring the reference test suite
+(tests/test_metran.py in the reference) plus golden numerical parity."""
+
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import metran_tpu
+
+GOLDEN = Path(__file__).parent / "golden" / "metran_example.json"
+
+
+@pytest.fixture(scope="module")
+def golden():
+    if not GOLDEN.exists():
+        pytest.skip("golden file not generated (tools/make_golden.py)")
+    return json.loads(GOLDEN.read_text())
+
+
+@pytest.fixture(scope="module")
+def mt_init(series_list):
+    return metran_tpu.Metran(series_list, name="B21B0214")
+
+
+@pytest.fixture(scope="module")
+def mt(series_list):
+    m = metran_tpu.Metran(series_list, name="B21B0214")
+    m.solve(report=False)
+    return m
+
+
+def test_construction(mt_init, golden):
+    assert mt_init.nseries == 5
+    np.testing.assert_allclose(mt_init.oseries_std, golden["oseries_std"], rtol=1e-12)
+    np.testing.assert_allclose(mt_init.oseries_mean, golden["oseries_mean"], rtol=1e-12)
+
+
+def test_matrices_at_init_match_reference(mt_init, golden):
+    mt_init.get_factors(mt_init.oseries)
+    mt_init.set_init_parameters()
+    p = mt_init.parameters["initial"]
+    np.testing.assert_allclose(
+        np.diag(mt_init.get_transition_matrix(p)),
+        golden["transition_matrix_diag_at_init"],
+        rtol=1e-10,
+    )
+    np.testing.assert_allclose(
+        np.diag(mt_init.get_transition_covariance(p)),
+        golden["transition_covariance_diag_at_init"],
+        rtol=1e-8,
+    )
+    np.testing.assert_allclose(
+        mt_init.get_observation_matrix(p), golden["observation_matrix"], rtol=1e-8
+    )
+    np.testing.assert_allclose(
+        mt_init.get_scaled_observation_matrix(p),
+        golden["scaled_observation_matrix"],
+        rtol=1e-8,
+    )
+
+
+@pytest.mark.parametrize("engine", ["sequential", "joint"])
+def test_deviance_parity_vs_reference(series_list, golden, engine):
+    """Engine parity: with the reference's own loadings injected, the
+    deviance at fixed parameter vectors must match the reference numpy
+    Kalman filter essentially to machine precision."""
+    m = metran_tpu.Metran(series_list, name="B21B0214", engine=engine)
+    m.factors = np.array(golden["factors"])
+    m.nfactors = m.factors.shape[1]
+    m._init_kalmanfilter()
+    m.set_init_parameters()
+    got = m.get_mle(m.parameters["initial"])
+    np.testing.assert_allclose(got, golden["deviance_at_init"], rtol=1e-12)
+    for case in golden["deviance_at_random"]:
+        got = m.get_mle(np.array(case["p"]))
+        np.testing.assert_allclose(got, case["deviance"], rtol=1e-12)
+
+
+def test_deviance_parity_with_own_fa(series_list, golden):
+    """End-to-end parity including our own factor analysis: the loadings
+    agree with the reference to ~1e-8, so the deviance agrees well below
+    the 1e-6 bar."""
+    m = metran_tpu.Metran(series_list, name="B21B0214")
+    m.get_factors(m.oseries)
+    m._init_kalmanfilter()
+    m.set_init_parameters()
+    got = m.get_mle(m.parameters["initial"])
+    np.testing.assert_allclose(got, golden["deviance_at_init"], rtol=1e-7)
+
+
+def test_metran_solve_scipy(mt, golden):
+    # optimizer should land on the reference optimum (same objective);
+    # trajectories differ (autodiff vs finite-difference gradients)
+    np.testing.assert_allclose(
+        mt.parameters["optimal"].values, golden["optimal"], rtol=1e-3
+    )
+    assert mt.fit.obj_func <= golden["obj_func"] + 1e-4
+    np.testing.assert_allclose(mt.fit.obj_func, golden["obj_func"], rtol=1e-7)
+    np.testing.assert_allclose(mt.fit.aic, golden["aic"], rtol=1e-7)
+    # deviance evaluated at the reference's optimum must match exactly
+    got = mt.get_mle(np.array(golden["optimal"]))
+    np.testing.assert_allclose(got, golden["deviance_at_optimal"], rtol=1e-8)
+
+
+def test_metran_solve_jax(series_list, golden):
+    m = metran_tpu.Metran(series_list, name="B21B0214")
+    m.solve(solver=metran_tpu.JaxSolve, report=False)
+    np.testing.assert_allclose(
+        m.parameters["optimal"].values, golden["optimal"], rtol=5e-3
+    )
+    assert m.fit.obj_func <= golden["obj_func"] + 1e-3
+
+
+def test_metran_state_means(mt, golden):
+    states = mt.get_state_means()
+    assert list(states.columns) == golden["state_means_columns"]
+    got = states.iloc[golden["state_means_rows_idx"]].values
+    np.testing.assert_allclose(got, golden["state_means_rows"], atol=2e-4)
+
+
+def test_metran_state_variances(mt, golden):
+    var = mt.get_state_variances()
+    got = var.iloc[golden["state_means_rows_idx"]].values
+    np.testing.assert_allclose(got, golden["state_variances_rows"], atol=2e-4)
+
+
+def test_metran_simulated_means(mt, golden):
+    sim = mt.get_simulated_means()
+    got = sim.iloc[golden["state_means_rows_idx"]].values
+    np.testing.assert_allclose(got, golden["simulated_means_rows"], atol=2e-3)
+
+
+def test_metran_simulated_variances(mt, golden):
+    sim = mt.get_simulated_variances()
+    got = sim.iloc[golden["state_means_rows_idx"]].values
+    np.testing.assert_allclose(got, golden["simulated_variances_rows"], atol=2e-3)
+
+
+def test_metran_get_simulation(mt):
+    sim = mt.get_simulation("B21B0214005")
+    assert list(sim.columns) == ["mean", "lower", "upper"]
+    assert (sim["lower"] <= sim["mean"]).all()
+    assert (sim["mean"] <= sim["upper"]).all()
+
+
+def test_metran_decompose_simulation(mt, golden):
+    dec = mt.decompose_simulation("B21B0214001")
+    assert list(dec.columns) == golden["decomposition_columns"]
+    got = dec.iloc[golden["state_means_rows_idx"]].values
+    np.testing.assert_allclose(got, golden["decomposition_rows"], atol=2e-3)
+
+
+def test_metran_get_state(mt):
+    state = mt.get_state(0)
+    assert list(state.columns) == ["mean", "lower", "upper"]
+    assert mt.get_state(99) is None
+
+
+def test_metran_communality(mt, golden):
+    np.testing.assert_allclose(mt.get_communality(), golden["communality"], rtol=1e-8)
+    np.testing.assert_allclose(
+        mt.get_specificity(), 1 - np.array(golden["communality"]), rtol=1e-7
+    )
+
+
+def test_metran_masked_oseries(mt):
+    proj1 = mt.get_simulation("B21B0214005")
+    oseries = mt.get_observations()
+    mask = (0 * oseries).astype(bool)
+    mask.loc["1997-8-28", "B21B0214005"] = True
+    mt.mask_observations(mask)
+    proj2 = mt.get_simulation("B21B0214005")
+    mt.unmask_observations()
+    assert (proj1 != proj2).any().any()
+    proj3 = mt.get_simulation("B21B0214005")
+    assert (proj1 == proj3).all().all()
+
+
+def test_masked_golden_value(mt, golden):
+    oseries = mt.get_observations()
+    mask = (0 * oseries).astype(bool)
+    mask.loc["1997-8-28", "B21B0214005"] = True
+    mt.mask_observations(mask)
+    sim = mt.get_simulation("B21B0214005", alpha=None)
+    np.testing.assert_allclose(
+        float(sim.loc["1997-08-28"]), golden["masked_sim_1997"][0], atol=2e-3
+    )
+    mt.unmask_observations()
+    sim = mt.get_simulation("B21B0214005", alpha=None)
+    np.testing.assert_allclose(
+        float(sim.loc["1997-08-28"]), golden["unmasked_sim_1997"][0], atol=2e-3
+    )
+
+
+def test_reports_render(mt):
+    fit_report = mt.fit_report()
+    assert "Fit report" in fit_report and "Parameters" in fit_report
+    metran_report = mt.metran_report()
+    assert "Metran report" in metran_report
+    assert "Communality" in metran_report
+    assert "State parameters" in metran_report
+
+
+def test_get_observations_roundtrip(mt):
+    std = mt.get_observations(standardized=True)
+    unstd = mt.get_observations(standardized=False)
+    np.testing.assert_allclose(
+        unstd.values,
+        (std * mt.oseries_std + mt.oseries_mean).values,
+        rtol=1e-12,
+    )
+
+
+def test_input_validation():
+    import pandas as pd
+
+    with pytest.raises(TypeError):
+        metran_tpu.Metran("not a frame")
+    with pytest.raises(Exception):
+        metran_tpu.Metran(pd.DataFrame({"a": [1.0, 2.0]}))  # only one series
+
+
+def test_resolve_and_cdf_named_series():
+    """Regressions: re-solve after optimal/stderr columns exist, and series
+    whose names start with 'cdf' (parameter classification by kind column)."""
+    import pandas as pd
+
+    idx = pd.date_range("2000-01-01", periods=300, freq="D")
+    rng = np.random.default_rng(3)
+    common = np.cumsum(rng.normal(size=300)) * 0.3
+    frame = pd.DataFrame(
+        {f"cdf{i}": common + np.cumsum(rng.normal(size=300)) * 0.2 for i in range(3)},
+        index=idx,
+    )
+    m = metran_tpu.Metran(frame)
+    m.solve(report=False)
+    obj1 = m.fit.obj_func
+    m.solve(report=False)
+    assert abs(m.fit.obj_func - obj1) < 1e-6

@@ -1,0 +1,229 @@
+"""Run the read-only reference implementation and dump golden parity values.
+
+The environment has no pastas/numba/lmfit, and the reference predates
+numpy 2.0, so this tool injects a minimal `pastas` shim and numpy compat
+aliases, imports the reference from /root/reference, runs it on the bundled
+example data, and writes tests/golden/metran_example.json with:
+
+- factor analysis intermediates (eigenvalues, loadings, fep)
+- the deviance (get_mle) at the initial parameter vector  -> engine parity
+- the fitted optimum (parameters, obj, aic, stderr)
+- smoothed state means / simulated means at the optimum   -> product parity
+
+Run:  python tools/make_golden.py
+"""
+
+import json
+import sys
+import types
+from pathlib import Path
+
+import numpy as np
+
+REFERENCE = Path("/root/reference")
+OUT = Path(__file__).resolve().parent.parent / "tests" / "golden"
+
+
+def install_shims():
+    # numpy < 1.24 aliases the reference still uses
+    if not hasattr(np, "int"):
+        np.int = int  # noqa: NPY001
+    if not hasattr(np, "float"):
+        np.float = float  # noqa: NPY001
+    if not hasattr(np, "NaN"):
+        np.NaN = np.nan  # noqa: NPY001
+
+    pastas = types.ModuleType("pastas")
+    pastas.__version__ = "0.20.0"
+
+    utils = types.ModuleType("pastas.utils")
+
+    def initialize_logger(logger=None, level=None):
+        return None
+
+    def validate_name(name):
+        return str(name)
+
+    def frequency_is_supported(freq):
+        return freq
+
+    utils.initialize_logger = initialize_logger
+    utils.validate_name = validate_name
+    utils.frequency_is_supported = frequency_is_supported
+
+    decorators = types.ModuleType("pastas.decorators")
+
+    def njit(function=None, parallel=False):
+        def decorator(f):
+            return f
+
+        if callable(function):
+            return function
+        return decorator
+
+    decorators.njit = njit
+
+    timeseries = types.ModuleType("pastas.timeseries")
+
+    class TimeSeries:  # only used for isinstance checks in the reference
+        pass
+
+    timeseries.TimeSeries = TimeSeries
+
+    version = types.ModuleType("pastas.version")
+    version.__version__ = pastas.__version__
+
+    modelplots = types.ModuleType("pastas.modelplots")
+
+    def _get_height_ratios(ylims):
+        return [max(abs(y1 - y0), 0.1) for (y0, y1) in ylims]
+
+    modelplots._get_height_ratios = _get_height_ratios
+
+    pastas.utils = utils
+    pastas.decorators = decorators
+    pastas.timeseries = timeseries
+    pastas.version = version
+    pastas.modelplots = modelplots
+
+    for name, mod in {
+        "pastas": pastas,
+        "pastas.utils": utils,
+        "pastas.decorators": decorators,
+        "pastas.timeseries": timeseries,
+        "pastas.version": version,
+        "pastas.modelplots": modelplots,
+    }.items():
+        sys.modules[name] = mod
+
+
+def load_series():
+    import pandas as pd
+
+    series = []
+    for fi in sorted((REFERENCE / "examples" / "data").glob("*_res.csv")):
+        s = pd.read_csv(
+            fi,
+            header=0,
+            index_col=0,
+            parse_dates=True,
+            date_format="%Y-%m-%d",
+            names=[fi.stem.split("_")[0]],
+        ).squeeze()
+        series.append(s)
+    return series
+
+
+def main():
+    install_shims()
+    sys.path.insert(0, str(REFERENCE))
+    import metran  # the reference package
+    import metran.metran as _mm
+
+    # pandas 3 removed Timedelta(<DateOffset>); feed _phi a parseable string
+    _mm.to_offset = lambda freq: freq if freq[:1].isdigit() else "1" + freq
+
+    series = load_series()
+    mt = metran.Metran(series, name="B21B0214")
+
+    golden = {}
+    golden["oseries_std"] = mt.oseries_std.tolist()
+    golden["oseries_mean"] = mt.oseries_mean.tolist()
+    golden["nseries"] = int(mt.nseries)
+
+    # ---- factor analysis ----
+    from metran.factoranalysis import FactorAnalysis
+
+    fa = FactorAnalysis()
+    corr = fa._get_correlations(mt.oseries)
+    eigval, eigvec = fa._get_eigval(corr)
+    nf_map, nf_map4 = fa._maptest(corr, eigvec, eigval)
+    factors = fa.solve(mt.oseries)
+    golden["correlation"] = corr.tolist()
+    golden["eigval"] = eigval.tolist()
+    golden["maptest"] = [int(nf_map), int(nf_map4)]
+    golden["factors"] = factors.tolist()
+    golden["fep"] = float(fa.fep)
+
+    # minres internals at the chosen nfactors (exposes eigh-ordering quirks)
+    nf = factors.shape[1]
+    loadings_raw = fa._minres(corr, nf)
+    golden["minres_loadings_raw"] = loadings_raw.tolist()
+
+    # ---- engine parity: deviance at fixed parameter vectors ----
+    mt.get_factors(mt.oseries)
+    mt._init_kalmanfilter(mt.oseries, engine="numpy")
+    mt.set_init_parameters()
+    p_init = mt.parameters["initial"]
+    golden["param_names"] = list(mt.parameters.index)
+    golden["p_init"] = [float(v) for v in p_init.values]
+    golden["deviance_at_init"] = float(mt.get_mle(p_init.values))
+
+    rng = np.random.default_rng(0)
+    p_list = []
+    for _ in range(3):
+        p = rng.uniform(2.0, 60.0, len(p_init))
+        p_list.append({"p": p.tolist(), "deviance": float(mt.get_mle(p))})
+    golden["deviance_at_random"] = p_list
+
+    # matrices at init (to check statespace builders)
+    T, Q, Z, R = mt._get_matrices(p_init)
+    golden["transition_matrix_diag_at_init"] = np.diag(T).tolist()
+    golden["transition_covariance_diag_at_init"] = np.diag(Q).tolist()
+    golden["observation_matrix"] = Z.tolist()
+    golden["scaled_observation_matrix"] = mt.get_scaled_observation_matrix(
+        p_init
+    ).tolist()
+
+    # ---- full solve ----
+    mt.solve(engine="numpy", report=False)
+    golden["optimal"] = [float(v) for v in mt.parameters["optimal"].values]
+    golden["stderr"] = [float(v) for v in mt.parameters["stderr"].values]
+    golden["obj_func"] = float(mt.fit.obj_func)
+    golden["aic"] = float(mt.fit.aic)
+    golden["nfev"] = int(mt.fit.nfev)
+    golden["deviance_at_optimal"] = float(mt.get_mle(mt.parameters["optimal"].values))
+
+    # ---- inference products at the optimum ----
+    states = mt.get_state_means()
+    golden["state_means_columns"] = list(states.columns)
+    idx = [0, 100, 1000, 3000, len(states) - 1]
+    golden["state_means_rows_idx"] = idx
+    golden["state_means_rows"] = states.iloc[idx].values.tolist()
+    variances = mt.get_state_variances()
+    golden["state_variances_rows"] = variances.iloc[idx].values.tolist()
+    sim = mt.get_simulated_means()
+    golden["simulated_means_rows"] = sim.iloc[idx].values.tolist()
+    simvar = mt.get_simulated_variances()
+    golden["simulated_variances_rows"] = simvar.iloc[idx].values.tolist()
+    dec = mt.decompose_simulation(golden["state_means_columns"][0].replace("_sdf", ""))
+    golden["decomposition_columns"] = list(dec.columns)
+    golden["decomposition_rows"] = dec.iloc[idx].values.tolist()
+    golden["communality"] = mt.get_communality().tolist()
+
+    # masked-observation behavior
+    import pandas as pd
+
+    oseries = mt.get_observations()
+    mask = (0 * oseries).astype(bool)
+    mask.loc["1997-8-28", "B21B0214005"] = True
+    mt.mask_observations(mask)
+    sim_masked = mt.get_simulation("B21B0214005", alpha=None)
+    golden["masked_sim_1997"] = [
+        float(sim_masked.loc["1997-08-28"]),
+    ]
+    mt.unmask_observations()
+    sim_unmasked = mt.get_simulation("B21B0214005", alpha=None)
+    golden["unmasked_sim_1997"] = [float(sim_unmasked.loc["1997-08-28"])]
+
+    OUT.mkdir(parents=True, exist_ok=True)
+    out_file = OUT / "metran_example.json"
+    out_file.write_text(json.dumps(golden, indent=1))
+    print(f"wrote {out_file}")
+    print("deviance_at_init:", golden["deviance_at_init"])
+    print("optimal:", golden["optimal"])
+    print("obj:", golden["obj_func"], "aic:", golden["aic"])
+
+
+if __name__ == "__main__":
+    main()
