@@ -252,18 +252,29 @@ class Metran:
             return self.parameters["optimal"]
         return self.parameters["initial"]
 
-    def _param_array(self, p) -> np.ndarray:
-        """Coerce parameters (array/Series/dict) to the canonical order
+    @property
+    def _canonical_idx(self) -> np.ndarray:
+        """Gather indices mapping the parameter-table row order
+        ([cdf..., sdf...]) to the canonical state ordering
         [sdf alphas..., cdf alphas...] used by the state-space builder."""
+        kinds = self.parameters["name"].values
+        return np.concatenate(
+            [np.flatnonzero(kinds == "sdf"), np.flatnonzero(kinds == "cdf")]
+        )
+
+    def _table_array(self, p) -> np.ndarray:
+        """Coerce parameters (array/Series/dict) to a float array in the
+        parameter-table row order — the order solvers optimize in."""
         if isinstance(p, dict):
             p = Series(p)
         if isinstance(p, Series):
             p = p.reindex(self.parameters.index).values
-        p = np.asarray(p, float)
-        kinds = self.parameters["name"].values
-        sdf_idx = np.flatnonzero(kinds == "sdf")
-        cdf_idx = np.flatnonzero(kinds == "cdf")
-        return np.concatenate([p[sdf_idx], p[cdf_idx]])
+        return np.asarray(p, float)
+
+    def _param_array(self, p) -> np.ndarray:
+        """Coerce parameters to the canonical order
+        [sdf alphas..., cdf alphas...] used by the state-space builder."""
+        return self._table_array(p)[self._canonical_idx]
 
     # ------------------------------------------------------------------
     # state-space matrices (host-side views for reports/parity)
@@ -327,11 +338,14 @@ class Metran:
             self._engine = _ENGINE_ALIASES[engine]
         self.kf = KalmanRunner(self._active_panel(), engine=self._engine)
 
-    def _deviance_jax(self, p_canonical):
-        """Deviance of the canonical [sdf..., cdf...] parameter vector as a
-        traced JAX value (used by autodiff in the solvers)."""
+    def _deviance_jax(self, p_table):
+        """Deviance of the *table-order* parameter vector (the order the
+        solvers optimize in) as a traced JAX value.  The reorder to the
+        canonical [sdf..., cdf...] layout happens inside the trace, so
+        autodiff gradients/Hessians come back in table order."""
+        idx = jnp.asarray(self._canonical_idx)
         return _dfm_deviance(
-            jnp.asarray(p_canonical),
+            jnp.take(jnp.asarray(p_table), idx),
             self.kf.y,
             self.kf.mask,
             jnp.asarray(self.factors),
@@ -340,9 +354,12 @@ class Metran:
             self._engine,
         )
 
-    def _deviance_value_and_grad(self, p_canonical):
-        return _dfm_deviance_vg(
-            jnp.asarray(p_canonical),
+    def _deviance_value_and_grad(self, p_table):
+        """(deviance, gradient) at the table-order parameter vector; the
+        gradient is returned in table order as well."""
+        idx = jnp.asarray(self._canonical_idx)
+        value, grad = _dfm_deviance_vg(
+            jnp.take(jnp.asarray(p_table), idx),
             self.kf.y,
             self.kf.mask,
             jnp.asarray(self.factors),
@@ -350,6 +367,7 @@ class Metran:
             self.settings["warmup"],
             self._engine,
         )
+        return value, jnp.zeros_like(grad).at[idx].set(grad)
 
     def get_mle(self, p) -> float:
         """Deviance (-2 log L) at parameters ``p`` — the solver objective.
@@ -357,11 +375,11 @@ class Metran:
         Note: like the reference (``metran/metran.py:605-622``), this leaves
         the filter set to ``p``, and is the per-iteration hot path.
         """
-        p_arr = self._param_array(p)
+        p_tab = self._table_array(p)
         if self.kf is None:
             self._init_kalmanfilter()
-        self.kf.set_matrices(self._statespace(p_arr))
-        return float(self._deviance_jax(p_arr))
+        self.kf.set_matrices(self._statespace(p_tab))
+        return float(self._deviance_jax(p_tab))
 
     # ------------------------------------------------------------------
     # inference products
@@ -526,7 +544,7 @@ class Metran:
 
         success, optimal, stderr = self.fit.solve(**kwargs)
 
-        # solver works in canonical [sdf..., cdf...] order == table order
+        # solver works in the parameter-table row order
         self.parameters["optimal"] = optimal
         self.parameters["stderr"] = stderr
 

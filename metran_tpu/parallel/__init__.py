@@ -1,0 +1,53 @@
+"""Fleet-scale parallelism: vmap over models, shard over device meshes.
+
+The reference is strictly single-process (SURVEY.md section 2.3); this
+package is the new design surface that scales Metran to TPU pods:
+
+- :func:`pack_fleet` / :class:`Fleet` — pad independent DFMs to static
+  shapes for batched execution;
+- :func:`fleet_deviance` / :func:`fleet_value_and_grad` — the vmapped
+  likelihood engine;
+- :func:`fit_fleet` — on-device batched L-BFGS, optionally sharded over a
+  :class:`jax.sharding.Mesh` (GSPMD or explicit ``shard_map``);
+- :func:`make_train_step` — first-order training step for mesh-sharded
+  fleets;
+- :func:`make_mesh` and friends — mesh/sharding helpers.
+"""
+
+from .fleet import (
+    ALPHA_INIT,
+    ALPHA_PMIN,
+    Fleet,
+    FleetFit,
+    default_init_params,
+    fit_fleet,
+    fleet_deviance,
+    fleet_value_and_grad,
+    make_train_step,
+    pack_fleet,
+)
+from .mesh import (
+    BATCH_AXIS,
+    batch_sharding,
+    make_mesh,
+    pad_to_multiple,
+    replicated,
+)
+
+__all__ = [
+    "ALPHA_INIT",
+    "ALPHA_PMIN",
+    "BATCH_AXIS",
+    "Fleet",
+    "FleetFit",
+    "batch_sharding",
+    "default_init_params",
+    "fit_fleet",
+    "fleet_deviance",
+    "fleet_value_and_grad",
+    "make_mesh",
+    "make_train_step",
+    "pack_fleet",
+    "pad_to_multiple",
+    "replicated",
+]

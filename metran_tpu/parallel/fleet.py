@@ -1,0 +1,309 @@
+"""Fleet-scale fitting: many independent Metran DFMs on one or many chips.
+
+The reference fits one model per process and has no parallel or distributed
+machinery (SURVEY.md section 2.3).  On TPU the equivalent scale story is a
+*fleet*: a batch of independent DFMs padded to common static shapes, the
+whole MLE pipeline (state-space build -> masked Kalman filter -> deviance ->
+exact gradient -> L-BFGS) vmapped over the fleet axis and sharded over a
+device mesh.  Communication is XLA collectives over ICI; there is no
+host-side loop anywhere in the hot path.
+
+Padding semantics (all verified by tests/test_parallel.py):
+
+- time padding: extra timesteps carry ``mask=False`` everywhere, so they are
+  skipped by the masked filter exactly like the reference skips NaN rows;
+- series padding: a padded series slot has ``mask=False`` at every timestep
+  and zero factor loadings, so its specific state evolves but never touches
+  the likelihood (zero gradient, parameters stay at their initial values);
+- factor padding: a padded common factor has zero loadings everywhere, so it
+  is invisible to the likelihood;
+- fleet padding (to a multiple of the mesh size): an all-masked model has
+  deviance 0 and zero gradients.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec
+
+from ..data import Panel
+from ..ops import deviance as _deviance
+from ..ops import dfm_statespace
+from .mesh import BATCH_AXIS, batch_sharding, pad_to_multiple
+
+ALPHA_PMIN = 1e-5  # reference lower bound for alpha (metran/metran.py:446-462)
+ALPHA_INIT = 10.0  # reference initial value
+
+
+class Fleet(NamedTuple):
+    """A batch of independent DFMs padded to common static shapes.
+
+    Attributes
+    ----------
+    y : (B, T, N) standardized observations (0 where masked).
+    mask : (B, T, N) bool, True where observed.
+    loadings : (B, N, K) factor loadings (0 rows/cols for padded slots).
+    dt : (B,) grid step in days per model.
+    n_series : (B,) true series count per model (before padding).
+    """
+
+    y: jnp.ndarray
+    mask: jnp.ndarray
+    loadings: jnp.ndarray
+    dt: jnp.ndarray
+    n_series: jnp.ndarray
+
+    @property
+    def batch(self) -> int:
+        return self.y.shape[0]
+
+    @property
+    def n_params(self) -> int:
+        return self.loadings.shape[1] + self.loadings.shape[2]
+
+
+class FleetFit(NamedTuple):
+    """Result of a fleet fit.
+
+    Attributes
+    ----------
+    params : (B, N+K) optimal ``[alpha_sdf..., alpha_cdf...]`` per model.
+    deviance : (B,) -2 log L at the optimum.
+    iterations : (B,) L-BFGS iterations used.
+    converged : (B,) bool gradient-norm convergence flag.
+    """
+
+    params: jnp.ndarray
+    deviance: jnp.ndarray
+    iterations: jnp.ndarray
+    converged: jnp.ndarray
+
+
+def pack_fleet(
+    panels: Sequence[Panel],
+    loadings: Sequence[np.ndarray],
+    pad_batch_to: Optional[int] = None,
+    dtype=None,
+) -> Fleet:
+    """Pad heterogeneous models into one ``Fleet`` with static shapes.
+
+    Parameters
+    ----------
+    panels : data panels (possibly different T and n_series).
+    loadings : per-model (n_series, n_factors) factor loadings.
+    pad_batch_to : pad the fleet axis to this size with all-masked dummy
+        models (use ``pad_to_multiple(B, mesh_size)`` for even shards).
+    """
+    if len(panels) != len(loadings):
+        raise ValueError("panels and loadings must have the same length")
+    if dtype is None:
+        dtype = np.float64 if jax.config.jax_enable_x64 else np.float32
+    b = len(panels)
+    bp = max(pad_batch_to or b, b)
+    t = max(p.n_timesteps for p in panels)
+    n = max(p.n_series for p in panels)
+    k = max(np.atleast_2d(ld).shape[1] for ld in loadings)
+
+    y = np.zeros((bp, t, n), dtype)
+    mask = np.zeros((bp, t, n), bool)
+    lds = np.zeros((bp, n, k), dtype)
+    dt = np.ones(bp, dtype)
+    n_series = np.full(bp, n, np.int32)
+    for i, (panel, ld) in enumerate(zip(panels, loadings)):
+        ti, ni = panel.n_timesteps, panel.n_series
+        ld = np.atleast_2d(np.asarray(ld, dtype))
+        y[i, :ti, :ni] = panel.values
+        mask[i, :ti, :ni] = panel.mask
+        lds[i, :ni, : ld.shape[1]] = ld
+        dt[i] = panel.dt
+        n_series[i] = ni
+    return Fleet(
+        y=jnp.asarray(y),
+        mask=jnp.asarray(mask),
+        loadings=jnp.asarray(lds),
+        dt=jnp.asarray(dt),
+        n_series=jnp.asarray(n_series),
+    )
+
+
+def _model_deviance(p, y, mask, loadings, dt, warmup, engine):
+    """Deviance of one fleet member; p = [alpha_sdf (N), alpha_cdf (K)]."""
+    n = loadings.shape[0]
+    ss = dfm_statespace(p[:n], p[n:], loadings, dt)
+    return _deviance(ss, y, mask, warmup=warmup, engine=engine)
+
+
+@functools.partial(jax.jit, static_argnames=("warmup", "engine"))
+def fleet_deviance(
+    params: jnp.ndarray,
+    fleet: Fleet,
+    warmup: int = 1,
+    engine: str = "joint",
+) -> jnp.ndarray:
+    """(B,) deviance of every fleet member at ``params`` (B, N+K)."""
+    return jax.vmap(
+        lambda p, y, m, ld, dt: _model_deviance(p, y, m, ld, dt, warmup, engine)
+    )(params, fleet.y, fleet.mask, fleet.loadings, fleet.dt)
+
+
+@functools.partial(jax.jit, static_argnames=("warmup", "engine"))
+def fleet_value_and_grad(params, fleet, warmup: int = 1, engine: str = "joint"):
+    """Per-model (deviance, gradient) — exact autodiff, fully batched."""
+    vg = jax.value_and_grad(_model_deviance)
+    return jax.vmap(
+        lambda p, y, m, ld, dt: vg(p, y, m, ld, dt, warmup, engine)
+    )(params, fleet.y, fleet.mask, fleet.loadings, fleet.dt)
+
+
+def default_init_params(fleet: Fleet) -> jnp.ndarray:
+    """Reference initial parameter values (alpha = 10) for every model."""
+    return jnp.full(
+        (fleet.batch, fleet.n_params), ALPHA_INIT, fleet.y.dtype
+    )
+
+
+def _solve_one(theta0, y, mask, loadings, dt, warmup, engine, maxiter, tol):
+    """On-device L-BFGS for one model in log-transformed parameters.
+
+    ``alpha = ALPHA_PMIN + exp(theta)`` enforces the reference's lower bound
+    (no upper bound exists, metran/metran.py:446-462).
+    """
+    from ..models.solver import run_lbfgs
+
+    def objective(theta):
+        p = ALPHA_PMIN + jnp.exp(theta)
+        return _model_deviance(p, y, mask, loadings, dt, warmup, engine)
+
+    theta, value, count, converged = run_lbfgs(
+        objective, theta0, maxiter=maxiter, tol=tol
+    )
+    return ALPHA_PMIN + jnp.exp(theta), value, count, converged
+
+
+def _fit_fleet_batched(fleet, p0, warmup, engine, maxiter, tol):
+    theta0 = jnp.log(jnp.maximum(p0 - ALPHA_PMIN, 1e-12))
+    params, value, count, conv = jax.vmap(
+        lambda th, y, m, ld, dt: _solve_one(
+            th, y, m, ld, dt, warmup, engine, maxiter, tol
+        )
+    )(theta0, fleet.y, fleet.mask, fleet.loadings, fleet.dt)
+    return FleetFit(params, value, count, conv)
+
+
+def fit_fleet(
+    fleet: Fleet,
+    p0: Optional[jnp.ndarray] = None,
+    warmup: int = 1,
+    engine: str = "joint",
+    maxiter: int = 100,
+    tol: float = 1e-8,
+    mesh: Optional[Mesh] = None,
+    use_shard_map: bool = False,
+) -> FleetFit:
+    """Fit every model in the fleet by on-device L-BFGS.
+
+    The entire optimization (objective, exact gradient, line search,
+    updates) runs inside one ``jit``; nothing touches the host until the
+    results are fetched.
+
+    Parameters
+    ----------
+    fleet : packed fleet (see :func:`pack_fleet`).
+    p0 : (B, N+K) initial parameters (default: reference init, alpha=10).
+    engine : "joint" (Cholesky update, MXU-friendly — default) or
+        "sequential" (reference-parity scalar updates).
+    mesh : optional device mesh; the fleet axis is sharded over its
+        ``"batch"`` axis.  ``fleet.batch`` must divide evenly (use
+        ``pack_fleet(..., pad_batch_to=pad_to_multiple(B, mesh.size))``).
+    use_shard_map : communicate via explicit ``shard_map`` SPMD (each
+        device solves its local shard; results gathered by XLA) instead of
+        GSPMD auto-partitioning.  Results are identical; this path keeps
+        per-device work fully independent so no partitioner choice can
+        introduce cross-device chatter into the L-BFGS loops.
+    """
+    if p0 is None:
+        p0 = default_init_params(fleet)
+    run = functools.partial(
+        _fit_fleet_batched,
+        warmup=warmup,
+        engine=engine,
+        maxiter=maxiter,
+        tol=tol,
+    )
+
+    if mesh is None:
+        return jax.jit(run)(fleet, p0)
+
+    if fleet.batch % mesh.size:
+        raise ValueError(
+            f"mesh size {mesh.size} must divide the fleet batch "
+            f"{fleet.batch}; pad with pack_fleet(..., pad_batch_to="
+            f"pad_to_multiple({fleet.batch}, {mesh.size}))"
+        )
+    if use_shard_map:
+        spec_in = (
+            Fleet(
+                y=PartitionSpec(BATCH_AXIS),
+                mask=PartitionSpec(BATCH_AXIS),
+                loadings=PartitionSpec(BATCH_AXIS),
+                dt=PartitionSpec(BATCH_AXIS),
+                n_series=PartitionSpec(BATCH_AXIS),
+            ),
+            PartitionSpec(BATCH_AXIS),
+        )
+        spec_out = FleetFit(
+            params=PartitionSpec(BATCH_AXIS),
+            deviance=PartitionSpec(BATCH_AXIS),
+            iterations=PartitionSpec(BATCH_AXIS),
+            converged=PartitionSpec(BATCH_AXIS),
+        )
+        # check_vma=False: the solver body mixes device-varying shards with
+        # unvarying constants (e.g. the identity initial covariance), which
+        # is fine for fully independent per-device work
+        sharded = jax.shard_map(
+            run, mesh=mesh, in_specs=spec_in, out_specs=spec_out,
+            check_vma=False,
+        )
+        return jax.jit(sharded)(fleet, p0)
+
+    shard = lambda x: batch_sharding(mesh, np.ndim(x))  # noqa: E731
+    fleet = jax.device_put(fleet, jax.tree.map(shard, fleet))
+    p0 = jax.device_put(p0, shard(p0))
+    return jax.jit(run)(fleet, p0)
+
+
+# ----------------------------------------------------------------------
+# gradient-descent training step (the multi-chip "training step" surface)
+# ----------------------------------------------------------------------
+def make_train_step(
+    optimizer,
+    warmup: int = 1,
+    engine: str = "joint",
+):
+    """Build a jittable fleet training step for first-order optimizers.
+
+    One step computes every model's deviance and exact gradient (vmapped
+    masked Kalman filter under autodiff), applies the optax update in
+    log-parameter space, and reports the fleet-mean deviance.  jit it with
+    sharded ``params``/``fleet`` to scale over a mesh: models are
+    independent, so the only cross-device traffic is the scalar mean.
+    """
+    import optax
+
+    def train_step(theta, opt_state, fleet):
+        def loss(th):
+            p = ALPHA_PMIN + jnp.exp(th)
+            dev = fleet_deviance(p, fleet, warmup=warmup, engine=engine)
+            return jnp.mean(dev)
+
+        value, grad = jax.value_and_grad(loss)(theta)
+        updates, opt_state = optimizer.update(grad, opt_state, theta)
+        theta = optax.apply_updates(theta, updates)
+        return theta, opt_state, value
+
+    return train_step

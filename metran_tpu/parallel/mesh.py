@@ -1,0 +1,73 @@
+"""Device-mesh helpers for fleet-scale Metran fitting.
+
+The reference has no distributed code at all (SURVEY.md section 2.3); its
+workload-scaling story on TPU is *fleets of independent DFMs* sharded over
+an ICI-connected device mesh.  These helpers build the meshes and shardings
+the fleet solvers consume.  All communication is XLA collectives inserted by
+GSPMD (via ``NamedSharding``) or written explicitly with ``shard_map``
+(``metran_tpu.parallel.fleet.fit_fleet``), never host-side.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+BATCH_AXIS = "batch"
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    axis_names: Sequence[str] = (BATCH_AXIS,),
+    devices=None,
+) -> Mesh:
+    """Build a device mesh for fleet sharding.
+
+    Parameters
+    ----------
+    n_devices : total number of devices to use (default: all available).
+    axis_names : mesh axis names; 1D ``("batch",)`` by default.  For a 2D
+        mesh pass e.g. ``("batch", "series")`` — the device count must
+        factorize, the batch axis gets the larger factor.
+    devices : explicit device list (default ``jax.devices()``).
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    devices = np.asarray(devices[:n_devices])
+    if len(axis_names) == 1:
+        shape = (n_devices,)
+    elif len(axis_names) == 2:
+        minor = _largest_minor_factor(n_devices)
+        shape = (n_devices // minor, minor)
+    else:
+        raise ValueError("make_mesh supports 1D or 2D meshes")
+    return Mesh(devices.reshape(shape), axis_names)
+
+
+def _largest_minor_factor(n: int, cap: int = 4) -> int:
+    """Largest factor of n that is <= min(cap, sqrt(n)), so the minor axis
+    never exceeds the leading (batch) axis."""
+    cap = min(cap, int(np.sqrt(n)))
+    for f in range(max(cap, 1), 0, -1):
+        if n % f == 0:
+            return f
+    return 1
+
+
+def batch_sharding(mesh: Mesh, ndim: int, axis: str = BATCH_AXIS) -> NamedSharding:
+    """Sharding that splits the leading (fleet) axis over ``axis``."""
+    return NamedSharding(mesh, PartitionSpec(axis, *([None] * (ndim - 1))))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def pad_to_multiple(n: int, m: int) -> int:
+    """Smallest multiple of m that is >= n (fleet padding for even shards)."""
+    return ((n + m - 1) // m) * m

@@ -1,0 +1,167 @@
+"""Fleet/mesh tests on the 8-device virtual CPU mesh (conftest.py).
+
+The TPU-native analog of "test multi-node without a cluster" (SURVEY.md
+section 4): every sharded path runs on ``xla_force_host_platform_device_count``
+devices and must agree exactly with the unsharded batched path.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pandas as pd
+import pytest
+
+from metran_tpu import data as mdata
+from metran_tpu.parallel import (
+    default_init_params,
+    fit_fleet,
+    fleet_deviance,
+    fleet_value_and_grad,
+    make_mesh,
+    make_train_step,
+    pack_fleet,
+    pad_to_multiple,
+)
+
+
+def _random_panel(rng, n_series, t, missing=0.3, freq="D"):
+    idx = pd.date_range("2000-01-01", periods=t, freq=freq)
+    raw = rng.normal(size=(t, n_series))
+    raw[rng.uniform(size=raw.shape) < missing] = np.nan
+    raw[0] = np.nan  # leading all-NaN timestep exercises mask handling
+    frame = pd.DataFrame(raw, index=idx, columns=[f"s{i}" for i in range(n_series)])
+    return mdata.pack_panel(frame)
+
+
+def _random_fleet(rng, sizes, t=120, **kwargs):
+    panels = [_random_panel(rng, n, t) for n in sizes]
+    loadings = [
+        rng.uniform(0.3, 0.8, (n, 1)) for n in sizes
+    ]
+    return pack_fleet(panels, loadings, **kwargs), panels, loadings
+
+
+def test_pack_fleet_pads_heterogeneous(rng):
+    fleet, panels, _ = _random_fleet(rng, [3, 5, 4], t=60, pad_batch_to=8)
+    assert fleet.y.shape == (8, 60, 5)
+    assert fleet.mask.shape == (8, 60, 5)
+    assert fleet.loadings.shape == (8, 5, 1)
+    # padded series slots and padded models are fully masked
+    assert not np.any(np.asarray(fleet.mask[0, :, 3:]))
+    assert not np.any(np.asarray(fleet.mask[3:]))
+    assert np.asarray(fleet.n_series[:3]).tolist() == [3, 5, 4]
+
+
+def test_fleet_deviance_matches_single(rng):
+    """Batched deviance equals the per-model ops.deviance, padding inert."""
+    from metran_tpu.ops import deviance, dfm_statespace
+
+    fleet, panels, loadings = _random_fleet(rng, [4, 4, 3], pad_batch_to=4)
+    params = default_init_params(fleet) * rng.uniform(
+        0.5, 1.5, (4, fleet.n_params)
+    )
+    got = np.asarray(fleet_deviance(params, fleet, engine="joint"))
+    n_pad = fleet.loadings.shape[1]
+    for i, (panel, ld) in enumerate(zip(panels, loadings)):
+        n = panel.n_series
+        p = np.asarray(params[i])
+        ss = dfm_statespace(p[:n], p[n_pad:], ld, panel.dt)
+        want = float(
+            deviance(ss, panel.values, panel.mask, warmup=1, engine="joint")
+        )
+        assert got[i] == pytest.approx(want, rel=1e-12)
+    assert got[3] == pytest.approx(0.0, abs=1e-12)  # padded model
+
+
+def test_fleet_grad_padded_params_zero(rng):
+    fleet, _, _ = _random_fleet(rng, [3, 5], pad_batch_to=2)
+    params = default_init_params(fleet)
+    _, grads = fleet_value_and_grad(params, fleet)
+    grads = np.asarray(grads)
+    # model 0 has 3 series; its padded sdf slots 3..4 must have zero grads
+    assert np.allclose(grads[0, 3:5], 0.0)
+    assert not np.allclose(grads[0, :3], 0.0)
+
+
+@pytest.mark.parametrize("engine", ["joint", "sequential"])
+def test_fit_fleet_improves_and_converges(rng, engine):
+    fleet, _, _ = _random_fleet(rng, [4, 4], t=100)
+    init = default_init_params(fleet)
+    dev0 = np.asarray(fleet_deviance(init, fleet, engine=engine))
+    fit = fit_fleet(fleet, engine=engine, maxiter=60)
+    dev1 = np.asarray(fit.deviance)
+    assert (dev1 <= dev0 + 1e-9).all()
+    assert np.asarray(fit.params).min() > 0
+
+
+def test_fit_fleet_matches_jaxsolve_single(rng, series_list):
+    """Fleet L-BFGS on one real-data model ~ the single-model JaxSolve fit."""
+    from metran_tpu.models.metran import Metran
+
+    mt = Metran(series_list, engine="joint")
+    from metran_tpu.models.solver import JaxSolve
+
+    mt.solve(solver=JaxSolve, report=False)
+    # canonical [sdf..., cdf...] order, mapped by parameter kind not row order
+    want = mt._param_array(mt.parameters["optimal"])
+
+    panel = mt._active_panel()
+    fleet = pack_fleet([panel], [mt.factors])
+    fit = fit_fleet(fleet, engine="joint", maxiter=200)
+    got = np.asarray(fit.params[0])  # order: sdf..., cdf...
+    assert float(fit.deviance[0]) == pytest.approx(
+        mt.fit.obj_func, rel=1e-6, abs=1e-4
+    )
+    np.testing.assert_allclose(got, want, rtol=2e-2)
+
+
+@pytest.mark.parametrize("use_shard_map", [False, True])
+def test_fit_fleet_sharded_matches_unsharded(rng, use_shard_map):
+    mesh = make_mesh(8)
+    b = pad_to_multiple(5, mesh.size)
+    fleet, _, _ = _random_fleet(rng, [4, 3, 4, 4, 3], t=80, pad_batch_to=b)
+    base = fit_fleet(fleet, maxiter=40)
+    sharded = fit_fleet(
+        fleet, maxiter=40, mesh=mesh, use_shard_map=use_shard_map
+    )
+    # independently-converged L-BFGS runs: tiny reduction-order differences
+    # in the line search can move the stopping point slightly
+    np.testing.assert_allclose(
+        np.asarray(sharded.params[:5]), np.asarray(base.params[:5]),
+        rtol=1e-3, atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(sharded.deviance[:5]),
+        np.asarray(base.deviance[:5]),
+        rtol=1e-8,
+    )
+
+
+def test_train_step_sharded(rng):
+    """make_train_step lowers/executes with fleet sharded over the mesh."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = make_mesh(8)
+    fleet, _, _ = _random_fleet(
+        rng, [3] * 8, t=40, pad_batch_to=8
+    )
+    opt = optax.adam(1e-2)
+    step = make_train_step(opt, engine="joint")
+    theta = jnp.log(default_init_params(fleet))
+    shard = NamedSharding(mesh, PartitionSpec("batch"))
+
+    def put(x):
+        return jax.device_put(
+            x, NamedSharding(mesh, PartitionSpec("batch", *[None] * (x.ndim - 1)))
+        )
+
+    fleet = jax.tree.map(put, fleet)
+    theta = jax.device_put(theta, shard)
+    opt_state = opt.init(theta)
+    jstep = jax.jit(step)
+    losses = []
+    for _ in range(3):
+        theta, opt_state, value = jstep(theta, opt_state, fleet)
+        losses.append(float(value))
+    assert losses[2] < losses[0]
