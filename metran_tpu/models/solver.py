@@ -272,19 +272,23 @@ def jax_sigmoid(x):
     return jax.nn.sigmoid(x)
 
 
-def run_lbfgs(objective, theta0, maxiter: int = 200, tol: float = 1e-8):
-    """Jitted optax L-BFGS loop; returns (theta, value, n_evals, converged).
+def lbfgs_advance(objective, opt, theta, state, tol, maxiter, max_new_iters):
+    """Advance an optax L-BFGS run by up to ``max_new_iters`` iterations.
 
-    Uses optax's zoom line search via ``value_and_grad_from_state`` so each
-    iteration reuses the line-search evaluations (optax docs pattern).
+    The shared device-side core of :func:`run_lbfgs` and the fleet solver
+    (``metran_tpu.parallel.fleet``): a ``while_loop`` using optax's zoom
+    line search via ``value_and_grad_from_state`` so each iteration reuses
+    the line-search evaluations.  Stops at convergence (gradient norm
+    below ``tol``), at ``maxiter`` total iterations, or after
+    ``max_new_iters`` iterations of this call (chunking), whichever comes
+    first.  Returns ``(theta, state)`` to carry across chunked calls.
     """
     import jax
-    import jax.numpy as jnp
     import optax
     import optax.tree_utils as otu
 
-    opt = optax.lbfgs()
     value_and_grad = optax.value_and_grad_from_state(objective)
+    count0 = otu.tree_get(state, "count")
 
     def step(carry):
         theta, state = carry
@@ -298,14 +302,29 @@ def run_lbfgs(objective, theta0, maxiter: int = 200, tol: float = 1e-8):
     def cond(carry):
         _, state = carry
         count = otu.tree_get(state, "count")
-        grad = otu.tree_get(state, "grad")
-        err = otu.tree_l2_norm(grad)
-        return (count == 0) | ((count < maxiter) & (err >= tol))
+        err = otu.tree_l2_norm(otu.tree_get(state, "grad"))
+        return (
+            ((count == 0) | (err >= tol))
+            & (count < maxiter)
+            & (count - count0 < max_new_iters)
+        )
+
+    return jax.lax.while_loop(cond, step, (theta, state))
+
+
+def run_lbfgs(objective, theta0, maxiter: int = 200, tol: float = 1e-8):
+    """Jitted optax L-BFGS loop; returns (theta, value, n_iters, converged)."""
+    import jax
+    import optax
+    import optax.tree_utils as otu
+
+    opt = optax.lbfgs()
 
     @jax.jit
     def run(theta0):
-        init = (theta0, opt.init(theta0))
-        theta, state = jax.lax.while_loop(cond, step, init)
+        theta, state = lbfgs_advance(
+            objective, opt, theta0, opt.init(theta0), tol, maxiter, maxiter
+        )
         return (
             theta,
             otu.tree_get(state, "value"),

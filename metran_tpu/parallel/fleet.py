@@ -167,32 +167,91 @@ def default_init_params(fleet: Fleet) -> jnp.ndarray:
     )
 
 
-def _solve_one(theta0, y, mask, loadings, dt, warmup, engine, maxiter, tol):
-    """On-device L-BFGS for one model in log-transformed parameters.
+ALPHA_MAX = 3e4  # soft upper cap on alpha during fleet optimization
 
-    ``alpha = ALPHA_PMIN + exp(theta)`` enforces the reference's lower bound
-    (no upper bound exists, metran/metran.py:446-462).
+
+def _soft_cap(theta, cap):
+    """Smooth monotone map R -> (-inf, cap): near-identity far below the cap.
+
+    Keeps the optimizer out of the degenerate ``alpha -> inf`` regime
+    (``phi -> 1``, ``q -> 0``) where the likelihood is flat and the
+    innovation covariance becomes singular in float32.  The reference has
+    no upper bound (metran/metran.py:446-462) but never needs one on CPU
+    float64; on accelerators the cap bounds the ill-conditioning.
+    Distortion is ``softplus(cap - theta) - (cap - theta)``: ~0.7% in
+    alpha at 5 below the cap, < 1e-2 percent at ~9 below (the default
+    init theta ~ 2.3 with cap ~ 10.3 sits at the latter).
     """
-    from ..models.solver import run_lbfgs
+    return cap - jax.nn.softplus(cap - theta)
 
-    def objective(theta):
-        p = ALPHA_PMIN + jnp.exp(theta)
+
+def _theta_to_alpha(theta, cap):
+    return ALPHA_PMIN + jnp.exp(_soft_cap(theta, cap))
+
+
+def _alpha_to_theta(p, cap):
+    """Exact inverse of :func:`_theta_to_alpha` (clamped just below cap)."""
+    t = jnp.log(jnp.maximum(jnp.asarray(p) - ALPHA_PMIN, 1e-12))
+    t = jnp.minimum(t, cap - 1e-6)
+    # invert t = cap - softplus(cap - theta):  theta = cap - log(expm1(cap-t))
+    return cap - jnp.log(jnp.expm1(cap - t))
+
+
+def _solve_chunk(theta, state, y, mask, loadings, dt, warmup, engine, tol,
+                 chunk, maxiter, opt, theta_cap):
+    """Advance one model's L-BFGS by up to ``chunk`` iterations.
+
+    Chunking keeps each device execution short and bounded (long single
+    XLA executions are both unprofileable and fragile on preemptible
+    hardware); the optimizer state pytree carries across chunks.
+    """
+    from ..models.solver import lbfgs_advance
+
+    def objective(th):
+        p = _theta_to_alpha(th, theta_cap)
         return _model_deviance(p, y, mask, loadings, dt, warmup, engine)
 
-    theta, value, count, converged = run_lbfgs(
-        objective, theta0, maxiter=maxiter, tol=tol
+    return lbfgs_advance(objective, opt, theta, state, tol, maxiter, chunk)
+
+
+def _chunk_outputs(theta, state, tol, theta_cap):
+    import optax.tree_utils as otu
+
+    return (
+        _theta_to_alpha(theta, theta_cap),
+        otu.tree_get(state, "value"),
+        otu.tree_get(state, "count"),
+        otu.tree_l2_norm(otu.tree_get(state, "grad")) < tol,
     )
-    return ALPHA_PMIN + jnp.exp(theta), value, count, converged
 
 
-def _fit_fleet_batched(fleet, p0, warmup, engine, maxiter, tol):
-    theta0 = jnp.log(jnp.maximum(p0 - ALPHA_PMIN, 1e-12))
-    params, value, count, conv = jax.vmap(
-        lambda th, y, m, ld, dt: _solve_one(
-            th, y, m, ld, dt, warmup, engine, maxiter, tol
+def _make_chunk_runner(warmup, engine, tol, chunk, maxiter,
+                       max_linesearch_steps, theta_cap):
+    """Build (opt, vmapped chunk advance, vmapped outputs)."""
+    import optax
+
+    opt = optax.lbfgs(
+        linesearch=optax.scale_by_zoom_linesearch(
+            max_linesearch_steps=max_linesearch_steps,
+            # optax.lbfgs()'s default: restart each linesearch at step 1
+            initial_guess_strategy="one",
         )
-    )(theta0, fleet.y, fleet.mask, fleet.loadings, fleet.dt)
-    return FleetFit(params, value, count, conv)
+    )
+
+    def advance(theta, state, y, mask, loadings, dt):
+        return _solve_chunk(
+            theta, state, y, mask, loadings, dt, warmup, engine, tol, chunk,
+            maxiter, opt, theta_cap,
+        )
+
+    def outputs(theta, state):
+        return _chunk_outputs(theta, state, tol, theta_cap)
+
+    return (
+        opt,
+        jax.vmap(advance, in_axes=(0, 0, 0, 0, 0, 0)),
+        jax.vmap(outputs),
+    )
 
 
 def fit_fleet(
@@ -204,12 +263,18 @@ def fit_fleet(
     tol: float = 1e-8,
     mesh: Optional[Mesh] = None,
     use_shard_map: bool = False,
+    chunk: Optional[int] = None,
+    max_linesearch_steps: int = 16,
+    alpha_max: float = ALPHA_MAX,
+    stall_tol: Optional[float] = None,
 ) -> FleetFit:
     """Fit every model in the fleet by on-device L-BFGS.
 
-    The entire optimization (objective, exact gradient, line search,
-    updates) runs inside one ``jit``; nothing touches the host until the
-    results are fetched.
+    The optimization (objective, exact gradient, line search, updates)
+    runs on-device in chunks of ``chunk`` L-BFGS iterations per
+    dispatch; the host only checks convergence flags between chunks and
+    stops early when every model is done.  Chunking bounds the wall time
+    of any single device execution without changing results.
 
     Parameters
     ----------
@@ -225,56 +290,96 @@ def fit_fleet(
         GSPMD auto-partitioning.  Results are identical; this path keeps
         per-device work fully independent so no partitioner choice can
         introduce cross-device chatter into the L-BFGS loops.
+    chunk : L-BFGS iterations per device dispatch (default: maxiter,
+        i.e. one dispatch, for small problems; pass e.g. 10 to bound
+        per-dispatch time on large ones).
+    max_linesearch_steps : cap on zoom line-search evaluations per
+        iteration (bounds worst-case cost when float32 can no longer
+        resolve objective differences near the optimum).
+    alpha_max : soft upper cap on alpha during optimization (see
+        ``_soft_cap``).
+    stall_tol : when set, a lane whose objective improved by less than
+        this across a whole chunk is treated as finished (early stop at
+        the float32 resolution floor).  Default off: chunking then never
+        changes results vs a single dispatch.
     """
     if p0 is None:
         p0 = default_init_params(fleet)
-    run = functools.partial(
-        _fit_fleet_batched,
-        warmup=warmup,
-        engine=engine,
-        maxiter=maxiter,
-        tol=tol,
-    )
-
-    if mesh is None:
-        return jax.jit(run)(fleet, p0)
-
-    if fleet.batch % mesh.size:
+    if not np.isfinite(alpha_max) or alpha_max <= ALPHA_PMIN:
+        raise ValueError(
+            f"alpha_max must be finite and > {ALPHA_PMIN}, got {alpha_max}"
+        )
+    theta_cap = float(np.log(alpha_max))
+    if chunk is None or chunk >= maxiter:
+        chunk = maxiter
+    if chunk < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
+    if mesh is not None and fleet.batch % mesh.size:
         raise ValueError(
             f"mesh size {mesh.size} must divide the fleet batch "
             f"{fleet.batch}; pad with pack_fleet(..., pad_batch_to="
             f"pad_to_multiple({fleet.batch}, {mesh.size}))"
         )
-    if use_shard_map:
-        spec_in = (
-            Fleet(
-                y=PartitionSpec(BATCH_AXIS),
-                mask=PartitionSpec(BATCH_AXIS),
-                loadings=PartitionSpec(BATCH_AXIS),
-                dt=PartitionSpec(BATCH_AXIS),
-                n_series=PartitionSpec(BATCH_AXIS),
-            ),
-            PartitionSpec(BATCH_AXIS),
-        )
-        spec_out = FleetFit(
-            params=PartitionSpec(BATCH_AXIS),
-            deviance=PartitionSpec(BATCH_AXIS),
-            iterations=PartitionSpec(BATCH_AXIS),
-            converged=PartitionSpec(BATCH_AXIS),
-        )
-        # check_vma=False: the solver body mixes device-varying shards with
-        # unvarying constants (e.g. the identity initial covariance), which
-        # is fine for fully independent per-device work
-        sharded = jax.shard_map(
-            run, mesh=mesh, in_specs=spec_in, out_specs=spec_out,
-            check_vma=False,
-        )
-        return jax.jit(sharded)(fleet, p0)
 
-    shard = lambda x: batch_sharding(mesh, np.ndim(x))  # noqa: E731
-    fleet = jax.device_put(fleet, jax.tree.map(shard, fleet))
-    p0 = jax.device_put(p0, shard(p0))
-    return jax.jit(run)(fleet, p0)
+    opt, advance, outputs = _make_chunk_runner(
+        warmup, engine, tol, chunk, maxiter, max_linesearch_steps, theta_cap
+    )
+    theta = _alpha_to_theta(jnp.asarray(p0), theta_cap)
+    if mesh is not None:
+        shard = lambda x: batch_sharding(mesh, np.ndim(x))  # noqa: E731
+        fleet = jax.device_put(fleet, jax.tree.map(shard, fleet))
+        theta = jax.device_put(theta, shard(theta))
+    state = jax.jit(jax.vmap(opt.init))(theta)
+
+    data_args = (fleet.y, fleet.mask, fleet.loadings, fleet.dt)
+    if mesh is not None and use_shard_map:
+        # explicit SPMD: every leaf (incl. the whole optimizer state) is
+        # batch-leading after vmap, so the specs follow from the shapes.
+        # check_vma=False: the solver body mixes device-varying shards
+        # with unvarying constants (e.g. the identity initial covariance),
+        # which is fine for fully independent per-device work.
+        def bspec(tree):
+            return jax.tree.map(
+                lambda leaf: PartitionSpec(
+                    BATCH_AXIS, *([None] * (np.ndim(leaf) - 1))
+                ),
+                tree,
+            )
+
+        carry_spec = (bspec(theta), bspec(state))
+        advance = jax.shard_map(
+            advance, mesh=mesh,
+            in_specs=carry_spec + tuple(bspec(a) for a in data_args),
+            out_specs=carry_spec, check_vma=False,
+        )
+        out_shapes = jax.eval_shape(outputs, theta, state)
+        outputs = jax.shard_map(
+            outputs, mesh=mesh, in_specs=carry_spec,
+            out_specs=bspec(out_shapes), check_vma=False,
+        )
+
+    advance = jax.jit(advance)
+    outputs = jax.jit(outputs)
+    import optax.tree_utils as otu
+
+    prev_value = None
+    for _ in range(max(-(-maxiter // chunk), 1)):
+        theta, state = advance(theta, state, *data_args)
+        if chunk >= maxiter:
+            break
+        count = np.asarray(otu.tree_get(state, "count"))
+        value = np.asarray(otu.tree_get(state, "value"))
+        grad_flat = np.asarray(otu.tree_get(state, "grad"))
+        err = np.linalg.norm(grad_flat, axis=-1)
+        done = (err < tol) | (count >= maxiter)
+        # optional early stop for lanes at the f32 resolution floor
+        if stall_tol is not None and prev_value is not None:
+            done |= ~(value < prev_value - stall_tol)
+        if done.all():
+            break
+        prev_value = value
+    params, value, count, conv = outputs(theta, state)
+    return FleetFit(params, value, count, conv)
 
 
 # ----------------------------------------------------------------------

@@ -165,3 +165,48 @@ def test_train_step_sharded(rng):
         theta, opt_state, value = jstep(theta, opt_state, fleet)
         losses.append(float(value))
     assert losses[2] < losses[0]
+
+
+def test_fit_fleet_chunked_matches_single_dispatch(rng):
+    """Chunked host-loop dispatches reproduce the one-shot solve and
+    never exceed maxiter iterations (even when chunk doesn't divide it)."""
+    fleet, _, _ = _random_fleet(rng, [4, 3], t=80)
+    one = fit_fleet(fleet, maxiter=25)
+    chunked = fit_fleet(fleet, maxiter=25, chunk=7)
+    assert np.asarray(chunked.iterations).max() <= 25
+    np.testing.assert_allclose(
+        np.asarray(chunked.params), np.asarray(one.params), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(chunked.deviance), np.asarray(one.deviance), rtol=1e-10
+    )
+
+
+def test_fit_fleet_shard_map_chunked(rng):
+    """shard_map path honors chunking and matches the unsharded result."""
+    mesh = make_mesh(8)
+    fleet, _, _ = _random_fleet(rng, [3] * 8, t=60, pad_batch_to=8)
+    base = fit_fleet(fleet, maxiter=20)
+    sharded = fit_fleet(
+        fleet, maxiter=20, chunk=6, mesh=mesh, use_shard_map=True
+    )
+    assert np.asarray(sharded.iterations).max() <= 20
+    np.testing.assert_allclose(
+        np.asarray(sharded.deviance), np.asarray(base.deviance), rtol=1e-8
+    )
+
+
+def test_alpha_theta_roundtrip():
+    """_alpha_to_theta is the exact inverse of _theta_to_alpha, including
+    warm starts near the cap (regression: log(p - pmin) is NOT the
+    inverse once the soft cap is applied)."""
+    from metran_tpu.parallel.fleet import (
+        ALPHA_MAX,
+        _alpha_to_theta,
+        _theta_to_alpha,
+    )
+
+    cap = float(np.log(ALPHA_MAX))
+    alphas = jnp.asarray([0.1, 10.0, 100.0, 2e4, 2.9e4])
+    back = _theta_to_alpha(_alpha_to_theta(alphas, cap), cap)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(alphas), rtol=1e-9)
