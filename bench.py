@@ -103,6 +103,7 @@ def cpu_filter_pass_seconds(y, mask, loadings):
     try:
         from metran_tpu.native import seq_filter_pass
 
+        seq_filter_pass(phi, q, z, r, y[:8], mask[:8])  # probe: builds/loads
         runner = lambda: seq_filter_pass(phi, q, z, r, y, mask)  # noqa: E731
         engine = "native"
     except Exception:

@@ -1,0 +1,212 @@
+"""Native (C++) CPU runtime for metran_tpu.
+
+The compiled host-side twin of the XLA engines: a sequential-processing
+Kalman filter/smoother/deviance in C++ (``kalman.cpp``), loaded through
+``ctypes``.  This plays the role the numba-jitted kernel plays in the
+reference (``metran/kalmanfilter.py:236-400``): a fast CPU path for
+host-only deployments, the honest CPU baseline for ``bench.py``, and an
+independent implementation for parity testing against the ``lax.scan``
+engines.
+
+The shared library is built on demand with ``g++ -O3`` into
+``metran_tpu/native/build/`` and cached; set ``METRAN_TPU_NO_NATIVE=1``
+to disable (pure-Python/JAX operation is always available).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from logging import getLogger
+from pathlib import Path
+from typing import Optional, Tuple
+
+import numpy as np
+
+logger = getLogger(__name__)
+
+_HERE = Path(__file__).resolve().parent
+_SRC = _HERE / "kalman.cpp"
+_BUILD_DIR = _HERE / "build"
+_LIB_PATH = _BUILD_DIR / "libmetran_native.so"
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+class NativeUnavailable(RuntimeError):
+    """Raised when the native library cannot be built or loaded."""
+
+
+def _build() -> Path:
+    _BUILD_DIR.mkdir(exist_ok=True)
+    cmd = [
+        "g++", "-O3", "-march=native", "-shared", "-fPIC",
+        "-o", str(_LIB_PATH), str(_SRC),
+    ]
+    logger.info("building native kernel: %s", " ".join(cmd))
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+    except OSError as e:  # g++ missing entirely
+        raise NativeUnavailable(f"no C++ toolchain: {e}") from e
+    if proc.returncode != 0:
+        raise NativeUnavailable(
+            f"native build failed (exit {proc.returncode}): {proc.stderr[-500:]}"
+        )
+    return _LIB_PATH
+
+
+def load() -> ctypes.CDLL:
+    """Load (building if needed) the native library; raises if impossible."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    if os.environ.get("METRAN_TPU_NO_NATIVE"):
+        raise NativeUnavailable("disabled by METRAN_TPU_NO_NATIVE")
+    if not _LIB_PATH.exists() or _LIB_PATH.stat().st_mtime < _SRC.stat().st_mtime:
+        _build()
+    try:
+        lib = ctypes.CDLL(str(_LIB_PATH))
+    except OSError as e:
+        raise NativeUnavailable(f"cannot load native library: {e}") from e
+
+    f64p = ctypes.POINTER(ctypes.c_double)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i64 = ctypes.c_int64
+
+    lib.seq_kalman_filter.restype = ctypes.c_int
+    lib.seq_kalman_filter.argtypes = [
+        f64p, f64p, f64p, f64p, f64p, u8p, i64, i64, i64,
+        f64p, f64p, f64p, f64p, f64p, f64p,
+    ]
+    lib.seq_kalman_deviance.restype = ctypes.c_double
+    lib.seq_kalman_deviance.argtypes = [f64p, f64p, u8p, i64, i64, i64]
+    lib.seq_kalman_smoother.restype = ctypes.c_int
+    lib.seq_kalman_smoother.argtypes = [
+        f64p, f64p, f64p, f64p, f64p, i64, i64, f64p, f64p,
+    ]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    try:
+        load()
+        return True
+    except NativeUnavailable:
+        return False
+
+
+def _f64(a) -> np.ndarray:
+    return np.ascontiguousarray(np.asarray(a, dtype=np.float64))
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+def seq_filter_pass(phi, q, z, r, y, mask) -> Tuple[float, float]:
+    """One filter pass; returns (sum sigma, sum detf).  Moment storage is
+    skipped — this is the likelihood-evaluation hot path."""
+    lib = load()
+    phi, q, z, r, y = map(_f64, (phi, q, z, r, y))
+    mask8 = np.ascontiguousarray(np.asarray(mask, dtype=np.uint8))
+    t, m = y.shape
+    n = phi.shape[0]
+    sigma = np.empty(t)
+    detf = np.empty(t)
+    rc = lib.seq_kalman_filter(
+        _ptr(phi), _ptr(q), _ptr(z), _ptr(r), _ptr(y),
+        mask8.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        t, m, n, _ptr(sigma), _ptr(detf), None, None, None, None,
+    )
+    if rc != 0:
+        raise RuntimeError(f"native filter failed (rc={rc})")
+    return float(sigma.sum()), float(detf.sum())
+
+
+def filter(phi, q, z, r, y, mask):
+    """Full filter pass storing moments.
+
+    Returns dict with mean_p/cov_p/mean_f/cov_f/sigma/detf (same layout
+    as the JAX ``kalman_filter`` FilterResult).
+    """
+    lib = load()
+    phi, q, z, r, y = map(_f64, (phi, q, z, r, y))
+    mask8 = np.ascontiguousarray(np.asarray(mask, dtype=np.uint8))
+    t, m = y.shape
+    n = phi.shape[0]
+    out = {
+        "sigma": np.empty(t),
+        "detf": np.empty(t),
+        "mean_f": np.empty((t, n)),
+        "cov_f": np.empty((t, n, n)),
+        "mean_p": np.empty((t, n)),
+        "cov_p": np.empty((t, n, n)),
+    }
+    rc = lib.seq_kalman_filter(
+        _ptr(phi), _ptr(q), _ptr(z), _ptr(r), _ptr(y),
+        mask8.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        t, m, n, _ptr(out["sigma"]), _ptr(out["detf"]),
+        _ptr(out["mean_f"]), _ptr(out["cov_f"]),
+        _ptr(out["mean_p"]), _ptr(out["cov_p"]),
+    )
+    if rc != 0:
+        raise RuntimeError(f"native filter failed (rc={rc})")
+    return out
+
+
+def deviance(phi, q, z, r, y, mask, warmup: int = 1) -> float:
+    """-2 log L with reference warmup semantics, entirely in native code."""
+    lib = load()
+    phi, q, z, r, y = map(_f64, (phi, q, z, r, y))
+    mask8 = np.ascontiguousarray(np.asarray(mask, dtype=np.uint8))
+    t, m = y.shape
+    n = phi.shape[0]
+    sigma = np.empty(t)
+    detf = np.empty(t)
+    rc = lib.seq_kalman_filter(
+        _ptr(phi), _ptr(q), _ptr(z), _ptr(r), _ptr(y),
+        mask8.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        t, m, n, _ptr(sigma), _ptr(detf), None, None, None, None,
+    )
+    if rc != 0:
+        raise RuntimeError(f"native filter failed (rc={rc})")
+    return float(
+        lib.seq_kalman_deviance(
+            _ptr(sigma), _ptr(detf),
+            mask8.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            t, m, warmup,
+        )
+    )
+
+
+def smoother(phi, filt):
+    """RTS smoother over stored filter moments; returns (mean_s, cov_s)."""
+    lib = load()
+    phi = _f64(phi)
+    mean_f = _f64(filt["mean_f"])
+    cov_f = _f64(filt["cov_f"])
+    mean_p = _f64(filt["mean_p"])
+    cov_p = _f64(filt["cov_p"])
+    t, n = mean_f.shape
+    mean_s = np.empty((t, n))
+    cov_s = np.empty((t, n, n))
+    rc = lib.seq_kalman_smoother(
+        _ptr(phi), _ptr(mean_f), _ptr(cov_f), _ptr(mean_p), _ptr(cov_p),
+        t, n, _ptr(mean_s), _ptr(cov_s),
+    )
+    if rc != 0:
+        raise RuntimeError(f"native smoother failed (rc={rc}): cov not PD")
+    return mean_s, cov_s
+
+
+__all__ = [
+    "NativeUnavailable",
+    "available",
+    "deviance",
+    "filter",
+    "load",
+    "seq_filter_pass",
+    "smoother",
+]

@@ -5,8 +5,10 @@ machinery (SURVEY.md section 2.3).  On TPU the equivalent scale story is a
 *fleet*: a batch of independent DFMs padded to common static shapes, the
 whole MLE pipeline (state-space build -> masked Kalman filter -> deviance ->
 exact gradient -> L-BFGS) vmapped over the fleet axis and sharded over a
-device mesh.  Communication is XLA collectives over ICI; there is no
-host-side loop anywhere in the hot path.
+device mesh.  Communication is XLA collectives over ICI.  The optimizer
+runs as a sequence of bounded on-device dispatches (``chunk`` iterations
+each) with the state pytree resident on device; between dispatches the
+host only reads convergence scalars to decide whether to stop early.
 
 Padding semantics (all verified by tests/test_parallel.py):
 
@@ -197,13 +199,15 @@ def _alpha_to_theta(p, cap):
     return cap - jnp.log(jnp.expm1(cap - t))
 
 
-def _solve_chunk(theta, state, y, mask, loadings, dt, warmup, engine, tol,
-                 chunk, maxiter, opt, theta_cap):
+def _solve_chunk(theta, state, frozen, y, mask, loadings, dt, warmup,
+                 engine, tol, chunk, maxiter, opt, theta_cap):
     """Advance one model's L-BFGS by up to ``chunk`` iterations.
 
     Chunking keeps each device execution short and bounded (long single
     XLA executions are both unprofileable and fragile on preemptible
-    hardware); the optimizer state pytree carries across chunks.
+    hardware); the optimizer state pytree carries across chunks.  A lane
+    with ``frozen=True`` (host-detected stall) takes no iterations, so
+    its result does not depend on what else shares the batch.
     """
     from ..models.solver import lbfgs_advance
 
@@ -211,7 +215,10 @@ def _solve_chunk(theta, state, y, mask, loadings, dt, warmup, engine, tol,
         p = _theta_to_alpha(th, theta_cap)
         return _model_deviance(p, y, mask, loadings, dt, warmup, engine)
 
-    return lbfgs_advance(objective, opt, theta, state, tol, maxiter, chunk)
+    return lbfgs_advance(
+        objective, opt, theta, state, tol,
+        jnp.where(frozen, 0, maxiter), chunk,
+    )
 
 
 def _chunk_outputs(theta, state, tol, theta_cap):
@@ -225,9 +232,15 @@ def _chunk_outputs(theta, state, tol, theta_cap):
     )
 
 
+@functools.lru_cache(maxsize=32)
 def _make_chunk_runner(warmup, engine, tol, chunk, maxiter,
                        max_linesearch_steps, theta_cap):
-    """Build (opt, vmapped chunk advance, vmapped outputs)."""
+    """Build (opt, vmapped chunk advance, vmapped outputs).
+
+    Cached on its (hashable) configuration so repeated ``fit_fleet`` calls
+    reuse the same function objects and hit JAX's jit cache instead of
+    re-tracing/re-compiling the whole L-BFGS program.
+    """
     import optax
 
     opt = optax.lbfgs(
@@ -238,10 +251,10 @@ def _make_chunk_runner(warmup, engine, tol, chunk, maxiter,
         )
     )
 
-    def advance(theta, state, y, mask, loadings, dt):
+    def advance(theta, state, frozen, y, mask, loadings, dt):
         return _solve_chunk(
-            theta, state, y, mask, loadings, dt, warmup, engine, tol, chunk,
-            maxiter, opt, theta_cap,
+            theta, state, frozen, y, mask, loadings, dt, warmup, engine,
+            tol, chunk, maxiter, opt, theta_cap,
         )
 
     def outputs(theta, state):
@@ -249,8 +262,8 @@ def _make_chunk_runner(warmup, engine, tol, chunk, maxiter,
 
     return (
         opt,
-        jax.vmap(advance, in_axes=(0, 0, 0, 0, 0, 0)),
-        jax.vmap(outputs),
+        jax.jit(jax.vmap(advance, in_axes=(0, 0, 0, 0, 0, 0, 0))),
+        jax.jit(jax.vmap(outputs)),
     )
 
 
@@ -331,6 +344,9 @@ def fit_fleet(
         theta = jax.device_put(theta, shard(theta))
     state = jax.jit(jax.vmap(opt.init))(theta)
 
+    frozen = jnp.zeros(fleet.batch, bool)
+    if mesh is not None:
+        frozen = jax.device_put(frozen, shard(frozen))
     data_args = (fleet.y, fleet.mask, fleet.loadings, fleet.dt)
     if mesh is not None and use_shard_map:
         # explicit SPMD: every leaf (incl. the whole optimizer state) is
@@ -347,24 +363,23 @@ def fit_fleet(
             )
 
         carry_spec = (bspec(theta), bspec(state))
-        advance = jax.shard_map(
+        advance = jax.jit(jax.shard_map(
             advance, mesh=mesh,
-            in_specs=carry_spec + tuple(bspec(a) for a in data_args),
+            in_specs=(carry_spec[0], carry_spec[1], bspec(frozen))
+            + tuple(bspec(a) for a in data_args),
             out_specs=carry_spec, check_vma=False,
-        )
+        ))
         out_shapes = jax.eval_shape(outputs, theta, state)
-        outputs = jax.shard_map(
+        outputs = jax.jit(jax.shard_map(
             outputs, mesh=mesh, in_specs=carry_spec,
             out_specs=bspec(out_shapes), check_vma=False,
-        )
+        ))
 
-    advance = jax.jit(advance)
-    outputs = jax.jit(outputs)
     import optax.tree_utils as otu
 
     prev_value = None
     for _ in range(max(-(-maxiter // chunk), 1)):
-        theta, state = advance(theta, state, *data_args)
+        theta, state = advance(theta, state, frozen, *data_args)
         if chunk >= maxiter:
             break
         count = np.asarray(otu.tree_get(state, "count"))
@@ -372,9 +387,16 @@ def fit_fleet(
         grad_flat = np.asarray(otu.tree_get(state, "grad"))
         err = np.linalg.norm(grad_flat, axis=-1)
         done = (err < tol) | (count >= maxiter)
-        # optional early stop for lanes at the f32 resolution floor
+        # optional per-lane stop at the f32 resolution floor: a frozen
+        # lane takes no further iterations (device-side cond), so its
+        # result never depends on what else shares the batch
         if stall_tol is not None and prev_value is not None:
-            done |= ~(value < prev_value - stall_tol)
+            stalled = ~(value < prev_value - stall_tol)
+            frozen_host = np.asarray(frozen) | stalled
+            done |= frozen_host
+            frozen = jnp.asarray(frozen_host)
+            if mesh is not None:
+                frozen = jax.device_put(frozen, shard(frozen))
         if done.all():
             break
         prev_value = value
