@@ -66,7 +66,9 @@ class KalmanRunner:
 
     def run_smoother(self) -> SmootherResult:
         if self.smoothed is None:
-            self.smoothed = rts_smoother(self.ss, self.run_filter())
+            self.smoothed = rts_smoother(
+                self.ss, self.run_filter(), engine=self.engine
+            )
         return self.smoothed
 
     def get_mle(self, warmup: int = 1) -> float:

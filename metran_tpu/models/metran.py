@@ -33,6 +33,7 @@ _ENGINE_ALIASES = {
     "numpy": "sequential",
     "sequential": "sequential",
     "joint": "joint",
+    "parallel": "parallel",  # associative-scan parallel-in-time engine
 }
 
 
@@ -62,10 +63,11 @@ class Metran:
     tmin, tmax : str, optional
         Start/end of the analysis period.
     engine : str, optional
-        Kalman update engine: "sequential" (default, parity with the
-        reference's sequential processing) or "joint" (batched Cholesky
-        update).  The reference's "numba"/"numpy" names are accepted
-        aliases of "sequential".
+        Kalman engine: "sequential" (default, parity with the reference's
+        sequential processing), "joint" (batched Cholesky update) or
+        "parallel" (associative-scan parallel-in-time filter/smoother,
+        O(log T) depth).  The reference's "numba"/"numpy" names are
+        accepted aliases of "sequential".
     """
 
     def __init__(
@@ -524,8 +526,8 @@ class Metran:
         report : bool, optional
             Print fit and metran reports when done.
         engine : str, optional
-            Kalman engine override ("sequential"/"joint"; the reference's
-            "numba"/"numpy" map to "sequential").
+            Kalman engine override ("sequential"/"joint"/"parallel"; the
+            reference's "numba"/"numpy" map to "sequential").
         **kwargs
             Passed through to the solver's minimize call.
         """

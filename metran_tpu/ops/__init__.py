@@ -12,6 +12,12 @@ from .kalman import (
     project,
     rts_smoother,
 )
+from .pkalman import (
+    parallel_deviance,
+    parallel_filter,
+    parallel_smoother,
+    sequence_sharded_filter,
+)
 from .statespace import StateSpace, ar1_decay, dfm_statespace, scale_observation_matrix
 
 __all__ = [
@@ -25,7 +31,11 @@ __all__ = [
     "dfm_statespace",
     "kalman_filter",
     "log_likelihood",
+    "parallel_deviance",
+    "parallel_filter",
+    "parallel_smoother",
     "project",
+    "sequence_sharded_filter",
     "rts_smoother",
     "scale_observation_matrix",
 ]

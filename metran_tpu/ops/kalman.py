@@ -155,6 +155,16 @@ def kalman_filter(
     FilterResult; when ``store=False`` the mean/cov arrays hold only the
     final carry values (shape (n,)/(n, n)).
     """
+    if engine == "parallel":
+        from .pkalman import parallel_filter
+
+        res = parallel_filter(ss, y, mask)
+        if not store:  # honor the O(n^2)-memory return contract
+            return FilterResult(
+                res.mean_f[-1], res.cov_f[-1], res.mean_f[-1],
+                res.cov_f[-1], res.sigma, res.detf,
+            )
+        return res
     dtype = ss.q.dtype
     y = jnp.asarray(y, dtype)
     mask = jnp.asarray(mask, bool)
@@ -231,6 +241,10 @@ def deviance(
     engine: str = "sequential",
 ) -> jnp.ndarray:
     """-2 log-likelihood (the quantity the reference minimizes)."""
+    if engine == "parallel":
+        from .pkalman import parallel_deviance
+
+        return parallel_deviance(ss, y, mask, warmup=warmup)
     res = kalman_filter(ss, y, mask, engine=engine, store=False)
     return deviance_terms(res.sigma, res.detf, mask, warmup=warmup)
 
@@ -245,15 +259,23 @@ class SmootherResult(NamedTuple):
     cov_s: jnp.ndarray  # (T, n, n)
 
 
-@jax.jit
-def rts_smoother(ss: StateSpace, filtered: FilterResult) -> SmootherResult:
+@functools.partial(jax.jit, static_argnames=("engine",))
+def rts_smoother(
+    ss: StateSpace, filtered: FilterResult, engine: str = "sequential"
+) -> SmootherResult:
     """RTS smoother as a reverse ``lax.scan``.
 
     Matches ``kalmansmoother`` (``metran/kalmanfilter.py:403-476``) but uses a
     symmetric Cholesky solve against the predicted covariance instead of
     ``pinv`` (both agree when the predicted covariance is PD, which holds for
-    the DFM with identity initial covariance).
+    the DFM with identity initial covariance).  ``engine="parallel"``
+    dispatches to the O(log T) associative-scan smoother; other engine
+    names use the sequential reverse scan.
     """
+    if engine == "parallel":
+        from .pkalman import parallel_smoother
+
+        return parallel_smoother(ss, filtered)
     phi = ss.phi
     mean_f, cov_f = filtered.mean_f, filtered.cov_f
     mean_p, cov_p = filtered.mean_p, filtered.cov_p

@@ -1,0 +1,259 @@
+"""Parallel-in-time Kalman filtering/smoothing via associative scans.
+
+The sequential filter (``metran_tpu.ops.kalman``) has O(T) depth — each
+timestep waits for the previous one.  This module reformulates the same
+Bayesian recursions as **associative operators** combined with
+``jax.lax.associative_scan`` (temporal parallelization of Bayesian
+filters/smoothers, cf. PAPERS.md "Parallel-in-Time Kalman Smoothing"),
+giving O(log T) depth on parallel hardware and making the *time axis* a
+shardable dimension: under ``jit`` with the elements sharded over a mesh
+axis, XLA turns the combine tree into collectives over ICI — the
+framework's sequence-parallelism backend for long series.
+
+The reference implementation has no equivalent (its recursion is a numba
+loop, ``metran/kalmanfilter.py:236-400``); results are numerically
+equivalent to the sequential engines and tested against them to float64
+precision.
+
+Missing data is handled with the same static-shape trick as the joint
+update: masked observation rows are zeroed in Z and given unit pseudo-
+noise, which provably leaves gains, likelihood terms, and posteriors
+identical to conditioning on the observed subset only.
+
+Filtering elements (per timestep): ``(A, b, C, J, eta)`` such that the
+pair ``(b, C)`` of the combined prefix equals the filtered mean/cov.
+Smoothing elements: ``(E, g, L)`` combined in reverse.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .kalman import FilterResult, SmootherResult
+from .statespace import StateSpace
+
+
+def _masked_obs(ss: StateSpace, mask_t, dtype):
+    """Static-shape masked observation model for one timestep.
+
+    Masked slots get a zero Z-row and unit observation noise; with y=0
+    there they contribute nothing to gains or likelihood (log 1 = 0).
+    """
+    maskf = mask_t.astype(dtype)
+    z_t = ss.z * maskf[:, None]
+    r_t = jnp.where(mask_t, ss.r, 0.0) + (1.0 - maskf)
+    return z_t, r_t
+
+
+def _filter_element(ss: StateSpace, y_t, mask_t, p_prior, first, dtype):
+    """Build one associative filtering element.
+
+    ``p_prior`` is the predicted covariance entering this step when it is
+    the first one (Phi I Phi' + Q, reference init semantics); interior
+    steps use Q (the paper's construction with A = Phi absorbed).
+    """
+    n = ss.phi.shape[-1]
+    eye = jnp.eye(n, dtype=dtype)
+    z_t, r_t = _masked_obs(ss, mask_t, dtype)
+
+    cov_pred = jnp.where(first, p_prior, ss.q)
+    phi_eff = jnp.where(first, jnp.zeros_like(ss.phi), ss.phi)
+
+    s = z_t @ cov_pred @ z_t.T + jnp.diag(r_t)
+    chol = jnp.linalg.cholesky(s)
+    # K = cov_pred Z' S^-1  (via Cholesky solves)
+    k = jax.scipy.linalg.cho_solve((chol, True), z_t @ cov_pred).T
+    ikh = eye - k @ z_t
+
+    a = ikh * phi_eff[None, :]  # (I - K Z) Phi, diagonal Phi
+    b = k @ y_t
+    c = ikh @ cov_pred
+    # eta = Phi' Z' S^-1 y ; J = Phi' Z' S^-1 Z Phi
+    sinv_y = jax.scipy.linalg.cho_solve((chol, True), y_t)
+    sinv_z = jax.scipy.linalg.cho_solve((chol, True), z_t)
+    eta = phi_eff * (z_t.T @ sinv_y)
+    j = (z_t.T @ sinv_z) * jnp.outer(phi_eff, phi_eff)
+    return a, b, c, j, eta
+
+
+def _filter_combine(e1, e2):
+    """Associative combine of filtering elements (e1 earlier, e2 later)."""
+    a1, b1, c1, j1, eta1 = e1
+    a2, b2, c2, j2, eta2 = e2
+    n = a1.shape[-1]
+    eye = jnp.eye(n, dtype=a1.dtype)
+
+    def comb(a1, b1, c1, j1, eta1, a2, b2, c2, j2, eta2):
+        m = jnp.linalg.solve(eye + c1 @ j2, jnp.concatenate(
+            [a1, (b1 + c1 @ eta2)[:, None], c1], axis=1))
+        m_a1, m_vec, m_c1 = m[:, :n], m[:, n], m[:, n + 1:]
+        a = a2 @ m_a1
+        b = a2 @ m_vec + b2
+        c = a2 @ m_c1 @ a2.T + c2
+        w = jnp.linalg.solve(eye + j2 @ c1, jnp.concatenate(
+            [(eta2 - j2 @ b1)[:, None], j2], axis=1))
+        eta = a1.T @ w[:, 0] + eta1
+        j = a1.T @ w[:, 1:] @ a1 + j1
+        return a, b, c, j, eta
+
+    return jax.vmap(comb)(a1, b1, c1, j1, eta1, a2, b2, c2, j2, eta2)
+
+
+@jax.jit
+def parallel_filter(ss: StateSpace, y: jnp.ndarray, mask: jnp.ndarray) -> FilterResult:
+    """Kalman filter with O(log T) depth via ``lax.associative_scan``.
+
+    Returns the same :class:`FilterResult` as the sequential
+    ``kalman_filter(store=True)``: predicted/filtered moments per step
+    and per-step likelihood terms (``sigma``, ``detf``) with identical
+    masked-data semantics.
+    """
+    dtype = ss.q.dtype
+    mask = jnp.asarray(mask, bool)
+    # zero out masked slots: unlike the sequential engines (whose gains
+    # never touch masked entries), 0-gain columns here still multiply y,
+    # and 0 * NaN would poison the scan
+    y = jnp.where(mask, jnp.asarray(y, dtype), 0.0)
+    t_steps = y.shape[0]
+    n = ss.phi.shape[-1]
+
+    # reference init: x0 ~ N(0, I) then one predict => P1- = Phi^2 + Q
+    p1p = jnp.diag(ss.phi**2).astype(dtype) + ss.q
+    first = jnp.arange(t_steps) == 0
+
+    elements = jax.vmap(
+        lambda y_t, m_t, f: _filter_element(ss, y_t, m_t, p1p, f, dtype)
+    )(y, mask, first)
+
+    a, b, c, j, eta = lax.associative_scan(_filter_combine, elements)
+    mean_f, cov_f = b, c
+
+    # predicted moments: from the filtered state one step back
+    mean_p = jnp.concatenate(
+        [jnp.zeros((1, n), dtype), mean_f[:-1] * ss.phi[None, :]], axis=0
+    )
+    cov_p = jnp.concatenate(
+        [
+            p1p[None],
+            ss.phi[None, :, None] * cov_f[:-1] * ss.phi[None, None, :]
+            + ss.q[None],
+        ],
+        axis=0,
+    )
+
+    # likelihood terms from masked innovations at the predicted state
+    def loglik_terms(y_t, mask_t, mp, pp):
+        z_t, r_t = _masked_obs(ss, mask_t, dtype)
+        v = jnp.where(mask_t, y_t - z_t @ mp, 0.0)
+        f = z_t @ pp @ z_t.T + jnp.diag(r_t)
+        chol = jnp.linalg.cholesky(f)
+        w = jax.scipy.linalg.solve_triangular(chol, v, lower=True)
+        return jnp.sum(w * w), 2.0 * jnp.sum(jnp.log(jnp.diagonal(chol)))
+
+    sigma, detf = jax.vmap(loglik_terms)(y, mask, mean_p, cov_p)
+    return FilterResult(mean_p, cov_p, mean_f, cov_f, sigma, detf)
+
+
+def _smoother_element(phi, mf, pf, mp_next, pp_next, last):
+    """Build one associative smoothing element (E, g, L)."""
+    n = phi.shape[-1]
+    # E = P^f Phi' (P^p_next)^-1 via Cholesky
+    chol = jnp.linalg.cholesky(pp_next)
+    e = jax.scipy.linalg.cho_solve((chol, True), phi[:, None] * pf.T).T
+    e = jnp.where(last, jnp.zeros((n, n), pf.dtype), e)
+    g = jnp.where(last, mf, mf - e @ mp_next)
+    l = jnp.where(last, pf, pf - e @ pp_next @ e.T)  # noqa: E741
+    return e, g, l
+
+
+def _smoother_combine(later, earlier):
+    """Combine for the reverse scan.
+
+    ``associative_scan(reverse=True)`` folds from the right, so the first
+    argument is the already-combined *suffix* (later timesteps) and the
+    second the new earlier element; the smoothing operator composes as
+    earlier ⊗ later: ``(E_e E_l, E_e g_l + g_e, E_e L_l E_e' + L_e)``.
+    """
+
+    def comb(e_l, g_l, l_l, e_e, g_e, l_e):
+        return (
+            e_e @ e_l,
+            e_e @ g_l + g_e,
+            e_e @ l_l @ e_e.T + l_e,
+        )
+
+    return jax.vmap(comb)(*later, *earlier)
+
+
+@jax.jit
+def parallel_smoother(ss: StateSpace, filtered: FilterResult) -> SmootherResult:
+    """RTS smoother with O(log T) depth via reverse associative scan."""
+    t_steps = filtered.mean_f.shape[0]
+    last = jnp.arange(t_steps) == t_steps - 1
+    # dummy next-step moments for the final element (unused: last flag)
+    mp_next = jnp.concatenate(
+        [filtered.mean_p[1:], filtered.mean_p[-1:]], axis=0
+    )
+    pp_next = jnp.concatenate([filtered.cov_p[1:], filtered.cov_p[-1:]], axis=0)
+    elements = jax.vmap(
+        lambda mf, pf, mpn, ppn, lt: _smoother_element(
+            ss.phi, mf, pf, mpn, ppn, lt
+        )
+    )(filtered.mean_f, filtered.cov_f, mp_next, pp_next, last)
+
+    _, g, l = lax.associative_scan(  # noqa: E741
+        _smoother_combine, elements, reverse=True
+    )
+    return SmootherResult(g, l)
+
+
+@functools.partial(jax.jit, static_argnames=("warmup",))
+def parallel_deviance(
+    ss: StateSpace, y: jnp.ndarray, mask: jnp.ndarray, warmup: int = 1
+) -> jnp.ndarray:
+    """-2 log L evaluated with the parallel filter (reference semantics)."""
+    from .kalman import deviance_terms
+
+    res = parallel_filter(ss, y, mask)
+    return deviance_terms(res.sigma, res.detf, mask, warmup=warmup)
+
+
+def sequence_sharded_filter(
+    ss: StateSpace,
+    y: jnp.ndarray,
+    mask: jnp.ndarray,
+    mesh,
+    axis: str = "seq",
+) -> Tuple[FilterResult, SmootherResult]:
+    """Filter + smoother with the time axis sharded over a mesh axis.
+
+    The associative-scan combine tree is what makes the time dimension
+    shardable at all: XLA partitions the element arrays over ``axis`` and
+    inserts the log-depth collectives over ICI.  Single-chip semantics
+    are unchanged (tested on the virtual CPU mesh).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    def put(x):
+        return jax.device_put(
+            x, NamedSharding(mesh, PartitionSpec(axis, *([None] * (x.ndim - 1))))
+        )
+
+    y = put(jnp.asarray(y, ss.q.dtype))
+    mask = put(jnp.asarray(mask))
+    filtered = parallel_filter(ss, y, mask)
+    smoothed = parallel_smoother(ss, filtered)
+    return filtered, smoothed
+
+
+__all__ = [
+    "parallel_deviance",
+    "parallel_filter",
+    "parallel_smoother",
+    "sequence_sharded_filter",
+]
