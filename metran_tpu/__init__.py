@@ -7,13 +7,17 @@ exact autodiff gradients of the marginal likelihood, ``vmap`` over fleets of
 models, and device-mesh sharding for multi-chip scale.
 """
 
-from . import config, data, ops, utils
+from . import config, data, io, ops, utils
+from .io import load_model, save_model
 from .utils import show_versions
 from .version import __version__
 
 __all__ = [
     "config",
     "data",
+    "io",
+    "load_model",
+    "save_model",
     "ops",
     "utils",
     "show_versions",

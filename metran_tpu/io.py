@@ -1,0 +1,238 @@
+"""Model serialization and fleet checkpoint/resume.
+
+The reference has **no** persistence at all (SURVEY.md section 5: no
+to_file/from_file anywhere; fitted state lives only in memory).  This
+module adds both layers the TPU-scale story needs:
+
+- :func:`save_model` / :func:`load_model` — a fitted :class:`Metran`
+  round-trips through a single self-contained JSON file (data, settings,
+  factor loadings, parameter table with optima/stderr, fit statistics),
+  so inference products (states, simulations, decompositions, reports)
+  are available without re-solving.
+- :func:`save_fleet_state` / :func:`load_fleet_state` — dense pytree
+  checkpoints (npz) of the chunked fleet L-BFGS used by
+  ``fit_fleet(checkpoint=...)`` for preemption-safe long runs.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+import pandas as pd
+
+FORMAT_VERSION = 1
+
+
+class LoadedFit:
+    """Fit statistics restored from disk (stands in for a solver object)."""
+
+    _name = "LoadedFit"
+
+    def __init__(self, obj_func, nfev, aic, pcov=None, pcor=None):
+        self.obj_func = obj_func
+        self.nfev = nfev
+        self.aic = aic
+        self.pcov = pcov
+        self.pcor = pcor
+
+
+def _frame_to_dict(frame: pd.DataFrame) -> dict:
+    return {
+        "index": [str(i) for i in frame.index],
+        "columns": [str(c) for c in frame.columns],
+        "values": np.where(
+            np.isfinite(frame.values.astype(float)), frame.values, None
+        ).tolist(),
+    }
+
+
+def _frame_from_dict(d: dict, datetime_index: bool = True) -> pd.DataFrame:
+    idx = pd.DatetimeIndex(d["index"]) if datetime_index else d["index"]
+    values = np.array(
+        [[np.nan if v is None else v for v in row] for row in d["values"]],
+        dtype=float,
+    )
+    return pd.DataFrame(values, index=idx, columns=d["columns"])
+
+
+def save_model(mt, path) -> Path:
+    """Serialize a (fitted or unfitted) Metran model to one JSON file."""
+    path = Path(path)
+    payload = {
+        "format_version": FORMAT_VERSION,
+        "name": mt.name,
+        "engine": mt._engine,
+        "settings": {
+            k: (str(v) if isinstance(v, pd.Timestamp) else v)
+            for k, v in mt.settings.items()
+        },
+        "file_info": {k: str(v) for k, v in mt.file_info.items()},
+        "oseries_unstd": _frame_to_dict(mt.oseries_unstd),
+        "parameters": {
+            "index": list(mt.parameters.index),
+            "columns": list(mt.parameters.columns),
+            "values": [
+                [None if (isinstance(v, float) and np.isnan(v)) else v for v in row]
+                for row in mt.parameters.where(pd.notna(mt.parameters), None)
+                .values.tolist()
+            ],
+        },
+        "factors": None if mt.factors is None else np.asarray(mt.factors).tolist(),
+        "eigval": None
+        if getattr(mt, "eigval", None) is None
+        else np.asarray(mt.eigval).tolist(),
+        "fep": getattr(mt, "fep", None),
+        "fit": None,
+    }
+    if mt.fit is not None and getattr(mt.fit, "obj_func", None) is not None:
+        payload["fit"] = {
+            "obj_func": float(mt.fit.obj_func),
+            "nfev": int(mt.fit.nfev) if mt.fit.nfev is not None else None,
+            "aic": float(mt.fit.aic) if mt.fit.aic is not None else None,
+            "pcor": None
+            if mt.fit.pcor is None
+            else {
+                "index": list(mt.fit.pcor.index),
+                "values": mt.fit.pcor.values.tolist(),
+            },
+            "pcov": None
+            if mt.fit.pcov is None
+            else {
+                "index": list(mt.fit.pcov.index),
+                "values": mt.fit.pcov.values.tolist(),
+            },
+        }
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    tmp.write_text(json.dumps(payload))
+    tmp.replace(path)
+    return path
+
+
+def load_model(path, cls=None):
+    """Rebuild a Metran model (with fitted state) from :func:`save_model`.
+
+    ``cls`` lets subclasses reconstruct as themselves (defaults to
+    :class:`Metran`).
+    """
+    from .models.metran import Metran
+
+    if cls is None:
+        cls = Metran
+    payload = json.loads(Path(path).read_text())
+    if payload.get("format_version") != FORMAT_VERSION:
+        raise ValueError(
+            f"unsupported model file format: {payload.get('format_version')}"
+        )
+    frame = _frame_from_dict(payload["oseries_unstd"])
+    settings = payload["settings"]
+    mt = cls(
+        frame,
+        name=payload["name"],
+        freq=settings.get("freq"),
+        tmin=settings.get("tmin"),
+        tmax=settings.get("tmax"),
+        engine=payload["engine"],
+    )
+    mt.settings.update(
+        {k: v for k, v in settings.items() if k not in ("freq", "tmin", "tmax")}
+    )
+
+    if payload["factors"] is not None:
+        mt.factors = np.asarray(payload["factors"], float)
+        mt.nfactors = mt.factors.shape[1]
+    if payload["eigval"] is not None:
+        mt.eigval = np.asarray(payload["eigval"], float)
+    if payload["fep"] is not None:
+        mt.fep = payload["fep"]
+
+    par = payload["parameters"]
+    values = [
+        [np.nan if v is None else v for v in row] for row in par["values"]
+    ]
+    mt.parameters = pd.DataFrame(values, index=par["index"], columns=par["columns"])
+
+    fit = payload["fit"]
+    if fit is not None:
+        pcor = pcov = None
+        if fit["pcor"] is not None:
+            pcor = pd.DataFrame(
+                fit["pcor"]["values"],
+                index=fit["pcor"]["index"],
+                columns=fit["pcor"]["index"],
+            )
+        if fit["pcov"] is not None:
+            pcov = pd.DataFrame(
+                fit["pcov"]["values"],
+                index=fit["pcov"]["index"],
+                columns=fit["pcov"]["index"],
+            )
+        mt.fit = LoadedFit(fit["obj_func"], fit["nfev"], fit["aic"], pcov, pcor)
+    return mt
+
+
+# ----------------------------------------------------------------------
+# fleet checkpoints (dense pytrees -> npz)
+# ----------------------------------------------------------------------
+def save_fleet_state(path, theta, state, frozen, prev_value, meta: dict) -> Path:
+    """Checkpoint the chunked fleet L-BFGS carry to ``path`` (npz
+    format, written atomically via a temp file)."""
+    import jax
+
+    path = Path(path)
+    leaves, _ = jax.tree_util.tree_flatten((theta, state, frozen))
+    arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
+    arrays["prev_value"] = (
+        np.asarray(prev_value) if prev_value is not None else np.zeros(0)
+    )
+    arrays["meta_json"] = np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8
+    )
+    tmp = path.with_suffix(".tmp.npz")
+    np.savez(tmp, **arrays)
+    tmp.replace(path)
+    return path
+
+
+def load_fleet_state(path, like_theta, like_state, like_frozen):
+    """Restore a fleet checkpoint into the given pytree structure.
+
+    Returns ``(theta, state, frozen, prev_value, meta)`` or ``None`` when
+    no (or an incompatible) checkpoint exists.
+    """
+    import jax
+
+    path = Path(path)
+    if not path.exists():
+        return None
+    with np.load(path, allow_pickle=False) as data:
+        if "meta_json" not in data:
+            return None
+        meta = json.loads(bytes(data["meta_json"]).decode())
+        template = (like_theta, like_state, like_frozen)
+        leaves, treedef = jax.tree_util.tree_flatten(template)
+        keys = [f"leaf_{i}" for i in range(len(leaves))]
+        # leaf count must match exactly (an extra or missing leaf means a
+        # different optimizer-state structure, e.g. another optax version)
+        n_stored = sum(1 for k in data.files if k.startswith("leaf_"))
+        if n_stored != len(leaves) or any(k not in data for k in keys):
+            return None
+        stored = [data[k] for k in keys]
+        if any(s.shape != np.shape(l) for s, l in zip(stored, leaves)):
+            return None
+        theta, state, frozen = jax.tree_util.tree_unflatten(treedef, stored)
+        prev_value = data["prev_value"]
+        prev_value = None if prev_value.size == 0 else prev_value
+    return theta, state, frozen, prev_value, meta
+
+
+__all__ = [
+    "FORMAT_VERSION",
+    "LoadedFit",
+    "load_fleet_state",
+    "load_model",
+    "save_fleet_state",
+    "save_model",
+]

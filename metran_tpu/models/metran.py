@@ -559,6 +559,23 @@ class Metran:
             print("\n" + self.metran_report())
 
     # ------------------------------------------------------------------
+    # persistence (new capability; the reference has none, SURVEY.md §5)
+    # ------------------------------------------------------------------
+    def to_file(self, path):
+        """Serialize the model (data, factors, fitted parameters, fit
+        statistics) to a single JSON file; see metran_tpu.io."""
+        from .. import io as _io
+
+        return _io.save_model(self, path)
+
+    @classmethod
+    def from_file(cls, path) -> "Metran":
+        """Load a model saved with :meth:`to_file` (as ``cls``)."""
+        from .. import io as _io
+
+        return _io.load_model(path, cls=cls)
+
+    # ------------------------------------------------------------------
     # reports
     # ------------------------------------------------------------------
     def _get_file_info(self) -> dict:

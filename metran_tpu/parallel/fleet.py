@@ -26,6 +26,7 @@ Padding semantics (all verified by tests/test_parallel.py):
 from __future__ import annotations
 
 import functools
+from logging import getLogger
 from typing import NamedTuple, Optional, Sequence
 
 import jax
@@ -37,6 +38,8 @@ from ..data import Panel
 from ..ops import deviance as _deviance
 from ..ops import dfm_statespace
 from .mesh import BATCH_AXIS, batch_sharding, pad_to_multiple
+
+logger = getLogger(__name__)
 
 ALPHA_PMIN = 1e-5  # reference lower bound for alpha (metran/metran.py:446-462)
 ALPHA_INIT = 10.0  # reference initial value
@@ -280,6 +283,7 @@ def fit_fleet(
     max_linesearch_steps: int = 16,
     alpha_max: float = ALPHA_MAX,
     stall_tol: Optional[float] = None,
+    checkpoint: Optional[str] = None,
 ) -> FleetFit:
     """Fit every model in the fleet by on-device L-BFGS.
 
@@ -315,6 +319,11 @@ def fit_fleet(
         this across a whole chunk is treated as finished (early stop at
         the float32 resolution floor).  Default off: chunking then never
         changes results vs a single dispatch.
+    checkpoint : optional file path; the optimizer carry is checkpointed
+        there after every chunk and restored on restart (preemption-safe
+        long runs — a capability the reference lacks, SURVEY.md section
+        5).  The checkpoint is invalidated automatically when shapes or
+        solver configuration change.
     """
     if p0 is None:
         p0 = default_init_params(fleet)
@@ -378,9 +387,56 @@ def fit_fleet(
     import optax.tree_utils as otu
 
     prev_value = None
+    ckpt_meta = None
+    if checkpoint is not None:
+        from .. import io as _io
+
+        def _fingerprint(*arrays):
+            # cheap content fingerprint: shapes + low-order moments, enough
+            # to reject a checkpoint from different data/init of same shape
+            parts = []
+            for a in arrays:
+                a = np.asarray(a)
+                # lists, not tuples: meta round-trips through JSON and
+                # must compare equal after load
+                parts.append(
+                    [list(a.shape), float(a.sum()), float((a * a).sum())]
+                )
+            return parts
+
+        ckpt_meta = dict(
+            maxiter=maxiter, chunk=chunk, tol=tol, engine=engine,
+            warmup=warmup, theta_cap=theta_cap, stall_tol=stall_tol,
+            max_linesearch_steps=max_linesearch_steps,
+            data=_fingerprint(
+                fleet.y, fleet.mask, fleet.loadings, fleet.dt, p0
+            ),
+        )
+        restored = _io.load_fleet_state(checkpoint, theta, state, frozen)
+        if restored is not None and restored[4] == ckpt_meta:
+            logger.info("resuming fleet fit from checkpoint %s", checkpoint)
+            theta, state, frozen, prev_value, _ = restored
+            theta = jnp.asarray(theta)
+            frozen = jnp.asarray(frozen)
+            if mesh is not None:
+                theta = jax.device_put(theta, shard(theta))
+                frozen = jax.device_put(frozen, shard(frozen))
+                state = jax.device_put(
+                    state, jax.tree.map(lambda x: shard(jnp.asarray(x)), state)
+                )
+
+    def _save_ckpt():
+        if checkpoint is not None:
+            from .. import io as _io
+
+            _io.save_fleet_state(
+                checkpoint, theta, state, frozen, prev_value, ckpt_meta
+            )
+
     for _ in range(max(-(-maxiter // chunk), 1)):
         theta, state = advance(theta, state, frozen, *data_args)
         if chunk >= maxiter:
+            _save_ckpt()
             break
         count = np.asarray(otu.tree_get(state, "count"))
         value = np.asarray(otu.tree_get(state, "value"))
@@ -397,9 +453,12 @@ def fit_fleet(
             frozen = jnp.asarray(frozen_host)
             if mesh is not None:
                 frozen = jax.device_put(frozen, shard(frozen))
+        # checkpoint AFTER the stall bookkeeping so a resumed run
+        # continues with exactly the state an uninterrupted one would have
+        prev_value = value
+        _save_ckpt()
         if done.all():
             break
-        prev_value = value
     params, value, count, conv = outputs(theta, state)
     return FleetFit(params, value, count, conv)
 

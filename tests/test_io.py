@@ -1,0 +1,122 @@
+"""Model serialization round-trips and fleet checkpoint/resume."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import metran_tpu
+from metran_tpu import data as mdata
+from metran_tpu.parallel import fit_fleet, pack_fleet
+
+
+@pytest.fixture(scope="module")
+def solved(series_list):
+    mt = metran_tpu.Metran(series_list, name="B21B0214")
+    mt.solve(report=False)
+    return mt
+
+
+def test_model_roundtrip_products(tmp_path, solved):
+    path = solved.to_file(tmp_path / "model.json")
+    mt2 = metran_tpu.Metran.from_file(path)
+
+    assert mt2.name == solved.name
+    assert mt2.nfactors == solved.nfactors
+    np.testing.assert_allclose(mt2.factors, solved.factors, rtol=1e-12)
+    # dtypes may tighten (object -> float) and missing values normalize
+    # (None -> NaN) through JSON; cell values must match semantically
+    def norm(frame):
+        return frame.map(
+            lambda v: None
+            if v is None or (isinstance(v, float) and np.isnan(v))
+            else v
+        )
+
+    assert norm(mt2.parameters).equals(norm(solved.parameters))
+    assert mt2.fit.obj_func == pytest.approx(solved.fit.obj_func)
+    assert mt2.fit.aic == pytest.approx(solved.fit.aic)
+
+    # inference products reproduce without re-solving
+    want = solved.get_simulation(solved.snames[0], alpha=0.05)
+    got = mt2.get_simulation(mt2.snames[0], alpha=0.05)
+    np.testing.assert_allclose(got.values, want.values, rtol=1e-8)
+    want_s = solved.get_state_means()
+    got_s = mt2.get_state_means()
+    np.testing.assert_allclose(got_s.values, want_s.values, rtol=1e-8)
+
+    # reports render from the restored fit statistics
+    assert "Fit report" in mt2.fit_report()
+    assert "Metran report" in mt2.metran_report()
+
+
+def test_model_roundtrip_unfitted(tmp_path, series_list):
+    mt = metran_tpu.Metran(series_list)
+    path = mt.to_file(tmp_path / "unfit.json")
+    mt2 = metran_tpu.load_model(path)
+    assert mt2.fit is None
+    assert mt2.parameters.shape[0] == mt.parameters.shape[0]
+    pd.testing.assert_frame_equal(mt2.oseries, mt.oseries)
+
+
+def _tiny_fleet(rng):
+    idx = pd.date_range("2001-01-01", periods=80, freq="D")
+    panels, loadings = [], []
+    for _ in range(3):
+        raw = rng.normal(size=(80, 4))
+        raw[rng.uniform(size=raw.shape) < 0.25] = np.nan
+        panels.append(
+            mdata.pack_panel(
+                pd.DataFrame(raw, index=idx, columns=list("abcd"))
+            )
+        )
+        loadings.append(rng.uniform(0.3, 0.8, (4, 1)))
+    return pack_fleet(panels, loadings)
+
+
+def test_fleet_checkpoint_resume(tmp_path, rng):
+    fleet = _tiny_fleet(rng)
+    ckpt = tmp_path / "fleet.npz"
+
+    full = fit_fleet(fleet, maxiter=24, chunk=6)
+    with_ckpt = fit_fleet(fleet, maxiter=24, chunk=6, checkpoint=str(ckpt))
+    assert ckpt.exists()
+    np.testing.assert_allclose(
+        np.asarray(with_ckpt.params), np.asarray(full.params), rtol=1e-9
+    )
+
+    # resume from the finished checkpoint: must actually restore
+    # (regression: a meta mismatch would silently refit from scratch)
+    import logging
+
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    handler = Capture()
+    logging.getLogger("metran_tpu.parallel.fleet").addHandler(handler)
+    logging.getLogger("metran_tpu.parallel.fleet").setLevel(logging.INFO)
+    try:
+        resumed = fit_fleet(fleet, maxiter=24, chunk=6, checkpoint=str(ckpt))
+    finally:
+        logging.getLogger("metran_tpu.parallel.fleet").removeHandler(handler)
+    assert any("resuming fleet fit" in m for m in records)
+    np.testing.assert_allclose(
+        np.asarray(resumed.params), np.asarray(full.params), rtol=1e-9
+    )
+    np.testing.assert_allclose(
+        np.asarray(resumed.deviance), np.asarray(full.deviance), rtol=1e-10
+    )
+
+
+def test_fleet_checkpoint_invalidated_on_config_change(tmp_path, rng):
+    fleet = _tiny_fleet(rng)
+    ckpt = tmp_path / "fleet.npz"
+    fit_fleet(fleet, maxiter=12, chunk=4, checkpoint=str(ckpt))
+    # different maxiter -> stale checkpoint ignored, solve still correct
+    fresh = fit_fleet(fleet, maxiter=24, chunk=6)
+    redone = fit_fleet(fleet, maxiter=24, chunk=6, checkpoint=str(ckpt))
+    np.testing.assert_allclose(
+        np.asarray(redone.params), np.asarray(fresh.params), rtol=1e-9
+    )
