@@ -1,0 +1,98 @@
+"""Fleet-scale fitting: many independent DFMs batched and mesh-sharded.
+
+What the reference cannot do at all: fit hundreds/thousands of dynamic
+factor models in one compiled program — vmapped over the fleet axis,
+L-BFGS fully on device, optionally sharded over a ``jax.sharding.Mesh``
+(data parallelism over models; on TPU pods the shards ride ICI).
+
+Run on CPU with a virtual mesh:
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/fleet_example.py
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))  # repo root
+
+import numpy as np
+import pandas as pd
+
+import jax
+
+from metran_tpu import data as mdata
+from metran_tpu.models.factoranalysis import FactorAnalysis
+from metran_tpu.parallel import (
+    fit_fleet,
+    make_mesh,
+    pack_fleet,
+    pad_to_multiple,
+)
+from metran_tpu.utils import ThroughputCounter
+
+
+def synthetic_panel(rng, n_series=8, t=730):
+    """One synthetic groundwater-like cluster (AR(1) + common factor)."""
+    idx = pd.date_range("2010-01-01", periods=t, freq="D")
+    phi_c = np.exp(-1.0 / rng.uniform(20, 60))
+    common = np.zeros(t)
+    for i in range(1, t):
+        common[i] = phi_c * common[i - 1] + rng.normal() * np.sqrt(
+            1 - phi_c**2
+        )
+    load = rng.uniform(0.5, 0.9, n_series)
+    phi_s = np.exp(-1.0 / rng.uniform(5, 30, n_series))
+    spec = np.zeros((t, n_series))
+    for i in range(1, t):
+        spec[i] = phi_s * spec[i - 1] + rng.normal(size=n_series) * np.sqrt(
+            1 - phi_s**2
+        )
+    y = spec * np.sqrt(1 - load**2) + np.outer(common, load)
+    y[rng.uniform(size=y.shape) < 0.2] = np.nan  # 20% missing
+    return pd.DataFrame(y, index=idx, columns=[f"w{i}" for i in range(n_series)])
+
+
+def main():
+    rng = np.random.default_rng(0)
+    n_models = 16
+
+    # ingest + factor analysis per model (host side, cheap)
+    panels, loadings = [], []
+    for _ in range(n_models):
+        frame = synthetic_panel(rng)
+        standardized, std, mean = mdata.standardize(frame)
+        panels.append(mdata.pack_panel(standardized, std=std, mean=mean))
+        loadings.append(FactorAnalysis().solve(standardized))
+
+    mesh = make_mesh()  # all available devices
+    fleet = pack_fleet(
+        panels, loadings,
+        pad_batch_to=pad_to_multiple(n_models, mesh.size),
+    )
+    print(
+        f"fleet: {fleet.batch} models x {fleet.y.shape[1]} steps x "
+        f"{fleet.y.shape[2]} series on {mesh.size} devices"
+    )
+
+    counter = ThroughputCounter(unit="fits")
+    with counter.measure(n=n_models):
+        # practical fleet settings: a deviance-scale tolerance plus
+        # stall-freezing (lanes that stop improving take no further
+        # iterations) keep the line search from thrashing at the
+        # floating-point resolution floor near each optimum
+        fit = fit_fleet(
+            fleet, mesh=mesh, maxiter=40, chunk=10,
+            tol=1e-2, stall_tol=0.0,
+            checkpoint="/tmp/fleet_ckpt.npz",  # preemption-safe
+        )
+        jax.block_until_ready(fit.params)
+    print(counter.summary())
+    print(
+        "deviance quantiles:",
+        np.quantile(np.asarray(fit.deviance[:n_models]), [0.1, 0.5, 0.9]).round(1),
+    )
+    print("converged:", int(np.asarray(fit.converged[:n_models]).sum()), "/", n_models)
+
+
+if __name__ == "__main__":
+    main()

@@ -107,3 +107,6 @@ def show_versions() -> None:
     except ModuleNotFoundError:
         msg += "\noptax version: not installed"
     print(msg)
+
+
+from .profiling import ThroughputCounter, annotate, trace  # noqa: E402,F401

@@ -1,0 +1,92 @@
+"""Tracing and throughput instrumentation.
+
+The reference ships no profiling at all (SURVEY.md section 5); on TPU the
+two things users actually need are (a) XLA traces viewable in
+TensorBoard/Perfetto and (b) simple fit-throughput counters for fleet
+runs.  Both are thin, dependency-free wrappers around ``jax.profiler``
+and ``time``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+from logging import getLogger
+from typing import Dict, Iterator, List, Optional
+
+logger = getLogger(__name__)
+
+
+@contextlib.contextmanager
+def trace(logdir: str, annotate: Optional[str] = None) -> Iterator[None]:
+    """Capture a device trace for the enclosed block.
+
+    Writes a TensorBoard/Perfetto-compatible trace to ``logdir``::
+
+        with metran_tpu.utils.trace("/tmp/trace"):
+            fit_fleet(fleet)
+    """
+    import jax
+
+    ctx = (
+        jax.profiler.TraceAnnotation(annotate)
+        if annotate
+        else contextlib.nullcontext()
+    )
+    jax.profiler.start_trace(logdir)
+    try:
+        with ctx:
+            yield
+    finally:
+        jax.profiler.stop_trace()
+        logger.info("device trace written to %s", logdir)
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named region that shows up on the device timeline inside a trace."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+@dataclass
+class ThroughputCounter:
+    """Accumulates throughput over repeated timed blocks.
+
+    >>> counter = ThroughputCounter(unit="fits")
+    >>> with counter.measure(n=batch):
+    ...     fit_fleet(fleet)
+    >>> counter.per_second
+    """
+
+    unit: str = "items"
+    total: int = 0
+    seconds: float = 0.0
+    laps: List[Dict] = field(default_factory=list)
+
+    @contextlib.contextmanager
+    def measure(self, n: int = 1) -> Iterator[None]:
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            elapsed = time.perf_counter() - start
+            self.total += n
+            self.seconds += elapsed
+            self.laps.append({"n": n, "seconds": elapsed})
+
+    @property
+    def per_second(self) -> float:
+        return self.total / self.seconds if self.seconds > 0 else 0.0
+
+    def summary(self) -> str:
+        return (
+            f"{self.total} {self.unit} in {self.seconds:.3f}s "
+            f"({self.per_second:.2f} {self.unit}/s over {len(self.laps)} laps)"
+        )
+
+
+__all__ = ["ThroughputCounter", "annotate", "trace"]
