@@ -1,24 +1,45 @@
-"""Benchmark: fleet DFM maximum-likelihood fits on device vs CPU reference.
+"""Benchmark: fleet DFM maximum-likelihood fits on device vs measured CPU.
 
 Workload is the BASELINE.md headline config: 20-series dynamic factor
 models (1 common factor, state dim 21), 5,000 timesteps, ~30% missing
 observations.  The device side fits a batch of B independent models with
-the fully on-device vmapped L-BFGS (`metran_tpu.parallel.fit_fleet`);
-the baseline side times the reference algorithm's sequential-processing
-filter pass on CPU (the native compiled kernel from `metran_tpu.native`
-when available — the stand-in for the reference's numba engine — else the
-plain numpy twin) and prices a CPU fit at
-``iters * (n_params + 1)`` filter passes (finite-difference L-BFGS-B, one
-pass per objective and ``n_params`` per gradient, using the same iteration
-count the device optimizer needed — conservative for the baseline).
+the fully on-device vmapped L-BFGS (``metran_tpu.parallel.fit_fleet``);
+the baseline side runs a REAL reference-equivalent CPU fit (scipy
+L-BFGS-B with finite differences over the native C++ sequential-
+processing kernel — the stand-in for the reference's numba engine) and
+times it end to end.
 
-Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": "fits/s/chip", "vs_baseline": N}
+Staging (each phase emits a progress JSON line on stderr and persists
+partial results, so a timeout localizes the failure instead of erasing
+the run):
+
+1. CPU baseline subprocess (runs in parallel with the device work).
+2. Device init (timed; a wedged tunnel is detected by subprocess timeout).
+3. Forward phase: one ``fleet_value_and_grad`` dispatch — small program,
+   compile time reported separately from run time.
+4. Fit phase: the chunked on-device L-BFGS (compile+first-run timed
+   separately from the steady-state timed run).
+5. Extra BASELINE configs (1k x 8-series forward fleet; 50-series
+   smoother + decomposition) when budget remains.
+
+If the device (tunneled TPU) cannot initialize or times out, the same
+staged benchmark reruns on the CPU backend and the result is labeled
+``"platform": "cpu"`` — a real measured number on the fallback platform
+rather than a watchdog zero.
+
+Prints ONE JSON line on stdout:
+    {"metric": ..., "value": N, "unit": "fits/s/chip", "vs_baseline": N,
+     "detail": {...}}
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import os
+import signal
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -28,13 +49,55 @@ N_FACTORS = 1
 T_STEPS = 5_000
 MISSING = 0.3
 BATCH = 32
-MAXITER = 40
+MAXITER = 60
+CHUNK = 10
+MAX_LS = 4
+# f32 convergence thresholds: the gradient-noise floor of a float32
+# deviance of magnitude ~1e5 sits far above scipy's f64 pgtol, so the
+# fleet stops on gradient norm < TOL or per-chunk objective improvement
+# < STALL_TOL (the f32 resolution floor), whichever first
+TOL = 0.05
+STALL_TOL = 1e-3
 SEED = 0
+METRIC = "DFM fits/sec/chip (20-series, 5k steps)"
+
+# smoke mode for CI / local sanity runs: tiny shapes, same code paths
+if os.environ.get("METRAN_TPU_BENCH_SMALL"):
+    T_STEPS, BATCH, MAXITER, CHUNK = 200, 4, 8, 4
+    METRIC = "DFM fits/sec/chip (SMALL smoke config)"
+
+T0 = time.monotonic()
+REPO = os.path.dirname(os.path.abspath(__file__))
+CACHE_DIR = os.path.join(REPO, ".cache")
+JAX_CACHE = os.path.join(CACHE_DIR, "jax")
 
 
-def make_workload(rng, batch):
+def elapsed() -> float:
+    return time.monotonic() - T0
+
+
+def progress(stage: str, **kw) -> None:
+    """One progress line per phase on stderr (stdout stays for the final
+    result line only)."""
+    rec = {"t": round(elapsed(), 1), "stage": stage}
+    rec.update(kw)
+    print(json.dumps(rec), file=sys.stderr, flush=True)
+
+
+def write_partial(path: str, payload: dict) -> None:
+    """Persist phase results so a killed subprocess still reports them."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(payload, fh)
+    os.replace(tmp, path)
+
+
+# ----------------------------------------------------------------------
+# workload
+# ----------------------------------------------------------------------
+def make_workload(rng, batch, n=N_SERIES, k=N_FACTORS, t=T_STEPS,
+                  missing=MISSING):
     """Synthetic standardized DFM panels with a true common factor."""
-    n, k, t = N_SERIES, N_FACTORS, T_STEPS
     loadings = rng.uniform(0.4, 0.8, (batch, n, k)) / np.sqrt(k)
     y = np.zeros((batch, t, n))
     for b in range(batch):
@@ -49,88 +112,39 @@ def make_workload(rng, batch):
             specific[i] = phi_s * specific[i - 1] + e_s[i]
         comm = np.sum(loadings[b] ** 2, axis=1)
         y[b] = specific * np.sqrt(1 - comm) + common @ loadings[b].T
-    mask = rng.uniform(size=y.shape) > MISSING
+    mask = rng.uniform(size=y.shape) > missing
     return np.where(mask, y, 0.0), mask, loadings
 
 
-def bench_device(y, mask, loadings):
-    """Time the batched on-device MLE; returns (fits/sec/chip, iters)."""
-    import jax
-    import jax.numpy as jnp
-
-    from metran_tpu.parallel import fit_fleet
-    from metran_tpu.parallel.fleet import Fleet
-
-    b = y.shape[0]
-    fleet = Fleet(
-        y=jnp.asarray(y, jnp.float32),
-        mask=jnp.asarray(mask),
-        loadings=jnp.asarray(loadings, jnp.float32),
-        dt=jnp.ones(b, jnp.float32),
-        n_series=jnp.full(b, N_SERIES, np.int32),
-    )
-    kwargs = dict(
-        engine="joint", maxiter=MAXITER, chunk=8, tol=0.5, stall_tol=0.0
-    )
-    fit = fit_fleet(fleet, **kwargs)  # compile + run
-    jax.block_until_ready(fit.params)
-    start = time.perf_counter()
-    fit = fit_fleet(fleet, **kwargs)
-    jax.block_until_ready(fit.params)
-    elapsed = time.perf_counter() - start
-    iters = float(np.mean(np.asarray(fit.iterations)))
-    return b / elapsed, iters
-
-
-def cpu_filter_pass_seconds(y, mask, loadings):
-    """Seconds for ONE sequential-processing filter pass on CPU.
-
-    Uses the compiled native kernel (metran_tpu.native) when available —
-    the honest stand-in for the reference's numba engine — else the plain
-    numpy loop implementing the same algorithm
-    (reference metran/kalmanfilter.py:122-233).
-    """
-    n, k = N_SERIES, N_FACTORS
-    alpha = np.full(n + k, 10.0)
+def _dfm_matrices(loadings, alpha):
+    """Host-side (phi, q, z, r) for the CPU sequential kernel."""
+    n, k = loadings.shape
     phi = np.exp(-1.0 / alpha)
     comm = np.sum(loadings**2, axis=1)
     q = np.diag(
         np.concatenate([(1 - phi[:n] ** 2) * (1 - comm), 1 - phi[n:] ** 2])
     )
     z = np.concatenate([np.eye(n), loadings], axis=1)
-    r = np.zeros(n)
-
-    try:
-        from metran_tpu.native import seq_filter_pass
-
-        seq_filter_pass(phi, q, z, r, y[:8], mask[:8])  # probe: builds/loads
-        runner = lambda: seq_filter_pass(phi, q, z, r, y, mask)  # noqa: E731
-        engine = "native"
-    except Exception:
-        runner = lambda: _np_filter_pass(phi, q, z, r, y, mask)  # noqa: E731
-        engine = "numpy"
-    runner()  # warm (JIT/alloc)
-    best = np.inf
-    for _ in range(2):
-        t0 = time.perf_counter()
-        runner()
-        best = min(best, time.perf_counter() - t0)
-    return best, engine
+    return phi, q, z, np.zeros(n)
 
 
-def _np_filter_pass(phi, q, z, r, y, mask):
+def _np_filter_deviance(phi, q, z, r, y, mask, warmup=1):
+    """Pure-numpy sequential-processing deviance (fallback when the
+    native kernel cannot build); same algorithm as the reference's
+    numpy twin (metran/kalmanfilter.py:122-233)."""
     t_steps, m = y.shape
     n = phi.shape[0]
     mean = np.zeros(n)
     cov = np.eye(n)
-    sigma = 0.0
-    detf = 0.0
+    sigmas, detfs, counts = [], [], np.zeros(t_steps, int)
     for t in range(t_steps):
         mean = phi * mean
         cov = phi[:, None] * cov * phi[None, :] + q
+        sigma = detf = 0.0
         for i in range(m):
             if not mask[t, i]:
                 continue
+            counts[t] += 1
             zi = z[i]
             v = y[t, i] - zi @ mean
             d = cov @ zi
@@ -140,61 +154,409 @@ def _np_filter_pass(phi, q, z, r, y, mask):
             mean = mean + kgain * v
             sigma += v * v / f
             detf += np.log(f)
-    return sigma, detf
+        sigmas.append(sigma)
+        detfs.append(detf)
+    observed = np.flatnonzero(counts > 0)
+    keep = observed[warmup:]
+    nobs = counts[warmup:].sum()
+    sig = np.asarray(sigmas)
+    det = np.asarray(detfs)
+    return nobs * np.log(2 * np.pi) + det[keep].sum() + sig[keep].sum()
 
 
-def main():
-    import signal
-    import sys
+# ----------------------------------------------------------------------
+# phase: CPU baseline (measured, not modeled)
+# ----------------------------------------------------------------------
+def run_cpu_baseline(out_path: str, budget_s: float) -> None:
+    """Time a real reference-equivalent fit: scipy L-BFGS-B with
+    finite-difference gradients over the native sequential kernel
+    (reference: metran/solver.py:222-288 + kalmanfilter.py:236-400)."""
+    from scipy.optimize import minimize
 
-    def _watchdog(signum, frame):
-        # a wedged device tunnel must not hang the driver: report failure
-        # as a JSON line and exit nonzero
-        print(
-            json.dumps(
-                {
-                    "metric": "DFM fits/sec/chip (20-series, 5k steps)",
-                    "value": 0.0,
-                    "unit": "fits/s/chip",
-                    "vs_baseline": 0.0,
-                    "error": "watchdog: device call exceeded 1200s",
-                }
-            )
-        )
-        sys.stdout.flush()
-        sys.exit(1)
-
-    signal.signal(signal.SIGALRM, _watchdog)
-    signal.alarm(1200)
-
+    # model 0 of the SAME batch workload the device fits, so the final
+    # deviances are directly comparable (parity evidence, not just speed)
     rng = np.random.default_rng(SEED)
     y, mask, loadings = make_workload(rng, BATCH)
-
-    fits_per_sec, iters = bench_device(y, mask, loadings)
-
-    pass_s, engine = cpu_filter_pass_seconds(y[0], mask[0], loadings[0])
+    y, mask, ld = y[0], mask[0], loadings[0]
     n_params = N_SERIES + N_FACTORS
-    cpu_fit_s = max(iters, 1.0) * (n_params + 1) * pass_s
-    cpu_fits_per_sec = 1.0 / cpu_fit_s
+    out = {"engine": None}
 
-    print(
-        json.dumps(
-            {
-                "metric": "DFM fits/sec/chip (20-series, 5k steps)",
-                "value": round(fits_per_sec, 3),
-                "unit": "fits/s/chip",
-                "vs_baseline": round(fits_per_sec / cpu_fits_per_sec, 1),
-                "detail": {
-                    "batch": BATCH,
-                    "lbfgs_iters_mean": round(iters, 1),
-                    "cpu_baseline_engine": engine,
-                    "cpu_filter_pass_s": round(pass_s, 4),
-                    "cpu_fit_s_est": round(cpu_fit_s, 2),
-                },
-            }
+    try:
+        from metran_tpu import native
+
+        native.load()
+        dev = lambda phi, q, z, r: native.deviance(  # noqa: E731
+            phi, q, z, r, y, mask, warmup=1
         )
+        out["engine"] = "native"
+    except Exception as e:  # pragma: no cover - toolchain-less hosts
+        progress("cpu_native_unavailable", error=str(e)[-200:])
+        dev = lambda phi, q, z, r: _np_filter_deviance(  # noqa: E731
+            phi, q, z, r, y, mask
+        )
+        out["engine"] = "numpy"
+
+    def objective(alpha):
+        return dev(*_dfm_matrices(ld, alpha))
+
+    x0 = np.full(n_params, 10.0)
+    objective(x0)  # warm (build/load)
+    t0 = time.perf_counter()
+    objective(x0)
+    pass_s = time.perf_counter() - t0
+    out["filter_pass_s"] = round(pass_s, 4)
+    progress("cpu_pass_timed", pass_s=out["filter_pass_s"])
+    write_partial(out_path, out)
+
+    # cap the fit's function evaluations to the child's time budget; if
+    # the cap binds, the timing still measures real optimizer progress
+    # and `capped` records that convergence was cut short
+    maxfun = int(max(100, min((budget_s - elapsed() - 10) / pass_s, 20000)))
+    t0 = time.perf_counter()
+    res = minimize(
+        objective, x0=x0, method="l-bfgs-b",
+        bounds=[(1e-5, None)] * n_params, options={"maxfun": maxfun},
+    )
+    fit_s = time.perf_counter() - t0
+    out.update(
+        fit_s=round(fit_s, 2),
+        nfev=int(res.nfev),
+        iterations=int(res.nit),
+        converged=bool(res.success),
+        capped=bool(res.nfev >= maxfun),
+        deviance=float(res.fun),
+        optimal_alpha_first=float(res.x[0]),
+    )
+    progress("cpu_fit_done", **{k: out[k] for k in
+                                ("fit_s", "nfev", "iterations", "converged")})
+    write_partial(out_path, out)
+
+
+# ----------------------------------------------------------------------
+# phase: device benchmark (runs in its own subprocess)
+# ----------------------------------------------------------------------
+def run_device_bench(out_path: str, budget_s: float,
+                     force_cpu: bool = False) -> None:
+    if force_cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", JAX_CACHE)
+
+    def left() -> float:
+        return budget_s - elapsed()
+
+    progress("device_init_start",
+             platform=os.environ.get("JAX_PLATFORMS", "default"))
+    import jax
+
+    if force_cpu:
+        jax.config.update("jax_platforms", "cpu")
+    t0 = time.perf_counter()
+    devices = jax.devices()
+    init_s = time.perf_counter() - t0
+    platform = devices[0].platform
+    out = {
+        "platform": platform,
+        "n_devices": len(devices),
+        "device_init_s": round(init_s, 1),
+    }
+    progress("device_init_done", platform=platform, init_s=out["device_init_s"])
+    write_partial(out_path, out)
+
+    import jax.numpy as jnp
+
+    from metran_tpu.parallel import fit_fleet, fleet_value_and_grad
+    from metran_tpu.parallel.fleet import Fleet, default_init_params
+    from metran_tpu.utils.profiling import ThroughputCounter
+
+    batch = min(2, BATCH) if force_cpu else BATCH
+    rng = np.random.default_rng(SEED)
+    # always generate the full-batch workload and slice, so model 0 is
+    # identical across the device run, the CPU fallback and the CPU
+    # baseline (deviances comparable)
+    y, mask, loadings = make_workload(rng, BATCH)
+    y, mask, loadings = y[:batch], mask[:batch], loadings[:batch]
+    fleet = Fleet(
+        y=jnp.asarray(y, jnp.float32),
+        mask=jnp.asarray(mask),
+        loadings=jnp.asarray(loadings, jnp.float32),
+        dt=jnp.ones(batch, jnp.float32),
+        n_series=jnp.full(batch, N_SERIES, np.int32),
+    )
+    params0 = default_init_params(fleet)
+    progress("workload_ready", batch=batch)
+
+    # ---- forward: one deviance+grad dispatch (small program) ----------
+    t0 = time.perf_counter()
+    val, grad = fleet_value_and_grad(params0, fleet)
+    jax.block_until_ready((val, grad))
+    fwd_compile_s = time.perf_counter() - t0
+    fwd = ThroughputCounter(unit="passes")
+    reps = 3
+    for _ in range(reps):
+        with fwd.measure(n=batch):
+            v, g = fleet_value_and_grad(params0, fleet)
+            jax.block_until_ready((v, g))
+    out["forward"] = {
+        "compile_plus_first_run_s": round(fwd_compile_s, 2),
+        "passes_per_s": round(fwd.per_second, 3),
+    }
+    progress("forward_done", **out["forward"])
+    write_partial(out_path, out)
+
+    # ---- fit: chunked on-device L-BFGS --------------------------------
+    kwargs = dict(engine="joint", maxiter=MAXITER, chunk=CHUNK, tol=TOL,
+                  stall_tol=STALL_TOL, max_linesearch_steps=MAX_LS)
+    t0 = time.perf_counter()
+    fit = fit_fleet(fleet, **kwargs)
+    jax.block_until_ready(fit.params)
+    fit_compile_s = time.perf_counter() - t0
+    iters = float(np.mean(np.asarray(fit.iterations)))
+    progress("fit_compiled", compile_plus_first_run_s=round(fit_compile_s, 1),
+             iters_mean=round(iters, 1))
+    counter = ThroughputCounter(unit="fits")
+    with counter.measure(n=batch):
+        fit = fit_fleet(fleet, **kwargs)
+        jax.block_until_ready(fit.params)
+    out["fit"] = {
+        "compile_plus_first_run_s": round(fit_compile_s, 1),
+        "run_s": round(counter.seconds, 2),
+        "fits_per_s": round(counter.per_second, 3),
+        "lbfgs_iters_mean": round(iters, 1),
+        "converged_frac": round(float(np.mean(np.asarray(fit.converged))), 3),
+        "deviance_model0": float(np.asarray(fit.deviance)[0]),
+        "batch": batch,
+    }
+    progress("fit_done", **{k: out["fit"][k] for k in
+                            ("run_s", "fits_per_s", "lbfgs_iters_mean")})
+    write_partial(out_path, out)
+
+    # ---- extra BASELINE configs, budget permitting --------------------
+    if left() > 240:  # config 3: 1k x 8-series vmap fleet, forward+grad
+        try:
+            b3, n3, t3 = (1024, 8, 1000) if not force_cpu else (64, 8, 200)
+            y3, m3, ld3 = make_workload(
+                np.random.default_rng(1), b3, n=n3, k=1, t=t3
+            )
+            fleet3 = Fleet(
+                y=jnp.asarray(y3, jnp.float32),
+                mask=jnp.asarray(m3),
+                loadings=jnp.asarray(ld3, jnp.float32),
+                dt=jnp.ones(b3, jnp.float32),
+                n_series=jnp.full(b3, n3, np.int32),
+            )
+            p3 = default_init_params(fleet3)
+            t0 = time.perf_counter()
+            v, g = fleet_value_and_grad(p3, fleet3)
+            jax.block_until_ready((v, g))
+            c3 = time.perf_counter() - t0
+            cnt = ThroughputCounter(unit="passes")
+            for _ in range(3):
+                with cnt.measure(n=b3):
+                    v, g = fleet_value_and_grad(p3, fleet3)
+                    jax.block_until_ready((v, g))
+            out["config3_vmap_fleet"] = {
+                "batch": b3, "n_series": n3, "t": t3,
+                "compile_plus_first_run_s": round(c3, 1),
+                "grad_passes_per_s": round(cnt.per_second, 1),
+            }
+            progress("config3_done", **out["config3_vmap_fleet"])
+            write_partial(out_path, out)
+        except Exception as e:  # extra configs must not sink the run
+            progress("config3_failed", error=str(e)[-200:])
+
+    if left() > 180:  # config 5: 50-series smoother + decomposition
+        try:
+            from metran_tpu.ops import (
+                decompose_states, dfm_statespace, kalman_filter, project,
+                rts_smoother,
+            )
+
+            n5, t5 = (50, 5000) if not force_cpu else (50, 500)
+            y5, m5, ld5 = make_workload(
+                np.random.default_rng(2), 1, n=n5, k=1, t=t5
+            )
+            dtype = jnp.float32
+            ss5 = dfm_statespace(
+                jnp.full(n5, 10.0, dtype), jnp.full(1, 10.0, dtype),
+                jnp.asarray(ld5[0], dtype), 1.0,
+            )
+            y5j = jnp.asarray(y5[0], dtype)
+            m5j = jnp.asarray(m5[0])
+
+            def smooth_decompose():
+                filt = kalman_filter(ss5, y5j, m5j, engine="joint")
+                sm = rts_smoother(ss5, filt)
+                sim = project(ss5.z, sm.mean_s, sm.cov_s)
+                dec = decompose_states(ss5.z, sm.mean_s, n5)
+                return sim, dec
+
+            t0 = time.perf_counter()
+            jax.block_until_ready(smooth_decompose())
+            c5 = time.perf_counter() - t0
+            cnt = ThroughputCounter(unit="runs")
+            for _ in range(3):
+                with cnt.measure(n=1):
+                    jax.block_until_ready(smooth_decompose())
+            out["config5_smoother"] = {
+                "n_series": n5, "t": t5, "missing": MISSING,
+                "compile_plus_first_run_s": round(c5, 1),
+                "smooth_decompose_per_s": round(cnt.per_second, 2),
+            }
+            progress("config5_done", **out["config5_smoother"])
+            write_partial(out_path, out)
+        except Exception as e:
+            progress("config5_failed", error=str(e)[-200:])
+
+
+# ----------------------------------------------------------------------
+# orchestrator
+# ----------------------------------------------------------------------
+def _read_json(path: str):
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except Exception:
+        return None
+
+
+def _spawn(phase: str, out_path: str, budget: float, extra_env=None):
+    env = dict(os.environ)
+    if extra_env:
+        env.update(extra_env)
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--phase", phase,
+         "--out", out_path, "--budget", str(budget)],
+        stdout=subprocess.DEVNULL, env=env,
     )
 
 
+def _wait(proc, timeout: float, label: str) -> bool:
+    try:
+        proc.wait(timeout=max(timeout, 1.0))
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        progress(f"{label}_timeout", timeout_s=round(timeout, 0))
+        proc.kill()
+        proc.wait()
+        return False
+
+
+def _wait_device(proc, out_path: str, deadline: float,
+                 init_timeout: float) -> bool:
+    """Wait for the device child, killing it EARLY if device init never
+    completes (wedged tunnel) so the CPU fallback gets real budget."""
+    init_deadline = time.monotonic() + init_timeout
+    while True:
+        try:
+            proc.wait(timeout=5.0)
+            return proc.returncode == 0
+        except subprocess.TimeoutExpired:
+            pass
+        now = time.monotonic()
+        part = _read_json(out_path)
+        initialized = part is not None and "device_init_s" in part
+        if not initialized and now > init_deadline:
+            progress("device_init_timeout", timeout_s=round(init_timeout, 0))
+            proc.kill()
+            proc.wait()
+            return False
+        if now > deadline:
+            progress("device_timeout")
+            proc.kill()
+            proc.wait()
+            return False
+
+
+def main() -> None:
+    budget = float(os.environ.get("METRAN_TPU_BENCH_BUDGET_S", "1100"))
+    os.makedirs(JAX_CACHE, exist_ok=True)
+
+    final = {"metric": METRIC, "value": 0.0, "unit": "fits/s/chip",
+             "vs_baseline": 0.0}
+
+    def emit_and_exit(code: int = 0):
+        print(json.dumps(final), flush=True)
+        sys.exit(code)
+
+    def on_alarm(signum, frame):
+        final.setdefault("detail", {})["error"] = (
+            f"bench watchdog fired at {budget + 60:.0f}s"
+        )
+        emit_and_exit(1)
+
+    signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(int(budget) + 60)
+
+    cpu_path = os.path.join(CACHE_DIR, "bench_cpu.json")
+    dev_path = os.path.join(CACHE_DIR, "bench_device.json")
+    for p in (cpu_path, dev_path):
+        if os.path.exists(p):
+            os.remove(p)
+
+    # CPU baseline and device bench run in parallel subprocesses; a
+    # wedged TPU tunnel therefore cannot hang the whole benchmark
+    # JAX_PLATFORMS=cpu + blanking the TPU-plugin autoregistration var
+    # makes CPU children immune to a wedged device tunnel
+    cpu_env = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}
+    cpu_budget = min(500.0, budget * 0.45)
+    cpu_proc = _spawn("cpu", cpu_path, cpu_budget, cpu_env)
+    device_budget = budget - 180.0
+    dev_proc = _spawn("device", dev_path, device_budget)
+
+    init_timeout = float(
+        os.environ.get("METRAN_TPU_BENCH_INIT_TIMEOUT_S", "300")
+    )
+    _wait_device(dev_proc, dev_path, T0 + device_budget, init_timeout)
+    device = _read_json(dev_path) or {}
+
+    if "fit" not in device:
+        # tunneled TPU failed or timed out: rerun the staged benchmark on
+        # the CPU backend so the round still produces a measured number
+        progress("device_fallback_cpu", reason="no fit result from device")
+        fb_path = os.path.join(CACHE_DIR, "bench_device_cpu.json")
+        if os.path.exists(fb_path):
+            os.remove(fb_path)
+        fb_budget = max(budget - elapsed() - 60.0, 120.0)
+        fb_proc = _spawn("device-cpu", fb_path, fb_budget, cpu_env)
+        _wait(fb_proc, fb_budget, "device_cpu")
+        fallback = _read_json(fb_path) or {}
+        if "fit" in fallback or "forward" in fallback:
+            fallback["tpu_attempt"] = device or {"error": "no output"}
+            device = fallback
+
+    _wait(cpu_proc, max(budget - elapsed() - 20.0, 5.0), "cpu_baseline")
+    cpu = _read_json(cpu_path) or {}
+
+    detail = {"device": device, "cpu_baseline": cpu,
+              "workload": {"n_series": N_SERIES, "n_factors": N_FACTORS,
+                           "t_steps": T_STEPS, "missing": MISSING,
+                           "maxiter": MAXITER, "tol": TOL}}
+    final["detail"] = detail
+
+    fit = device.get("fit")
+    if fit:
+        final["value"] = fit["fits_per_s"]
+        final["platform"] = device.get("platform", "unknown")
+    if fit and cpu.get("fit_s"):
+        cpu_fits_per_s = 1.0 / cpu["fit_s"]
+        final["vs_baseline"] = round(fit["fits_per_s"] / cpu_fits_per_s, 1)
+        detail["cpu_fit_s_measured"] = cpu["fit_s"]
+    progress("final", value=final["value"], vs_baseline=final["vs_baseline"])
+    emit_and_exit(0 if final["value"] > 0 else 1)
+
+
 if __name__ == "__main__":
-    main()
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--phase", default="main",
+                        choices=["main", "cpu", "device", "device-cpu"])
+    parser.add_argument("--out", default=None)
+    parser.add_argument("--budget", type=float, default=900.0)
+    args = parser.parse_args()
+    if args.phase == "main":
+        main()
+    elif args.phase == "cpu":
+        run_cpu_baseline(args.out, args.budget)
+    elif args.phase == "device":
+        run_device_bench(args.out, args.budget)
+    else:  # device-cpu fallback
+        run_device_bench(args.out, args.budget, force_cpu=True)

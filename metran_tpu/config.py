@@ -56,5 +56,19 @@ def default_dtype():
     return jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
 
 
+def is_accelerator() -> bool:
+    """True when the default JAX backend is an accelerator (TPU/GPU).
+
+    Drives backend-aware defaults: on an accelerator ``Metran`` picks the
+    batched-update filter engine and the on-device ``JaxSolve`` solver so
+    a naive ``Metran(series).solve()`` stays on device; on CPU the
+    reference-parity defaults (sequential engine, ``ScipySolve``) apply.
+    """
+    try:
+        return jax.default_backend() != "cpu"
+    except Exception:  # backend init failure: fall back to CPU behavior
+        return False
+
+
 if os.environ.get("METRAN_TPU_X64", "").lower() in ("1", "true", "yes"):
     enable_x64(True)

@@ -67,10 +67,18 @@ def combine_series(
     Mirrors the reference's input handling (``metran/metran.py:509-567``):
     lists/tuples of Series or single-column DataFrames are concatenated;
     unnamed series get ``Series{i+1}`` names; fewer than 2 series raises.
+    Objects exposing a pandas ``.series`` attribute (duck-typed
+    ``pastas.TimeSeries``, accepted by the reference at
+    ``metran/metran.py:536-538``) are unwrapped, preserving drop-in
+    compatibility without a pastas dependency.
     """
     if isinstance(oseries, (list, tuple)):
         collected = []
         for i, os in enumerate(oseries):
+            if not isinstance(os, (pd.Series, pd.DataFrame)) and isinstance(
+                getattr(os, "series", None), (pd.Series, pd.DataFrame)
+            ):
+                os = os.series  # pastas.TimeSeries-like wrapper
             if isinstance(os, pd.DataFrame):
                 if os.shape[1] > 1:
                     msg = "One or more series have DataFrame with multiple columns"

@@ -63,11 +63,13 @@ class Metran:
     tmin, tmax : str, optional
         Start/end of the analysis period.
     engine : str, optional
-        Kalman engine: "sequential" (default, parity with the reference's
+        Kalman engine: "sequential" (parity with the reference's
         sequential processing), "joint" (batched Cholesky update) or
         "parallel" (associative-scan parallel-in-time filter/smoother,
         O(log T) depth).  The reference's "numba"/"numpy" names are
-        accepted aliases of "sequential".
+        accepted aliases of "sequential".  Default: backend-aware —
+        "sequential" on CPU (reference parity), "joint" on accelerators
+        (MXU-friendly batched updates).
     """
 
     def __init__(
@@ -77,11 +79,13 @@ class Metran:
         freq: Optional[str] = None,
         tmin=None,
         tmax=None,
-        engine: str = "sequential",
+        engine: Optional[str] = None,
     ):
-        from ..config import ensure_precision
+        from ..config import ensure_precision, is_accelerator
 
         ensure_precision()
+        if engine is None:
+            engine = "joint" if is_accelerator() else "sequential"
         self.settings = {
             "tmin": None,
             "tmax": None,
@@ -522,7 +526,10 @@ class Metran:
         Parameters
         ----------
         solver : solver class (not instance), optional
-            e.g. ``ScipySolve`` (default) or ``JaxSolve``.
+            e.g. ``ScipySolve`` or ``JaxSolve``.  Default: backend-aware
+            — ``ScipySolve`` on CPU (reference parity), ``JaxSolve`` on
+            accelerators (the whole L-BFGS loop runs on device; no
+            host round-trip per iteration).
         report : bool, optional
             Print fit and metran reports when done.
         engine : str, optional
@@ -539,7 +546,14 @@ class Metran:
 
         if solver is None:
             if self.fit is None:
-                self.fit = ScipySolve(mt=self)
+                from ..config import is_accelerator
+
+                if is_accelerator():
+                    from .solver import JaxSolve
+
+                    self.fit = JaxSolve(mt=self)
+                else:
+                    self.fit = ScipySolve(mt=self)
         elif self.fit is None or not isinstance(self.fit, solver):
             self.fit = solver(mt=self)
         self.settings["solver"] = self.fit._name

@@ -1,194 +1,224 @@
-"""Plotting helpers exposed as ``mt.plots`` (reference: ``metran/plots.py``).
+"""Visualization for Metran models, exposed as ``mt.plots``.
 
-Same plot surface: scree plot, stacked state means, per-series simulation
-with observations and confidence band, and sdf/cdf decomposition (optionally
-split over axes with height ratios).
+Covers the reference's plot surface (``metran/plots.py``: scree plot,
+stacked state means, simulations with confidence bands, sdf/cdf
+decompositions) with an independent implementation.  The visual
+conventions — specific factors in blue (C0), common factors cycling from
+red (C3), legends above the axes, gridded panels with data-driven height
+ratios — match the reference so figures stay familiar to its users, but
+the code is organized around small layout/style helpers (`_stack`,
+`_component_style`, `_window`) instead of per-method gridspec wrangling.
 """
 
 from __future__ import annotations
 
+from typing import List, NamedTuple, Optional, Sequence, Tuple
+
 import matplotlib.pyplot as plt
 import numpy as np
-from pandas import Timestamp
+from pandas import DataFrame, Timestamp
 
 from ..utils import get_height_ratios
 
+_PANEL_W = 10.0  # house figure width
+_PANEL_H = 2.0  # per-panel height in stacked figures
+
+
+class _Style(NamedTuple):
+    color: str
+    label: str
+    zorder: int
+
+
+def _component_style(column: str, cdf_rank: int = 0) -> _Style:
+    """House style for a state/decomposition column.
+
+    Specific factors ("<series>_sdf") draw in blue on top; the k-th common
+    factor ("cdf<k>") draws behind in the red-onward color cycle.
+    """
+    if column.startswith("cdf"):
+        color = f"C{3 + cdf_rank % 10}"
+        label = f"common dynamic factor {column[len('cdf'):]}"
+        return _Style(color, label, 2)
+    series = column[: -len("_sdf")] if column.endswith("_sdf") else column
+    return _Style("C0", f"specific dynamic factor {series}", 3)
+
+
+def _decorate(ax) -> None:
+    """Grid plus the house legend: above the axis, unframed, 3 columns."""
+    ax.grid(visible=True)
+    ax.legend(loc=(0, 1), ncol=3, frameon=False, numpoints=3)
+
+
+def _window(index, tmin, tmax) -> Tuple:
+    """Resolve a (tmin, tmax) request against a DatetimeIndex."""
+    lo = index[0] if tmin is None else Timestamp(tmin)
+    hi = index[-1] if tmax is None else Timestamp(tmax)
+    return lo, hi
+
+
+def _panel_limits(frame: DataFrame, lo, hi) -> List[Tuple[float, float]]:
+    """Per-column (min, max) over the plot window, for height ratios."""
+    visible = frame.loc[lo:hi]
+    return [(float(visible[c].min()), float(visible[c].max())) for c in frame]
+
+
+def _stack(n_panels: int, ratios: Sequence[float], height: Optional[float] = None):
+    """A shared-x column of axes whose heights follow ``ratios``."""
+    fig = plt.figure(figsize=(_PANEL_W, height or n_panels * _PANEL_H))
+    grid = fig.add_gridspec(nrows=n_panels, ncols=1, height_ratios=list(ratios))
+    axes: List = []
+    for row in range(n_panels):
+        axes.append(fig.add_subplot(grid[row], sharex=axes[0] if axes else None))
+    return fig, axes
+
 
 class MetranPlot:
-    """Plots available directly from the Metran class."""
+    """Plotting namespace bound to a solved :class:`Metran` model."""
 
     def __init__(self, mt):
         self.mt = mt
 
+    # -- factor analysis ------------------------------------------------
     def scree_plot(self):
         """Eigenvalue scree plot of the factor analysis."""
-        n_ev = np.arange(self.mt.eigval.shape[0]) + 1
-        fig, ax = plt.subplots(1, 1, figsize=(10, 4))
-        ax.plot(n_ev, self.mt.eigval, marker="o", ms=7, mfc="none", c="C3")
-        ax.bar(n_ev, self.mt.eigval, facecolor="none", edgecolor="C0", linewidth=2)
-        ax.grid(visible=True)
-        ax.set_xticks(n_ev)
-        ax.set_ylabel("eigenvalue")
+        eigval = np.asarray(self.mt.eigval)
+        rank = 1 + np.arange(eigval.shape[0])
+        fig, ax = plt.subplots(figsize=(_PANEL_W, 4))
+        ax.bar(rank, eigval, facecolor="none", edgecolor="C0", linewidth=2)
+        ax.plot(rank, eigval, marker="o", ms=7, mfc="none", color="C3")
+        ax.set_xticks(rank)
         ax.set_xlabel("eigenvalue number")
+        ax.set_ylabel("eigenvalue")
+        ax.grid(visible=True)
         fig.tight_layout()
         return ax
 
+    # -- states ---------------------------------------------------------
     def state_means(self, tmin=None, tmax=None, adjust_height=True):
-        """Stacked plots of all smoothed specific/common state means."""
+        """Stacked panels of every smoothed state mean (sdf + cdf)."""
         states = self.mt.get_state_means()
-        tmin = states.index[0] if tmin is None else tmin
-        tmax = states.index[-1] if tmax is None else tmax
-
-        ylims = []
-        if adjust_height:
-            for s in states:
-                hs = states.loc[tmin:tmax, s]
-                ylims.append((float(hs.min()), float(hs.max())))
-            hrs = get_height_ratios(ylims)
-        else:
-            hrs = [1] * states.columns.size
-
-        fig = plt.figure(figsize=(10, states.columns.size * 2))
-        gs = fig.add_gridspec(ncols=1, nrows=states.columns.size, height_ratios=hrs)
-
-        ax0 = None
-        for i, col in enumerate(states.columns):
-            iax = fig.add_subplot(gs[i], sharex=ax0)
-            if ax0 is None:
-                ax0 = iax
-            if col.startswith("cdf"):
-                c, lbl = "C3", f"common dynamic factor {col[3:]}"
-            else:
-                c, lbl = "C0", f"specific dynamic factor {col.replace('_sdf', '')}"
-            states.loc[:, col].plot(ax=iax, label=lbl, color=c)
-            iax.legend(loc=(0, 1), ncol=3, frameon=False, numpoints=3)
-            iax.grid(visible=True)
-            if adjust_height:
-                iax.set_ylim(ylims[i])
-        iax.set_xlabel("")
+        lo, hi = _window(states.index, tmin, tmax)
+        limits = _panel_limits(states, lo, hi) if adjust_height else None
+        ratios = (
+            get_height_ratios(limits)
+            if adjust_height
+            else np.ones(states.columns.size)
+        )
+        fig, axes = _stack(states.columns.size, ratios)
+        cdf_rank = 0
+        for ax, column in zip(axes, states.columns):
+            style = _component_style(column, cdf_rank=cdf_rank)
+            cdf_rank += column.startswith("cdf")
+            ax.plot(states.index, states[column], color=style.color,
+                    label=style.label)
+            _decorate(ax)
+        if limits is not None:
+            for ax, lim in zip(axes, limits):
+                ax.set_ylim(lim)
+        axes[-1].set_xlabel("")
         fig.tight_layout()
         return fig.axes
 
+    # -- simulations ----------------------------------------------------
     def simulation(self, name, alpha=0.05, tmin=None, tmax=None, ax=None):
-        """Simulated mean + observations (+ confidence band) for a series."""
+        """Simulated mean for one series, with observations and CI band."""
         sim = self.mt.get_simulation(name, alpha=alpha)
         obs = self.mt.get_observations(
-            standardized=False, masked=self.mt.masked_observations is not None
-        ).loc[:, name]
-
-        tmin = sim.index[0] if tmin is None else Timestamp(tmin)
-        tmax = sim.index[-1] if tmax is None else Timestamp(tmax)
-
-        created_fig = None
-        if ax is None:
-            created_fig, ax = plt.subplots(1, 1, figsize=(10, 4))
-
-        if alpha is None:
-            ax.plot(sim.index, sim, label=f"simulation {name}")
-        else:
-            ax.plot(sim.index, sim["mean"], label=f"simulation {name}")
-            ax.fill_between(
-                sim.index,
-                sim["lower"],
-                sim["upper"],
-                color="gray",
-                alpha=0.5,
-                label=f"{1 - alpha:.0%}-confidence interval",
-            )
-        ax.plot(
-            obs.index, obs, marker=".", ms=3, color="k", ls="none", label="observations"
-        )
-        ax.legend(loc=(0, 1), ncol=3, frameon=False, numpoints=3)
-        ax.grid(visible=True)
-        ax.set_xlim(tmin, tmax)
-        if created_fig is not None:
-            created_fig.tight_layout()
-        return ax
-
-    def simulations(self, alpha=0.05, tmin=None, tmax=None):
-        """Simulation plot per observed series, shared axes."""
-        nrows = len(self.mt.snames)
-        fig, axes = plt.subplots(
-            nrows, 1, sharex=True, sharey=True, figsize=(10, nrows * 2)
-        )
-        for i, name in enumerate(self.mt.snames):
-            self.simulation(name, alpha=alpha, tmin=tmin, tmax=tmax, ax=axes.flat[i])
-        fig.tight_layout()
-        return axes
-
-    def decomposition(
-        self,
-        name,
-        tmin=None,
-        tmax=None,
-        ax=None,
-        split=False,
-        adjust_height=True,
-        **kwargs,
-    ):
-        """Plot the sdf + cdf decomposition of a simulated series."""
-        decomposition = self.mt.decompose_simulation(name, **kwargs)
-        tmin = decomposition.index[0] if tmin is None else tmin
-        tmax = decomposition.index[-1] if tmax is None else tmax
+            standardized=False,
+            masked=self.mt.masked_observations is not None,
+        )[name]
 
         fig = None
         if ax is None:
-            if adjust_height and split:
-                ylims = [
-                    (
-                        float(decomposition.loc[tmin:tmax, s].min()),
-                        float(decomposition.loc[tmin:tmax, s].max()),
-                    )
-                    for s in decomposition
-                ]
-                hrs = get_height_ratios(ylims)
-            elif split:
-                ylims, hrs = None, [1] * decomposition.columns.size
-            else:
-                ylims, hrs = None, [1]
-            nrows = decomposition.columns.size if split else 1
-            fig = plt.figure(figsize=(10, 6 if split else 4))
-            gs = fig.add_gridspec(ncols=1, nrows=nrows, height_ratios=hrs)
-
-        cdfcount = 0
-        iax = ax
-        ax0 = None
-        for i, col in enumerate(decomposition.columns):
-            if fig is not None and (i == 0 or split):
-                iax = fig.add_subplot(gs[i], sharex=ax0)
-                if ax0 is None:
-                    ax0 = iax
-            if col.startswith("cdf"):
-                c = f"C{3 + cdfcount % 10}"
-                cdfcount += 1
-                zorder = 2
-            else:
-                c, zorder = "C0", 3
-            s = decomposition[col]
-            iax.plot(s.index, s, label=f"{col} {name}", color=c, zorder=zorder)
-            iax.grid(visible=True)
-            iax.legend(loc=(0, 1), ncol=3, frameon=False, numpoints=3)
-            if fig is not None and split and adjust_height and ylims is not None:
-                iax.set_ylim(ylims[i])
+            fig, ax = plt.subplots(figsize=(_PANEL_W, 4))
+        if alpha is None:  # point simulation only — sim is a Series
+            ax.plot(sim.index, np.asarray(sim), label=f"simulation {name}")
+        else:
+            ax.plot(sim.index, sim["mean"], label=f"simulation {name}")
+            ax.fill_between(
+                sim.index, sim["lower"], sim["upper"], color="gray",
+                alpha=0.5, label=f"{1 - alpha:.0%}-confidence interval",
+            )
+        ax.plot(obs.index, obs, ls="none", marker=".", ms=3, color="k",
+                label="observations")
+        _decorate(ax)
+        ax.set_xlim(_window(sim.index, tmin, tmax))
         if fig is not None:
             fig.tight_layout()
-        return iax.figure.axes
+        return ax
+
+    def simulations(self, alpha=0.05, tmin=None, tmax=None):
+        """One simulation panel per observed series, shared axes."""
+        def draw(name, ax):
+            self.simulation(name, alpha=alpha, tmin=tmin, tmax=tmax, ax=ax)
+
+        return self._series_grid(draw)
+
+    # -- decompositions -------------------------------------------------
+    def decomposition(self, name, tmin=None, tmax=None, ax=None, split=False,
+                      adjust_height=True, **kwargs):
+        """sdf + per-factor cdf contributions to one simulated series.
+
+        ``split=True`` gives each contribution its own panel (heights
+        scaled to the data range unless ``adjust_height=False``); the
+        default overlays them on a single axis.
+        """
+        parts = self.mt.decompose_simulation(name, **kwargs)
+        lo, hi = _window(parts.index, tmin, tmax)
+        styles = []
+        cdf_rank = 0
+        for column in parts.columns:
+            styles.append(_component_style(column, cdf_rank=cdf_rank))
+            cdf_rank += column.startswith("cdf")
+
+        def draw(target, column, style):
+            target.plot(parts.index, parts[column], color=style.color,
+                        zorder=style.zorder, label=f"{column} {name}")
+            _decorate(target)
+
+        if ax is not None:  # caller-managed axis: always overlay
+            for column, style in zip(parts.columns, styles):
+                draw(ax, column, style)
+            return ax.figure.axes
+
+        if split:
+            limits = _panel_limits(parts, lo, hi) if adjust_height else None
+            ratios = (
+                get_height_ratios(limits)
+                if adjust_height
+                else np.ones(parts.columns.size)
+            )
+            fig, axes = _stack(parts.columns.size, ratios, height=6)
+            for target, column, style in zip(axes, parts.columns, styles):
+                draw(target, column, style)
+            if limits is not None:
+                for target, lim in zip(axes, limits):
+                    target.set_ylim(lim)
+        else:
+            fig, one = plt.subplots(figsize=(_PANEL_W, 4))
+            for column, style in zip(parts.columns, styles):
+                draw(one, column, style)
+        fig.tight_layout()
+        return fig.axes
 
     def decompositions(self, tmin=None, tmax=None, **kwargs):
-        """Decomposition plot per observed series, shared axes."""
-        nrows = len(self.mt.snames)
+        """One overlay decomposition panel per observed series."""
+        def draw(name, ax):
+            self.decomposition(name, tmin=tmin, tmax=tmax, ax=ax, **kwargs)
+
+        return self._series_grid(draw)
+
+    # -- shared layout --------------------------------------------------
+    def _series_grid(self, draw):
+        """A shared-x/y panel per observed series; ``draw(name, ax)``."""
+        names = list(self.mt.snames)
         fig, axes = plt.subplots(
-            nrows, 1, sharex=True, sharey=True, figsize=(10, nrows * 2)
+            len(names), 1, sharex=True, sharey=True,
+            figsize=(_PANEL_W, len(names) * _PANEL_H), squeeze=False,
         )
-        for i, name in enumerate(self.mt.snames):
-            self.decomposition(
-                name,
-                tmin=tmin,
-                tmax=tmax,
-                ax=axes.flat[i],
-                split=False,
-                adjust_height=False,
-                **kwargs,
-            )
+        axes = axes.ravel()
+        for name, ax in zip(names, axes):
+            draw(name, ax)
         fig.tight_layout()
         return axes

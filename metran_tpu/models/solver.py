@@ -219,7 +219,7 @@ class JaxSolve(BaseSolver):
             return dev_full(full)
 
         theta0 = transform.inverse(jnp.asarray(self.initial[self.vary]))
-        theta, value, nfev, converged = run_lbfgs(
+        theta, value, _iters, nfev, converged = run_lbfgs(
             objective, theta0, maxiter=maxiter, tol=tol
         )
         x = np.asarray(transform.forward(theta), float)
@@ -272,7 +272,8 @@ def jax_sigmoid(x):
     return jax.nn.sigmoid(x)
 
 
-def lbfgs_advance(objective, opt, theta, state, tol, maxiter, max_new_iters):
+def lbfgs_advance(objective, opt, theta, state, tol, maxiter, max_new_iters,
+                  nfev=0):
     """Advance an optax L-BFGS run by up to ``max_new_iters`` iterations.
 
     The shared device-side core of :func:`run_lbfgs` and the fleet solver
@@ -281,9 +282,12 @@ def lbfgs_advance(objective, opt, theta, state, tol, maxiter, max_new_iters):
     the line-search evaluations.  Stops at convergence (gradient norm
     below ``tol``), at ``maxiter`` total iterations, or after
     ``max_new_iters`` iterations of this call (chunking), whichever comes
-    first.  Returns ``(theta, state)`` to carry across chunked calls.
+    first.  Returns ``(theta, state, nfev)`` to carry across chunked
+    calls; ``nfev`` counts true objective evaluations (one per line-search
+    step, plus the initial evaluation), comparable to scipy's ``nfev``.
     """
     import jax
+    import jax.numpy as jnp
     import optax
     import optax.tree_utils as otu
 
@@ -291,16 +295,21 @@ def lbfgs_advance(objective, opt, theta, state, tol, maxiter, max_new_iters):
     count0 = otu.tree_get(state, "count")
 
     def step(carry):
-        theta, state = carry
+        theta, state, nfev = carry
+        count = otu.tree_get(state, "count")
+        # value_and_grad_from_state reuses the stored value/grad except on
+        # the very first iteration, where it evaluates the objective once
+        nfev = nfev + jnp.where(count == 0, 1, 0).astype(jnp.int32)
         value, grad = value_and_grad(theta, state=state)
         updates, state = opt.update(
             grad, state, theta, value=value, grad=grad, value_fn=objective
         )
         theta = optax.apply_updates(theta, updates)
-        return theta, state
+        steps = otu.tree_get(state, "info").num_linesearch_steps
+        return theta, state, nfev + jnp.asarray(steps, jnp.int32)
 
     def cond(carry):
-        _, state = carry
+        _, state, _ = carry
         count = otu.tree_get(state, "count")
         err = otu.tree_l2_norm(otu.tree_get(state, "grad"))
         return (
@@ -309,11 +318,16 @@ def lbfgs_advance(objective, opt, theta, state, tol, maxiter, max_new_iters):
             & (count - count0 < max_new_iters)
         )
 
-    return jax.lax.while_loop(cond, step, (theta, state))
+    return jax.lax.while_loop(
+        cond, step, (theta, state, jnp.asarray(nfev, jnp.int32))
+    )
 
 
 def run_lbfgs(objective, theta0, maxiter: int = 200, tol: float = 1e-8):
-    """Jitted optax L-BFGS loop; returns (theta, value, n_iters, converged)."""
+    """Jitted optax L-BFGS loop.
+
+    Returns ``(theta, value, n_iters, nfev, converged)`` where ``nfev``
+    counts true objective evaluations (scipy-comparable)."""
     import jax
     import optax
     import optax.tree_utils as otu
@@ -322,13 +336,14 @@ def run_lbfgs(objective, theta0, maxiter: int = 200, tol: float = 1e-8):
 
     @jax.jit
     def run(theta0):
-        theta, state = lbfgs_advance(
+        theta, state, nfev = lbfgs_advance(
             objective, opt, theta0, opt.init(theta0), tol, maxiter, maxiter
         )
         return (
             theta,
             otu.tree_get(state, "value"),
             otu.tree_get(state, "count"),
+            nfev,
             otu.tree_l2_norm(otu.tree_get(state, "grad")) < tol,
         )
 

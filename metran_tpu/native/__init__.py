@@ -8,16 +8,22 @@ host-only deployments, the honest CPU baseline for ``bench.py``, and an
 independent implementation for parity testing against the ``lax.scan``
 engines.
 
-The shared library is built on demand with ``g++ -O3`` into
-``metran_tpu/native/build/`` and cached; set ``METRAN_TPU_NO_NATIVE=1``
-to disable (pure-Python/JAX operation is always available).
+The shared library is always built locally on demand (``g++ -O3``) into
+``metran_tpu/native/build/`` — build artifacts are never shipped in the
+repo, so the binary always matches the host ISA.  Rebuilds key on a
+content hash of the C++ source, not mtimes (checkout-time mtimes are
+meaningless).  Set ``METRAN_TPU_NO_NATIVE=1`` to disable
+(pure-Python/JAX operation is always available), or
+``METRAN_TPU_NATIVE_MARCH=native`` to opt into host-specific codegen.
 """
 
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
+import sys
 from logging import getLogger
 from pathlib import Path
 from typing import Optional, Tuple
@@ -30,6 +36,7 @@ _HERE = Path(__file__).resolve().parent
 _SRC = _HERE / "kalman.cpp"
 _BUILD_DIR = _HERE / "build"
 _LIB_PATH = _BUILD_DIR / "libmetran_native.so"
+_STAMP_PATH = _BUILD_DIR / "libmetran_native.stamp"
 
 _lib: Optional[ctypes.CDLL] = None
 
@@ -38,12 +45,24 @@ class NativeUnavailable(RuntimeError):
     """Raised when the native library cannot be built or loaded."""
 
 
-def _build() -> Path:
+def _build_flags() -> list:
+    flags = ["-O3", "-shared", "-fPIC"]
+    march = os.environ.get("METRAN_TPU_NATIVE_MARCH")
+    if march:  # opt-in only: host-specific ISA breaks on other machines
+        flags.append(f"-march={march}")
+    return flags
+
+
+def _build_stamp() -> str:
+    """Content hash keying the build: source bytes + compile flags."""
+    h = hashlib.sha256(_SRC.read_bytes())
+    h.update(" ".join(_build_flags()).encode())
+    return h.hexdigest()
+
+
+def _build(stamp: str) -> Path:
     _BUILD_DIR.mkdir(exist_ok=True)
-    cmd = [
-        "g++", "-O3", "-march=native", "-shared", "-fPIC",
-        "-o", str(_LIB_PATH), str(_SRC),
-    ]
+    cmd = ["g++", *_build_flags(), "-o", str(_LIB_PATH), str(_SRC)]
     logger.info("building native kernel: %s", " ".join(cmd))
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True)
@@ -53,7 +72,32 @@ def _build() -> Path:
         raise NativeUnavailable(
             f"native build failed (exit {proc.returncode}): {proc.stderr[-500:]}"
         )
+    _STAMP_PATH.write_text(stamp)
     return _LIB_PATH
+
+
+def _probe() -> None:
+    """Run one tiny filter call in a subprocess before trusting the library.
+
+    A stale or foreign binary (wrong ISA, truncated file) dies with
+    SIGILL/SIGSEGV — in a subprocess that is a catchable nonzero exit,
+    not a crash of the caller's process.
+    """
+    code = (
+        "import numpy as np; from metran_tpu.native import seq_filter_pass; "
+        "seq_filter_pass(np.full(2,.5), np.eye(2)*.1, np.eye(2), "
+        "np.zeros(2), np.zeros((3,2)), np.ones((3,2),bool))"
+    )
+    env = dict(os.environ, METRAN_TPU_NATIVE_PROBED="1", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, env=env,
+        cwd=str(_HERE.parent.parent), timeout=120,
+    )
+    if proc.returncode != 0:
+        raise NativeUnavailable(
+            f"native library failed sanity probe (exit {proc.returncode}): "
+            f"{proc.stderr[-300:]}"
+        )
 
 
 def load() -> ctypes.CDLL:
@@ -63,8 +107,12 @@ def load() -> ctypes.CDLL:
         return _lib
     if os.environ.get("METRAN_TPU_NO_NATIVE"):
         raise NativeUnavailable("disabled by METRAN_TPU_NO_NATIVE")
-    if not _LIB_PATH.exists() or _LIB_PATH.stat().st_mtime < _SRC.stat().st_mtime:
-        _build()
+    stamp = _build_stamp()
+    have = _STAMP_PATH.read_text() if _STAMP_PATH.exists() else None
+    if not _LIB_PATH.exists() or have != stamp:
+        _build(stamp)
+        if not os.environ.get("METRAN_TPU_NATIVE_PROBED"):
+            _probe()
     try:
         lib = ctypes.CDLL(str(_LIB_PATH))
     except OSError as e:

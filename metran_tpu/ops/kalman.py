@@ -148,7 +148,11 @@ def kalman_filter(
     mask : (T, n_obs) bool, True where a real observation is present.
     engine : "sequential" (parity) or "joint" (Cholesky batch update).
     store : if False, per-step means/covariances are not stacked (loglik-only
-        path — keeps memory O(n^2) instead of O(T n^2)).
+        path — keeps memory O(n^2) instead of O(T n^2)).  Note this memory
+        saving applies to the ``sequential``/``joint`` scan engines only:
+        the ``parallel`` associative-scan engine materializes all per-step
+        moments regardless of ``store`` (only the return shapes follow the
+        contract), so its memory is always O(T n^2).
 
     Returns
     -------
@@ -159,7 +163,8 @@ def kalman_filter(
         from .pkalman import parallel_filter
 
         res = parallel_filter(ss, y, mask)
-        if not store:  # honor the O(n^2)-memory return contract
+        if not store:  # return shapes follow the store=False contract, but
+            # the associative scan has already materialized O(T n^2) moments
             return FilterResult(
                 res.mean_f[-1], res.cov_f[-1], res.mean_f[-1],
                 res.cov_f[-1], res.sigma, res.detf,

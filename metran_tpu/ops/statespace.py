@@ -79,16 +79,29 @@ def dfm_statespace(
     alpha_sdf = jnp.asarray(alpha_sdf)
     alpha_cdf = jnp.asarray(alpha_cdf)
     loadings = jnp.atleast_2d(jnp.asarray(loadings))
-    dtype = jnp.result_type(alpha_sdf, alpha_cdf, loadings, jnp.zeros(0))
+    # the input dtype decides the engine precision: explicit float32
+    # inputs stay float32 even when x64 is enabled (the TPU policy needs
+    # f32 programs testable on the x64 CPU backend, tests/test_precision)
+    dtype = jnp.result_type(alpha_sdf, alpha_cdf, loadings)
+    if not jnp.issubdtype(dtype, jnp.floating):  # e.g. int parameter inits
+        from ..config import default_dtype
+
+        dtype = default_dtype()
     n_series = loadings.shape[0]
 
-    phi_sdf = ar1_decay(alpha_sdf.astype(dtype), dt)
-    phi_cdf = ar1_decay(alpha_cdf.astype(dtype), dt)
+    alpha_sdf = alpha_sdf.astype(dtype)
+    alpha_cdf = alpha_cdf.astype(dtype)
+    phi_sdf = ar1_decay(alpha_sdf, dt)
+    phi_cdf = ar1_decay(alpha_cdf, dt)
     phi = jnp.concatenate([phi_sdf, phi_cdf])
 
     communality = jnp.sum(jnp.square(loadings), axis=1)
-    q_sdf = (1.0 - phi_sdf**2) * (1.0 - communality)
-    q_cdf = 1.0 - phi_cdf**2
+    # 1 - phi^2 = -expm1(-2 dt / alpha): the expm1 form avoids the
+    # catastrophic cancellation of literal ``1 - phi**2`` as phi -> 1
+    # (near-unit-root alpha ~ 3e4 loses ~4 digits in float32 otherwise;
+    # in float64 both forms agree to machine precision)
+    q_sdf = -jnp.expm1(-2.0 * dt / alpha_sdf) * (1.0 - communality)
+    q_cdf = -jnp.expm1(-2.0 * dt / alpha_cdf)
     q = jnp.diag(jnp.concatenate([q_sdf, q_cdf]).astype(dtype))
 
     z = jnp.concatenate(

@@ -218,10 +218,11 @@ def _solve_chunk(theta, state, frozen, y, mask, loadings, dt, warmup,
         p = _theta_to_alpha(th, theta_cap)
         return _model_deviance(p, y, mask, loadings, dt, warmup, engine)
 
-    return lbfgs_advance(
+    theta, state, _nfev = lbfgs_advance(
         objective, opt, theta, state, tol,
         jnp.where(frozen, 0, maxiter), chunk,
     )
+    return theta, state
 
 
 def _chunk_outputs(theta, state, tol, theta_cap):
@@ -460,6 +461,18 @@ def fit_fleet(
         if done.all():
             break
     params, value, count, conv = outputs(theta, state)
+    # distinguish capped optima from interior ones: the reference has no
+    # upper alpha bound, so a lane pinned at the soft cap is a different
+    # animal than a converged interior solution (ADVICE r1)
+    at_cap = np.asarray(params) >= 0.5 * alpha_max
+    if at_cap.any():
+        lanes = np.flatnonzero(at_cap.any(axis=-1))
+        logger.warning(
+            "fleet lanes %s have parameters at/near the alpha soft cap "
+            "(alpha_max=%g); their optima are cap-limited, not interior "
+            "(raise alpha_max to compare with an uncapped fit)",
+            lanes.tolist()[:20], alpha_max,
+        )
     return FleetFit(params, value, count, conv)
 
 

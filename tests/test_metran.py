@@ -111,6 +111,9 @@ def test_metran_solve_jax(series_list, golden):
         m.parameters["optimal"].values, golden["optimal"], rtol=5e-3
     )
     assert m.fit.obj_func <= golden["obj_func"] + 1e-3
+    # nfev reports true objective evaluations (one per line-search step),
+    # scipy-comparable: a real fit evaluates many times
+    assert m.fit.nfev > 5
 
 
 def test_metran_state_means(mt, golden):
@@ -239,3 +242,20 @@ def test_resolve_and_cdf_named_series():
     obj1 = m.fit.obj_func
     m.solve(report=False)
     assert abs(m.fit.obj_func - obj1) < 1e-6
+
+
+def test_timeseries_duck_typed_input(series_list):
+    """Objects exposing ``.series`` (pastas.TimeSeries-like) are unwrapped
+    (reference accepts pastas.TimeSeries at metran/metran.py:536-538)."""
+
+    class FakeTimeSeries:
+        def __init__(self, series):
+            self.series = series
+
+    wrapped = [FakeTimeSeries(s) for s in series_list]
+    m_wrapped = metran_tpu.Metran(wrapped, name="wrapped")
+    m_plain = metran_tpu.Metran(series_list, name="plain")
+    np.testing.assert_array_equal(
+        m_wrapped.oseries.values, m_plain.oseries.values
+    )
+    assert list(m_wrapped.snames) == list(m_plain.snames)

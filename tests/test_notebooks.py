@@ -1,0 +1,39 @@
+"""Execute every example notebook headlessly (reference pattern:
+tests/test_notebooooks.py executing examples via nbconvert).  Marked
+``notebooks`` so the default suite can skip them; CI runs them in a
+dedicated job."""
+
+import os
+import shutil
+import subprocess
+from pathlib import Path
+
+import pytest
+
+EXAMPLES = Path(__file__).parent.parent / "examples"
+NOTEBOOKS = sorted(EXAMPLES.glob("*.ipynb"))
+
+
+@pytest.mark.notebooks
+@pytest.mark.parametrize("nb", NOTEBOOKS, ids=lambda p: p.name)
+def test_notebook_executes(nb, tmp_path):
+    if shutil.which("jupyter") is None:
+        pytest.skip("jupyter not installed")
+    env = dict(os.environ)
+    # force the CPU backend in the kernel; also neutralize any ambient
+    # TPU-plugin autoregistration that would override the platform choice
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env.setdefault("MPLBACKEND", "Agg")
+    # the notebook kernel must see the (uninstalled) in-repo package
+    repo = str(EXAMPLES.parent)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [
+            "jupyter", "nbconvert", "--to", "notebook", "--execute",
+            "--ExecutePreprocessor.timeout=600",
+            "--output-dir", str(tmp_path), str(nb),
+        ],
+        capture_output=True, text=True, env=env, timeout=900,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]

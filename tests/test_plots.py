@@ -1,0 +1,110 @@
+"""Smoke tests for every MetranPlot method on a solved model, mirroring
+the reference's plot test coverage (reference tests/test_plots.py) and
+additionally exercising the split/adjust_height branches."""
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import matplotlib.pyplot as plt  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+import metran_tpu  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def mt(series_list):
+    m = metran_tpu.Metran(series_list, name="B21B0214")
+    m.solve(report=False)
+    return m
+
+
+@pytest.fixture(autouse=True)
+def _close_figures():
+    yield
+    plt.close("all")
+
+
+def test_scree_plot(mt):
+    ax = mt.plots.scree_plot()
+    # one bar and one marker line per eigenvalue
+    assert len(ax.patches) == mt.eigval.shape[0]
+    assert len(ax.lines) == 1
+
+
+def test_state_means(mt):
+    axes = mt.plots.state_means()
+    assert len(axes) == mt.nstate
+
+
+def test_state_means_no_adjust_height(mt):
+    axes = mt.plots.state_means(adjust_height=False)
+    assert len(axes) == mt.nstate
+
+
+def test_simulation(mt):
+    name = mt.snames[0]
+    ax = mt.plots.simulation(name)
+    # mean line + observation dots (+ CI band patch)
+    assert len(ax.lines) == 2
+    assert len(ax.collections) == 1
+
+
+def test_simulation_no_ci(mt):
+    ax = mt.plots.simulation(mt.snames[0], alpha=None)
+    assert len(ax.collections) == 0
+
+
+def test_simulation_window(mt):
+    ax = mt.plots.simulation(mt.snames[0], tmin="1995-1-1", tmax="2000-1-1")
+    lo, hi = ax.get_xlim()
+    assert hi > lo
+
+
+def test_simulations(mt):
+    axes = mt.plots.simulations()
+    assert len(axes) == mt.nseries
+
+
+def test_decomposition_overlay(mt):
+    axes = mt.plots.decomposition(mt.snames[0])
+    assert len(axes) == 1
+    # every component drawn on the single axis
+    assert len(axes[0].lines) == 1 + mt.nfactors
+
+
+def test_decomposition_split(mt):
+    axes = mt.plots.decomposition(mt.snames[0], split=True)
+    assert len(axes) == 1 + mt.nfactors
+
+
+def test_decomposition_split_no_adjust_height(mt):
+    axes = mt.plots.decomposition(
+        mt.snames[0], split=True, adjust_height=False
+    )
+    assert len(axes) == 1 + mt.nfactors
+
+
+def test_decomposition_on_existing_axis(mt):
+    _, ax = plt.subplots()
+    axes = mt.plots.decomposition(mt.snames[0], ax=ax)
+    assert ax in axes
+    assert len(ax.lines) == 1 + mt.nfactors
+
+
+def test_decompositions(mt):
+    axes = mt.plots.decompositions()
+    assert len(axes) == mt.nseries
+
+
+def test_plots_after_masking(mt):
+    """Masked observations flow through to the simulation plot."""
+    mask = np.zeros((mt.oseries.shape[0], mt.nseries), dtype=bool)
+    mask[:50, 0] = True
+    mt.mask_observations(mask)
+    try:
+        ax = mt.plots.simulation(mt.snames[0])
+        assert len(ax.lines) == 2
+    finally:
+        mt.unmask_observations()
