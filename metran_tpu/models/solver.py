@@ -311,7 +311,7 @@ def lbfgs_advance(objective, opt, theta, state, tol, maxiter, max_new_iters,
     def cond(carry):
         _, state, _ = carry
         count = otu.tree_get(state, "count")
-        err = otu.tree_l2_norm(otu.tree_get(state, "grad"))
+        err = otu.tree_norm(otu.tree_get(state, "grad"))
         return (
             ((count == 0) | (err >= tol))
             & (count < maxiter)
@@ -344,7 +344,7 @@ def run_lbfgs(objective, theta0, maxiter: int = 200, tol: float = 1e-8):
             otu.tree_get(state, "value"),
             otu.tree_get(state, "count"),
             nfev,
-            otu.tree_l2_norm(otu.tree_get(state, "grad")) < tol,
+            otu.tree_norm(otu.tree_get(state, "grad")) < tol,
         )
 
     return run(theta0)

@@ -12,6 +12,11 @@ from .kalman import (
     project,
     rts_smoother,
 )
+from .lanes import (
+    lanes_deviance_terms,
+    lanes_dfm_deviance,
+    lanes_statespace,
+)
 from .pkalman import (
     parallel_deviance,
     parallel_filter,
@@ -30,6 +35,9 @@ __all__ = [
     "deviance_terms",
     "dfm_statespace",
     "kalman_filter",
+    "lanes_deviance_terms",
+    "lanes_dfm_deviance",
+    "lanes_statespace",
     "log_likelihood",
     "parallel_deviance",
     "parallel_filter",

@@ -59,9 +59,15 @@ def _largest_minor_factor(n: int, cap: int = 4) -> int:
     return 1
 
 
-def batch_sharding(mesh: Mesh, ndim: int, axis: str = BATCH_AXIS) -> NamedSharding:
-    """Sharding that splits the leading (fleet) axis over ``axis``."""
-    return NamedSharding(mesh, PartitionSpec(axis, *([None] * (ndim - 1))))
+def batch_sharding(
+    mesh: Mesh, ndim: int, axis: str = BATCH_AXIS, dim: int = 0
+) -> NamedSharding:
+    """Sharding that splits array dimension ``dim`` (the fleet axis — 0
+    for ``layout="batch"``, ``ndim-1`` for ``layout="lanes"``) over mesh
+    axis ``axis``."""
+    parts = [None] * ndim
+    parts[dim] = axis
+    return NamedSharding(mesh, PartitionSpec(*parts))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
