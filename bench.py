@@ -462,6 +462,96 @@ def run_device_bench(out_path: str, budget_s: float,
 
 
 # ----------------------------------------------------------------------
+# phase: mesh scaling (virtual 8-device CPU mesh — BASELINE config 4)
+# ----------------------------------------------------------------------
+def run_mesh_bench(out_path: str, budget_s: float) -> None:
+    """Measure fleet sharding overhead on a virtual 8-device CPU mesh.
+
+    Virtual devices share one host's cores, so this measures the COST of
+    sharding (GSPMD partitioning + collectives + per-shard dispatch),
+    not a speedup; the v5e-8 extrapolation in BASELINE.md is
+    single-chip-TPU-throughput x 8 minus the overhead bounded here.
+    """
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", JAX_CACHE)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+
+    from metran_tpu.parallel import (
+        fit_fleet, fleet_value_and_grad, make_mesh,
+    )
+    from metran_tpu.parallel.fleet import Fleet, default_init_params
+
+    out = {"n_virtual_devices": len(jax.devices())}
+    b, t = 64, 1000
+    y, mask, loadings = make_workload(np.random.default_rng(3), b, t=t)
+    fleet = Fleet(
+        y=jnp.asarray(y, jnp.float32),
+        mask=jnp.asarray(mask),
+        loadings=jnp.asarray(loadings, jnp.float32),
+        dt=jnp.ones(b, jnp.float32),
+        n_series=jnp.full(b, N_SERIES, np.int32),
+    )
+    p0 = default_init_params(fleet)
+    from metran_tpu.parallel.mesh import batch_sharding
+
+    kw = dict(layout="lanes", remat_seg=REMAT_SEG)
+    scaling = {}
+    for n_dev in (1, 2, 4, 8):
+        mesh = make_mesh(n_dev)
+        # inputs are batch-leading; GSPMD propagates the sharding through
+        # the internal transpose to the lane-layout program
+        bshard = lambda x: batch_sharding(mesh, np.ndim(x))  # noqa: E731
+        fl = jax.tree.map(lambda a: jax.device_put(a, bshard(a)), fleet)
+        p = jax.device_put(p0, bshard(p0))
+        v, g = fleet_value_and_grad(p, fl, **kw)
+        np.asarray(v)  # compile + first run
+        laps = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            v, g = fleet_value_and_grad(p, fl, **kw)
+            np.asarray(v), np.asarray(g)
+            laps.append(round(time.perf_counter() - t0, 4))
+        scaling[str(n_dev)] = {
+            "laps_s": laps, "lap_s": round(float(np.median(laps)), 4)
+        }
+        progress("mesh_vg", n_dev=n_dev, lap_s=scaling[str(n_dev)]["lap_s"])
+        out["vg_strong_scaling"] = scaling
+        write_partial(out_path, out)
+    base = scaling["1"]["lap_s"]
+    out["sharding_overhead_frac_8dev"] = round(
+        scaling["8"]["lap_s"] / base - 1.0, 3
+    )
+
+    # one sharded fit vs unsharded fit (same small workload)
+    if budget_s - elapsed() > 120:
+        mesh = make_mesh(8)
+        fit_kw = dict(maxiter=10, chunk=5, tol=TOL, stall_tol=STALL_TOL,
+                      max_linesearch_steps=MAX_LS, **kw)
+        for label, m in (("unsharded", None), ("mesh8", mesh)):
+            t0 = time.perf_counter()
+            fit = fit_fleet(fleet, mesh=m, **fit_kw)
+            np.asarray(fit.params)
+            t1 = time.perf_counter()
+            fit = fit_fleet(fleet, mesh=m, **fit_kw)
+            np.asarray(fit.params)
+            out[f"fit_{label}"] = {
+                "compile_plus_first_s": round(t1 - t0, 1),
+                "run_s": round(time.perf_counter() - t1, 2),
+                "deviance_model0": float(np.asarray(fit.deviance)[0]),
+            }
+            progress(f"mesh_fit_{label}", **out[f"fit_{label}"])
+            write_partial(out_path, out)
+
+
+# ----------------------------------------------------------------------
 # orchestrator
 # ----------------------------------------------------------------------
 def _read_json(path: str):
@@ -556,6 +646,16 @@ def main() -> None:
     device_budget = budget - 180.0
     dev_proc = _spawn("device", dev_path, device_budget)
 
+    # the CPU baseline must own the host cores while it times its fit —
+    # the (CPU-hungry) virtual-mesh phase starts only after it exits,
+    # overlapping the TPU-bound remainder of the device child instead
+    _wait(cpu_proc, cpu_budget + 30.0, "cpu_baseline")
+    mesh_path = os.path.join(CACHE_DIR, "bench_mesh.json")
+    if os.path.exists(mesh_path):
+        os.remove(mesh_path)
+    mesh_budget = max(min(420.0, budget - elapsed() - 120.0), 60.0)
+    mesh_proc = _spawn("mesh", mesh_path, mesh_budget, cpu_env)
+
     init_timeout = float(
         os.environ.get("METRAN_TPU_BENCH_INIT_TIMEOUT_S", "300")
     )
@@ -577,10 +677,12 @@ def main() -> None:
             fallback["tpu_attempt"] = device or {"error": "no output"}
             device = fallback
 
-    _wait(cpu_proc, max(budget - elapsed() - 20.0, 5.0), "cpu_baseline")
     cpu = _read_json(cpu_path) or {}
+    _wait(mesh_proc, max(budget - elapsed() - 15.0, 5.0), "mesh")
+    mesh = _read_json(mesh_path) or {}
 
     detail = {"device": device, "cpu_baseline": cpu,
+              "mesh_cpu_virtual": mesh,
               "workload": {"n_series": N_SERIES, "n_factors": N_FACTORS,
                            "t_steps": T_STEPS, "missing": MISSING,
                            "maxiter": MAXITER, "tol": TOL}}
@@ -601,7 +703,8 @@ def main() -> None:
 if __name__ == "__main__":
     parser = argparse.ArgumentParser()
     parser.add_argument("--phase", default="main",
-                        choices=["main", "cpu", "device", "device-cpu"])
+                        choices=["main", "cpu", "device", "device-cpu",
+                                 "mesh"])
     parser.add_argument("--out", default=None)
     parser.add_argument("--budget", type=float, default=900.0)
     args = parser.parse_args()
@@ -609,6 +712,8 @@ if __name__ == "__main__":
         main()
     elif args.phase == "cpu":
         run_cpu_baseline(args.out, args.budget)
+    elif args.phase == "mesh":
+        run_mesh_bench(args.out, args.budget)
     elif args.phase == "device":
         run_device_bench(args.out, args.budget)
     else:  # device-cpu fallback

@@ -1,0 +1,159 @@
+"""Generate the API reference (docs/api/*.md) by introspection.
+
+The reference ships a Sphinx autodoc build
+(``/root/reference/docs/conf.py``, ``modules.rst``); this is the
+dependency-free equivalent for an image without sphinx/mkdocs/pdoc: it
+imports every public module, walks its public classes/functions, and
+writes one markdown page per module with real signatures and the full
+docstrings.  Deterministic output, so CI can check freshness with
+``python tools/gen_api_docs.py --check``.
+
+Usage:
+    python tools/gen_api_docs.py          # (re)write docs/api/
+    python tools/gen_api_docs.py --check  # exit 1 if docs/api/ is stale
+"""
+
+from __future__ import annotations
+
+import importlib
+import inspect
+import os
+import sys
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+MODULES = [
+    "metran_tpu",
+    "metran_tpu.models.metran",
+    "metran_tpu.models.solver",
+    "metran_tpu.models.factoranalysis",
+    "metran_tpu.models.plots",
+    "metran_tpu.models.kalman_runner",
+    "metran_tpu.ops.statespace",
+    "metran_tpu.ops.kalman",
+    "metran_tpu.ops.pkalman",
+    "metran_tpu.ops.lanes",
+    "metran_tpu.ops.fa",
+    "metran_tpu.parallel.fleet",
+    "metran_tpu.parallel.lanes_lbfgs",
+    "metran_tpu.parallel.mesh",
+    "metran_tpu.data",
+    "metran_tpu.io",
+    "metran_tpu.config",
+    "metran_tpu.native",
+    "metran_tpu.utils",
+    "metran_tpu.utils.profiling",
+]
+
+
+def _signature(obj) -> str:
+    try:
+        return str(inspect.signature(obj))
+    except (ValueError, TypeError):
+        return "(...)"
+
+
+def _doc(obj) -> str:
+    doc = inspect.getdoc(obj)
+    return doc.strip() if doc else "*(no docstring)*"
+
+
+def _is_public_member(mod, name, obj) -> bool:
+    if name.startswith("_"):
+        return False
+    owner = getattr(obj, "__module__", None)
+    # only document members defined in (or re-exported by) this package
+    if owner is None or not str(owner).startswith("metran_tpu"):
+        return False
+    if mod.__name__ != "metran_tpu" and owner != mod.__name__:
+        return False  # skip re-exports except in the package root
+    return True
+
+
+def render_module(modname: str) -> str:
+    mod = importlib.import_module(modname)
+    lines = [f"# `{modname}`", "", _doc(mod), ""]
+    classes, functions = [], []
+    for name, obj in sorted(vars(mod).items()):
+        if not _is_public_member(mod, name, obj):
+            continue
+        if inspect.isclass(obj):
+            classes.append((name, obj))
+        elif inspect.isfunction(obj):
+            functions.append((name, obj))
+    for name, cls in classes:
+        lines += [f"## class `{name}{_signature(cls)}`", "", _doc(cls), ""]
+        for mname, meth in sorted(vars(cls).items()):
+            if mname.startswith("_") or not (
+                inspect.isfunction(meth) or isinstance(meth, property)
+            ):
+                continue
+            if isinstance(meth, property):
+                lines += [f"### property `{name}.{mname}`", "",
+                          _doc(meth), ""]
+            else:
+                lines += [
+                    f"### `{name}.{mname}{_signature(meth)}`", "",
+                    _doc(meth), "",
+                ]
+    for name, fn in functions:
+        lines += [f"## `{name}{_signature(fn)}`", "", _doc(fn), ""]
+    return "\n".join(lines).rstrip() + "\n"
+
+
+def render_index() -> str:
+    lines = [
+        "# API reference",
+        "",
+        "Generated from the package docstrings by "
+        "`tools/gen_api_docs.py` (run it after changing any public "
+        "signature; CI checks freshness with `--check`).",
+        "",
+    ]
+    for m in MODULES:
+        page = m.replace(".", "_") + ".md"
+        lines.append(f"- [`{m}`]({page})")
+    return "\n".join(lines) + "\n"
+
+
+def generate() -> dict:
+    pages = {"index.md": render_index()}
+    for m in MODULES:
+        pages[m.replace(".", "_") + ".md"] = render_module(m)
+    return pages
+
+
+def main() -> int:
+    out_dir = REPO / "docs" / "api"
+    pages = generate()
+    if "--check" in sys.argv:
+        stale = []
+        for name, content in pages.items():
+            path = out_dir / name
+            if not path.exists() or path.read_text() != content:
+                stale.append(name)
+        extra = {
+            p.name for p in out_dir.glob("*.md")
+        } - set(pages) if out_dir.exists() else set()
+        if stale or extra:
+            print(f"stale: {stale} extra: {sorted(extra)}")
+            print("run: python tools/gen_api_docs.py")
+            return 1
+        print(f"docs/api up to date ({len(pages)} pages)")
+        return 0
+    out_dir.mkdir(parents=True, exist_ok=True)
+    for old in out_dir.glob("*.md"):
+        old.unlink()
+    for name, content in pages.items():
+        (out_dir / name).write_text(content)
+    print(f"wrote {len(pages)} pages to {out_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
