@@ -50,7 +50,7 @@ T_STEPS = 5_000
 MISSING = 0.3
 BATCH = 512  # lane-layout fleet: fleet axis rides the TPU lane dim
 MAXITER = 60
-CHUNK = 5  # L-BFGS iterations per dispatch (~9 s at B=512 — keeps every
+CHUNK = 8  # L-BFGS iterations per dispatch (~15 s at B=512 — keeps every
 #            device execution far below the tunnel's kill threshold)
 MAX_LS = 6  # grid line-search trials (one stacked forward dispatch)
 REMAT_SEG = 100  # checkpointed filter segments: O(seg) autodiff memory
@@ -405,7 +405,7 @@ def run_device_bench(out_path: str, budget_s: float,
                 lambda: fleet_value_and_grad(p3, fleet3, **fwd_kwargs)
             )
             out["config3_vmap_fleet"] = {
-                "batch": b3, "n_series": n3, "t": t3,
+                "batch": b3, "n_series": n3, "t_steps": t3,
                 "compile_plus_first_run_s": round(c3, 1),
                 "laps_s": laps3, "plausible": ok3,
                 "grad_passes_per_s": (
@@ -448,7 +448,7 @@ def run_device_bench(out_path: str, budget_s: float,
             c5 = time.perf_counter() - t0
             laps5, ok5 = timed_laps(smooth_decompose)
             out["config5_smoother"] = {
-                "n_series": n5, "t": t5, "missing": MISSING,
+                "n_series": n5, "t_steps": t5, "missing": MISSING,
                 "compile_plus_first_run_s": round(c5, 1),
                 "laps_s": laps5, "plausible": ok5,
                 "smooth_decompose_per_s": (
@@ -459,7 +459,6 @@ def run_device_bench(out_path: str, budget_s: float,
             write_partial(out_path, out)
         except Exception as e:
             progress("config5_failed", error=str(e)[-200:])
-
 
 # ----------------------------------------------------------------------
 # phase: mesh scaling (virtual 8-device CPU mesh — BASELINE config 4)
@@ -640,16 +639,23 @@ def main() -> None:
     # wedged TPU tunnel therefore cannot hang the whole benchmark
     # JAX_PLATFORMS=cpu + blanking the TPU-plugin autoregistration var
     # makes CPU children immune to a wedged device tunnel
-    cpu_env = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}
-    cpu_budget = min(500.0, budget * 0.45)
+    # CPU children get their own compilation cache: sharing the TPU
+    # children's cache dir makes XLA load CPU AOT entries compiled under
+    # a different host-feature set (SIGILL risk, noisy warnings)
+    cpu_env = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+               "JAX_COMPILATION_CACHE_DIR": JAX_CACHE + "-cpu"}
+    # the CPU baseline runs SOLO first: it must own the host cores while
+    # it times the reference-equivalent fit (running it alongside the
+    # device child inflated it 22.7s -> 26s; alongside the mesh child,
+    # 22.7s -> 61s — and vs_baseline with it)
+    cpu_budget = min(400.0, budget * 0.4)
     cpu_proc = _spawn("cpu", cpu_path, cpu_budget, cpu_env)
-    device_budget = budget - 180.0
-    dev_proc = _spawn("device", dev_path, device_budget)
-
-    # the CPU baseline must own the host cores while it times its fit —
-    # the (CPU-hungry) virtual-mesh phase starts only after it exits,
-    # overlapping the TPU-bound remainder of the device child instead
     _wait(cpu_proc, cpu_budget + 30.0, "cpu_baseline")
+
+    device_budget = budget - elapsed() - 120.0
+    dev_proc = _spawn("device", dev_path, device_budget)
+    # the (CPU-hungry) virtual-mesh phase overlaps only the TPU-bound
+    # device child, never the CPU baseline
     mesh_path = os.path.join(CACHE_DIR, "bench_mesh.json")
     if os.path.exists(mesh_path):
         os.remove(mesh_path)
@@ -659,7 +665,9 @@ def main() -> None:
     init_timeout = float(
         os.environ.get("METRAN_TPU_BENCH_INIT_TIMEOUT_S", "300")
     )
-    _wait_device(dev_proc, dev_path, T0 + device_budget, init_timeout)
+    _wait_device(
+        dev_proc, dev_path, time.monotonic() + device_budget, init_timeout
+    )
     device = _read_json(dev_path) or {}
 
     if "fit" not in device:

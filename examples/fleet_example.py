@@ -76,13 +76,15 @@ def main():
 
     counter = ThroughputCounter(unit="fits")
     with counter.measure(n=n_models):
-        # practical fleet settings: a deviance-scale tolerance plus
-        # stall-freezing (lanes that stop improving take no further
-        # iterations) keep the line search from thrashing at the
-        # floating-point resolution floor near each optimum
+        # practical fleet settings: the lane-layout kernel + grid
+        # L-BFGS (the TPU hot path — see README), a deviance-scale
+        # tolerance, segmented gradient remat, and per-iteration
+        # stall-freezing so each lane stops the moment it hits the
+        # floating-point resolution floor near its optimum
         fit = fit_fleet(
             fleet, mesh=mesh, maxiter=40, chunk=10,
-            tol=1e-2, stall_tol=0.0,
+            tol=1e-2, stall_tol=1e-4,
+            layout="lanes", remat_seg=128,
             checkpoint="/tmp/fleet_ckpt.npz",  # preemption-safe
         )
         jax.block_until_ready(fit.params)

@@ -349,7 +349,7 @@ def _make_chunk_runner(warmup, engine, tol, chunk, maxiter,
 
 @functools.lru_cache(maxsize=32)
 def _make_lanes_runner(warmup, tol, chunk, maxiter, ls_steps,
-                       history, theta_cap, remat_seg):
+                       history, theta_cap, remat_seg, stall_tol=None):
     """Build (init, run_chunk) for the lane-layout batched L-BFGS.
 
     The objective is the hand-written lane-layout Kalman deviance
@@ -384,7 +384,7 @@ def _make_lanes_runner(warmup, tol, chunk, maxiter, ls_steps,
         )
     )
     run_chunk = lanes_lbfgs.make_chunk_runner(
-        vg_fn, obj_fn, ls_steps, maxiter, tol, chunk
+        vg_fn, obj_fn, ls_steps, maxiter, tol, chunk, stall_tol
     )
     return init, run_chunk
 
@@ -399,7 +399,19 @@ def _fit_fleet_lanes(fleet, p0, warmup, maxiter, tol, mesh,
     ls_steps = lanes_lbfgs.default_ls_steps(min(max_linesearch_steps, 6))
     init, run_chunk = _make_lanes_runner(
         warmup, tol, chunk, maxiter, ls_steps, history,
-        theta_cap, remat_seg,
+        theta_cap, remat_seg, stall_tol,
+    )
+    # two-phase schedule: after the first full chunk, advance in short
+    # tail dispatches so the run ends within ~tail iterations of the
+    # last lane's convergence instead of a full chunk past it.  With the
+    # per-iteration device-side stall stop, chunking cannot change
+    # results — only how many already-frozen iterations get executed.
+    tail = min(2, chunk)
+    _, run_tail = (
+        (None, run_chunk) if tail == chunk else _make_lanes_runner(
+            warmup, tol, tail, maxiter, ls_steps, history,
+            theta_cap, remat_seg, stall_tol,
+        )
     )
     theta0 = _alpha_to_theta(jnp.asarray(p0), theta_cap)
     theta_t, y_l, mask_l, loadings_l, dt_l = _lanes_args(theta0, fleet)
@@ -449,23 +461,23 @@ def _fit_fleet_lanes(fleet, p0, warmup, maxiter, tol, mesh,
                 prev_value, ckpt_meta,
             )
 
-    n_chunks = max(-(-maxiter // chunk), 1)
-    if max_chunks is not None:
-        n_chunks = min(n_chunks, max_chunks)
-    for _ in range(n_chunks):
-        state = run_chunk(state, *data)
-        value = np.asarray(state.value)
+    iters_left = maxiter
+    dispatches = 0
+    while iters_left > 0:
+        if max_chunks is not None and dispatches >= max_chunks:
+            break
+        if dispatches == 0 and iters_left >= chunk:
+            state = run_chunk(state, *data)
+            iters_left -= chunk
+        else:
+            state = run_tail(state, *data)
+            iters_left -= tail
+        dispatches += 1
+        # stall stopping is per-iteration ON DEVICE in the lanes step
+        # (lanes_lbfgs.make_step); the host only checks the aggregate
+        # frozen flags between dispatches
         frozen_host = np.asarray(state.frozen)
-        # per-lane stop at the f32 resolution floor, decided host-side
-        # between chunks exactly like the batch-layout driver
-        if stall_tol is not None and prev_value is not None:
-            stalled = ~(value < prev_value - stall_tol)
-            frozen_host = frozen_host | stalled
-            new_frozen = jnp.asarray(frozen_host)
-            if mesh is not None:  # keep placement stable across chunks
-                new_frozen = jax.device_put(new_frozen, shard(new_frozen))
-            state = state._replace(frozen=new_frozen)
-        prev_value = value
+        prev_value = np.asarray(state.value)
         _save_ckpt()
         if frozen_host.all():
             break
