@@ -65,57 +65,75 @@ def lanes_statespace(
     return phi, q, z, r
 
 
+def _adj_series_update(carry, xs, dtype):
+    m, p, sigma, detf = carry
+    y_i, mask_i, z_i, r_i = xs
+    obs = mask_i > 0
+    v = y_i - jnp.sum(z_i * m, axis=0)
+    d = jnp.sum(p * z_i[None, :, :], axis=1)
+    f = jnp.sum(z_i * d, axis=0) + r_i
+    f_safe = jnp.where(obs, f, jnp.ones((), dtype))
+    k = d / f_safe
+    m = jnp.where(obs, m + k * v, m)
+    p = jnp.where(obs, p - k[:, None, :] * k[None, :, :] * f_safe, p)
+    sigma = sigma + jnp.where(obs, v * v / f_safe, 0.0)
+    detf = detf + jnp.where(obs, jnp.log(f_safe), 0.0)
+    return (m, p, sigma, detf), (d, f_safe, v)
+
+
+def _adj_step(phi, q, z, r, carry, y_t, m_t, eye):
+    dtype = phi.dtype
+    b = phi.shape[1]
+    mean, cov = carry
+    mean_p = phi * mean
+    cov_p = phi[:, None, :] * cov * phi[None, :, :] + eye * q[None]
+    (m_f, p_f, sig, det), res = lax.scan(
+        lambda c, xs: _adj_series_update(c, xs, dtype),
+        (mean_p, cov_p, jnp.zeros(b, dtype), jnp.zeros(b, dtype)),
+        (y_t, m_t, z, r),
+    )
+    return (m_f, p_f), (sig, det), res
+
+
+def _adj_init_carry(phi, eye):
+    n, b = phi.shape
+    return (
+        jnp.zeros((n, b), phi.dtype),
+        jnp.broadcast_to(eye, (n, n, b)),
+    )
+
+
 def _lanes_filter_terms(phi, q, z, r, y, mask, remat_seg):
     """Per-timestep (sigma, detf), both (T, B), via the masked
-    sequential-processing filter in lane layout."""
+    sequential-processing filter in lane layout (checkpointed segments;
+    shares the single filter-step definition ``_adj_step`` with the
+    analytical-adjoint path so the two score paths cannot drift)."""
     n, b = phi.shape
     t_steps = y.shape[0]
     dtype = phi.dtype
     eye = jnp.eye(n, dtype=dtype)[:, :, None]
-
-    def update_series(carry, xs):
-        m, p, sigma, detf = carry
-        y_i, mask_i, z_i, r_i = xs  # (B,), (B,), (n, B), (B,)
-        v = y_i - jnp.sum(z_i * m, axis=0)
-        d = jnp.sum(p * z_i[None, :, :], axis=1)  # (n, B)
-        f = jnp.sum(z_i * d, axis=0) + r_i
-        f_safe = jnp.where(mask_i, f, jnp.ones((), dtype))
-        k = d / f_safe
-        m_new = m + k * v
-        p_new = p - k[:, None, :] * k[None, :, :] * f_safe
-        m = jnp.where(mask_i, m_new, m)
-        p = jnp.where(mask_i, p_new, p)
-        sigma = sigma + jnp.where(mask_i, v * v / f_safe, 0.0)
-        detf = detf + jnp.where(mask_i, jnp.log(f_safe), 0.0)
-        return (m, p, sigma, detf), None
-
-    def step(carry, xs):
-        mean, cov = carry
-        y_t, mask_t = xs  # (N, B)
-        mean_p = phi * mean
-        cov_p = phi[:, None, :] * cov * phi[None, :, :] + eye * q[None]
-        (mean_f, cov_f, sigma, detf), _ = lax.scan(
-            update_series,
-            (mean_p, cov_p, jnp.zeros(b, dtype), jnp.zeros(b, dtype)),
-            (y_t, mask_t, z, r),
-        )
-        return (mean_f, cov_f), (sigma, detf)
+    maskf = jnp.asarray(mask, dtype)
 
     pad = (-t_steps) % remat_seg
     if pad:
         y = jnp.concatenate([y, jnp.zeros((pad,) + y.shape[1:], dtype)])
-        mask = jnp.concatenate(
-            [mask, jnp.zeros((pad,) + mask.shape[1:], bool)]
+        maskf = jnp.concatenate(
+            [maskf, jnp.zeros((pad,) + maskf.shape[1:], dtype)]
         )
     y_seg = y.reshape(-1, remat_seg, *y.shape[1:])
-    m_seg = mask.reshape(-1, remat_seg, *mask.shape[1:])
+    m_seg = maskf.reshape(-1, remat_seg, *maskf.shape[1:])
 
     @jax.checkpoint
     def seg_body(carry, xs):
+        def step(c, t_xs):
+            c2, out, _ = _adj_step(phi, q, z, r, c, *t_xs, eye)
+            return c2, out
+
         return lax.scan(step, carry, xs)
 
-    init = (jnp.zeros((n, b), dtype), jnp.broadcast_to(eye, (n, n, b)))
-    _, (sigma, detf) = lax.scan(seg_body, init, (y_seg, m_seg))
+    _, (sigma, detf) = lax.scan(
+        seg_body, _adj_init_carry(phi, eye), (y_seg, m_seg)
+    )
     t_pad = t_steps + pad
     return (
         sigma.reshape(t_pad, b)[:t_steps],
@@ -147,7 +165,200 @@ def lanes_deviance_terms(sigma, detf, mask, warmup: int = 1):
     )
 
 
-@functools.partial(jax.jit, static_argnames=("warmup", "remat_seg"))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6,))
+def _terms_adjoint_core(phi, q, z, r, y_seg, m_seg, seg):
+    """Segmented filter terms with an analytical (phi, q) adjoint.
+
+    See :func:`_lanes_terms_adjoint` for the derivation and layout; this
+    core takes pre-segmented ``y_seg``/``m_seg`` of shape
+    (n_seg, seg, N, B) (mask as float) and returns (sigma, detf) of
+    shape (n_seg*seg, B).
+    """
+    n = phi.shape[0]
+    eye = jnp.eye(n, dtype=phi.dtype)[:, :, None]
+
+    def body(c, xs):
+        def inner(cc, t_xs):
+            cc2, out, _ = _adj_step(phi, q, z, r, cc, *t_xs, eye)
+            return cc2, out
+
+        return lax.scan(inner, c, xs)
+
+    _, (sig, det) = lax.scan(
+        body, _adj_init_carry(phi, eye), (y_seg, m_seg)
+    )
+    t_pad, b = sig.shape[0] * sig.shape[1], sig.shape[2]
+    return sig.reshape(t_pad, b), det.reshape(t_pad, b)
+
+
+def _terms_adjoint_fwd(phi, q, z, r, y_seg, m_seg, seg):
+    n = phi.shape[0]
+    eye = jnp.eye(n, dtype=phi.dtype)[:, :, None]
+
+    def body(c, xs):
+        def inner(cc, t_xs):
+            cc2, out, _ = _adj_step(phi, q, z, r, cc, *t_xs, eye)
+            return cc2, out
+
+        c2, out = lax.scan(inner, c, xs)
+        return c2, out + (c,)
+
+    _, (sig, det, bounds) = lax.scan(
+        body, _adj_init_carry(phi, eye), (y_seg, m_seg)
+    )
+    t_pad, b = sig.shape[0] * sig.shape[1], sig.shape[2]
+    out = (sig.reshape(t_pad, b), det.reshape(t_pad, b))
+    return out, (phi, q, z, r, y_seg, m_seg, bounds)
+
+
+def _terms_adjoint_bwd(seg, residuals, cotangents):
+    phi, q, z, r, y_seg, m_seg, bounds = residuals
+    n, b = phi.shape
+    dtype = phi.dtype
+    eye = jnp.eye(n, dtype=dtype)[:, :, None]
+    n_seg = y_seg.shape[0]
+    sb_all, db_all = cotangents
+    sb_seg = sb_all.reshape(n_seg, seg, b)
+    db_seg = db_all.reshape(n_seg, seg, b)
+
+    def step_bwd(ubar, stored, sb_t, db_t, m_t):
+        mean0, cov0, d_all, f_all, v_all = stored
+        u, s = ubar  # adjoint of the step-END (post-update) state
+
+        def series_bwd(carry, xs):
+            u, s = carry
+            d, f, v, z_i, mask_i = xs
+            obs = mask_i > 0
+            ud = jnp.sum(u * d, axis=0)  # (B,)
+            sd = jnp.sum(s * d[None, :, :], axis=1)  # S d
+            std = jnp.sum(s * d[:, None, :], axis=0)  # S' d
+            dsd = jnp.sum(d * sd, axis=0)
+            vbar = 2.0 * sb_t * v / f + ud / f
+            fbar = (-sb_t * v * v / (f * f) + db_t / f
+                    + dsd / (f * f) - ud * v / (f * f))
+            dvec = -(sd + std) / f + u * (v / f) + fbar * z_i
+            s_new = s + dvec[:, None, :] * z_i[None, :, :]
+            u_new = u - vbar * z_i
+            u = jnp.where(obs, u_new, u)
+            s = jnp.where(obs, s_new, s)
+            return (u, s), None
+
+        (u, s), _ = lax.scan(
+            series_bwd, (u, s), (d_all, f_all, v_all, z, m_t),
+            reverse=True,
+        )
+        # predict backward: (u, s) is now the adjoint of
+        # (mean_p, cov_p); mean0/cov0 are the pre-predict carry
+        sc = s * cov0
+        phibar_t = (
+            u * mean0
+            + jnp.sum(sc * phi[None, :, :], axis=1)
+            + jnp.sum(sc * phi[:, None, :], axis=0)
+        )
+        qbar_t = jnp.sum(s * eye, axis=1)  # diag(S)
+        u_prev = u * phi
+        s_prev = s * phi[:, None, :] * phi[None, :, :]
+        return (u_prev, s_prev), phibar_t, qbar_t
+
+    def seg_replay(carry, ys, ms):
+        """Replay one segment, stacking per-step residuals."""
+        def body(c, xs):
+            c2, _, res = _adj_step(phi, q, z, r, c, *xs, eye)
+            return c2, (c[0], c[1]) + res
+
+        return lax.scan(body, carry, (ys, ms))[1]
+
+    def seg_bwd(carry, seg_idx):
+        ubar, pb, qb = carry
+        stored = seg_replay(
+            jax.tree.map(lambda a: a[seg_idx], bounds),
+            y_seg[seg_idx], m_seg[seg_idx],
+        )
+        sb_s, db_s, m_s = (
+            sb_seg[seg_idx], db_seg[seg_idx], m_seg[seg_idx]
+        )
+
+        def body(c, t):
+            ub, pbi, qbi = c
+            ub, pbar_t, qbar_t = step_bwd(
+                ub, jax.tree.map(lambda a: a[t], stored),
+                sb_s[t], db_s[t], m_s[t],
+            )
+            return (ub, pbi + pbar_t, qbi + qbar_t), None
+
+        (ubar, pb, qb), _ = lax.scan(
+            body, (ubar, pb, qb), jnp.arange(seg), reverse=True
+        )
+        return (ubar, pb, qb), None
+
+    ubar0 = (jnp.zeros((n, b), dtype), jnp.zeros((n, n, b), dtype))
+    (_, phibar, qbar), _ = lax.scan(
+        seg_bwd, (ubar0, jnp.zeros_like(phi), jnp.zeros_like(q)),
+        jnp.arange(n_seg), reverse=True,
+    )
+    return (phibar, qbar, jnp.zeros_like(z), jnp.zeros_like(r),
+            jnp.zeros_like(y_seg), jnp.zeros_like(m_seg))
+
+
+_terms_adjoint_core.defvjp(_terms_adjoint_fwd, _terms_adjoint_bwd)
+
+
+def _lanes_terms_adjoint(phi, q, z, r, y, mask, seg):
+    """Filter terms with a hand-derived analytical (phi, q) adjoint.
+
+    JAX autodiff through the sequential-update scan generates a backward
+    pass ~5x the forward cost (generic transposition materializes an
+    adjoint temporary per rank-1 update).  The score of a linear-
+    Gaussian state-space model has a compact closed-form adjoint,
+    derived per series update (validated against autodiff to machine
+    precision in tests/test_lanes_adjoint.py):
+
+        v = y_i - z_i.m ; d = P z_i ; f = z_i.d ; k = d/f
+        m' = m + k v ;  P' = P - d d'/f
+        sigma_t += v^2/f ; detf_t += log f
+
+    with incoming adjoints ``u = mbar'``, ``S = Pbar'``, ``sb``, ``db``:
+
+        vbar = 2 sb v/f + (u.d)/f
+        fbar = -sb v^2/f^2 + db/f + (d'Sd)/f^2 - (u.d) v/f^2
+        dbar = -(S + S')d/f + u v/f + fbar z_i
+        Pbar = S + outer(dbar, z_i) ;  mbar = u - vbar z_i
+
+    and for the predict step ``m_p = phi m``, ``P_p = (phi phi')P +
+    diag(q)``:
+
+        phibar += u m + sum_j S_kj phi_j P_kj + sum_i S_ik phi_i P_ik
+        qbar   += diag(S)
+        mbar = u phi ;  Pbar_ij = S_ij phi_i phi_j
+
+    Memory: the forward stores only segment-boundary carries
+    (O(T/seg n^2 B)); the backward replays each segment once, storing
+    that segment's per-step (carry, d, f, v) residuals, then runs the
+    reverse sweep — the same two-level rematerialization the autodiff
+    path uses, with a leaner hand-written inner adjoint.  Cotangents
+    are produced for (phi, q) only; z/r/y/mask are fixed data in the
+    MLE (the optimizer differentiates the AR decay parameters alpha).
+    """
+    n_obs, n, b = z.shape
+    t_steps = y.shape[0]
+    dtype = z.dtype
+    maskf = jnp.asarray(mask, dtype)
+    pad = (-t_steps) % seg
+    if pad:
+        y = jnp.concatenate([y, jnp.zeros((pad,) + y.shape[1:], dtype)])
+        maskf = jnp.concatenate(
+            [maskf, jnp.zeros((pad,) + maskf.shape[1:], dtype)]
+        )
+    t_pad = t_steps + pad
+    y_seg = y.reshape(t_pad // seg, seg, n_obs, b)
+    m_seg = maskf.reshape(t_pad // seg, seg, n_obs, b)
+    sig, det = _terms_adjoint_core(phi, q, z, r, y_seg, m_seg, seg)
+    return sig[:t_steps], det[:t_steps]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("warmup", "remat_seg", "score")
+)
 def lanes_dfm_deviance(
     alpha: jnp.ndarray,
     loadings: jnp.ndarray,
@@ -156,6 +367,7 @@ def lanes_dfm_deviance(
     mask: jnp.ndarray,
     warmup: int = 1,
     remat_seg: Optional[int] = 100,
+    score: str = "adjoint",
 ) -> jnp.ndarray:
     """(B,) deviance of a fleet at ``alpha`` — the lanes hot path.
 
@@ -163,9 +375,34 @@ def lanes_dfm_deviance(
     (``engine="sequential"`` of :func:`metran_tpu.ops.deviance`), so its
     values match the reference parity bar; only the array layout (and
     hence rounding-neutral op order within each reduction) differs.
+
+    ``score="adjoint"`` (default) uses the hand-derived analytical
+    (phi, q) adjoint under differentiation (~2x faster than autodiff
+    through the scan on TPU v5e, same values to f32 rounding).  The
+    adjoint differentiates the MLE parameters only: gradients w.r.t.
+    ``alpha`` and ``dt`` are exact, while ``loadings``, ``y`` and
+    ``mask`` are treated as fixed data (``stop_gradient`` — their
+    cotangents are exactly zero, never silently partial).  Pass
+    ``score="autodiff"`` to differentiate the plain checkpointed scan
+    instead when gradients w.r.t. loadings or observations are needed.
+    Both scores execute the same single forward-step definition
+    (``_adj_step``), so their values are identical.
     """
-    phi, q, z, r = lanes_statespace(alpha, loadings, dt)
-    sigma, detf = _lanes_filter_terms(
-        phi, q, z, r, y, mask, remat_seg or y.shape[0]
-    )
+    if score == "adjoint":
+        # the analytical adjoint covers (phi, q) only: freeze the data
+        # inputs so their gradients are an explicit zero rather than a
+        # silently partial value (loadings otherwise still reaches q
+        # through the communality term)
+        phi, q, z, r = lanes_statespace(
+            alpha, lax.stop_gradient(loadings), dt
+        )
+        y = lax.stop_gradient(y)
+        sigma, detf = _lanes_terms_adjoint(
+            phi, q, z, r, y, mask, remat_seg or y.shape[0]
+        )
+    else:
+        phi, q, z, r = lanes_statespace(alpha, loadings, dt)
+        sigma, detf = _lanes_filter_terms(
+            phi, q, z, r, y, mask, remat_seg or y.shape[0]
+        )
     return lanes_deviance_terms(sigma, detf, mask, warmup=warmup)

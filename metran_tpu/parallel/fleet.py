@@ -406,7 +406,10 @@ def _fit_fleet_lanes(fleet, p0, warmup, maxiter, tol, mesh,
     # last lane's convergence instead of a full chunk past it.  With the
     # per-iteration device-side stall stop, chunking cannot change
     # results — only how many already-frozen iterations get executed.
-    tail = min(2, chunk)
+    # under an explicit dispatch budget (max_chunks) every dispatch must
+    # advance a FULL chunk, otherwise the budget semantics silently
+    # shrink; the short-tail optimization applies to unbounded runs only
+    tail = chunk if max_chunks is not None else min(2, chunk)
     _, run_tail = (
         (None, run_chunk) if tail == chunk else _make_lanes_runner(
             warmup, tol, tail, maxiter, ls_steps, history,

@@ -147,7 +147,7 @@ def make_step(vg_fn, obj_fn, ls_steps: Tuple[float, ...], maxiter: int,
         per-iteration (device-side) version of the fleet driver's
         between-chunk stall stop.  Per-iteration granularity stops each
         lane the moment it hits the f32 resolution floor instead of at
-        the next chunk boundary (measured: ~25%% fewer iterations per
+        the next chunk boundary (measured: ~25 percent fewer iterations per
         fit at chunk=5 on the benchmark workload).
     """
     steps = jnp.asarray(ls_steps)

@@ -1,0 +1,94 @@
+"""The hand-derived analytical (phi, q) adjoint of the lanes filter
+(`ops/lanes.py::_terms_adjoint_core`) must agree with JAX autodiff
+through the same recursion — in float64 to machine precision, in float32
+to rounding.  This is the correctness contract behind the TPU fleet
+gradient (the adjoint is ~2x faster than the autodiff backward on v5e
+and is the default `score` of `lanes_dfm_deviance`)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metran_tpu.ops.lanes import lanes_dfm_deviance
+
+N, K = 6, 1
+
+
+def _workload(rng, b, t, missing=0.3):
+    loadings = rng.uniform(0.4, 0.8, (b, N, K))
+    y = rng.normal(size=(b, t, N))
+    mask = rng.uniform(size=y.shape) > missing
+    mask[:, 0] = False  # leading all-masked step
+    return (
+        jnp.asarray(np.transpose(np.where(mask, y, 0.0), (1, 2, 0))),
+        jnp.asarray(np.transpose(mask, (1, 2, 0))),
+        jnp.asarray(np.transpose(loadings, (1, 2, 0))),
+    )
+
+
+def _vg(score, alpha, ld, dt, y, mask, seg):
+    def f(a):
+        return lanes_dfm_deviance(
+            a, ld, dt, y, mask, remat_seg=seg, score=score
+        )
+
+    val, vjp = jax.vjp(f, alpha)
+    (g,) = vjp(jnp.ones_like(val))
+    return np.asarray(val), np.asarray(g)
+
+
+@pytest.mark.parametrize("t_steps,seg", [(120, 40), (130, 40)])
+def test_adjoint_matches_autodiff_f64(rng, t_steps, seg):
+    """Exact-arithmetic agreement, including when T % seg != 0 (the
+    padded tail must contribute exactly zero to the score)."""
+    b = 4
+    y, mask, ld = _workload(rng, b, t_steps)
+    dt = jnp.ones(b)
+    alpha = jnp.asarray(rng.uniform(2.0, 50.0, (N + K, b)))
+    v1, g1 = _vg("adjoint", alpha, ld, dt, y, mask, seg)
+    v2, g2 = _vg("autodiff", alpha, ld, dt, y, mask, seg)
+    np.testing.assert_allclose(v1, v2, rtol=1e-14)
+    np.testing.assert_allclose(g1, g2, rtol=1e-11, atol=1e-11)
+
+
+def test_adjoint_matches_autodiff_f32(rng):
+    b, t_steps = 8, 200
+    y, mask, ld = _workload(rng, b, t_steps)
+    y, ld = jnp.asarray(y, jnp.float32), jnp.asarray(ld, jnp.float32)
+    dt = jnp.ones(b, jnp.float32)
+    alpha = jnp.asarray(
+        rng.uniform(2.0, 50.0, (N + K, b)), jnp.float32
+    )
+    v1, g1 = _vg("adjoint", alpha, ld, dt, y, mask, 50)
+    v2, g2 = _vg("autodiff", alpha, ld, dt, y, mask, 50)
+    np.testing.assert_allclose(v1, v2, rtol=1e-6)
+    np.testing.assert_allclose(g1, g2, rtol=2e-4, atol=2e-4)
+
+
+def test_adjoint_near_unit_root(rng):
+    """The cap-regime stress point (phi -> 1) — where a wrong adjoint
+    term would be amplified — still matches autodiff."""
+    b, t_steps = 4, 150
+    y, mask, ld = _workload(rng, b, t_steps)
+    dt = jnp.ones(b)
+    alpha = jnp.full((N + K, b), 3e4)
+    v1, g1 = _vg("adjoint", alpha, ld, dt, y, mask, 50)
+    v2, g2 = _vg("autodiff", alpha, ld, dt, y, mask, 50)
+    np.testing.assert_allclose(v1, v2, rtol=1e-14)
+    np.testing.assert_allclose(g1, g2, rtol=1e-9, atol=1e-12)
+
+
+def test_adjoint_fully_masked_series(rng):
+    """A series masked at every timestep (padding pattern) contributes
+    nothing and produces finite gradients."""
+    b, t_steps = 4, 100
+    y, mask, ld = _workload(rng, b, t_steps)
+    mask = mask.at[:, -1, :].set(False)  # silence the last series slot
+    dt = jnp.ones(b)
+    alpha = jnp.asarray(rng.uniform(2.0, 50.0, (N + K, b)))
+    v1, g1 = _vg("adjoint", alpha, ld, dt, y, mask, 50)
+    v2, g2 = _vg("autodiff", alpha, ld, dt, y, mask, 50)
+    assert np.isfinite(g1).all()
+    np.testing.assert_allclose(v1, v2, rtol=1e-14)
+    np.testing.assert_allclose(g1, g2, rtol=1e-11, atol=1e-11)
