@@ -52,7 +52,9 @@ BATCH = 512  # lane-layout fleet: fleet axis rides the TPU lane dim
 MAXITER = 60
 CHUNK = 8  # L-BFGS iterations per dispatch (~15 s at B=512 — keeps every
 #            device execution far below the tunnel's kill threshold)
-MAX_LS = 6  # grid line-search trials (one stacked forward dispatch)
+MAX_LS = 4  # grid line-search trials (one stacked forward dispatch);
+#             measured on-chip: 4 beats 6 (38.1 vs 26.0 fits/s — fewer
+#             forward passes/iter) and 3 (37.2 — too many rejected steps)
 REMAT_SEG = 100  # checkpointed filter segments: O(seg) autodiff memory
 # f32 convergence thresholds: the gradient-noise floor of a float32
 # deviance of magnitude ~1e5 sits far above scipy's f64 pgtol, so the
@@ -278,7 +280,9 @@ def run_device_bench(out_path: str, budget_s: float,
     import jax.numpy as jnp
 
     from metran_tpu.parallel import fit_fleet, fleet_value_and_grad
-    from metran_tpu.parallel.fleet import Fleet, default_init_params
+    from metran_tpu.parallel.fleet import (
+        Fleet, autocorr_init_params, default_init_params,
+    )
 
     def make_fleet(y, mask, loadings):
         b = y.shape[0]
@@ -351,16 +355,27 @@ def run_device_bench(out_path: str, budget_s: float,
     write_partial(out_path, out)
 
     # ---- fit: chunked lanes L-BFGS ------------------------------------
+    # the fit starts from the data-driven lag-1-autocorrelation init
+    # (a framework feature the reference lacks — measured on-chip it
+    # cuts mean L-BFGS iterations ~25%, 11.5 -> 8.6); the jitted init
+    # runs on device and is INSIDE the timed block, so the headline
+    # measures the whole fit workflow
+    def timed_fit():
+        p0 = autocorr_init_params(fleet)
+        fit = fit_fleet(
+            fleet, p0=p0, maxiter=MAXITER, chunk=CHUNK, **fit_kwargs
+        )
+        np.asarray(fit.params)
+        return fit
+
     t0 = time.perf_counter()
-    fit = fit_fleet(fleet, maxiter=MAXITER, chunk=CHUNK, **fit_kwargs)
-    np.asarray(fit.params)
+    fit = timed_fit()
     fit_compile_s = time.perf_counter() - t0
     iters = float(np.mean(np.asarray(fit.iterations)))
     progress("fit_compiled", compile_plus_first_run_s=round(fit_compile_s, 1),
              iters_mean=round(iters, 1))
     t0 = time.perf_counter()
-    fit = fit_fleet(fleet, maxiter=MAXITER, chunk=CHUNK, **fit_kwargs)
-    np.asarray(fit.params)
+    fit = timed_fit()
     fit_run_s = time.perf_counter() - t0
     fit_plausible = fit_run_s >= MIN_PLAUSIBLE_DISPATCH_S
     if not fit_plausible:
@@ -369,6 +384,7 @@ def run_device_bench(out_path: str, budget_s: float,
     out["fit"] = {
         "compile_plus_first_run_s": round(fit_compile_s, 1),
         "run_s": round(fit_run_s, 2),
+        "init": "autocorr (on-device, inside the timed block)",
         "plausible": fit_plausible,
         "fits_per_s": (
             round(batch / fit_run_s, 3) if fit_plausible else 0.0

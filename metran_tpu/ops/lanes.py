@@ -103,6 +103,25 @@ def _adj_init_carry(phi, eye):
     )
 
 
+def _segment(y, mask, seg, dtype):
+    """Zero-pad (y, mask-as-float) to a multiple of ``seg`` timesteps and
+    reshape to (n_seg, seg, ...) — padded steps are all-masked no-ops.
+    One definition shared by both score paths so the padding semantics
+    cannot drift between them."""
+    t_steps = y.shape[0]
+    maskf = jnp.asarray(mask, dtype)
+    pad = (-t_steps) % seg
+    if pad:
+        y = jnp.concatenate([y, jnp.zeros((pad,) + y.shape[1:], dtype)])
+        maskf = jnp.concatenate(
+            [maskf, jnp.zeros((pad,) + maskf.shape[1:], dtype)]
+        )
+    return (
+        y.reshape(-1, seg, *y.shape[1:]),
+        maskf.reshape(-1, seg, *maskf.shape[1:]),
+    )
+
+
 def _lanes_filter_terms(phi, q, z, r, y, mask, remat_seg):
     """Per-timestep (sigma, detf), both (T, B), via the masked
     sequential-processing filter in lane layout (checkpointed segments;
@@ -112,16 +131,7 @@ def _lanes_filter_terms(phi, q, z, r, y, mask, remat_seg):
     t_steps = y.shape[0]
     dtype = phi.dtype
     eye = jnp.eye(n, dtype=dtype)[:, :, None]
-    maskf = jnp.asarray(mask, dtype)
-
-    pad = (-t_steps) % remat_seg
-    if pad:
-        y = jnp.concatenate([y, jnp.zeros((pad,) + y.shape[1:], dtype)])
-        maskf = jnp.concatenate(
-            [maskf, jnp.zeros((pad,) + maskf.shape[1:], dtype)]
-        )
-    y_seg = y.reshape(-1, remat_seg, *y.shape[1:])
-    m_seg = maskf.reshape(-1, remat_seg, *maskf.shape[1:])
+    y_seg, m_seg = _segment(y, mask, remat_seg, dtype)
 
     @jax.checkpoint
     def seg_body(carry, xs):
@@ -134,7 +144,7 @@ def _lanes_filter_terms(phi, q, z, r, y, mask, remat_seg):
     _, (sigma, detf) = lax.scan(
         seg_body, _adj_init_carry(phi, eye), (y_seg, m_seg)
     )
-    t_pad = t_steps + pad
+    t_pad = sigma.shape[0] * sigma.shape[1]
     return (
         sigma.reshape(t_pad, b)[:t_steps],
         detf.reshape(t_pad, b)[:t_steps],
@@ -165,33 +175,10 @@ def lanes_deviance_terms(sigma, detf, mask, warmup: int = 1):
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6,))
-def _terms_adjoint_core(phi, q, z, r, y_seg, m_seg, seg):
-    """Segmented filter terms with an analytical (phi, q) adjoint.
-
-    See :func:`_lanes_terms_adjoint` for the derivation and layout; this
-    core takes pre-segmented ``y_seg``/``m_seg`` of shape
-    (n_seg, seg, N, B) (mask as float) and returns (sigma, detf) of
-    shape (n_seg*seg, B).
-    """
-    n = phi.shape[0]
-    eye = jnp.eye(n, dtype=phi.dtype)[:, :, None]
-
-    def body(c, xs):
-        def inner(cc, t_xs):
-            cc2, out, _ = _adj_step(phi, q, z, r, cc, *t_xs, eye)
-            return cc2, out
-
-        return lax.scan(inner, c, xs)
-
-    _, (sig, det) = lax.scan(
-        body, _adj_init_carry(phi, eye), (y_seg, m_seg)
-    )
-    t_pad, b = sig.shape[0] * sig.shape[1], sig.shape[2]
-    return sig.reshape(t_pad, b), det.reshape(t_pad, b)
-
-
-def _terms_adjoint_fwd(phi, q, z, r, y_seg, m_seg, seg):
+def _run_segments(phi, q, z, r, y_seg, m_seg, keep_bounds):
+    """Forward filter over pre-segmented inputs; one definition for the
+    custom-vjp primal and fwd rules.  Returns flattened (sigma, detf)
+    plus the stacked segment-boundary carries when ``keep_bounds``."""
     n = phi.shape[0]
     eye = jnp.eye(n, dtype=phi.dtype)[:, :, None]
 
@@ -201,14 +188,31 @@ def _terms_adjoint_fwd(phi, q, z, r, y_seg, m_seg, seg):
             return cc2, out
 
         c2, out = lax.scan(inner, c, xs)
-        return c2, out + (c,)
+        return (c2, out + (c,)) if keep_bounds else (c2, out)
 
-    _, (sig, det, bounds) = lax.scan(
-        body, _adj_init_carry(phi, eye), (y_seg, m_seg)
-    )
+    _, outs = lax.scan(body, _adj_init_carry(phi, eye), (y_seg, m_seg))
+    sig, det = outs[0], outs[1]
     t_pad, b = sig.shape[0] * sig.shape[1], sig.shape[2]
-    out = (sig.reshape(t_pad, b), det.reshape(t_pad, b))
-    return out, (phi, q, z, r, y_seg, m_seg, bounds)
+    flat = (sig.reshape(t_pad, b), det.reshape(t_pad, b))
+    return flat + (outs[2],) if keep_bounds else flat + (None,)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6,))
+def _terms_adjoint_core(phi, q, z, r, y_seg, m_seg, seg):
+    """Segmented filter terms with an analytical (phi, q) adjoint.
+
+    See :func:`_lanes_terms_adjoint` for the derivation and layout; this
+    core takes pre-segmented ``y_seg``/``m_seg`` of shape
+    (n_seg, seg, N, B) (mask as float) and returns (sigma, detf) of
+    shape (n_seg*seg, B).
+    """
+    sig, det, _ = _run_segments(phi, q, z, r, y_seg, m_seg, False)
+    return sig, det
+
+
+def _terms_adjoint_fwd(phi, q, z, r, y_seg, m_seg, seg):
+    sig, det, bounds = _run_segments(phi, q, z, r, y_seg, m_seg, True)
+    return (sig, det), (phi, q, z, r, y_seg, m_seg, bounds)
 
 
 def _terms_adjoint_bwd(seg, residuals, cotangents):
@@ -339,19 +343,8 @@ def _lanes_terms_adjoint(phi, q, z, r, y, mask, seg):
     are produced for (phi, q) only; z/r/y/mask are fixed data in the
     MLE (the optimizer differentiates the AR decay parameters alpha).
     """
-    n_obs, n, b = z.shape
     t_steps = y.shape[0]
-    dtype = z.dtype
-    maskf = jnp.asarray(mask, dtype)
-    pad = (-t_steps) % seg
-    if pad:
-        y = jnp.concatenate([y, jnp.zeros((pad,) + y.shape[1:], dtype)])
-        maskf = jnp.concatenate(
-            [maskf, jnp.zeros((pad,) + maskf.shape[1:], dtype)]
-        )
-    t_pad = t_steps + pad
-    y_seg = y.reshape(t_pad // seg, seg, n_obs, b)
-    m_seg = maskf.reshape(t_pad // seg, seg, n_obs, b)
+    y_seg, m_seg = _segment(y, mask, seg, z.dtype)
     sig, det = _terms_adjoint_core(phi, q, z, r, y_seg, m_seg, seg)
     return sig[:t_steps], det[:t_steps]
 
@@ -400,9 +393,13 @@ def lanes_dfm_deviance(
         sigma, detf = _lanes_terms_adjoint(
             phi, q, z, r, y, mask, remat_seg or y.shape[0]
         )
-    else:
+    elif score == "autodiff":
         phi, q, z, r = lanes_statespace(alpha, loadings, dt)
         sigma, detf = _lanes_filter_terms(
             phi, q, z, r, y, mask, remat_seg or y.shape[0]
+        )
+    else:
+        raise ValueError(
+            f"unknown score {score!r}; expected 'adjoint' or 'autodiff'"
         )
     return lanes_deviance_terms(sigma, detf, mask, warmup=warmup)

@@ -19,6 +19,7 @@ from .fleet import (
     ALPHA_PMIN,
     Fleet,
     FleetFit,
+    autocorr_init_params,
     default_init_params,
     fit_fleet,
     fleet_deviance,
@@ -40,6 +41,7 @@ __all__ = [
     "BATCH_AXIS",
     "Fleet",
     "FleetFit",
+    "autocorr_init_params",
     "batch_sharding",
     "default_init_params",
     "fit_fleet",

@@ -1,0 +1,100 @@
+"""On-chip experiment: init strategy / line-search width / batch scaling.
+
+Not part of the bench; a scratch harness for measuring candidate
+optimizations on the real TPU before they change bench.py defaults.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".cache", "jax"),
+)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from bench import (  # noqa: E402
+    BATCH, CHUNK, MAXITER, REMAT_SEG, SEED, STALL_TOL, TOL,
+    make_workload,
+)
+from metran_tpu.parallel import fit_fleet  # noqa: E402
+from metran_tpu.parallel.fleet import (  # noqa: E402
+    Fleet, autocorr_init_params, default_init_params,
+)
+
+
+def log(**kw):
+    print(json.dumps(kw), flush=True)
+
+
+def make_fleet(y, mask, loadings):
+    b = y.shape[0]
+    return Fleet(
+        y=jnp.asarray(y, jnp.float32),
+        mask=jnp.asarray(mask),
+        loadings=jnp.asarray(loadings, jnp.float32),
+        dt=jnp.ones(b, jnp.float32),
+        n_series=jnp.full(b, y.shape[2], np.int32),
+    )
+
+
+def run_fit(label, fleet, p0, ls, reps=2, chunk=CHUNK):
+    kw = dict(layout="lanes", remat_seg=REMAT_SEG, tol=TOL,
+              stall_tol=STALL_TOL, max_linesearch_steps=ls,
+              maxiter=MAXITER, chunk=chunk)
+    t0 = time.perf_counter()
+    fit = fit_fleet(fleet, p0=p0, **kw)
+    np.asarray(fit.params)
+    compile_s = time.perf_counter() - t0
+    runs = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fit = fit_fleet(fleet, p0=p0, **kw)
+        np.asarray(fit.params)
+        runs.append(round(time.perf_counter() - t0, 2))
+    run_s = float(np.median(runs))
+    b = fleet.batch
+    log(label=label, compile_plus_first_s=round(compile_s, 1),
+        runs_s=runs, fits_per_s=round(b / run_s, 1),
+        iters_mean=round(float(np.mean(np.asarray(fit.iterations))), 1),
+        dev0=float(np.asarray(fit.deviance)[0]),
+        dev_sum=float(np.asarray(fit.deviance).sum()),
+        converged=round(float(np.mean(np.asarray(fit.converged))), 3))
+    return fit
+
+
+def main():
+    log(platform=jax.devices()[0].platform, n=len(jax.devices()))
+    rng = np.random.default_rng(SEED)
+    y, mask, loadings = make_workload(rng, BATCH)
+    fleet = make_fleet(y, mask, loadings)
+    p_ref = default_init_params(fleet)
+    t0 = time.perf_counter()
+    p_auto = autocorr_init_params(fleet)
+    log(stage="autocorr_init_host_s", s=round(time.perf_counter() - t0, 2))
+
+    # ls widths pinned literally: these labels document the comparison
+    # that justified bench.py's MAX_LS default, so they must not drift
+    # with it
+    run_fit("A_ref_init_ls6", fleet, p_ref, 6)
+    run_fit("B_auto_init_ls6", fleet, p_auto, 6)
+    run_fit("C_auto_init_ls4", fleet, p_auto, 4)
+    run_fit("D_auto_init_ls3", fleet, p_auto, 3)
+
+    # batch scaling at the best-known config
+    y2, mask2, ld2 = make_workload(np.random.default_rng(SEED), 1024)
+    fleet2 = make_fleet(y2, mask2, ld2)
+    run_fit("E_auto_init_ls4_b1024", fleet2,
+            autocorr_init_params(fleet2), 4, reps=1)
+
+
+if __name__ == "__main__":
+    main()
