@@ -241,16 +241,55 @@ class Metran:
     # ------------------------------------------------------------------
     # parameters
     # ------------------------------------------------------------------
-    def set_init_parameters(self) -> None:
-        pinit_alpha = 10.0
+    def set_init_parameters(self, method: str = "reference") -> None:
+        """Populate the initial-parameter table.
+
+        ``method="reference"`` (default) uses the reference's constant
+        ``alpha = 10`` for every state (metran/metran.py:439-462).
+        ``method="autocorr"`` seeds each alpha from the data's lag-1
+        autocorrelations instead (see
+        :func:`metran_tpu.parallel.autocorr_init_params`) — measured to
+        cut L-BFGS iterations ~25 percent with identical optima; it
+        needs factor loadings, so call it after ``get_factors`` (done
+        automatically by ``solve(init="autocorr")``).
+        """
+        if method == "autocorr":
+            if self.factors is None:
+                raise ValueError(
+                    "init method 'autocorr' needs factor loadings; call "
+                    "get_factors first or use solve(init='autocorr')"
+                )
+            import jax.numpy as jnp
+
+            from ..parallel.fleet import Fleet, autocorr_init_params
+
+            panel = self._active_panel()
+            fleet = Fleet(
+                y=jnp.asarray(panel.values[None]),
+                mask=jnp.asarray(panel.mask[None]),
+                loadings=jnp.asarray(np.asarray(self.factors)[None]),
+                dt=jnp.full(1, panel.dt),
+                n_series=np.full(1, self.nseries, np.int32),
+            )
+            alpha = np.asarray(autocorr_init_params(fleet))[0]
+            init_sdf = alpha[: self.nseries]
+            init_cdf = alpha[self.nseries :]
+        elif method == "reference":
+            init_sdf = np.full(self.nseries, 10.0)
+            init_cdf = np.full(self.nfactors, 10.0)
+        else:
+            raise ValueError(
+                f"unknown init method {method!r}; expected 'reference' "
+                "or 'autocorr'"
+            )
         cols = ["initial", "pmin", "pmax", "vary", "name"]
         for n in range(self.nfactors):
             self.parameters.loc[f"cdf{n + 1}_alpha", cols] = (
-                pinit_alpha, 1e-5, None, True, "cdf",
+                init_cdf[n], 1e-5, None, True, "cdf",
             )
         for n in range(self.nseries):
             self.parameters.loc[f"{self.snames[n]}_sdf_alpha", cols] = (
-                pinit_alpha, 1e-5, None, True, "sdf",
+                init_sdf[n], 1e-5, None, True, "sdf",
             )
 
     def get_parameters(self, initial: bool = False) -> Series:
@@ -519,7 +558,12 @@ class Metran:
     # solve
     # ------------------------------------------------------------------
     def solve(
-        self, solver=None, report: bool = True, engine: Optional[str] = None, **kwargs
+        self,
+        solver=None,
+        report: bool = True,
+        engine: Optional[str] = None,
+        init: str = "reference",
+        **kwargs,
     ) -> None:
         """Estimate parameters by maximum likelihood.
 
@@ -535,6 +579,11 @@ class Metran:
         engine : str, optional
             Kalman engine override ("sequential"/"joint"/"parallel"; the
             reference's "numba"/"numpy" map to "sequential").
+        init : str, optional
+            Initial-parameter strategy: "reference" (constant alpha=10,
+            reference parity) or "autocorr" (data-driven lag-1
+            autocorrelation seed — same optimum, fewer iterations; see
+            :meth:`set_init_parameters`).
         **kwargs
             Passed through to the solver's minimize call.
         """
@@ -542,7 +591,7 @@ class Metran:
         if factors is None:
             return
         self._init_kalmanfilter(engine=engine)
-        self.set_init_parameters()
+        self.set_init_parameters(method=init)
 
         if solver is None:
             if self.fit is None:

@@ -259,9 +259,11 @@ def autocorr_init_params(fleet: Fleet) -> jnp.ndarray:
     ``phi = exp(-dt/alpha)`` has lag-1 autocorrelation exactly ``phi``,
     and a standardized observed series is a variance-weighted mixture of
     its specific state and the common factors, so the *observed* lag-1
-    autocorrelation ``r1_i = sum(y_t y_{t-dt}) / sum(y^2)`` over
-    consecutive-observed pairs is a moment estimate of the mixture decay
-    — a far better start than a fixed constant.  Per model:
+    autocorrelation ``r1_i = sum(y_t y_{t-dt}) / sqrt(sum(y_t^2) *
+    sum(y_{t-dt}^2))`` over consecutive-observed pairs (both norms on
+    the same pair support, so scale drift and uneven missingness cancel)
+    is a moment estimate of the mixture decay — a far better start than
+    a fixed constant.  Per model:
 
     - specific states: ``phi_i^hat = r1`` of series ``i``;
     - common factors: ``r1`` of the loading-weighted factor proxy
@@ -328,7 +330,13 @@ def _autocorr_init(y, mask, loadings, dt):
         jnp.einsum("bn,bnk->bk", r1_s, noise_w) / jnp.where(w > 0, w, 1.0),
         0.0,
     )
-    obs_rate = mask.mean(axis=(1, 2))[:, None]  # (B, 1)
+    # observation rate over REAL series only: padded all-masked columns
+    # would otherwise dilute the rate for heterogeneous fleets
+    active = jnp.any(mask, axis=1)  # (B, N)
+    n_active = jnp.maximum(active.sum(axis=1), 1)  # (B,)
+    obs_rate = (
+        mask.sum(axis=(1, 2)) / (mask.shape[1] * n_active)
+    )[:, None]  # (B, 1)
     r1_c = r1_c * (1.0 + v) - v * obs_rate * phi_w
 
     r1 = jnp.concatenate([r1_s, r1_c], axis=1)  # (B, N+K)

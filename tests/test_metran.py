@@ -259,3 +259,23 @@ def test_timeseries_duck_typed_input(series_list):
         m_wrapped.oseries.values, m_plain.oseries.values
     )
     assert list(m_wrapped.snames) == list(m_plain.snames)
+
+
+def test_metran_solve_autocorr_init(series_list, golden):
+    """solve(init="autocorr") seeds alphas from the data's lag-1
+    autocorrelations and reaches the reference optimum (the init changes
+    the path, not the destination); set_init_parameters validates its
+    inputs."""
+    m = metran_tpu.Metran(series_list, name="B21B0214")
+    with pytest.raises(ValueError, match="autocorr"):
+        m.set_init_parameters(method="autocorr")  # no loadings yet
+    with pytest.raises(ValueError, match="unknown init"):
+        m.set_init_parameters(method="bogus")
+    m.solve(init="autocorr", report=False)
+    init = m.parameters["initial"].values
+    assert not np.allclose(init, 10.0)  # genuinely data-driven
+    assert np.all(init >= 1e-5)
+    np.testing.assert_allclose(
+        m.parameters["optimal"].values, golden["optimal"], rtol=1e-3
+    )
+    np.testing.assert_allclose(m.fit.obj_func, golden["obj_func"], rtol=1e-6)
