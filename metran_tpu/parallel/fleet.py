@@ -499,9 +499,23 @@ def _make_lanes_runner(warmup, tol, chunk, maxiter, ls_steps,
     return init, run_chunk
 
 
+def _gather_lanes(tree, idx):
+    """Take lanes ``idx`` along the LAST axis of every leaf."""
+    return jax.tree.map(lambda a: jnp.take(a, idx, axis=-1), tree)
+
+
+def _scatter_lanes(full, part, idx):
+    """Write lanes ``part`` back into ``full`` at ``idx`` (last axis)."""
+    return jax.tree.map(lambda f, p: f.at[..., idx].set(p), full, part)
+
+
+COMPACT_MIN = 128  # never compact below one full TPU lane tile
+
+
 def _fit_fleet_lanes(fleet, p0, warmup, maxiter, tol, mesh,
                      chunk, max_linesearch_steps, alpha_max, stall_tol,
-                     checkpoint, remat_seg, history=8, max_chunks=None):
+                     checkpoint, remat_seg, history=8, max_chunks=None,
+                     compact_min=COMPACT_MIN):
     """Lane-layout fleet fit driver (see ``fit_fleet(layout="lanes")``)."""
     from . import lanes_lbfgs
 
@@ -576,24 +590,67 @@ def _fit_fleet_lanes(fleet, p0, warmup, maxiter, tol, mesh,
 
     iters_left = maxiter
     dispatches = 0
+    sel = sel_dev = None  # original lane indices of the compacted set
+    work_state, work_data = state, data
+
+    def full_state():
+        """Full-fleet state: the working set scattered over the last
+        full snapshot (lanes dropped at earlier compactions kept their
+        final values at that sync point).  O(batch) — called only at
+        checkpoint saves, compaction events and loop exit, so steady-
+        state tail dispatches stay O(working set)."""
+        if sel is None:
+            return work_state
+        return _scatter_lanes(state, work_state, sel_dev)
+
     while iters_left > 0:
         if max_chunks is not None and dispatches >= max_chunks:
             break
         if dispatches == 0 and iters_left >= chunk:
-            state = run_chunk(state, *data)
+            work_state = run_chunk(work_state, *work_data)
             iters_left -= chunk
         else:
-            state = run_tail(state, *data)
+            work_state = run_tail(work_state, *work_data)
             iters_left -= tail
         dispatches += 1
         # stall stopping is per-iteration ON DEVICE in the lanes step
         # (lanes_lbfgs.make_step); the host only checks the aggregate
         # frozen flags between dispatches
-        frozen_host = np.asarray(state.frozen)
-        prev_value = np.asarray(state.value)
-        _save_ckpt()
+        frozen_host = np.asarray(work_state.frozen)
+        if checkpoint is not None:
+            state = full_state()
+            prev_value = np.asarray(state.value)
+            _save_ckpt()
         if frozen_host.all():
             break
+        # tail compaction (single-device only): once most of the working
+        # set is frozen, gather the live lanes into a power-of-two
+        # sub-batch (>= compact_min, one full TPU lane tile) so tail
+        # dispatches stop paying for finished lanes.  Lanes never
+        # interact inside the optimizer, so results are identical to
+        # the uncompacted schedule (tests/test_parallel.py).
+        if mesh is None:
+            live = np.flatnonzero(~frozen_host)
+            bw = frozen_host.size
+            target = max(
+                compact_min,
+                1 << int(np.ceil(np.log2(max(live.size, 1)))),
+            )
+            if target < bw:
+                # sync first so lanes leaving the working set keep
+                # their final values; then pad the live set with frozen
+                # lanes (inert riders) up to the power-of-two size
+                state = full_state()
+                frozen_idx = np.flatnonzero(frozen_host)
+                local = np.concatenate(
+                    [live, frozen_idx[: target - live.size]]
+                )
+                sel_prev = np.arange(bw) if sel is None else sel
+                sel = sel_prev[local]
+                sel_dev = jnp.asarray(sel)
+                work_state = _gather_lanes(state, sel_dev)
+                work_data = _gather_lanes(data, sel_dev)
+    state = full_state()
     params = _theta_to_alpha(state.theta, theta_cap).T  # (B, N+K)
     conv = jnp.linalg.norm(state.grad, axis=0) < tol
     return FleetFit(params, state.value, state.count, conv)
@@ -628,6 +685,7 @@ def fit_fleet(
     layout: str = "batch",
     remat_seg: Optional[int] = None,
     max_chunks: Optional[int] = None,
+    compact_min: int = COMPACT_MIN,
 ) -> FleetFit:
     """Fit every model in the fleet by on-device L-BFGS.
 
@@ -685,6 +743,11 @@ def fit_fleet(
         (e.g. under an external preemption budget); combined with
         ``checkpoint``, a later identical call resumes where this one
         stopped.  Default: run to convergence/maxiter.
+    compact_min : (``layout="lanes"``, single-device) smallest
+        power-of-two working-batch size tail compaction may shrink to
+        (default one full TPU lane tile).  Compaction gathers the
+        not-yet-converged lanes into a smaller batch so tail dispatches
+        stop paying for finished lanes; results are identical.
     """
     if p0 is None:
         p0 = default_init_params(fleet)
@@ -717,7 +780,7 @@ def fit_fleet(
         return _fit_fleet_lanes(
             fleet, p0, warmup, maxiter, tol, mesh, chunk,
             max_linesearch_steps, alpha_max, stall_tol, checkpoint,
-            remat_seg, max_chunks=max_chunks,
+            remat_seg, max_chunks=max_chunks, compact_min=compact_min,
         )
     opt, advance, outputs = _make_chunk_runner(
         warmup, engine, tol, chunk, maxiter, max_linesearch_steps,

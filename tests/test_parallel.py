@@ -403,3 +403,27 @@ def test_fit_fleet_auto_init_same_optimum(rng):
     np.testing.assert_allclose(
         np.asarray(auto.deviance), np.asarray(ref.deviance), rtol=2e-4
     )
+
+
+def test_fit_fleet_lanes_compaction_invariant(rng):
+    """Tail compaction (gathering live lanes into a smaller working
+    batch once most lanes froze) must not change any lane's result:
+    the optimizer never couples lanes, so the compacted schedule is the
+    same computation with the finished riders removed."""
+    fleet = _structured_fleet(rng, batch=8)
+    kwargs = dict(
+        maxiter=40, chunk=6, layout="lanes", remat_seg=32,
+        stall_tol=1e-9,
+    )
+    base = fit_fleet(fleet, compact_min=fleet.batch, **kwargs)  # never
+    compacted = fit_fleet(fleet, compact_min=1, **kwargs)  # aggressive
+    np.testing.assert_allclose(
+        np.asarray(compacted.deviance), np.asarray(base.deviance),
+        rtol=1e-12,
+    )
+    np.testing.assert_allclose(
+        np.asarray(compacted.params), np.asarray(base.params), rtol=1e-12
+    )
+    np.testing.assert_array_equal(
+        np.asarray(compacted.iterations), np.asarray(base.iterations)
+    )
