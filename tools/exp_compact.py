@@ -1,0 +1,83 @@
+"""On-chip experiment: tail compaction + chunk-size ablation.
+
+Scratch harness (like tools/exp_init.py) for measuring the lanes fleet
+fit at the current bench defaults (autocorr init, 4-trial line search)
+with compaction on/off and different chunk sizes, on the real TPU.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".cache", "jax"),
+)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from bench import (  # noqa: E402
+    BATCH, MAXITER, REMAT_SEG, SEED, STALL_TOL, TOL, make_workload,
+)
+from metran_tpu.parallel import fit_fleet  # noqa: E402
+from metran_tpu.parallel.fleet import (  # noqa: E402
+    Fleet, autocorr_init_params,
+)
+
+
+def log(**kw):
+    print(json.dumps(kw), flush=True)
+
+
+def run_fit(label, fleet, p0, chunk, compact_min, reps=2):
+    kw = dict(layout="lanes", remat_seg=REMAT_SEG, tol=TOL,
+              stall_tol=STALL_TOL, max_linesearch_steps=4,
+              maxiter=MAXITER, chunk=chunk, compact_min=compact_min)
+    t0 = time.perf_counter()
+    fit = fit_fleet(fleet, p0=p0, **kw)
+    np.asarray(fit.params)
+    compile_s = time.perf_counter() - t0
+    runs = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fit = fit_fleet(fleet, p0=p0, **kw)
+        np.asarray(fit.params)
+        runs.append(round(time.perf_counter() - t0, 2))
+    run_s = float(np.median(runs))
+    log(label=label, compile_plus_first_s=round(compile_s, 1),
+        runs_s=runs, fits_per_s=round(fleet.batch / run_s, 1),
+        iters_mean=round(float(np.mean(np.asarray(fit.iterations))), 1),
+        iters_max=int(np.max(np.asarray(fit.iterations))),
+        dev_sum=float(np.asarray(fit.deviance).sum()))
+    return fit
+
+
+def main():
+    log(platform=jax.devices()[0].platform)
+    rng = np.random.default_rng(SEED)
+    y, mask, loadings = make_workload(rng, BATCH)
+    fleet = Fleet(
+        y=jnp.asarray(y, jnp.float32),
+        mask=jnp.asarray(mask),
+        loadings=jnp.asarray(loadings, jnp.float32),
+        dt=jnp.ones(BATCH, jnp.float32),
+        n_series=jnp.full(BATCH, y.shape[2], np.int32),
+    )
+    p0 = autocorr_init_params(fleet)
+    np.asarray(p0)
+    log(stage="workload_ready")
+
+    run_fit("F_defaults_compact128", fleet, p0, 8, 128)
+    run_fit("G_no_compaction", fleet, p0, 8, BATCH)
+    run_fit("H_chunk5_compact128", fleet, p0, 5, 128)
+    run_fit("I_chunk6_compact128", fleet, p0, 6, 128)
+
+
+if __name__ == "__main__":
+    main()
