@@ -86,8 +86,12 @@ def _map_criteria(cov: np.ndarray, eigvec: np.ndarray):
         diag = np.diag(partcov)
         if diag.min() < 0:
             return vals, vals4, True
-        d = np.diag(1.0 / np.sqrt(diag))
-        pr = d @ partcov @ d
+        # a zero partial variance yields inf/nan entries, matching the
+        # reference's arithmetic (it early-exits only on NEGATIVE
+        # diagonals); silence the numpy warnings, keep the values
+        with np.errstate(divide="ignore", invalid="ignore"):
+            d = np.diag(1.0 / np.sqrt(diag))
+            pr = d @ partcov @ d
         vals.append((np.sum(pr**2) - nvars) / denom)
         vals4.append((np.sum(pr**4) - nvars) / denom)
     return vals, vals4, False

@@ -5,34 +5,24 @@ fit at the current bench defaults (autocorr init, 4-trial line search)
 with compaction on/off and different chunk sizes, on the real TPU.
 """
 
-import json
 import os
 import sys
 import time
 
 import numpy as np
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR",
-    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                 ".cache", "jax"),
-)
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+sys.path.insert(0, _HERE)
 
 import jax  # noqa: E402
-import jax.numpy as jnp  # noqa: E402
+from exp_init import log, make_fleet  # noqa: E402  (shared harness bits)
 
 from bench import (  # noqa: E402
     BATCH, MAXITER, REMAT_SEG, SEED, STALL_TOL, TOL, make_workload,
 )
 from metran_tpu.parallel import fit_fleet  # noqa: E402
-from metran_tpu.parallel.fleet import (  # noqa: E402
-    Fleet, autocorr_init_params,
-)
-
-
-def log(**kw):
-    print(json.dumps(kw), flush=True)
+from metran_tpu.parallel.fleet import autocorr_init_params  # noqa: E402
 
 
 def run_fit(label, fleet, p0, chunk, compact_min, reps=2):
@@ -62,13 +52,7 @@ def main():
     log(platform=jax.devices()[0].platform)
     rng = np.random.default_rng(SEED)
     y, mask, loadings = make_workload(rng, BATCH)
-    fleet = Fleet(
-        y=jnp.asarray(y, jnp.float32),
-        mask=jnp.asarray(mask),
-        loadings=jnp.asarray(loadings, jnp.float32),
-        dt=jnp.ones(BATCH, jnp.float32),
-        n_series=jnp.full(BATCH, y.shape[2], np.int32),
-    )
+    fleet = make_fleet(y, mask, loadings)
     p0 = autocorr_init_params(fleet)
     np.asarray(p0)
     log(stage="workload_ready")
