@@ -23,6 +23,7 @@ import jax
 from metran_tpu import data as mdata
 from metran_tpu.models.factoranalysis import FactorAnalysis
 from metran_tpu.parallel import (
+    autocorr_init_params,
     fit_fleet,
     make_mesh,
     pack_fleet,
@@ -77,12 +78,14 @@ def main():
     counter = ThroughputCounter(unit="fits")
     with counter.measure(n=n_models):
         # practical fleet settings: the lane-layout kernel + grid
-        # L-BFGS (the TPU hot path — see README), a deviance-scale
-        # tolerance, segmented gradient remat, and per-iteration
-        # stall-freezing so each lane stops the moment it hits the
-        # floating-point resolution floor near its optimum
+        # L-BFGS (the TPU hot path — see README), the data-driven
+        # lag-1-autocorrelation init (~25% fewer iterations), a
+        # deviance-scale tolerance, segmented gradient remat, and
+        # per-iteration stall-freezing so each lane stops the moment it
+        # hits the floating-point resolution floor near its optimum
         fit = fit_fleet(
-            fleet, mesh=mesh, maxiter=40, chunk=10,
+            fleet, p0=autocorr_init_params(fleet),
+            mesh=mesh, maxiter=40, chunk=10,
             tol=1e-2, stall_tol=1e-4,
             layout="lanes", remat_seg=128,
             checkpoint="/tmp/fleet_ckpt.npz",  # preemption-safe
