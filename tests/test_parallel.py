@@ -463,3 +463,26 @@ def test_fit_fleet_lanes_checkpoint_with_compaction(rng, tmp_path, monkeypatch):
     np.testing.assert_allclose(
         np.asarray(resumed.params), np.asarray(full.params), rtol=1e-12
     )
+
+
+def test_fit_fleet_lanes_compaction_with_padding(rng):
+    """Fleet padding (all-masked dummy models) freezes immediately
+    (deviance 0, zero gradient), so compaction drops the padding early;
+    real models' results must match the unpadded fit."""
+    fleet, _, _ = _random_fleet(rng, [4, 3, 4], t=80, pad_batch_to=8)
+    kwargs = dict(
+        maxiter=20, chunk=4, layout="lanes", remat_seg=32,
+        stall_tol=1e-6,
+    )
+    padded = fit_fleet(fleet, compact_min=1, **kwargs)
+    unpadded = fit_fleet(
+        jax.tree.map(lambda a: a[:3], fleet), compact_min=1, **kwargs
+    )
+    np.testing.assert_allclose(
+        np.asarray(padded.deviance[:3]), np.asarray(unpadded.deviance),
+        rtol=1e-12,
+    )
+    np.testing.assert_allclose(
+        np.asarray(padded.params[:3]), np.asarray(unpadded.params),
+        rtol=1e-12,
+    )
