@@ -220,7 +220,13 @@ def load_fleet_state(path, like_theta, like_state, like_frozen):
         if n_stored != len(leaves) or any(k not in data for k in keys):
             return None
         stored = [data[k] for k in keys]
-        if any(s.shape != np.shape(l) for s, l in zip(stored, leaves)):
+        # shape AND dtype must match the live template: a checkpoint
+        # written under a different precision mode (e.g. jax_enable_x64
+        # flipped) would otherwise silently promote the resumed fit
+        if any(
+            s.shape != np.shape(l) or s.dtype != np.result_type(l)
+            for s, l in zip(stored, leaves)
+        ):
             return None
         theta, state, frozen = jax.tree_util.tree_unflatten(treedef, stored)
         prev_value = data["prev_value"]

@@ -120,3 +120,27 @@ def test_fleet_checkpoint_invalidated_on_config_change(tmp_path, rng):
     np.testing.assert_allclose(
         np.asarray(redone.params), np.asarray(fresh.params), rtol=1e-9
     )
+
+
+def test_fleet_checkpoint_rejects_dtype_mismatch(tmp_path):
+    """A checkpoint written under another precision mode (leaf dtypes
+    differ from the live template) must be rejected, not silently
+    promoted into the resumed fit."""
+    import jax.numpy as jnp
+
+    from metran_tpu import io as mio
+
+    theta = jnp.zeros((3, 2), jnp.float64)
+    state = {"v": jnp.ones(2, jnp.float64)}
+    frozen = jnp.zeros(2, bool)
+    path = tmp_path / "state.npz"
+    mio.save_fleet_state(path, theta, state, frozen, None, {"k": 1})
+    # same shapes, f32 template -> reject
+    got = mio.load_fleet_state(
+        path, jnp.zeros((3, 2), jnp.float32),
+        {"v": jnp.ones(2, jnp.float32)}, frozen,
+    )
+    assert got is None
+    # matching template -> restores
+    got = mio.load_fleet_state(path, theta, state, frozen)
+    assert got is not None and got[4] == {"k": 1}
