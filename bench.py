@@ -378,7 +378,8 @@ def run_device_bench(out_path: str, budget_s: float,
     fit = timed_fit()
     fit_run_s = time.perf_counter() - t0
     # stats below come from THIS run so mean/max are coherent
-    iters = float(np.mean(np.asarray(fit.iterations)))
+    iters_arr = np.asarray(fit.iterations)
+    iters = float(np.mean(iters_arr))
     fit_plausible = fit_run_s >= MIN_PLAUSIBLE_DISPATCH_S
     if not fit_plausible:
         progress("implausible_timing", laps_s=[fit_run_s],
@@ -392,7 +393,7 @@ def run_device_bench(out_path: str, budget_s: float,
             round(batch / fit_run_s, 3) if fit_plausible else 0.0
         ),
         "lbfgs_iters_mean": round(iters, 1),
-        "lbfgs_iters_max": int(np.max(np.asarray(fit.iterations))),
+        "lbfgs_iters_max": int(iters_arr.max()),
         "converged_frac": round(float(np.mean(np.asarray(fit.converged))), 3),
         "deviance_model0": float(np.asarray(fit.deviance)[0]),
         "batch": batch,

@@ -110,3 +110,17 @@ def show_versions() -> None:
 
 
 from .profiling import ThroughputCounter, annotate, trace  # noqa: E402,F401
+
+__all__ = [
+    "ILLEGAL_NAME_CHARS",
+    "ThroughputCounter",
+    "annotate",
+    "freq_to_days",
+    "frequency_is_supported",
+    "get_height_ratios",
+    "get_logger",
+    "initialize_logger",
+    "show_versions",
+    "trace",
+    "validate_name",
+]

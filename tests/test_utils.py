@@ -1,0 +1,63 @@
+"""Direct unit tests for the host-side utilities (the local equivalents
+of the pastas helpers the reference imports — SURVEY.md section 2.4)."""
+
+import logging
+
+import numpy as np
+import pytest
+
+from metran_tpu import utils
+
+
+def test_validate_name_passthrough_and_warning(caplog):
+    assert utils.validate_name("well_1") == "well_1"
+    with caplog.at_level(logging.WARNING, "metran_tpu"):
+        assert utils.validate_name("bad name") == "bad name"
+    assert any("illegal character" in r.message for r in caplog.records)
+    with pytest.raises(ValueError, match="illegal character"):
+        utils.validate_name("a/b", raise_error=True)
+
+
+def test_frequency_is_supported():
+    assert utils.frequency_is_supported("D")
+    assert utils.frequency_is_supported("2D")
+    assert utils.frequency_is_supported("h")
+    for bad in ("M", "not-a-freq"):  # month has no fixed length
+        with pytest.raises(ValueError):
+            utils.frequency_is_supported(bad)
+
+
+def test_freq_to_days():
+    assert utils.freq_to_days("D") == 1.0
+    assert utils.freq_to_days("2D") == 2.0
+    assert utils.freq_to_days("12h") == 0.5
+
+
+def test_get_height_ratios():
+    ratios = utils.get_height_ratios([(0.0, 2.0), (0.0, 1.0)])
+    assert len(ratios) == 2
+    assert ratios[0] == pytest.approx(2.0 * ratios[1])
+
+
+def test_show_versions_prints_versions(capsys):
+    utils.show_versions()
+    out = capsys.readouterr().out
+    for token in ("numpy", "jax", "pandas"):
+        assert token in out
+
+
+def test_throughput_counter():
+    cnt = utils.ThroughputCounter(unit="items")
+    with cnt.measure(n=4):
+        np.ones(10).sum()
+    assert len(cnt.laps) == 1
+    assert cnt.laps[0]["n"] == 4
+    assert "items" in cnt.summary()
+
+
+def test_utils_all_exports_resolve():
+    for name in utils.__all__:
+        assert hasattr(utils, name), name
+    # the typing/pandas imports must not be part of the public surface
+    for leaked in ("List", "Sequence", "Tuple", "Timedelta", "to_offset"):
+        assert leaked not in utils.__all__
