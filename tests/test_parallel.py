@@ -239,13 +239,19 @@ def test_lanes_value_and_grad_matches_batch_layout(rng):
     )
 
 
-def _structured_fleet(rng, batch=4, n=6, t=150, missing=0.2):
+def _structured_fleet(rng, batch=4, n=6, t=150, missing=0.2,
+                      alpha_c_range=(10, 40), alpha_s_range=(5, 20),
+                      return_truth=False):
     """Panels with a TRUE common factor + AR(1) specifics, so the DFM
     likelihood has a well-defined optimum (pure-noise panels are
-    multi-modal: optimizers legitimately land in different basins)."""
+    multi-modal: optimizers legitimately land in different basins).
+    ``return_truth`` also returns the generating (alpha_c, alpha_s,
+    loadings) for estimator-accuracy tests."""
     loadings = rng.uniform(0.4, 0.7, (batch, n, 1))
-    phi_c = np.exp(-1.0 / rng.uniform(10, 40, (batch, 1)))
-    phi_s = np.exp(-1.0 / rng.uniform(5, 20, (batch, n)))
+    alpha_c = rng.uniform(*alpha_c_range, (batch, 1))
+    alpha_s = rng.uniform(*alpha_s_range, (batch, n))
+    phi_c = np.exp(-1.0 / alpha_c)
+    phi_s = np.exp(-1.0 / alpha_s)
     e_c = rng.normal(size=(t, batch, 1)) * np.sqrt(1 - phi_c**2)
     e_s = rng.normal(size=(t, batch, n)) * np.sqrt(1 - phi_s**2)
     common = np.zeros((t, batch, 1))
@@ -262,13 +268,16 @@ def _structured_fleet(rng, batch=4, n=6, t=150, missing=0.2):
     mask = rng.uniform(size=y.shape) > missing
     from metran_tpu.parallel.fleet import Fleet
 
-    return Fleet(
+    fleet = Fleet(
         y=jnp.asarray(np.where(mask, y, 0.0)),
         mask=jnp.asarray(mask),
         loadings=jnp.asarray(loadings),
         dt=jnp.ones(batch),
         n_series=jnp.full(batch, n, np.int32),
     )
+    if return_truth:
+        return fleet, alpha_c, alpha_s, loadings
+    return fleet
 
 
 def test_fit_fleet_lanes_reaches_batch_optimum(rng):
@@ -340,34 +349,22 @@ def test_autocorr_init_recovers_persistence(rng):
     the optimizer's metric) — much nearer than the constant reference
     init — and padded slots fall back to ALPHA_INIT."""
     from metran_tpu.parallel import autocorr_init_params
-    from metran_tpu.parallel.fleet import ALPHA_INIT
+    from metran_tpu.parallel.fleet import ALPHA_INIT, Fleet
 
     batch, n, t = 4, 8, 2000
-    loadings = rng.uniform(0.4, 0.7, (batch, n, 1))
-    alpha_c = rng.uniform(10, 60, (batch, 1))
-    alpha_s = rng.uniform(5, 40, (batch, n))
-    phi_c, phi_s = np.exp(-1.0 / alpha_c), np.exp(-1.0 / alpha_s)
-    e_c = rng.normal(size=(t, batch, 1)) * np.sqrt(1 - phi_c**2)
-    e_s = rng.normal(size=(t, batch, n)) * np.sqrt(1 - phi_s**2)
-    common = np.zeros((t, batch, 1))
-    specific = np.zeros((t, batch, n))
-    for i in range(1, t):
-        common[i] = phi_c * common[i - 1] + e_c[i]
-        specific[i] = phi_s * specific[i - 1] + e_s[i]
-    comm = np.sum(loadings**2, axis=2)
-    y = np.transpose(
-        specific * np.sqrt(1 - comm)[None]
-        + np.einsum("tbk,bnk->tbn", common, loadings),
-        (1, 0, 2),
+    base, alpha_c, alpha_s, loadings = _structured_fleet(
+        rng, batch=batch, n=n, t=t, missing=0.3,
+        alpha_c_range=(10, 60), alpha_s_range=(5, 40), return_truth=True,
     )
-    mask = rng.uniform(size=y.shape) > 0.3
+    phi_c, phi_s = np.exp(-1.0 / alpha_c), np.exp(-1.0 / alpha_s)
+    comm = np.sum(loadings**2, axis=2)
     # pad one extra series slot (all-masked, zero loadings) + one factor
-    y_p = np.concatenate([np.where(mask, y, 0.0), np.zeros((batch, t, 1))], 2)
-    mask_p = np.concatenate([mask, np.zeros((batch, t, 1), bool)], 2)
+    y_p = np.concatenate([np.asarray(base.y), np.zeros((batch, t, 1))], 2)
+    mask_p = np.concatenate(
+        [np.asarray(base.mask), np.zeros((batch, t, 1), bool)], 2
+    )
     ld_p = np.zeros((batch, n + 1, 2))
     ld_p[:, :n, :1] = loadings
-    from metran_tpu.parallel.fleet import Fleet
-
     fleet = Fleet(
         y=jnp.asarray(y_p), mask=jnp.asarray(mask_p),
         loadings=jnp.asarray(ld_p), dt=jnp.ones(batch),
