@@ -23,6 +23,7 @@ from .fleet import (
     default_init_params,
     fit_fleet,
     fleet_deviance,
+    fleet_stderr,
     fleet_value_and_grad,
     make_train_step,
     pack_fleet,
@@ -46,6 +47,7 @@ __all__ = [
     "default_init_params",
     "fit_fleet",
     "fleet_deviance",
+    "fleet_stderr",
     "fleet_value_and_grad",
     "make_mesh",
     "make_train_step",

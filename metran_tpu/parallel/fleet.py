@@ -908,6 +908,43 @@ def fit_fleet(
     return FleetFit(params, value, count, conv)
 
 
+@functools.partial(
+    jax.jit, static_argnames=("warmup", "engine", "remat_seg")
+)
+def fleet_stderr(
+    params: jnp.ndarray,
+    fleet: Fleet,
+    warmup: int = 1,
+    engine: str = "joint",
+    remat_seg: Optional[int] = None,
+):
+    """Per-model parameter standard errors at ``params`` (B, N+K).
+
+    Batched exact-autodiff Hessian of the deviance with the reference's
+    covariance convention (``pcov = pinv(Hessian of the objective)``,
+    ``metran/solver.py:258-266``; our solvers' ``_get_covariance``):
+    one vmapped forward-over-reverse dispatch for the whole fleet.
+    Completes the fleet workflow's parity with the single-model solvers,
+    which report stderr in ``fit_report``.
+
+    Returns ``(stderr, pcov)`` with shapes (B, P) and (B, P, P).
+    Negative/zero curvature directions (e.g. parameters pinned at the
+    soft cap, padded slots) yield NaN stderr rather than a misleading
+    number.
+    """
+    def dev(p, y, m, ld, dt):
+        return _model_deviance(p, y, m, ld, dt, warmup, engine, remat_seg)
+
+    hess = jax.vmap(jax.hessian(dev))(
+        params, fleet.y, fleet.mask, fleet.loadings, fleet.dt
+    )
+    pcov = jnp.linalg.pinv(hess)
+    diag = jnp.diagonal(pcov, axis1=-2, axis2=-1)
+    stderr = jnp.where(diag > 0, jnp.sqrt(jnp.where(diag > 0, diag, 1.0)),
+                       jnp.nan)
+    return stderr, pcov
+
+
 # ----------------------------------------------------------------------
 # gradient-descent training step (the multi-chip "training step" surface)
 # ----------------------------------------------------------------------

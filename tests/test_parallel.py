@@ -483,3 +483,31 @@ def test_fit_fleet_lanes_compaction_with_padding(rng):
         np.asarray(padded.params[:3]), np.asarray(unpadded.params),
         rtol=1e-12,
     )
+
+
+def test_fleet_stderr_matches_solver_covariance(rng, series_list):
+    """Batched fleet_stderr reproduces the single-model solver's exact
+    autodiff covariance (pcov = pinv(H), metran/solver.py:258-266) at
+    the fitted optimum, modulo the table->canonical parameter order."""
+    from metran_tpu.models.metran import Metran
+    from metran_tpu.models.solver import JaxSolve
+    from metran_tpu.parallel import fleet_stderr
+
+    mt = Metran(series_list, engine="joint")
+    mt.solve(solver=JaxSolve, report=False)
+    x = mt.parameters["optimal"].values.astype(float)
+    cov_table = mt.fit._get_covariance(x)  # table order (cdf..., sdf...)
+    idx = mt._canonical_idx
+    want_stderr = np.sqrt(np.diag(cov_table))[idx]
+
+    fleet = pack_fleet([mt._active_panel()], [mt.factors])
+    params = jnp.asarray(mt._param_array(x))[None]
+    stderr, pcov = fleet_stderr(params, fleet, engine="joint")
+    got = np.asarray(stderr[0])
+    assert np.all(np.isfinite(got))
+    np.testing.assert_allclose(got, want_stderr, rtol=1e-5)
+    # covariance matrix itself matches after reordering to table order
+    np.testing.assert_allclose(
+        np.asarray(pcov[0]), cov_table[np.ix_(idx, idx)], rtol=1e-4,
+        atol=1e-10,
+    )
