@@ -23,6 +23,7 @@ from .fleet import (
     default_init_params,
     fit_fleet,
     fleet_deviance,
+    fleet_simulate,
     fleet_stderr,
     fleet_value_and_grad,
     make_train_step,
@@ -47,6 +48,7 @@ __all__ = [
     "default_init_params",
     "fit_fleet",
     "fleet_deviance",
+    "fleet_simulate",
     "fleet_stderr",
     "fleet_value_and_grad",
     "make_mesh",

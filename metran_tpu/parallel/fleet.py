@@ -908,6 +908,82 @@ def fit_fleet(
     return FleetFit(params, value, count, conv)
 
 
+def fleet_simulate(
+    params: jnp.ndarray,
+    fleet: Fleet,
+    engine: str = "joint",
+    smooth: bool = True,
+    batch_chunk: Optional[int] = None,
+):
+    """Observation-space projections for every fleet member.
+
+    The fleet analog of the reference's per-model ``simulate``
+    (``metran/kalmanfilter.py:569-603``): run the masked filter (and RTS
+    smoother when ``smooth``), then project states onto the observation
+    space — per-timestep means ``Z x_t`` and variances ``diag(Z P_t Z')``
+    — for the whole fleet in vmapped dispatches.  Returns
+    ``(means, variances)`` of shape (B, T, N), in standardized units
+    (multiply by each model's series std to rescale, as
+    ``Metran.get_scaled_observation_matrix`` does).
+
+    The smoother stores O(T n^2) covariances per model, so the fleet is
+    advanced in a host-driven loop of ``batch_chunk``-model dispatches
+    (default: everything in one dispatch) — that bounds the smoother
+    intermediates at O(batch_chunk T n^2); the (B, T, N) outputs
+    themselves stay on device and are concatenated there.  A short tail
+    is padded with inert all-masked models (one compiled shape per
+    configuration, no tail recompile).  Padded series slots/models
+    produce inert zero-mean projections.
+    """
+    run = _make_simulate_runner(engine, smooth)
+    b = fleet.batch
+    chunk = b if batch_chunk is None else min(max(int(batch_chunk), 1), b)
+
+    def sliced(a, i):
+        part = a[i : i + chunk]
+        pad = chunk - part.shape[0]
+        if pad:
+            # edge-replicate (a real model) rather than zero-fill: zero
+            # dt/params would put NaNs through the padded lanes
+            part = jnp.concatenate(
+                [part, jnp.broadcast_to(part[-1:],
+                                        (pad,) + part.shape[1:])]
+            )
+        return part
+
+    outs = [
+        run(*(sliced(a, i) for a in (
+            params, fleet.y, fleet.mask, fleet.loadings, fleet.dt
+        )))
+        for i in range(0, b, chunk)
+    ]
+    means = jnp.concatenate([o[0] for o in outs], axis=0)[:b]
+    variances = jnp.concatenate([o[1] for o in outs], axis=0)[:b]
+    return means, variances
+
+
+@functools.lru_cache(maxsize=8)
+def _make_simulate_runner(engine, smooth):
+    """Jitted vmapped filter(+smoother)+project pipeline, cached per
+    configuration so repeated ``fleet_simulate`` calls reuse the
+    compiled program."""
+    from ..ops import kalman_filter, rts_smoother
+    from ..ops import project as _project
+
+    def one(p, y, mask, loadings, dt):
+        n = loadings.shape[0]
+        ss = dfm_statespace(p[:n], p[n:], loadings, dt)
+        filt = kalman_filter(ss, y, mask, engine=engine)
+        if smooth:
+            sm = rts_smoother(ss, filt, engine=engine)
+            means, covs = sm.mean_s, sm.cov_s
+        else:
+            means, covs = filt.mean_f, filt.cov_f
+        return _project(ss.z, means, covs)
+
+    return jax.jit(jax.vmap(one))
+
+
 @functools.partial(
     jax.jit, static_argnames=("warmup", "engine", "remat_seg")
 )
