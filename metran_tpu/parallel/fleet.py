@@ -936,6 +936,31 @@ def fleet_simulate(
     produce inert zero-mean projections.
     """
     run = _make_simulate_runner(engine, smooth)
+    return _run_chunked(run, params, fleet, batch_chunk)
+
+
+def fleet_decompose(
+    params: jnp.ndarray,
+    fleet: Fleet,
+    engine: str = "joint",
+    smooth: bool = True,
+    batch_chunk: Optional[int] = None,
+):
+    """Per-member decomposition into specific and common contributions.
+
+    The fleet analog of the reference's ``decompose``
+    (``metran/kalmanfilter.py:605-644``): smoothed (or filtered) states
+    split into the specific part ``Z[:, :N] x[:N]`` (B, T, N) and the
+    per-factor parts (B, K, T, N).  Chunking semantics are those of
+    :func:`fleet_simulate`.
+    """
+    run = _make_simulate_runner(engine, smooth, decompose=True)
+    return _run_chunked(run, params, fleet, batch_chunk)
+
+
+def _run_chunked(run, params, fleet, batch_chunk):
+    """Host-driven loop of fixed-shape dispatches over the fleet axis;
+    outputs are concatenated on device and trimmed to the true batch."""
     b = fleet.batch
     chunk = b if batch_chunk is None else min(max(int(batch_chunk), 1), b)
 
@@ -957,17 +982,18 @@ def fleet_simulate(
         )))
         for i in range(0, b, chunk)
     ]
-    means = jnp.concatenate([o[0] for o in outs], axis=0)[:b]
-    variances = jnp.concatenate([o[1] for o in outs], axis=0)[:b]
-    return means, variances
+    return tuple(
+        jnp.concatenate([o[j] for o in outs], axis=0)[:b]
+        for j in range(len(outs[0]))
+    )
 
 
-@functools.lru_cache(maxsize=8)
-def _make_simulate_runner(engine, smooth):
+@functools.lru_cache(maxsize=16)
+def _make_simulate_runner(engine, smooth, decompose=False):
     """Jitted vmapped filter(+smoother)+project pipeline, cached per
-    configuration so repeated ``fleet_simulate`` calls reuse the
-    compiled program."""
-    from ..ops import kalman_filter, rts_smoother
+    configuration so repeated ``fleet_simulate``/``fleet_decompose``
+    calls reuse the compiled program."""
+    from ..ops import decompose_states, kalman_filter, rts_smoother
     from ..ops import project as _project
 
     def one(p, y, mask, loadings, dt):
@@ -979,6 +1005,8 @@ def _make_simulate_runner(engine, smooth):
             means, covs = sm.mean_s, sm.cov_s
         else:
             means, covs = filt.mean_f, filt.cov_f
+        if decompose:
+            return decompose_states(ss.z, means, n)
         return _project(ss.z, means, covs)
 
     return jax.jit(jax.vmap(one))

@@ -513,15 +513,32 @@ def test_fleet_stderr_matches_solver_covariance(rng, series_list):
     )
 
 
+def _padded_single_smoother(fleet, panel, ld, p):
+    """Smoothed states of one fleet member recomputed as a standalone
+    PADDED single-model problem (the oracle both fleet_simulate and
+    fleet_decompose tests compare against)."""
+    from metran_tpu.ops import dfm_statespace, kalman_filter, rts_smoother
+
+    n_pad = fleet.loadings.shape[1]
+    n = panel.n_series
+    ld_p = np.zeros((n_pad, fleet.loadings.shape[2]))
+    ld_p[:n] = ld
+    y_p = np.zeros((panel.n_timesteps, n_pad))
+    y_p[:, :n] = panel.values
+    m_p = np.zeros((panel.n_timesteps, n_pad), bool)
+    m_p[:, :n] = panel.mask
+    ss = dfm_statespace(p[:n_pad], p[n_pad:], ld_p, panel.dt)
+    filt = kalman_filter(ss, y_p, m_p, engine="joint")
+    return ss, rts_smoother(ss, filt, engine="joint")
+
+
 def test_fleet_simulate_matches_single_model(rng):
     """Batched fleet_simulate equals the per-model ops pipeline
     (filter -> smoother -> project) on a heterogeneous padded fleet,
     including an uneven tail chunk (batch 5, chunk 2) and the padding
     semantics the docstring promises (finite everywhere; padded series
     slots project with zero loadings)."""
-    from metran_tpu.ops import (
-        dfm_statespace, kalman_filter, project, rts_smoother,
-    )
+    from metran_tpu.ops import project
     from metran_tpu.parallel import fleet_simulate
 
     fleet, panels, loadings = _random_fleet(rng, [4, 3, 4], pad_batch_to=5)
@@ -534,21 +551,10 @@ def test_fleet_simulate_matches_single_model(rng):
     assert means.shape == fleet.y.shape
     assert np.all(np.isfinite(np.asarray(means)))
     assert np.all(np.isfinite(np.asarray(variances)))
-    n_pad = fleet.loadings.shape[1]
     for i, (panel, ld) in enumerate(zip(panels, loadings)):
-        n = panel.n_series
-        p = np.asarray(params[i])
-        # the fleet member is computed on PADDED shapes; build the same
-        # padded single-model problem for the oracle
-        ld_p = np.zeros((n_pad, fleet.loadings.shape[2]))
-        ld_p[:n] = ld
-        y_p = np.zeros((panel.n_timesteps, n_pad))
-        y_p[:, :n] = panel.values
-        m_p = np.zeros((panel.n_timesteps, n_pad), bool)
-        m_p[:, :n] = panel.mask
-        ss = dfm_statespace(p[:n_pad], p[n_pad:], ld_p, panel.dt)
-        filt = kalman_filter(ss, y_p, m_p, engine="joint")
-        sm = rts_smoother(ss, filt, engine="joint")
+        ss, sm = _padded_single_smoother(
+            fleet, panel, ld, np.asarray(params[i])
+        )
         want_m, want_v = project(ss.z, sm.mean_s, sm.cov_s)
         np.testing.assert_allclose(
             np.asarray(means[i]), np.asarray(want_m), rtol=1e-10, atol=1e-12
@@ -557,3 +563,34 @@ def test_fleet_simulate_matches_single_model(rng):
             np.asarray(variances[i]), np.asarray(want_v), rtol=1e-10,
             atol=1e-12,
         )
+
+
+def test_fleet_decompose_matches_single_model(rng):
+    """Batched fleet_decompose equals the per-model decompose_states
+    pipeline, and sdf + sum of cdf contributions reconstruct the
+    projected means."""
+    from metran_tpu.ops import decompose_states
+    from metran_tpu.parallel import fleet_decompose, fleet_simulate
+
+    fleet, panels, loadings = _random_fleet(rng, [4, 3], pad_batch_to=3)
+    params = default_init_params(fleet) * rng.uniform(
+        0.5, 1.5, (3, fleet.n_params)
+    )
+    sdf, cdf = fleet_decompose(params, fleet, engine="joint", batch_chunk=2)
+    means, _ = fleet_simulate(params, fleet, engine="joint")
+    np.testing.assert_allclose(
+        np.asarray(sdf + cdf.sum(axis=1)), np.asarray(means),
+        rtol=1e-10, atol=1e-12,
+    )
+    ss, sm = _padded_single_smoother(
+        fleet, panels[0], loadings[0], np.asarray(params[0])
+    )
+    want_sdf, want_cdf = decompose_states(
+        ss.z, sm.mean_s, fleet.loadings.shape[1]
+    )
+    np.testing.assert_allclose(
+        np.asarray(sdf[0]), np.asarray(want_sdf), rtol=1e-10, atol=1e-12
+    )
+    np.testing.assert_allclose(
+        np.asarray(cdf[0]), np.asarray(want_cdf), rtol=1e-10, atol=1e-12
+    )
