@@ -25,6 +25,8 @@ from metran_tpu.models.factoranalysis import FactorAnalysis
 from metran_tpu.parallel import (
     autocorr_init_params,
     fit_fleet,
+    fleet_simulate,
+    fleet_stderr,
     make_mesh,
     pack_fleet,
     pad_to_multiple,
@@ -97,6 +99,15 @@ def main():
         np.quantile(np.asarray(fit.deviance[:n_models]), [0.1, 0.5, 0.9]).round(1),
     )
     print("converged:", int(np.asarray(fit.converged[:n_models]).sum()), "/", n_models)
+
+    # batched post-fit products: per-model stderr and smoothed projections
+    stderr, _ = fleet_stderr(fit.params, fleet)
+    means, variances = fleet_simulate(fit.params, fleet, batch_chunk=8)
+    print(
+        "median stderr(alpha):",
+        float(np.nanmedian(np.asarray(stderr[:n_models]))).__round__(2),
+        "| simulation grid:", tuple(means.shape),
+    )
 
 
 if __name__ == "__main__":
