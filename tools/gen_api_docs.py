@@ -84,7 +84,12 @@ def render_module(modname: str) -> str:
             continue
         if inspect.isclass(obj):
             classes.append((name, obj))
-        elif inspect.isfunction(obj):
+        elif inspect.isfunction(obj) or inspect.isfunction(
+            getattr(obj, "__wrapped__", None)
+        ):
+            # plain functions AND wrapped callables (jax.jit preserves
+            # __wrapped__/__doc__/__module__ via functools.wraps) — the
+            # jitted entry points ARE the public API
             functions.append((name, obj))
     for name, cls in classes:
         lines += [f"## class `{name}{_signature(cls)}`", "", _doc(cls), ""]
