@@ -594,3 +594,30 @@ def test_fleet_decompose_matches_single_model(rng):
     np.testing.assert_allclose(
         np.asarray(cdf[0]), np.asarray(want_cdf), rtol=1e-10, atol=1e-12
     )
+
+
+def test_fleet_simulate_filtered_path(rng):
+    """smooth=False projects FILTERED states: matches the filter-only
+    oracle and differs from the smoothed projections."""
+    from metran_tpu.ops import (
+        dfm_statespace, kalman_filter, project,
+    )
+    from metran_tpu.parallel import fleet_simulate
+
+    fleet, panels, loadings = _random_fleet(rng, [4], pad_batch_to=1)
+    params = default_init_params(fleet)
+    means_f, vars_f = fleet_simulate(params, fleet, smooth=False)
+    means_s, _ = fleet_simulate(params, fleet, smooth=True)
+    assert not np.allclose(np.asarray(means_f), np.asarray(means_s))
+    panel, ld = panels[0], loadings[0]
+    p = np.asarray(params[0])
+    n = panel.n_series
+    ss = dfm_statespace(p[:n], p[n:], ld, panel.dt)
+    filt = kalman_filter(ss, panel.values, panel.mask, engine="joint")
+    want_m, want_v = project(ss.z, filt.mean_f, filt.cov_f)
+    np.testing.assert_allclose(
+        np.asarray(means_f[0]), np.asarray(want_m), rtol=1e-10, atol=1e-12
+    )
+    np.testing.assert_allclose(
+        np.asarray(vars_f[0]), np.asarray(want_v), rtol=1e-10, atol=1e-12
+    )
