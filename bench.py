@@ -701,9 +701,16 @@ def main() -> None:
             os.remove(dev_path)
         retry_budget = budget - elapsed() - 150.0
         dev_proc = _spawn("device", dev_path, retry_budget)
+        # when the FIRST attempt already failed at init (wedged tunnel),
+        # a recovered tunnel initializes in seconds — give the retry a
+        # short init window so a still-wedged device hands the remaining
+        # budget to the CPU fallback instead of burning another full
+        # init_timeout.  If the first attempt initialized fine (it died
+        # later, in forward/fit), keep the operator's full init window.
+        first_inited = "device_init_s" in first_attempt
         _wait_device(
             dev_proc, dev_path, time.monotonic() + retry_budget,
-            init_timeout,
+            init_timeout if first_inited else min(init_timeout, 120.0),
         )
         device = _read_json(dev_path) or {}
         if first_attempt:

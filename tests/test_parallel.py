@@ -513,10 +513,11 @@ def test_fleet_stderr_matches_solver_covariance(rng, series_list):
     )
 
 
-def _padded_single_smoother(fleet, panel, ld, p):
-    """Smoothed states of one fleet member recomputed as a standalone
-    PADDED single-model problem (the oracle both fleet_simulate and
-    fleet_decompose tests compare against)."""
+def _padded_single_states(fleet, panel, ld, p, smooth=True):
+    """(ss, means, covs) of one fleet member recomputed as a standalone
+    PADDED single-model problem (the oracle the fleet_simulate /
+    fleet_decompose tests compare against); ``smooth=False`` returns the
+    filtered states instead of the smoothed ones."""
     from metran_tpu.ops import dfm_statespace, kalman_filter, rts_smoother
 
     n_pad = fleet.loadings.shape[1]
@@ -529,7 +530,10 @@ def _padded_single_smoother(fleet, panel, ld, p):
     m_p[:, :n] = panel.mask
     ss = dfm_statespace(p[:n_pad], p[n_pad:], ld_p, panel.dt)
     filt = kalman_filter(ss, y_p, m_p, engine="joint")
-    return ss, rts_smoother(ss, filt, engine="joint")
+    if not smooth:
+        return ss, filt.mean_f, filt.cov_f
+    sm = rts_smoother(ss, filt, engine="joint")
+    return ss, sm.mean_s, sm.cov_s
 
 
 def test_fleet_simulate_matches_single_model(rng):
@@ -552,10 +556,10 @@ def test_fleet_simulate_matches_single_model(rng):
     assert np.all(np.isfinite(np.asarray(means)))
     assert np.all(np.isfinite(np.asarray(variances)))
     for i, (panel, ld) in enumerate(zip(panels, loadings)):
-        ss, sm = _padded_single_smoother(
+        ss, mean_s, cov_s = _padded_single_states(
             fleet, panel, ld, np.asarray(params[i])
         )
-        want_m, want_v = project(ss.z, sm.mean_s, sm.cov_s)
+        want_m, want_v = project(ss.z, mean_s, cov_s)
         np.testing.assert_allclose(
             np.asarray(means[i]), np.asarray(want_m), rtol=1e-10, atol=1e-12
         )
@@ -582,11 +586,11 @@ def test_fleet_decompose_matches_single_model(rng):
         np.asarray(sdf + cdf.sum(axis=1)), np.asarray(means),
         rtol=1e-10, atol=1e-12,
     )
-    ss, sm = _padded_single_smoother(
+    ss, mean_s, _ = _padded_single_states(
         fleet, panels[0], loadings[0], np.asarray(params[0])
     )
     want_sdf, want_cdf = decompose_states(
-        ss.z, sm.mean_s, fleet.loadings.shape[1]
+        ss.z, mean_s, fleet.loadings.shape[1]
     )
     np.testing.assert_allclose(
         np.asarray(sdf[0]), np.asarray(want_sdf), rtol=1e-10, atol=1e-12
@@ -597,27 +601,30 @@ def test_fleet_decompose_matches_single_model(rng):
 
 
 def test_fleet_simulate_filtered_path(rng):
-    """smooth=False projects FILTERED states: matches the filter-only
-    oracle and differs from the smoothed projections."""
-    from metran_tpu.ops import (
-        dfm_statespace, kalman_filter, project,
-    )
+    """smooth=False projects FILTERED states on a heterogeneous padded
+    fleet with chunked dispatch: matches the filter-only oracle and
+    differs from the smoothed projections."""
+    from metran_tpu.ops import project
     from metran_tpu.parallel import fleet_simulate
 
-    fleet, panels, loadings = _random_fleet(rng, [4], pad_batch_to=1)
+    fleet, panels, loadings = _random_fleet(rng, [4, 3], pad_batch_to=3)
     params = default_init_params(fleet)
-    means_f, vars_f = fleet_simulate(params, fleet, smooth=False)
+    means_f, vars_f = fleet_simulate(
+        params, fleet, smooth=False, batch_chunk=2
+    )
     means_s, _ = fleet_simulate(params, fleet, smooth=True)
     assert not np.allclose(np.asarray(means_f), np.asarray(means_s))
-    panel, ld = panels[0], loadings[0]
-    p = np.asarray(params[0])
-    n = panel.n_series
-    ss = dfm_statespace(p[:n], p[n:], ld, panel.dt)
-    filt = kalman_filter(ss, panel.values, panel.mask, engine="joint")
-    want_m, want_v = project(ss.z, filt.mean_f, filt.cov_f)
-    np.testing.assert_allclose(
-        np.asarray(means_f[0]), np.asarray(want_m), rtol=1e-10, atol=1e-12
-    )
-    np.testing.assert_allclose(
-        np.asarray(vars_f[0]), np.asarray(want_v), rtol=1e-10, atol=1e-12
-    )
+    assert np.all(np.isfinite(np.asarray(means_f)))
+    for i, (panel, ld) in enumerate(zip(panels, loadings)):
+        ss, mean_f, cov_f = _padded_single_states(
+            fleet, panel, ld, np.asarray(params[i]), smooth=False
+        )
+        want_m, want_v = project(ss.z, mean_f, cov_f)
+        np.testing.assert_allclose(
+            np.asarray(means_f[i]), np.asarray(want_m), rtol=1e-10,
+            atol=1e-12,
+        )
+        np.testing.assert_allclose(
+            np.asarray(vars_f[i]), np.asarray(want_v), rtol=1e-10,
+            atol=1e-12,
+        )
