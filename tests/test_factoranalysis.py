@@ -90,3 +90,32 @@ def test_varimax_orthogonal():
     np.testing.assert_allclose(
         np.sum(rot**2, axis=1), np.sum(phi**2, axis=1), rtol=1e-10
     )
+
+
+def test_maxfactors_caps_and_zero_returns_none(caplog):
+    """maxfactors caps the retained factor count; a cap of 0 exercises
+    the reference's 'no proper common factors' path (loadings None,
+    warning logged — factoranalysis.py:113-117)."""
+    import logging
+
+    import numpy as np
+
+    from metran_tpu.ops.fa import factor_analysis
+
+    # two clear, nearly-noiseless factor groups -> 2 factors uncapped
+    rng = np.random.default_rng(0)
+    f = rng.normal(size=(2000, 2))
+    load_a = np.outer(f[:, 0], [0.95, 0.9, 0.92, 0.93])
+    load_b = np.outer(f[:, 1], [0.92, 0.95, 0.9, 0.94])
+    y = np.concatenate([load_a, load_b], axis=1)
+    y += 0.1 * rng.normal(size=y.shape)
+    corr = np.corrcoef(y, rowvar=False)
+    # the reference-quirk MAP undercounts here (documented parity);
+    # textbook mode sees both factors, so the cap has something to bind
+    assert factor_analysis(corr, mode="textbook").factors.shape[1] == 2
+    capped = factor_analysis(corr, maxfactors=1, mode="textbook")
+    assert capped.factors.shape[1] == 1
+    with caplog.at_level(logging.WARNING, "metran_tpu.ops.fa"):
+        none = factor_analysis(corr, maxfactors=0)
+    assert none.factors is None
+    assert any("No proper common factors" in r.message for r in caplog.records)

@@ -313,3 +313,36 @@ def test_lmfit_missing_raises(series_list, monkeypatch):
     m = metran_tpu.Metran(series_list, name="B21B0214")
     with pytest.raises(ImportError, match="lmfit not installed"):
         m.solve(solver=metran_tpu.LmfitSolve, report=False)
+
+
+def test_insufficient_cross_section_raises():
+    """Series with too little cross-sectional overlap are rejected at
+    construction (reference metran/metran.py:150-197)."""
+    import pandas as pd
+
+    idx = pd.date_range("2000-01-01", periods=60, freq="D")
+    a = pd.Series(np.random.default_rng(0).normal(size=60), index=idx)
+    b = a.copy()
+    b.iloc[:55] = np.nan  # only 5 usable dates for series b
+    with pytest.raises(Exception, match="cross-sectional"):
+        metran_tpu.Metran(
+            pd.DataFrame({"a": a, "b": b}), name="overlap"
+        )
+
+
+def test_solve_no_factors_is_silent(series_list, monkeypatch, caplog):
+    """When factor analysis finds no proper common factors, solve does
+    nothing (reference metran/metran.py:220-224: silent early return,
+    no fit, no parameters['optimal'])."""
+    import logging
+
+    from metran_tpu.models import factoranalysis as fa_mod
+
+    monkeypatch.setattr(
+        fa_mod.FactorAnalysis, "solve", lambda self, oseries: None
+    )
+    m = metran_tpu.Metran(series_list, name="B21B0214")
+    with caplog.at_level(logging.WARNING):
+        m.solve(report=False)
+    assert m.fit is None
+    assert "optimal" not in m.parameters.columns
