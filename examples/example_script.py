@@ -21,8 +21,8 @@ import pandas as pd
 
 import metran_tpu
 
-DATA = Path(sys.argv[1]) if len(sys.argv) > 1 else Path(
-    "/root/reference/examples/data"
+DATA = Path(sys.argv[1]) if len(sys.argv) > 1 else (
+    Path(__file__).resolve().parent / "data"
 )
 
 
