@@ -197,7 +197,8 @@ class JaxSolve(BaseSolver):
 
     _name = "JaxSolve"
 
-    def solve(self, maxiter: int = 200, tol: float = 1e-8, **kwargs):
+    def solve(self, maxiter: int = 200, tol: Optional[float] = None,
+              **kwargs):
         import jax
         import jax.numpy as jnp
 
@@ -323,31 +324,101 @@ def lbfgs_advance(objective, opt, theta, state, tol, maxiter, max_new_iters,
     )
 
 
-def run_lbfgs(objective, theta0, maxiter: int = 200, tol: float = 1e-8):
-    """Jitted optax L-BFGS loop.
+def default_gtol(dtype) -> float:
+    """Default gradient-norm tolerance resolvable in ``dtype``.
+
+    ``sqrt(machine eps)``: 1.5e-8 in float64 (the reference regime —
+    scipy's L-BFGS-B ``pgtol`` ballpark), 3.5e-4 in float32, where
+    gradients computed from an objective with ~1e-7 relative noise
+    cannot meaningfully shrink below this.
+    """
+    import numpy as _np
+
+    return float(_np.sqrt(_np.finfo(_np.dtype(dtype)).eps))
+
+
+def default_ftol(dtype) -> float:
+    """Default relative-improvement stopping tolerance for ``dtype``.
+
+    The scipy L-BFGS-B ``factr`` criterion — stop (and report success)
+    when ``f_prev - f <= ftol * max(|f_prev|, |f|, 1)`` — with
+    ``factr * eps`` scaled per dtype: ``1e7 * eps`` in float64 (scipy's
+    default ``factr``, the stop the reference inherits,
+    ``/root/reference/metran/solver.py:252-256``) and ``1e2 * eps`` in
+    float32 (~1e-5 relative: just above the float32 objective
+    resolution floor, where the gradient-norm test is unreachable and
+    iterations stop producing any decrease).
+    """
+    import numpy as _np
+
+    dt = _np.dtype(dtype)
+    factr = 1e7 if dt.itemsize >= 8 else 1e2
+    return float(factr * _np.finfo(dt).eps)
+
+
+def run_lbfgs(objective, theta0, maxiter: int = 200,
+              tol: Optional[float] = None, ftol: Optional[float] = None):
+    """Chunked optax L-BFGS loop with dtype-aware stopping.
 
     Returns ``(theta, value, n_iters, nfev, converged)`` where ``nfev``
-    counts true objective evaluations (scipy-comparable)."""
+    counts true objective evaluations (scipy-comparable).  ``converged``
+    is True when either the gradient-norm test (``tol``, default
+    :func:`default_gtol`) or the scipy-style relative-improvement test
+    (``ftol``, default :func:`default_ftol`) fired — the latter is what
+    actually terminates float32 runs, where gradient norms plateau well
+    above any f64-style ``tol`` while the optimum is already resolved to
+    the objective's resolution floor (scipy reports success for its
+    ``factr`` stop the same way).  The loop runs on device in chunks of
+    up to 20 iterations; the host checks the stopping tests between
+    chunks, so the improvement test compares values a whole chunk apart
+    (strictly more conservative than scipy's per-iteration check).
+    """
     import jax
+    import jax.numpy as jnp
+    import numpy as _np
     import optax
     import optax.tree_utils as otu
 
+    theta0 = jnp.asarray(theta0)
+    if tol is None:
+        tol = default_gtol(theta0.dtype)
+    if ftol is None:
+        ftol = default_ftol(theta0.dtype)
     opt = optax.lbfgs()
+    chunk = min(20, maxiter)
 
     @jax.jit
-    def run(theta0):
-        theta, state, nfev = lbfgs_advance(
-            objective, opt, theta0, opt.init(theta0), tol, maxiter, maxiter
-        )
-        return (
-            theta,
-            otu.tree_get(state, "value"),
-            otu.tree_get(state, "count"),
-            nfev,
-            otu.tree_norm(otu.tree_get(state, "grad")) < tol,
+    def advance(theta, state, nfev):
+        return lbfgs_advance(
+            objective, opt, theta, state, tol, maxiter, chunk, nfev
         )
 
-    return run(theta0)
+    theta, state, nfev = theta0, opt.init(theta0), 0
+    prev_value = None
+    converged = False
+    while True:
+        theta, state, nfev = advance(theta, state, nfev)
+        value = float(otu.tree_get(state, "value"))
+        count = int(otu.tree_get(state, "count"))
+        gnorm = float(otu.tree_norm(otu.tree_get(state, "grad")))
+        if gnorm < tol:
+            converged = True
+            break
+        if prev_value is not None and (
+            prev_value - value <= ftol * max(abs(prev_value), abs(value), 1.0)
+        ):
+            converged = True  # resolution-floor stop, scipy factr-style
+            break
+        if count >= maxiter or not _np.isfinite(value):
+            break
+        prev_value = value
+    return (
+        theta,
+        otu.tree_get(state, "value"),
+        otu.tree_get(state, "count"),
+        nfev,
+        converged,
+    )
 
 
 class LmfitSolve(BaseSolver):

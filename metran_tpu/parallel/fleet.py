@@ -80,13 +80,25 @@ class FleetFit(NamedTuple):
     params : (B, N+K) optimal ``[alpha_sdf..., alpha_cdf...]`` per model.
     deviance : (B,) -2 log L at the optimum.
     iterations : (B,) L-BFGS iterations used.
-    converged : (B,) bool gradient-norm convergence flag.
+    converged : (B,) bool — the lane finished at a resolved optimum:
+        either the gradient-norm test fired (``tol``) or the lane froze
+        at the objective's resolution floor (``stalled``).  In float32
+        the gradient test alone is typically unreachable (the objective
+        carries ~1e-7 relative noise), so floor-frozen lanes count as
+        converged — the same contract as scipy L-BFGS-B's ``factr``
+        stop, which reports success when iterations stop producing
+        resolvable decrease.
+    stalled : (B,) bool — the subset of ``converged`` that stopped via
+        the resolution-floor stall stop rather than the gradient test
+        (distinct flag so cap-pinned / noise-limited lanes remain
+        identifiable).
     """
 
     params: jnp.ndarray
     deviance: jnp.ndarray
     iterations: jnp.ndarray
     converged: jnp.ndarray
+    stalled: Optional[jnp.ndarray] = None
 
 
 def pack_fleet(
@@ -618,6 +630,10 @@ def _fit_fleet_lanes(fleet, p0, warmup, maxiter, tol, mesh,
         # frozen flags between dispatches
         frozen_host = np.asarray(work_state.frozen)
         if checkpoint is not None:
+            # prev_value is checkpoint-only state (stall stopping is
+            # per-iteration on device here); it is deliberately not
+            # refreshed on checkpoint-less runs — don't read it after
+            # the loop
             state = full_state()
             prev_value = np.asarray(state.value)
             _save_ckpt()
@@ -652,8 +668,13 @@ def _fit_fleet_lanes(fleet, p0, warmup, maxiter, tol, mesh,
                 work_data = _gather_lanes(data, sel_dev)
     state = full_state()
     params = _theta_to_alpha(state.theta, theta_cap).T  # (B, N+K)
-    conv = jnp.linalg.norm(state.grad, axis=0) < tol
-    return FleetFit(params, state.value, state.count, conv)
+    grad_ok = jnp.linalg.norm(state.grad, axis=0) < tol
+    # the device-side stall counter is part of the carry, so "frozen at
+    # the resolution floor" is recorded exactly (not re-inferred)
+    stalled = (state.stall >= lanes_lbfgs.STALL_ITERS) & ~grad_ok
+    return FleetFit(
+        params, state.value, state.count, grad_ok | stalled, stalled
+    )
 
 
 def _fleet_fingerprint(*arrays):
@@ -674,7 +695,7 @@ def fit_fleet(
     warmup: int = 1,
     engine: str = "joint",
     maxiter: int = 100,
-    tol: float = 1e-8,
+    tol: Optional[float] = None,
     mesh: Optional[Mesh] = None,
     use_shard_map: bool = False,
     chunk: Optional[int] = None,
@@ -717,10 +738,18 @@ def fit_fleet(
         resolve objective differences near the optimum).
     alpha_max : soft upper cap on alpha during optimization (see
         ``_soft_cap``).
-    stall_tol : when set, a lane whose objective improved by less than
-        this across a whole chunk is treated as finished (early stop at
-        the float32 resolution floor).  Default off: chunking then never
-        changes results vs a single dispatch.
+    tol : gradient-norm convergence tolerance.  Default (``None``):
+        ``sqrt(machine eps)`` of the fleet dtype — 1.5e-8 in float64,
+        3.5e-4 in float32 (a tolerance the dtype can actually resolve).
+    stall_tol : a lane whose objective improves by no more than this for
+        consecutive iterations (lanes layout: per-iteration on device)
+        or across a whole chunk (batch layout) is frozen at the
+        objective's resolution floor and counted converged, flagged
+        ``FleetFit.stalled``.  Default (``None``): off in float64 —
+        chunking then never changes results vs a single dispatch — and
+        ``0.0`` in float32, where the floor, not the gradient test, is
+        what terminates every fit.  Pass a negative value to force it
+        off (zero improvement never satisfies a negative bound).
     checkpoint : optional file path; the optimizer carry is checkpointed
         there after every chunk and restored on restart (preemption-safe
         long runs — a capability the reference lacks, SURVEY.md section
@@ -747,15 +776,38 @@ def fit_fleet(
         power-of-two working-batch size tail compaction may shrink to
         (default one full TPU lane tile).  Compaction gathers the
         not-yet-converged lanes into a smaller batch so tail dispatches
-        stop paying for finished lanes; results are identical.
+        stop paying for finished lanes; results are identical.  Each
+        distinct compacted size between ``compact_min`` and the batch
+        triggers one fresh jit compile of the tail runner, so on small
+        fleets or expensive-to-compile configs (large ``remat_seg``,
+        long chunks) the first compacted dispatch can cost more than
+        the finished-lane savings; raise ``compact_min`` (or set it to
+        the batch size to disable) when compile time dominates.
     """
     if p0 is None:
         p0 = default_init_params(fleet)
+    is_f32 = jnp.dtype(fleet.y.dtype).itemsize < 8
+    if tol is None:
+        from ..models.solver import default_gtol
+
+        tol = default_gtol(fleet.y.dtype)
+    if stall_tol is None and is_f32:
+        # float32 runs terminate at the objective resolution floor, not
+        # at any reachable gradient norm: freeze lanes that make zero
+        # resolvable progress for consecutive iterations (and count them
+        # converged, FleetFit.stalled) instead of spinning to maxiter
+        stall_tol = 0.0
     if not np.isfinite(alpha_max) or alpha_max <= ALPHA_PMIN:
         raise ValueError(
             f"alpha_max must be finite and > {ALPHA_PMIN}, got {alpha_max}"
         )
     theta_cap = float(np.log(alpha_max))
+    if (chunk is None and layout == "batch" and stall_tol is not None
+            and stall_tol >= 0):
+        # the batch layout's stall stop runs host-side BETWEEN chunks,
+        # so a single maxiter-sized dispatch would never evaluate it;
+        # give stall-enabled runs a chunked schedule by default
+        chunk = min(20, maxiter)
     if chunk is None or chunk >= maxiter:
         chunk = maxiter
     if chunk < 1:
@@ -893,6 +945,19 @@ def fit_fleet(
         if done.all():
             break
     params, value, count, conv = outputs(theta, state)
+    # in this layout ``frozen`` only ever gets set by the host-side
+    # stall bookkeeping above, so the floor-frozen subset is exactly the
+    # frozen lanes the gradient/maxiter tests don't explain.  A lane
+    # whose objective went non-finite also freezes (NaN never improves
+    # — freezing stops wasting compute on it) but is divergence, not
+    # convergence: the finiteness guard keeps it out of both flags.
+    err = np.linalg.norm(
+        np.asarray(otu.tree_get(state, "grad")), axis=-1
+    )
+    cnt = np.asarray(otu.tree_get(state, "count"))
+    finite = np.isfinite(np.asarray(value))
+    stalled = np.asarray(frozen) & ~(err < tol) & ~(cnt >= maxiter) & finite
+    conv = jnp.asarray((np.asarray(conv) | stalled) & finite)
     # distinguish capped optima from interior ones: the reference has no
     # upper alpha bound, so a lane pinned at the soft cap is a different
     # animal than a converged interior solution (ADVICE r1)
@@ -905,7 +970,7 @@ def fit_fleet(
             "(raise alpha_max to compare with an uncapped fit)",
             capped_rows.tolist()[:20], alpha_max,
         )
-    return FleetFit(params, value, count, conv)
+    return FleetFit(params, value, count, conv, jnp.asarray(stalled))
 
 
 def fleet_simulate(
@@ -1012,41 +1077,60 @@ def _make_simulate_runner(engine, smooth, decompose=False):
     return jax.jit(jax.vmap(one))
 
 
-@functools.partial(
-    jax.jit, static_argnames=("warmup", "engine", "remat_seg")
-)
+@functools.lru_cache(maxsize=16)
+def _make_stderr_runner(warmup, engine, remat_seg):
+    """Jitted vmapped Hessian->pcov->stderr pipeline, cached per
+    configuration (one compiled shape per chunk configuration)."""
+
+    def one_chunk(p, y, mask, loadings, dt):
+        def dev(pi, yi, mi, ldi, dti):
+            return _model_deviance(
+                pi, yi, mi, ldi, dti, warmup, engine, remat_seg
+            )
+
+        hess = jax.vmap(jax.hessian(dev))(p, y, mask, loadings, dt)
+        pcov = jnp.linalg.pinv(hess)
+        diag = jnp.diagonal(pcov, axis1=-2, axis2=-1)
+        stderr = jnp.where(
+            diag > 0, jnp.sqrt(jnp.where(diag > 0, diag, 1.0)), jnp.nan
+        )
+        return stderr, pcov
+
+    return jax.jit(one_chunk)
+
+
 def fleet_stderr(
     params: jnp.ndarray,
     fleet: Fleet,
     warmup: int = 1,
     engine: str = "joint",
     remat_seg: Optional[int] = None,
+    batch_chunk: Optional[int] = None,
 ):
     """Per-model parameter standard errors at ``params`` (B, N+K).
 
     Batched exact-autodiff Hessian of the deviance with the reference's
     covariance convention (``pcov = pinv(Hessian of the objective)``,
-    ``metran/solver.py:258-266``; our solvers' ``_get_covariance``):
-    one vmapped forward-over-reverse dispatch for the whole fleet.
-    Completes the fleet workflow's parity with the single-model solvers,
-    which report stderr in ``fit_report``.
+    ``metran/solver.py:258-266``; our solvers' ``_get_covariance``), in
+    vmapped forward-over-reverse dispatches.  Completes the fleet
+    workflow's parity with the single-model solvers, which report
+    stderr in ``fit_report``.
+
+    The forward-over-reverse Hessian holds O(P) reverse sweeps of
+    residuals live per model, so — like :func:`fleet_simulate` — the
+    fleet is advanced in ``batch_chunk``-model dispatches (default:
+    everything in one dispatch); that bounds peak memory at
+    O(batch_chunk * P * T) while outputs stay on device.  Pass e.g.
+    ``batch_chunk=8`` at batch 512 x T=5000, where a single whole-fleet
+    dispatch does not fit in HBM.
 
     Returns ``(stderr, pcov)`` with shapes (B, P) and (B, P, P).
     Negative/zero curvature directions (e.g. parameters pinned at the
     soft cap, padded slots) yield NaN stderr rather than a misleading
     number.
     """
-    def dev(p, y, m, ld, dt):
-        return _model_deviance(p, y, m, ld, dt, warmup, engine, remat_seg)
-
-    hess = jax.vmap(jax.hessian(dev))(
-        params, fleet.y, fleet.mask, fleet.loadings, fleet.dt
-    )
-    pcov = jnp.linalg.pinv(hess)
-    diag = jnp.diagonal(pcov, axis1=-2, axis2=-1)
-    stderr = jnp.where(diag > 0, jnp.sqrt(jnp.where(diag > 0, diag, 1.0)),
-                       jnp.nan)
-    return stderr, pcov
+    run = _make_stderr_runner(warmup, engine, remat_seg)
+    return _run_chunked(run, jnp.asarray(params), fleet, batch_chunk)
 
 
 # ----------------------------------------------------------------------

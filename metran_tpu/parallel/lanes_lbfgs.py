@@ -150,10 +150,12 @@ def make_step(vg_fn, obj_fn, ls_steps: Tuple[float, ...], maxiter: int,
         the next chunk boundary (measured: ~25 percent fewer iterations per
         fit at chunk=5 on the benchmark workload).
     """
-    steps = jnp.asarray(ls_steps)
     n_trials = len(ls_steps)
 
     def step(state: LanesLbfgsState, *data) -> LanesLbfgsState:
+        # the grid follows the carry dtype: a default-precision constant
+        # here would silently promote an f32 fleet to f64 under x64
+        steps = jnp.asarray(ls_steps, state.theta.dtype)
         d = _direction(state)
         # descent safeguard: degenerate curvature (boundary/plateau
         # problems) can corrupt the history into a NON-descent two-loop

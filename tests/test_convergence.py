@@ -1,0 +1,115 @@
+"""Convergence semantics across dtypes (VERDICT r3 item 4).
+
+In float32 the gradient-norm test with an f64-style tolerance is
+unreachable: the objective carries ~1e-7 relative noise, so iterations
+stop producing resolvable decrease while the gradient norm plateaus
+orders of magnitude above 1e-8.  The reference's scipy L-BFGS-B reports
+success for its ``factr`` (relative-improvement) stop in exactly this
+situation (``/root/reference/metran/solver.py:252-256``); these tests
+pin the same contract onto ``run_lbfgs`` (JaxSolve's engine) and
+``fit_fleet`` — a good float32 fit must report converged, with the
+floor-stopped subset flagged distinctly (``FleetFit.stalled``).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from metran_tpu import data as mdata
+from metran_tpu.models.solver import (
+    default_ftol,
+    default_gtol,
+    run_lbfgs,
+)
+from metran_tpu.parallel import fit_fleet, pack_fleet
+
+
+def test_default_tolerances_scale_with_dtype():
+    assert default_gtol(np.float64) == pytest.approx(1.49e-8, rel=1e-2)
+    assert default_gtol(np.float32) == pytest.approx(3.45e-4, rel=1e-2)
+    # f64 ftol is scipy's default factr * eps
+    assert default_ftol(np.float64) == pytest.approx(2.22e-9, rel=1e-2)
+    assert default_ftol(np.float32) == pytest.approx(1.19e-5, rel=1e-2)
+
+
+def test_run_lbfgs_f64_gradient_stop():
+    def objective(x):
+        return jnp.sum((x - 1.0) ** 2)
+
+    theta, value, iters, nfev, converged = run_lbfgs(
+        objective, jnp.zeros(3), maxiter=100
+    )
+    assert converged
+    np.testing.assert_allclose(np.asarray(theta), 1.0, atol=1e-6)
+
+
+def test_run_lbfgs_f32_floor_stop_counts_as_converged():
+    """A large-offset f32 objective hits the resolution floor while its
+    gradient norm is still ~1e-2 — the factr-style stop must fire and
+    report success (the gradient test alone never would)."""
+
+    def objective(x):
+        return 1e4 + jnp.sum((x - 1.0) ** 2)
+
+    theta0 = jnp.zeros(3, jnp.float32)
+    theta, value, iters, nfev, converged = run_lbfgs(
+        objective, theta0, maxiter=200
+    )
+    assert theta.dtype == jnp.float32
+    assert converged
+    assert int(iters) < 200  # stopped by a test, not the budget
+    # resolved to the f32 floor: (x-1)^2 below ~eps * 1e4
+    assert np.all(np.abs(np.asarray(theta) - 1.0) < 0.1)
+
+
+def _small_fleet(rng, dtype, n_models=3, n=4, t=120):
+    panels = []
+    for _ in range(n_models):
+        idx = pd.date_range("2000-01-01", periods=t, freq="D")
+        raw = rng.normal(size=(t, n))
+        raw[rng.uniform(size=raw.shape) < 0.2] = np.nan
+        raw[0] = np.nan
+        panels.append(
+            mdata.pack_panel(
+                pd.DataFrame(raw, index=idx,
+                             columns=[f"s{i}" for i in range(n)])
+            )
+        )
+    loadings = [rng.uniform(0.3, 0.8, (n, 1)) for _ in range(n_models)]
+    return pack_fleet(panels, loadings, dtype=dtype)
+
+
+@pytest.mark.parametrize("layout", ["lanes", "batch"])
+def test_fit_fleet_f32_reports_converged(rng, layout):
+    fleet = _small_fleet(rng, np.float32)
+    assert fleet.y.dtype == jnp.float32
+    kwargs = dict(maxiter=80, layout=layout)
+    if layout == "batch":
+        kwargs["chunk"] = 10  # host-side stall stop needs chunking
+    fit = fit_fleet(fleet, **kwargs)
+    conv = np.asarray(fit.converged)
+    stalled = np.asarray(fit.stalled)
+    assert conv.dtype == bool and stalled.dtype == bool
+    # every lane finishes converged on f32 (gradient test or floor stop)
+    assert conv.all()
+    # the floor-stopped subset is flagged within converged
+    assert not np.any(stalled & ~conv)
+    # and the f32 optimum matches the f64 one to f32-floor accuracy
+    fit64 = fit_fleet(
+        _small_fleet(np.random.default_rng(42), np.float64),
+        maxiter=80, layout=layout,
+    )
+    np.testing.assert_allclose(
+        np.asarray(fit.deviance, np.float64),
+        np.asarray(fit64.deviance),
+        rtol=1e-4,
+    )
+
+
+def test_fit_fleet_f64_defaults_unchanged(rng):
+    """float64 keeps the strict regime: stall stop off, gradient test on."""
+    fleet = _small_fleet(rng, np.float64)
+    fit = fit_fleet(fleet, maxiter=80, layout="lanes")
+    assert not np.asarray(fit.stalled).any()
+    assert np.asarray(fit.converged).any()

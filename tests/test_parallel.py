@@ -513,6 +513,29 @@ def test_fleet_stderr_matches_solver_covariance(rng, series_list):
     )
 
 
+def test_fleet_stderr_chunked_matches_unchunked(rng):
+    """batch_chunk bounds the Hessian dispatch at O(chunk) models (the
+    whole-fleet dispatch OOMs at bench scale, VERDICT r3); an uneven
+    chunk size exercises the edge-replicated tail."""
+    from metran_tpu.parallel import fleet_stderr
+
+    fleet, _, _ = _random_fleet(rng, [4, 3, 4, 5, 4], t=80)
+    params = default_init_params(fleet) * rng.uniform(
+        0.8, 1.2, (5, fleet.n_params)
+    )
+    stderr, pcov = fleet_stderr(params, fleet, engine="joint")
+    stderr_c, pcov_c = fleet_stderr(
+        params, fleet, engine="joint", batch_chunk=2
+    )
+    np.testing.assert_allclose(
+        np.asarray(stderr_c), np.asarray(stderr), rtol=1e-12, atol=0,
+        equal_nan=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(pcov_c), np.asarray(pcov), rtol=1e-12, atol=1e-15
+    )
+
+
 def _padded_single_states(fleet, panel, ld, p, smooth=True):
     """(ss, means, covs) of one fleet member recomputed as a standalone
     PADDED single-model problem (the oracle the fleet_simulate /
