@@ -401,15 +401,23 @@ def run_lbfgs(objective, theta0, maxiter: int = 200,
         value = float(otu.tree_get(state, "value"))
         count = int(otu.tree_get(state, "count"))
         gnorm = float(otu.tree_norm(otu.tree_get(state, "grad")))
+        if not _np.isfinite(value):
+            break  # diverged — never report success
         if gnorm < tol:
             converged = True
             break
+        # floor stop: the value CHANGED by less than the resolution
+        # tolerance across a whole chunk.  Two-sided on purpose — a
+        # chunk that made the value meaningfully worse (line-search
+        # failure excursion) must keep running or exhaust maxiter
+        # unconverged, not masquerade as a factr-style success.
         if prev_value is not None and (
-            prev_value - value <= ftol * max(abs(prev_value), abs(value), 1.0)
+            abs(prev_value - value)
+            <= ftol * max(abs(prev_value), abs(value), 1.0)
         ):
             converged = True  # resolution-floor stop, scipy factr-style
             break
-        if count >= maxiter or not _np.isfinite(value):
+        if count >= maxiter:
             break
         prev_value = value
     return (

@@ -50,6 +50,102 @@ def _masked_obs(ss: StateSpace, mask_t, dtype):
     return z_t, r_t
 
 
+def blocked_associative_scan(combine, elements, block: int,
+                             reverse: bool = False):
+    """``lax.associative_scan`` with compile cost O(log block), not O(log T).
+
+    The full-length associative scan unrolls ~log2(T) combine levels over
+    progressively-sliced arrays; at T = 32k that is a huge HLO program
+    (measured 188.8 s XLA compile on TPU for the filter, BASELINE.md
+    round 3).  By associativity the same prefix (suffix, when
+    ``reverse``) combines decompose into
+
+    1. within-block scans over ``block`` elements — ONE compiled
+       program, ``vmap``-ed over the T/block blocks;
+    2. a sequential ``lax.scan`` over the block totals (T/block steps of
+       a single combine — trivial to compile, negligible to run);
+    3. one broadcast combine applying each block's incoming exclusive
+       prefix/suffix to its within-block results.
+
+    Results are numerically equivalent (same operator, same element
+    order; only the combine tree's shape changes, so values agree to
+    floating-point reassociation rounding — parity-tested at 1e-10).
+    ``combine`` must be elementwise
+    along the leading axis of its inputs — the ``associative_scan``
+    contract.  A non-divisible tail is padded with replicated edge
+    elements on the side that cannot influence the kept results (after
+    the true end for forward scans, before the true start for reverse)
+    and trimmed.
+    """
+    leaves = jax.tree.leaves(elements)
+    t = leaves[0].shape[0]
+    if block >= t:
+        return lax.associative_scan(combine, elements, reverse=reverse)
+    nb = -(-t // block)
+    pad = nb * block - t
+
+    def prep(x):
+        if pad:
+            edge = x[:1] if reverse else x[-1:]
+            reps = jnp.broadcast_to(edge, (pad,) + x.shape[1:])
+            x = jnp.concatenate([reps, x] if reverse else [x, reps], axis=0)
+        return x.reshape((nb, block) + x.shape[1:])
+
+    el = jax.tree.map(prep, elements)
+    within = jax.vmap(
+        lambda e: lax.associative_scan(combine, e, reverse=reverse)
+    )(el)
+    # block totals, then their exclusive running combine across blocks.
+    # In both directions ``combine``'s first argument is the
+    # already-combined far side (earlier prefix forward, later suffix in
+    # reverse), so the cross-block steps share one expression.
+    # block totals keep a singleton leading axis: ``combine`` is
+    # elementwise over the leading axis by contract, so single elements
+    # are passed as length-1 batches
+    totals = jax.tree.map(
+        lambda x: x[:, :1] if reverse else x[:, -1:], within
+    )
+    edge_tot = jax.tree.map(
+        lambda x: x[-1] if reverse else x[0], totals
+    )
+    inner_tot = jax.tree.map(
+        lambda x: x[:-1] if reverse else x[1:], totals
+    )
+    _, excl = lax.scan(
+        lambda carry, tot: (combine(carry, tot), carry),
+        edge_tot, inner_tot, reverse=reverse,
+    )
+    # apply the incoming combine to every block that has one; the edge
+    # block (first forward, last in reverse) passes through unchanged
+    affected = jax.tree.map(
+        lambda x: x[:-1] if reverse else x[1:], within
+    )
+
+    def apply(pref, win):
+        s = jax.tree.leaves(win)[0].shape[0]
+        pref_b = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (s,) + x.shape[1:]), pref
+        )
+        return combine(pref_b, win)
+
+    applied = jax.vmap(apply)(excl, affected)
+    edge_win = jax.tree.map(
+        lambda x: x[-1:] if reverse else x[:1], within
+    )
+    parts = [applied, edge_win] if reverse else [edge_win, applied]
+    out = jax.tree.map(
+        lambda a, b: jnp.concatenate([a, b], axis=0), parts[0], parts[1]
+    )
+    out = jax.tree.map(
+        lambda x: x.reshape((nb * block,) + x.shape[2:]), out
+    )
+    if pad:
+        out = jax.tree.map(
+            lambda x: x[pad:] if reverse else x[:t], out
+        )
+    return out
+
+
 def _filter_element(ss: StateSpace, y_t, mask_t, p_prior, first, dtype):
     """Build one associative filtering element.
 
@@ -104,14 +200,21 @@ def _filter_combine(e1, e2):
     return jax.vmap(comb)(a1, b1, c1, j1, eta1, a2, b2, c2, j2, eta2)
 
 
-@jax.jit
-def parallel_filter(ss: StateSpace, y: jnp.ndarray, mask: jnp.ndarray) -> FilterResult:
+@functools.partial(jax.jit, static_argnames=("block",))
+def parallel_filter(ss: StateSpace, y: jnp.ndarray, mask: jnp.ndarray,
+                    block: int = None) -> FilterResult:
     """Kalman filter with O(log T) depth via ``lax.associative_scan``.
 
     Returns the same :class:`FilterResult` as the sequential
     ``kalman_filter(store=True)``: predicted/filtered moments per step
     and per-step likelihood terms (``sigma``, ``detf``) with identical
     masked-data semantics.
+
+    ``block`` routes the combine through
+    :func:`blocked_associative_scan` (numerically equivalent results;
+    compile time scales with ``log(block)`` instead of ``log(T)`` —
+    essential at T >~ 10k, see docs/performance.md).  Default:
+    full-length scan.
     """
     dtype = ss.q.dtype
     mask = jnp.asarray(mask, bool)
@@ -130,7 +233,12 @@ def parallel_filter(ss: StateSpace, y: jnp.ndarray, mask: jnp.ndarray) -> Filter
         lambda y_t, m_t, f: _filter_element(ss, y_t, m_t, p1p, f, dtype)
     )(y, mask, first)
 
-    a, b, c, j, eta = lax.associative_scan(_filter_combine, elements)
+    if block is not None:
+        a, b, c, j, eta = blocked_associative_scan(
+            _filter_combine, elements, block
+        )
+    else:
+        a, b, c, j, eta = lax.associative_scan(_filter_combine, elements)
     mean_f, cov_f = b, c
 
     # predicted moments: from the filtered state one step back
@@ -190,9 +298,13 @@ def _smoother_combine(later, earlier):
     return jax.vmap(comb)(*later, *earlier)
 
 
-@jax.jit
-def parallel_smoother(ss: StateSpace, filtered: FilterResult) -> SmootherResult:
-    """RTS smoother with O(log T) depth via reverse associative scan."""
+@functools.partial(jax.jit, static_argnames=("block",))
+def parallel_smoother(ss: StateSpace, filtered: FilterResult,
+                      block: int = None) -> SmootherResult:
+    """RTS smoother with O(log T) depth via reverse associative scan.
+
+    ``block`` as in :func:`parallel_filter` (blocked combine tree,
+    numerically equivalent results, O(log block) compile)."""
     t_steps = filtered.mean_f.shape[0]
     last = jnp.arange(t_steps) == t_steps - 1
     # dummy next-step moments for the final element (unused: last flag)
@@ -206,20 +318,28 @@ def parallel_smoother(ss: StateSpace, filtered: FilterResult) -> SmootherResult:
         )
     )(filtered.mean_f, filtered.cov_f, mp_next, pp_next, last)
 
-    _, g, l = lax.associative_scan(  # noqa: E741
-        _smoother_combine, elements, reverse=True
-    )
+    if block is not None:
+        _, g, l = blocked_associative_scan(  # noqa: E741
+            _smoother_combine, elements, block, reverse=True
+        )
+    else:
+        _, g, l = lax.associative_scan(  # noqa: E741
+            _smoother_combine, elements, reverse=True
+        )
     return SmootherResult(g, l)
 
 
-@functools.partial(jax.jit, static_argnames=("warmup",))
+@functools.partial(jax.jit, static_argnames=("warmup", "block"))
 def parallel_deviance(
-    ss: StateSpace, y: jnp.ndarray, mask: jnp.ndarray, warmup: int = 1
+    ss: StateSpace, y: jnp.ndarray, mask: jnp.ndarray, warmup: int = 1,
+    block: int = None,
 ) -> jnp.ndarray:
-    """-2 log L evaluated with the parallel filter (reference semantics)."""
+    """-2 log L evaluated with the parallel filter (reference semantics).
+
+    ``block`` as in :func:`parallel_filter`."""
     from .kalman import deviance_terms
 
-    res = parallel_filter(ss, y, mask)
+    res = parallel_filter(ss, y, mask, block=block)
     return deviance_terms(res.sigma, res.detf, mask, warmup=warmup)
 
 

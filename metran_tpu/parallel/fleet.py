@@ -741,11 +741,12 @@ def fit_fleet(
     tol : gradient-norm convergence tolerance.  Default (``None``):
         ``sqrt(machine eps)`` of the fleet dtype — 1.5e-8 in float64,
         3.5e-4 in float32 (a tolerance the dtype can actually resolve).
-    stall_tol : a lane whose objective improves by no more than this for
-        consecutive iterations (lanes layout: per-iteration on device)
-        or across a whole chunk (batch layout) is frozen at the
-        objective's resolution floor and counted converged, flagged
-        ``FleetFit.stalled``.  Default (``None``): off in float64 —
+    stall_tol : a lane whose objective changes by no more than this for
+        consecutive iterations (lanes layout: per-iteration on device,
+        where the grid line search is monotone so change = improvement)
+        or across a whole chunk (batch layout: two-sided |change|) is
+        frozen at the objective's resolution floor and counted
+        converged, flagged ``FleetFit.stalled``.  Default (``None``): off in float64 —
         chunking then never changes results vs a single dispatch — and
         ``0.0`` in float32, where the floor, not the gradient test, is
         what terminates every fit.  Pass a negative value to force it
@@ -806,8 +807,10 @@ def fit_fleet(
             and stall_tol >= 0):
         # the batch layout's stall stop runs host-side BETWEEN chunks,
         # so a single maxiter-sized dispatch would never evaluate it;
-        # give stall-enabled runs a chunked schedule by default
-        chunk = min(20, maxiter)
+        # give stall-enabled runs a chunked schedule by default (chunk
+        # strictly below maxiter, or the single-dispatch fast path
+        # would skip the stall bookkeeping entirely)
+        chunk = max(1, min(20, maxiter - 1))
     if chunk is None or chunk >= maxiter:
         chunk = maxiter
     if chunk < 1:
@@ -932,7 +935,13 @@ def fit_fleet(
         # lane takes no further iterations (device-side cond), so its
         # result never depends on what else shares the batch
         if stall_tol is not None and prev_value is not None:
-            stalled = ~(value < prev_value - stall_tol)
+            # two-sided: freeze only lanes whose value CHANGED by at
+            # most stall_tol over the chunk.  A lane that regressed
+            # beyond stall_tol (line-search failure excursion) keeps
+            # running — it either recovers or exhausts maxiter
+            # unconverged; freezing it here would misreport divergence
+            # as a floor stop in the post-loop classification
+            stalled = np.abs(value - prev_value) <= stall_tol
             frozen_host = np.asarray(frozen) | stalled
             done |= frozen_host
             frozen = jnp.asarray(frozen_host)
