@@ -450,6 +450,15 @@ def run_device_bench(out_path: str, budget_s: float,
             if probe_r < 25.0 and left() > 180:
                 se_kw["batch_chunk"] = prod_chunk
                 measure("stderr", fleet_stderr, se_kw, nprod)
+            # the lane-layout FD Hessian (TPU-fast path: 2P central-
+            # difference points per model ride the lane axis)
+            if left() > 150:
+                measure(
+                    "stderr_lanes_fd", fleet_stderr,
+                    dict(remat_seg=REMAT_SEG, batch_chunk=prod_chunk,
+                         method="lanes-fd"),
+                    nprod,
+                )
             if left() > 120:
                 measure("simulate", fleet_simulate,
                         dict(smooth=True, batch_chunk=prod_chunk), nprod)

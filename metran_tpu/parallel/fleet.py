@@ -963,9 +963,11 @@ def fit_fleet(
     err = np.linalg.norm(
         np.asarray(otu.tree_get(state, "grad")), axis=-1
     )
-    cnt = np.asarray(otu.tree_get(state, "count"))
     finite = np.isfinite(np.asarray(value))
-    stalled = np.asarray(frozen) & ~(err < tol) & ~(cnt >= maxiter) & finite
+    # no maxiter exclusion: a lane the stall bookkeeping froze on the
+    # final dispatch genuinely stopped at the floor even if its count
+    # also reached the budget (frozen has no other setter here)
+    stalled = np.asarray(frozen) & ~(err < tol) & finite
     conv = jnp.asarray((np.asarray(conv) | stalled) & finite)
     # distinguish capped optima from interior ones: the reference has no
     # upper alpha bound, so a lane pinned at the soft cap is a different
@@ -1086,9 +1088,20 @@ def _make_simulate_runner(engine, smooth, decompose=False):
     return jax.jit(jax.vmap(one))
 
 
+def _pcov_stderr(hess):
+    """(stderr, pcov) from a (B, P, P) Hessian stack with the NaN
+    convention for non-positive curvature directions."""
+    pcov = jnp.linalg.pinv(hess)
+    diag = jnp.diagonal(pcov, axis1=-2, axis2=-1)
+    stderr = jnp.where(
+        diag > 0, jnp.sqrt(jnp.where(diag > 0, diag, 1.0)), jnp.nan
+    )
+    return stderr, pcov
+
+
 @functools.lru_cache(maxsize=16)
 def _make_stderr_runner(warmup, engine, remat_seg):
-    """Jitted vmapped Hessian->pcov->stderr pipeline, cached per
+    """Jitted vmapped exact-Hessian->pcov->stderr pipeline, cached per
     configuration (one compiled shape per chunk configuration)."""
 
     def one_chunk(p, y, mask, loadings, dt):
@@ -1098,12 +1111,69 @@ def _make_stderr_runner(warmup, engine, remat_seg):
             )
 
         hess = jax.vmap(jax.hessian(dev))(p, y, mask, loadings, dt)
-        pcov = jnp.linalg.pinv(hess)
-        diag = jnp.diagonal(pcov, axis1=-2, axis2=-1)
-        stderr = jnp.where(
-            diag > 0, jnp.sqrt(jnp.where(diag > 0, diag, 1.0)), jnp.nan
+        return _pcov_stderr(hess)
+
+    return jax.jit(one_chunk)
+
+
+@functools.lru_cache(maxsize=16)
+def _make_stderr_lanes_runner(warmup, remat_seg):
+    """Lane-layout finite-difference Hessian runner.
+
+    The exact forward-over-reverse Hessian runs in the batch-leading
+    layout — the slow one on TPU (docs/performance.md).  Here the 2P
+    central-difference perturbation points per model ride the 128-wide
+    LANE axis instead: ONE stacked lanes value-and-grad dispatch over
+    ``B * 2P`` lanes yields every column of every model's Hessian as
+    ``H[:, j] = (g(p + h_j e_j) - g(p - h_j e_j)) / (2 h_j)`` — central
+    differences of the EXACT analytical-adjoint gradient (one order of
+    accuracy better than the reference's double-FD numerical Hessian,
+    ``/root/reference/metran/solver.py:65-140``), at full lane
+    throughput.
+    """
+    from ..ops.lanes import lanes_dfm_deviance
+
+    def one_chunk(p, y, mask, loadings, dt):
+        b, n_p = p.shape
+        dtype = p.dtype
+        # per-parameter step: cbrt(eps) * scale — the optimum for a
+        # CENTRAL difference of a function whose own relative error is
+        # ~eps (here the exact autodiff gradient, noise = rounding):
+        # truncation O(h^2) balances roundoff O(eps/h) at h ~ eps^(1/3)
+        # (6e-6 in f64, 4.9e-3 in f32 — sqrt(eps) would let the
+        # roundoff term ~sqrt(eps)*|g| dominate, worst exactly in the
+        # f32 regime this path exists for)
+        h = jnp.cbrt(jnp.finfo(dtype).eps) * jnp.maximum(jnp.abs(p), 1.0)
+        eye = jnp.eye(n_p, dtype=dtype)
+        pert = jnp.concatenate(
+            [
+                p[:, None, :] + h[:, :, None] * eye[None],
+                p[:, None, :] - h[:, :, None] * eye[None],
+            ],
+            axis=1,
+        )  # (B, 2P, P): model-major, matching jnp.repeat below
+        reps = 2 * n_p
+        alpha_t = pert.reshape(b * reps, n_p).T  # (P, B*2P)
+        y_l = jnp.repeat(jnp.transpose(y, (1, 2, 0)), reps, axis=-1)
+        mask_l = jnp.repeat(jnp.transpose(mask, (1, 2, 0)), reps, axis=-1)
+        ld_l = jnp.repeat(
+            jnp.transpose(loadings, (1, 2, 0)), reps, axis=-1
         )
-        return stderr, pcov
+        dt_l = jnp.repeat(dt, reps)
+
+        val, vjp = jax.vjp(
+            lambda a: lanes_dfm_deviance(
+                a, ld_l, dt_l, y_l, mask_l, warmup=warmup,
+                remat_seg=remat_seg,
+            ),
+            alpha_t,
+        )
+        (g,) = vjp(jnp.ones_like(val))  # (P, B*2P)
+        g = g.reshape(n_p, b, reps)
+        gp, gm = g[..., :n_p], g[..., n_p:]  # (P_i, B, P_j)
+        hess = jnp.transpose(gp - gm, (1, 0, 2)) / (2.0 * h[:, None, :])
+        hess = 0.5 * (hess + jnp.transpose(hess, (0, 2, 1)))
+        return _pcov_stderr(hess)
 
     return jax.jit(one_chunk)
 
@@ -1115,30 +1185,48 @@ def fleet_stderr(
     engine: str = "joint",
     remat_seg: Optional[int] = None,
     batch_chunk: Optional[int] = None,
+    method: str = "exact",
 ):
     """Per-model parameter standard errors at ``params`` (B, N+K).
 
-    Batched exact-autodiff Hessian of the deviance with the reference's
-    covariance convention (``pcov = pinv(Hessian of the objective)``,
-    ``metran/solver.py:258-266``; our solvers' ``_get_covariance``), in
-    vmapped forward-over-reverse dispatches.  Completes the fleet
-    workflow's parity with the single-model solvers, which report
-    stderr in ``fit_report``.
+    Batched Hessian of the deviance with the reference's covariance
+    convention (``pcov = pinv(Hessian of the objective)``,
+    ``metran/solver.py:258-266``; our solvers' ``_get_covariance``).
+    Completes the fleet workflow's parity with the single-model
+    solvers, which report stderr in ``fit_report``.
 
-    The forward-over-reverse Hessian holds O(P) reverse sweeps of
-    residuals live per model, so — like :func:`fleet_simulate` — the
-    fleet is advanced in ``batch_chunk``-model dispatches (default:
-    everything in one dispatch); that bounds peak memory at
-    O(batch_chunk * P * T) while outputs stay on device.  Pass e.g.
-    ``batch_chunk=8`` at batch 512 x T=5000, where a single whole-fleet
-    dispatch does not fit in HBM.
+    ``method="exact"`` (default) is the exact forward-over-reverse
+    autodiff Hessian, vmapped in the batch-leading layout.
+    ``method="lanes-fd"`` instead central-differences the exact
+    lane-layout gradient with all ``2P`` perturbation points riding the
+    lane axis — the TPU-fast path (the batch-leading layout is ~15-45x
+    slower per pass there, docs/performance.md), accurate to the FD
+    truncation error of an exact gradient (still one order better than
+    the reference's double-FD numerical Hessian).  ``engine`` is
+    ignored by ``lanes-fd`` (sequential-processing semantics, like the
+    fit hot path).
+
+    Like :func:`fleet_simulate`, the fleet is advanced in
+    ``batch_chunk``-model dispatches (default: everything in one
+    dispatch); outputs stay on device.  The per-chunk memory model
+    differs by method: ``exact`` holds O(P) reverse sweeps of residuals
+    live per model (O(batch_chunk * P * T)); ``lanes-fd`` instead
+    replicates each chunked model's (T, N) panel across its 2P
+    perturbation lanes (O(batch_chunk * 2P * T * N) data, cheap
+    per-lane compute).  Pass e.g. ``batch_chunk=8`` at batch 512 x
+    T=5000, where a single whole-fleet dispatch does not fit in HBM.
 
     Returns ``(stderr, pcov)`` with shapes (B, P) and (B, P, P).
     Negative/zero curvature directions (e.g. parameters pinned at the
     soft cap, padded slots) yield NaN stderr rather than a misleading
     number.
     """
-    run = _make_stderr_runner(warmup, engine, remat_seg)
+    if method == "lanes-fd":
+        run = _make_stderr_lanes_runner(warmup, remat_seg)
+    elif method == "exact":
+        run = _make_stderr_runner(warmup, engine, remat_seg)
+    else:
+        raise ValueError(f"unknown method {method!r}")
     return _run_chunked(run, jnp.asarray(params), fleet, batch_chunk)
 
 

@@ -113,3 +113,33 @@ def test_fit_fleet_f64_defaults_unchanged(rng):
     fit = fit_fleet(fleet, maxiter=80, layout="lanes")
     assert not np.asarray(fit.stalled).any()
     assert np.asarray(fit.converged).any()
+
+
+def test_run_lbfgs_divergence_not_converged():
+    """An objective that blows up must never report success — the
+    finiteness guard runs before the factr-style stop (a NaN/inf chunk
+    difference would otherwise satisfy the one-sided inequality)."""
+
+    def objective(x):
+        # minimizing drives x[0] -> +inf and the value -> -inf
+        return -jnp.sum(x ** 3)
+
+    theta, value, iters, nfev, converged = run_lbfgs(
+        objective, jnp.ones(2), maxiter=300
+    )
+    assert not converged
+
+
+def test_fit_fleet_batch_f32_small_maxiter_still_stalls(rng):
+    """The stall-enabling chunk default stays strictly below maxiter, so
+    the host-side floor stop is EVALUATED even at maxiter <= 20, and a
+    lane frozen on the final dispatch still counts (review r4).
+    Refitting from the optimum makes every chunk a zero-change chunk."""
+    fleet = _small_fleet(rng, np.float32, n_models=2)
+    warm = fit_fleet(fleet, maxiter=80, layout="batch", chunk=10)
+    assert np.asarray(warm.converged).all()
+    refit = fit_fleet(fleet, p0=warm.params, maxiter=16, layout="batch")
+    assert np.asarray(refit.converged).all()
+    np.testing.assert_allclose(
+        np.asarray(refit.deviance), np.asarray(warm.deviance), rtol=1e-5
+    )

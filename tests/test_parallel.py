@@ -536,6 +536,57 @@ def test_fleet_stderr_chunked_matches_unchunked(rng):
     )
 
 
+def test_fleet_stderr_lanes_fd_matches_exact(rng):
+    """The lane-layout central-difference Hessian (TPU-fast path, all
+    2P perturbations riding the lane axis) reproduces the exact
+    autodiff Hessian's stderr/pcov to FD truncation accuracy, NaN
+    pattern included."""
+    from metran_tpu.parallel import fleet_stderr
+
+    fleet, _, _ = _random_fleet(rng, [5, 4, 5], t=100)
+    params = default_init_params(fleet) * rng.uniform(
+        0.8, 1.2, (3, fleet.n_params)
+    )
+    se_e, pc_e = fleet_stderr(params, fleet, engine="sequential")
+    se_f, pc_f = fleet_stderr(
+        params, fleet, method="lanes-fd", batch_chunk=2
+    )
+    np.testing.assert_allclose(
+        np.asarray(se_f), np.asarray(se_e), rtol=1e-4, equal_nan=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(pc_f), np.asarray(pc_e), rtol=1e-3, atol=1e-10
+    )
+
+
+def test_fleet_stderr_lanes_fd_f32(rng):
+    """lanes-fd in float32 — the regime the path exists for — stays
+    within the f32 FD error budget of the f64 exact stderr (cbrt(eps)
+    step; a sqrt(eps) step would fail this by orders of magnitude)."""
+    from metran_tpu.parallel import fleet_stderr
+
+    panels, loadings = [], []
+    for n in (5, 4):
+        fleet_one, ps, lds = _random_fleet(rng, [n], t=100)
+        panels.append(ps[0])
+        loadings.append(lds[0])
+    fleet64 = pack_fleet(panels, loadings, dtype=np.float64)
+    fleet32 = pack_fleet(panels, loadings, dtype=np.float32)
+    params = np.asarray(
+        default_init_params(fleet64)
+        * rng.uniform(0.8, 1.2, (2, fleet64.n_params))
+    )
+    se_e, _ = fleet_stderr(params, fleet64, engine="sequential")
+    se_f, _ = fleet_stderr(
+        params.astype(np.float32), fleet32, method="lanes-fd"
+    )
+    se_e, se_f = np.asarray(se_e), np.asarray(se_f)
+    # identical defined/NaN pattern, values to ~1% (f32 gradient noise
+    # through a cbrt(eps_f32)=5e-3 step)
+    assert (np.isnan(se_f) == np.isnan(se_e)).all()
+    np.testing.assert_allclose(se_f, se_e, rtol=5e-2, equal_nan=True)
+
+
 def _padded_single_states(fleet, panel, ld, p, smooth=True):
     """(ss, means, covs) of one fleet member recomputed as a standalone
     PADDED single-model problem (the oracle the fleet_simulate /
