@@ -8,10 +8,21 @@ running on the JAX engine with exact autodiff gradients.  Works on CPU
 Run:  python examples/example_script.py [data_dir]
 """
 
+import os
 import sys
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))  # repo root
+
+# Default to the CPU backend: an ambient tunneled-TPU platform makes
+# ``jax.devices()`` hang indefinitely when the tunnel is wedged, and the
+# JAX_PLATFORMS env var is ignored by that plugin (only the config call
+# works).  Set METRAN_TPU_EXAMPLE_TPU=1 on a healthy accelerator host.
+if not os.environ.get("METRAN_TPU_EXAMPLE_TPU"):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
 
 import matplotlib
 

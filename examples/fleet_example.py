@@ -10,6 +10,7 @@ Run on CPU with a virtual mesh:
         python examples/fleet_example.py
 """
 
+import os
 import sys
 from pathlib import Path
 
@@ -19,6 +20,14 @@ import numpy as np
 import pandas as pd
 
 import jax
+
+# Default to the CPU backend: an ambient tunneled-TPU platform makes
+# ``jax.devices()`` hang indefinitely when the tunnel is wedged, and the
+# JAX_PLATFORMS env var is ignored by that plugin (only the config call
+# works).  Set METRAN_TPU_EXAMPLE_TPU=1 on a healthy accelerator host.
+if not os.environ.get("METRAN_TPU_EXAMPLE_TPU"):
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
 
 from metran_tpu import data as mdata
 from metran_tpu.models.factoranalysis import FactorAnalysis
@@ -98,10 +107,17 @@ def main():
         "deviance quantiles:",
         np.quantile(np.asarray(fit.deviance[:n_models]), [0.1, 0.5, 0.9]).round(1),
     )
-    print("converged:", int(np.asarray(fit.converged[:n_models]).sum()), "/", n_models)
+    print(
+        "converged:", int(np.asarray(fit.converged[:n_models]).sum()),
+        "/", n_models,
+        "(stalled at the resolution floor:",
+        int(np.asarray(fit.stalled[:n_models]).sum()), ")",
+    )
 
-    # batched post-fit products: per-model stderr and smoothed projections
-    stderr, _ = fleet_stderr(fit.params, fleet)
+    # batched post-fit products: per-model stderr and smoothed
+    # projections (method="lanes-fd" is the TPU-fast Hessian)
+    stderr, _ = fleet_stderr(fit.params, fleet, method="lanes-fd",
+                             batch_chunk=8)
     means, variances = fleet_simulate(fit.params, fleet, batch_chunk=8)
     print(
         "median stderr(alpha):",

