@@ -31,7 +31,8 @@ def __getattr__(name):
         from . import models
 
         return getattr(models, name)
-    if name in ("BaseSolver", "ScipySolve", "JaxSolve", "LmfitSolve"):
+    if name in ("BaseSolver", "ScipySolve", "JaxSolve", "LanesSolve",
+                "LmfitSolve"):
         from .models import solver
 
         return getattr(solver, name)

@@ -2,12 +2,15 @@
 
 from .factoranalysis import FactorAnalysis
 from .metran import Metran
-from .solver import BaseSolver, JaxSolve, LmfitSolve, ScipySolve
+from .solver import (
+    BaseSolver, JaxSolve, LanesSolve, LmfitSolve, ScipySolve,
+)
 
 __all__ = [
     "BaseSolver",
     "FactorAnalysis",
     "JaxSolve",
+    "LanesSolve",
     "LmfitSolve",
     "Metran",
     "ScipySolve",

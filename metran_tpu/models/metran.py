@@ -570,10 +570,12 @@ class Metran:
         Parameters
         ----------
         solver : solver class (not instance), optional
-            e.g. ``ScipySolve`` or ``JaxSolve``.  Default: backend-aware
-            — ``ScipySolve`` on CPU (reference parity), ``JaxSolve`` on
-            accelerators (the whole L-BFGS loop runs on device; no
-            host round-trip per iteration).
+            e.g. ``ScipySolve``, ``JaxSolve`` or ``LanesSolve``.
+            Default: backend-aware — ``ScipySolve`` on CPU (reference
+            parity); on accelerators ``LanesSolve`` (the fleet lanes
+            engine at batch 1: fixed-structure compiled programs,
+            bounded dispatches, lanes-fd standard errors), falling back
+            to ``JaxSolve`` when some parameters are fixed.
         report : bool, optional
             Print fit and metran reports when done.
         engine : str, optional
@@ -594,13 +596,29 @@ class Metran:
         self.set_init_parameters(method=init)
 
         if solver is None:
+            from .solver import LanesSolve
+
+            if isinstance(self.fit, LanesSolve) and not LanesSolve.supports(
+                self
+            ):
+                # the cached auto-choice is parameter-table-dependent:
+                # a row fixed (or a bound customized) since the last
+                # solve invalidates it in favor of the general solver
+                self.fit = None
             if self.fit is None:
                 from ..config import is_accelerator
 
                 if is_accelerator():
-                    from .solver import JaxSolve
+                    # lanes engine: fixed-structure programs, bounded
+                    # dispatches — the TPU-proven path.  It optimizes
+                    # every parameter over the standard box; other fits
+                    # take the general JaxSolve instead.
+                    if LanesSolve.supports(self):
+                        self.fit = LanesSolve(mt=self)
+                    else:
+                        from .solver import JaxSolve
 
-                    self.fit = JaxSolve(mt=self)
+                        self.fit = JaxSolve(mt=self)
                 else:
                     self.fit = ScipySolve(mt=self)
         elif self.fit is None or not isinstance(self.fit, solver):

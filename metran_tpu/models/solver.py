@@ -429,6 +429,97 @@ def run_lbfgs(objective, theta0, maxiter: int = 200,
     )
 
 
+class LanesSolve(BaseSolver):
+    """Single-model solve on the fleet lanes engine — the accelerator
+    default.
+
+    Routes ``Metran.solve()`` through the same machinery as
+    ``fit_fleet(layout="lanes")``: the lane-layout Kalman kernel with
+    its analytical adjoint and the fixed-structure grid-line-search
+    L-BFGS (:mod:`metran_tpu.parallel.lanes_lbfgs`).  Versus ``JaxSolve``
+    (optax zoom line search under one big ``jit``) this compiles much
+    smaller programs and keeps every device dispatch short and bounded —
+    the properties that make the fleet path robust on real TPU runtimes
+    — while converging to the same optima (``tests/test_parallel.py::
+    test_fit_fleet_matches_jaxsolve_single``).  Standard errors come
+    from the lane-layout FD Hessian (``fleet_stderr(method="lanes-fd")``).
+
+    Scope: optimizes every parameter with the fleet box (``alpha`` in
+    ``[ALPHA_PMIN, alpha_max soft cap]`` — the reference's lower bound,
+    ``metran/metran.py:446-462``, plus the float32 safety cap).  Fixed
+    parameters (``vary=False``) or custom ``pmin/pmax`` are not
+    supported; ``Metran.solve`` falls back to :class:`JaxSolve` then.
+    """
+
+    _name = "LanesSolve"
+
+    @classmethod
+    def supports(cls, mt) -> bool:
+        """True when the fit is expressible on the lanes engine: every
+        parameter varying, with the fleet's standard box (the
+        reference-default ``pmin`` and no upper bound)."""
+        from ..parallel.fleet import ALPHA_PMIN
+
+        pt = mt.parameters
+        if not pt.vary.values.astype(bool).all():
+            return False
+        pmin = pt.pmin.values.astype(float)
+        pmax = pt.pmax.values.astype(float)
+        return bool(
+            np.allclose(pmin, ALPHA_PMIN) and np.isnan(pmax).all()
+        )
+
+    def solve(self, maxiter: int = 100, tol: Optional[float] = None,
+              stall_tol: Optional[float] = None,
+              stall_rtol: Optional[float] = None, chunk: int = 8,
+              remat_seg: Optional[int] = 100, **kwargs):
+        import jax.numpy as jnp
+
+        from ..parallel import fleet as _fleet
+
+        self._setup()
+        if not self.supports(self.mt):
+            raise ValueError(
+                "LanesSolve optimizes all parameters over the fleet's "
+                "standard box (pmin=1e-5, no pmax); use JaxSolve/"
+                "ScipySolve for fits with fixed (vary=False) "
+                "parameters or custom bounds"
+            )
+        mt = self.mt
+        panel = mt._active_panel()
+        flt = _fleet.pack_fleet([panel], [mt.factors])
+        idx = mt._canonical_idx  # canonical[i] = table[idx[i]]
+        p0 = jnp.asarray(mt._param_array(self.initial))[None]
+        if stall_rtol is None and stall_tol is None:
+            # scipy-factr default: stop once per-iteration improvement
+            # falls below ftol * |current f| (the grid-line-search
+            # L-BFGS converges to the optimum long before its gradient
+            # norm can pass an absolute f64 test; the reference's scipy
+            # stop is exactly this relative criterion and reports
+            # success).  Evaluated per-iteration on device.
+            stall_rtol = default_ftol(p0.dtype)
+        fit = _fleet.fit_fleet(
+            flt, p0=p0, maxiter=maxiter, tol=tol, stall_tol=stall_tol,
+            stall_rtol=stall_rtol or 0.0, chunk=chunk, layout="lanes",
+            remat_seg=remat_seg, **kwargs
+        )
+        params = np.asarray(fit.params[0], float)  # canonical order
+        se, pcov_c = _fleet.fleet_stderr(
+            fit.params, flt, remat_seg=remat_seg, method="lanes-fd"
+        )
+        pcov_c = np.asarray(pcov_c[0], float)
+
+        n = len(params)
+        x = np.empty(n)
+        x[idx] = params  # back to table row order
+        pcov = np.empty((n, n))
+        pcov[np.ix_(idx, idx)] = pcov_c
+        return self._finalize(
+            x, float(fit.deviance[0]), int(fit.nfev[0]),
+            bool(fit.converged[0]), pcov,
+        )
+
+
 class LmfitSolve(BaseSolver):
     """lmfit-backed solver for API parity with the reference.
 

@@ -92,6 +92,9 @@ class FleetFit(NamedTuple):
         the resolution-floor stall stop rather than the gradient test
         (distinct flag so cap-pinned / noise-limited lanes remain
         identifiable).
+    nfev : (B,) objective evaluations per lane (lanes layout only —
+        the batch layout's optax line search does not expose a per-lane
+        count; ``None`` there).
     """
 
     params: jnp.ndarray
@@ -99,6 +102,7 @@ class FleetFit(NamedTuple):
     iterations: jnp.ndarray
     converged: jnp.ndarray
     stalled: Optional[jnp.ndarray] = None
+    nfev: Optional[jnp.ndarray] = None
 
 
 def pack_fleet(
@@ -471,7 +475,8 @@ def _make_chunk_runner(warmup, engine, tol, chunk, maxiter,
 
 @functools.lru_cache(maxsize=32)
 def _make_lanes_runner(warmup, tol, chunk, maxiter, ls_steps,
-                       history, theta_cap, remat_seg, stall_tol=None):
+                       history, theta_cap, remat_seg, stall_tol=None,
+                       stall_rtol=0.0):
     """Build (init, run_chunk) for the lane-layout batched L-BFGS.
 
     The objective is the hand-written lane-layout Kalman deviance
@@ -506,7 +511,8 @@ def _make_lanes_runner(warmup, tol, chunk, maxiter, ls_steps,
         )
     )
     run_chunk = lanes_lbfgs.make_chunk_runner(
-        vg_fn, obj_fn, ls_steps, maxiter, tol, chunk, stall_tol
+        vg_fn, obj_fn, ls_steps, maxiter, tol, chunk, stall_tol,
+        stall_rtol,
     )
     return init, run_chunk
 
@@ -527,7 +533,7 @@ COMPACT_MIN = 128  # never compact below one full TPU lane tile
 def _fit_fleet_lanes(fleet, p0, warmup, maxiter, tol, mesh,
                      chunk, max_linesearch_steps, alpha_max, stall_tol,
                      checkpoint, remat_seg, history=8, max_chunks=None,
-                     compact_min=COMPACT_MIN):
+                     compact_min=COMPACT_MIN, stall_rtol=0.0):
     """Lane-layout fleet fit driver (see ``fit_fleet(layout="lanes")``)."""
     from . import lanes_lbfgs
 
@@ -535,7 +541,7 @@ def _fit_fleet_lanes(fleet, p0, warmup, maxiter, tol, mesh,
     ls_steps = lanes_lbfgs.default_ls_steps(min(max_linesearch_steps, 6))
     init, run_chunk = _make_lanes_runner(
         warmup, tol, chunk, maxiter, ls_steps, history,
-        theta_cap, remat_seg, stall_tol,
+        theta_cap, remat_seg, stall_tol, stall_rtol,
     )
     # two-phase schedule: after the first full chunk, advance in short
     # tail dispatches so the run ends within ~tail iterations of the
@@ -549,7 +555,7 @@ def _fit_fleet_lanes(fleet, p0, warmup, maxiter, tol, mesh,
     _, run_tail = (
         (None, run_chunk) if tail == chunk else _make_lanes_runner(
             warmup, tol, tail, maxiter, ls_steps, history,
-            theta_cap, remat_seg, stall_tol,
+            theta_cap, remat_seg, stall_tol, stall_rtol,
         )
     )
     theta0 = _alpha_to_theta(jnp.asarray(p0), theta_cap)
@@ -571,6 +577,7 @@ def _fit_fleet_lanes(fleet, p0, warmup, maxiter, tol, mesh,
         ckpt_meta = dict(
             maxiter=maxiter, chunk=chunk, tol=tol, engine="sequential",
             warmup=warmup, theta_cap=theta_cap, stall_tol=stall_tol,
+            stall_rtol=stall_rtol,
             ls_steps=list(ls_steps), history=history, layout="lanes",
             remat_seg=remat_seg,
             data=_fleet_fingerprint(
@@ -685,7 +692,8 @@ def _fit_fleet_lanes(fleet, p0, warmup, maxiter, tol, mesh,
     # the resolution floor" is recorded exactly (not re-inferred)
     stalled = (state.stall >= lanes_lbfgs.STALL_ITERS) & ~grad_ok
     return FleetFit(
-        params, state.value, state.count, grad_ok | stalled, stalled
+        params, state.value, state.count, grad_ok | stalled, stalled,
+        state.nfev,
     )
 
 
@@ -714,6 +722,7 @@ def fit_fleet(
     max_linesearch_steps: int = 16,
     alpha_max: float = ALPHA_MAX,
     stall_tol: Optional[float] = None,
+    stall_rtol: float = 0.0,
     checkpoint: Optional[str] = None,
     layout: str = "batch",
     remat_seg: Optional[int] = None,
@@ -763,6 +772,12 @@ def fit_fleet(
         ``0.0`` in float32, where the floor, not the gradient test, is
         what terminates every fit.  Pass a negative value to force it
         off (zero improvement never satisfies a negative bound).
+    stall_rtol : relative companion to ``stall_tol``: the freeze
+        threshold becomes ``stall_tol + stall_rtol * |value|``,
+        re-evaluated at each lane's CURRENT objective — scipy
+        L-BFGS-B's ``factr`` criterion (see
+        :func:`metran_tpu.models.solver.default_ftol`).  Either part
+        alone enables the stall machinery.
     checkpoint : optional file path; the optimizer carry is checkpointed
         there after every chunk and restored on restart (preemption-safe
         long runs — a capability the reference lacks, SURVEY.md section
@@ -816,8 +831,8 @@ def fit_fleet(
             f"alpha_max must be finite and > {ALPHA_PMIN}, got {alpha_max}"
         )
     theta_cap = float(np.log(alpha_max))
-    if (chunk is None and layout == "batch" and stall_tol is not None
-            and stall_tol >= 0):
+    stall_on = (stall_tol is not None and stall_tol >= 0) or stall_rtol > 0
+    if chunk is None and layout == "batch" and stall_on:
         # the batch layout's stall stop runs host-side BETWEEN chunks,
         # so a single maxiter-sized dispatch would never evaluate it;
         # give stall-enabled runs a chunked schedule by default (chunk
@@ -849,6 +864,7 @@ def fit_fleet(
             fleet, p0, warmup, maxiter, tol, mesh, chunk,
             max_linesearch_steps, alpha_max, stall_tol, checkpoint,
             remat_seg, max_chunks=max_chunks, compact_min=compact_min,
+            stall_rtol=stall_rtol,
         )
     opt, advance, outputs = _make_chunk_runner(
         warmup, engine, tol, chunk, maxiter, max_linesearch_steps,
@@ -904,6 +920,7 @@ def fit_fleet(
         ckpt_meta = dict(
             maxiter=maxiter, chunk=chunk, tol=tol, engine=engine,
             warmup=warmup, theta_cap=theta_cap, stall_tol=stall_tol,
+            stall_rtol=stall_rtol,
             max_linesearch_steps=max_linesearch_steps,
             layout="batch", remat_seg=remat_seg,
             data=_fleet_fingerprint(
@@ -947,14 +964,16 @@ def fit_fleet(
         # optional per-lane stop at the f32 resolution floor: a frozen
         # lane takes no further iterations (device-side cond), so its
         # result never depends on what else shares the batch
-        if stall_tol is not None and prev_value is not None:
+        if ((stall_tol is not None or stall_rtol > 0)
+                and prev_value is not None):
             # two-sided: freeze only lanes whose value CHANGED by at
             # most stall_tol over the chunk.  A lane that regressed
             # beyond stall_tol (line-search failure excursion) keeps
             # running — it either recovers or exhausts maxiter
             # unconverged; freezing it here would misreport divergence
             # as a floor stop in the post-loop classification
-            stalled = np.abs(value - prev_value) <= stall_tol
+            thresh = (stall_tol or 0.0) + stall_rtol * np.abs(value)
+            stalled = np.abs(value - prev_value) <= thresh
             frozen_host = np.asarray(frozen) | stalled
             done |= frozen_host
             frozen = jnp.asarray(frozen_host)

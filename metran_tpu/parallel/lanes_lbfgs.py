@@ -131,7 +131,7 @@ STALL_ITERS = 2  # consecutive sub-stall_tol iterations before freezing
 
 
 def make_step(vg_fn, obj_fn, ls_steps: Tuple[float, ...], maxiter: int,
-              tol: float, stall_tol=None):
+              tol: float, stall_tol=None, stall_rtol: float = 0.0):
     """Build one fixed-structure L-BFGS iteration over ``(state, *data)``.
 
     Parameters
@@ -149,6 +149,13 @@ def make_step(vg_fn, obj_fn, ls_steps: Tuple[float, ...], maxiter: int,
         lane the moment it hits the f32 resolution floor instead of at
         the next chunk boundary (measured: ~25 percent fewer iterations per
         fit at chunk=5 on the benchmark workload).
+    stall_rtol : relative companion to ``stall_tol``: the per-lane
+        freeze threshold is ``stall_tol + stall_rtol * |value|``,
+        re-evaluated at the CURRENT objective each iteration — scipy
+        L-BFGS-B's ``factr`` criterion (improvement below
+        ``factr * eps * max(|f|, 1)`` is success), not a threshold
+        anchored at the initial deviance.  Either part alone enables
+        the stall machinery.
     """
     n_trials = len(ls_steps)
 
@@ -234,12 +241,16 @@ def make_step(vg_fn, obj_fn, ls_steps: Tuple[float, ...], maxiter: int,
         frz = state.frozen
         sel = lambda a, b: jnp.where(frz, a, b)  # noqa: E731
         count = state.count + (~frz).astype(jnp.int32)
-        if stall_tol is None:
+        if stall_tol is None and not stall_rtol:
             stall = state.stall
             stalled = jnp.zeros_like(state.frozen)
         else:
-            # <= so stall_tol=0.0 still freezes zero-improvement lanes
-            small = (state.value - value_new) <= stall_tol
+            # <= so a zero threshold still freezes zero-improvement
+            # lanes; the relative part tracks the CURRENT value
+            thresh = (stall_tol or 0.0) + stall_rtol * jnp.abs(
+                state.value
+            )
+            small = (state.value - value_new) <= thresh
             stall = jnp.where(small, state.stall + 1, 0)
             stalled = stall >= STALL_ITERS
         return LanesLbfgsState(
@@ -264,14 +275,16 @@ def make_step(vg_fn, obj_fn, ls_steps: Tuple[float, ...], maxiter: int,
 
 
 def make_chunk_runner(vg_fn, obj_fn, ls_steps, maxiter, tol, chunk,
-                      stall_tol=None):
+                      stall_tol=None, stall_rtol=0.0):
     """jit a fixed-length chunk of iterations (a ``scan``, no cond).
 
     Frozen lanes ride along unchanged; the host inspects
     ``count``/``value``/``frozen`` between chunks for early stop,
     exactly like the batch-layout driver.
     """
-    step = make_step(vg_fn, obj_fn, ls_steps, maxiter, tol, stall_tol)
+    step = make_step(
+        vg_fn, obj_fn, ls_steps, maxiter, tol, stall_tol, stall_rtol
+    )
 
     @jax.jit
     def run_chunk(state: LanesLbfgsState, *data) -> LanesLbfgsState:

@@ -220,6 +220,119 @@ def _normalize_report(text):
     return re.sub(r"(nfev\s+)\d+", r"\g<1>N", text)
 
 
+def test_lanessolve_matches_golden(series_list, golden):
+    """LanesSolve (the accelerator-default single-model solver riding
+    the fleet lanes engine) reaches the reference optimum and reports
+    success via the factr-style floor stop."""
+    import logging
+
+    m = metran_tpu.Metran(series_list, name="B21B0214")
+    records = []
+    handler = logging.Handler()
+    handler.emit = lambda r: records.append(r.getMessage())
+    logging.getLogger("metran_tpu").addHandler(handler)
+    try:
+        m.solve(solver=metran_tpu.LanesSolve, report=False)
+    finally:
+        logging.getLogger("metran_tpu").removeHandler(handler)
+    assert m.fit.obj_func == pytest.approx(golden["obj_func"], rel=1e-5)
+    np.testing.assert_allclose(
+        m.parameters["optimal"].values.astype(float),
+        np.asarray(golden["optimal"], float),
+        rtol=1e-3,
+    )
+    # a good fit must not warn (VERDICT r3 item 4 contract)
+    assert not [r for r in records if "estimated" in r]
+    # stderr populated from the lanes-fd Hessian
+    assert np.isfinite(m.parameters["stderr"].values.astype(float)).all()
+    assert "LanesSolve" in m.fit_report()
+
+
+def test_lanessolve_rejects_fixed_parameters(series_list):
+    m = metran_tpu.Metran(series_list, name="B21B0214")
+    m.get_factors(m.oseries)
+    m._init_kalmanfilter()
+    m.set_init_parameters()
+    m.parameters.loc[m.parameters.index[0], "vary"] = False
+    solver = metran_tpu.LanesSolve(mt=m)
+    with pytest.raises(ValueError, match="vary=False"):
+        solver.solve()
+
+
+def test_accelerator_default_solver_selection(series_list, monkeypatch):
+    """On accelerators Metran.solve picks LanesSolve (all-vary fits) or
+    JaxSolve (fits with fixed rows) — without running the solve."""
+    from metran_tpu import config as _config
+    from metran_tpu.models.solver import JaxSolve, LanesSolve
+
+    monkeypatch.setattr(_config, "is_accelerator", lambda: True)
+
+    captured = {}
+
+    def fake_solve(self, **kw):
+        captured["cls"] = type(self).__name__
+        n = len(self.mt.parameters)
+        return True, np.ones(n), np.ones(n)
+
+    monkeypatch.setattr(LanesSolve, "solve", fake_solve)
+    monkeypatch.setattr(JaxSolve, "solve", fake_solve)
+    m = metran_tpu.Metran(series_list, name="B21B0214")
+    m.solve(report=False)
+    assert captured["cls"] == "LanesSolve"
+
+    # solve() rebuilds the parameter table (set_init_parameters), so a
+    # fixed row / custom bound must survive that rebuild to steer
+    # selection.  Reusing the SAME model exercises cache invalidation:
+    # the previously cached LanesSolve must yield to JaxSolve once the
+    # table stops qualifying.
+    orig_init = metran_tpu.Metran.set_init_parameters
+
+    def init_with_fixed_row(self, **kw):
+        orig_init(self, **kw)
+        self.parameters.loc[self.parameters.index[0], "vary"] = False
+
+    monkeypatch.setattr(
+        metran_tpu.Metran, "set_init_parameters", init_with_fixed_row
+    )
+    m.solve(report=False)
+    assert captured["cls"] == "JaxSolve"
+
+    def init_with_custom_bound(self, **kw):
+        orig_init(self, **kw)
+        self.parameters.loc[self.parameters.index[0], "pmax"] = 500.0
+
+    monkeypatch.setattr(
+        metran_tpu.Metran, "set_init_parameters", init_with_custom_bound
+    )
+    m3 = metran_tpu.Metran(series_list, name="B21B0214")
+    m3.solve(report=False)
+    assert captured["cls"] == "JaxSolve"
+
+
+def test_fit_report_renders_high_correlations(mt):
+    """The |rho| > 0.5 section lists each pair once with its rounded
+    value (reference metran/metran.py:1148-1170); the example fit's own
+    pcor is all-low so the populated path needs a crafted table."""
+    import pandas as pd
+
+    real_pcor = mt.fit.pcor
+    names = list(mt.parameters.index[:2])
+    try:
+        pcor = pd.DataFrame(
+            [[1.0, -0.87], [-0.87, 1.0]], index=names, columns=names
+        )
+        mt.fit.pcor = pcor
+        report = mt.fit_report()
+        assert "Parameter correlations |rho| > 0.5" in report
+        assert "-0.87" in report
+        # each pair appears exactly once (not mirrored)
+        assert report.count("-0.87") == 1
+        # output="basic" omits the correlations section entirely
+        assert "correlations" not in mt.fit_report(output="basic")
+    finally:
+        mt.fit.pcor = real_pcor
+
+
 @pytest.mark.parametrize("which", ["fit_report", "metran_report"])
 def test_report_golden_text(mt, which):
     """Byte-level layout parity against the committed golden snapshot
