@@ -596,33 +596,32 @@ class Metran:
         self.set_init_parameters(method=init)
 
         if solver is None:
-            from .solver import LanesSolve
+            from ..config import is_accelerator
 
-            if isinstance(self.fit, LanesSolve) and not LanesSolve.supports(
-                self
+            if is_accelerator():
+                from .solver import JaxSolve, LanesSolve
+
+                # lanes engine: fixed-structure programs, bounded
+                # dispatches — the TPU-proven path.  It optimizes every
+                # parameter over the standard box; other fits take the
+                # general JaxSolve instead.
+                desired = (
+                    LanesSolve if LanesSolve.supports(self) else JaxSolve
+                )
+            else:
+                desired = ScipySolve
+            # the auto-choice is parameter-table-dependent, so a cached
+            # AUTO-selected solver is re-validated each solve (in both
+            # directions); an explicitly requested solver stays sticky
+            if self.fit is None or (
+                getattr(self, "_fit_auto", False)
+                and not isinstance(self.fit, desired)
             ):
-                # the cached auto-choice is parameter-table-dependent:
-                # a row fixed (or a bound customized) since the last
-                # solve invalidates it in favor of the general solver
-                self.fit = None
-            if self.fit is None:
-                from ..config import is_accelerator
-
-                if is_accelerator():
-                    # lanes engine: fixed-structure programs, bounded
-                    # dispatches — the TPU-proven path.  It optimizes
-                    # every parameter over the standard box; other fits
-                    # take the general JaxSolve instead.
-                    if LanesSolve.supports(self):
-                        self.fit = LanesSolve(mt=self)
-                    else:
-                        from .solver import JaxSolve
-
-                        self.fit = JaxSolve(mt=self)
-                else:
-                    self.fit = ScipySolve(mt=self)
+                self.fit = desired(mt=self)
+                self._fit_auto = True
         elif self.fit is None or not isinstance(self.fit, solver):
             self.fit = solver(mt=self)
+            self._fit_auto = False
         self.settings["solver"] = self.fit._name
 
         success, optimal, stderr = self.fit.solve(**kwargs)

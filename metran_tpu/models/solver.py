@@ -504,7 +504,8 @@ class LanesSolve(BaseSolver):
             remat_seg=remat_seg, **kwargs
         )
         params = np.asarray(fit.params[0], float)  # canonical order
-        se, pcov_c = _fleet.fleet_stderr(
+        # stderr re-derives from the covariance diagonal in _finalize
+        _, pcov_c = _fleet.fleet_stderr(
             fit.params, flt, remat_seg=remat_seg, method="lanes-fd"
         )
         pcov_c = np.asarray(pcov_c[0], float)

@@ -22,6 +22,7 @@ from .fleet import (
     autocorr_init_params,
     default_init_params,
     fit_fleet,
+    multistart_fit_fleet,
     fleet_decompose,
     fleet_deviance,
     fleet_simulate,
@@ -48,6 +49,7 @@ __all__ = [
     "batch_sharding",
     "default_init_params",
     "fit_fleet",
+    "multistart_fit_fleet",
     "fleet_decompose",
     "fleet_deviance",
     "fleet_simulate",

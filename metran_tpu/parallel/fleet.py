@@ -972,7 +972,9 @@ def fit_fleet(
             # running — it either recovers or exhausts maxiter
             # unconverged; freezing it here would misreport divergence
             # as a floor stop in the post-loop classification
-            thresh = (stall_tol or 0.0) + stall_rtol * np.abs(value)
+            thresh = (stall_tol or 0.0) + stall_rtol * np.maximum(
+                np.abs(value), 1.0
+            )
             stalled = np.abs(value - prev_value) <= thresh
             frozen_host = np.asarray(frozen) | stalled
             done |= frozen_host
@@ -1014,6 +1016,65 @@ def fit_fleet(
             capped_rows.tolist()[:20], alpha_max,
         )
     return FleetFit(params, value, count, conv, jnp.asarray(stalled))
+
+
+def multistart_fit_fleet(
+    fleet: Fleet,
+    n_starts: int = 4,
+    p0: Optional[jnp.ndarray] = None,
+    seed: int = 0,
+    spread: float = 3.0,
+    **fit_kwargs,
+):
+    """Fit every model from several initial points and keep the best.
+
+    A global-optimization guard with no reference equivalent (its
+    single L-BFGS-B run from ``alpha = 10`` commits to one basin,
+    ``metran/solver.py:245-256``): the DFM deviance can be multimodal
+    in the alphas (specific/common decay roles swapping is the classic
+    case), and extra starts are nearly free on TPU because they ride
+    the same lane axis as the fleet — the tiled problem is ONE lanes
+    program of batch ``B * n_starts``, not ``n_starts`` sequential
+    runs.
+
+    Starts per model: the data-driven autocorr init (or ``p0`` when
+    given), the reference constant init, then log-normal perturbations
+    of the first with scale ``log(spread)``, clamped to the interior
+    regime — deterministic in ``seed``.
+
+    Under a ``mesh``, the device count must divide ``B * n_starts``
+    (pack accordingly).  Memory scales with ``n_starts``; the peak is
+    the same lanes program at a larger batch.
+
+    Returns ``(fit, deviances)``: a :class:`FleetFit` of per-model
+    winners and the (B, n_starts) deviance table (column 0 = the base
+    start), so "how much did extra starts matter" is one subtraction.
+    """
+    if n_starts < 1:
+        raise ValueError(f"n_starts must be >= 1, got {n_starts}")
+    b = fleet.batch
+    base = autocorr_init_params(fleet) if p0 is None else jnp.asarray(p0)
+    starts = [base]
+    if n_starts >= 2:
+        starts.append(default_init_params(fleet))
+    rng = np.random.default_rng(seed)
+    while len(starts) < n_starts:
+        fac = rng.lognormal(
+            0.0, np.log(spread), size=(b, fleet.n_params)
+        ).astype(np.asarray(base).dtype)
+        starts.append(
+            jnp.clip(base * fac, ALPHA_INIT_MIN, ALPHA_INIT_MAX)
+        )
+    # model-major layout: model 0's starts first, matching jnp.repeat
+    p0_all = jnp.stack(starts, axis=1).reshape(b * n_starts, -1)
+    big = jax.tree.map(lambda a: jnp.repeat(a, n_starts, axis=0), fleet)
+    fit = fit_fleet(big, p0=p0_all, **fit_kwargs)
+    dev = fit.deviance.reshape(b, n_starts)
+    flat = jnp.argmin(dev, axis=1) + jnp.arange(b) * n_starts
+    best = FleetFit(*(
+        None if f is None else jnp.take(f, flat, axis=0) for f in fit
+    ))
+    return best, dev
 
 
 def fleet_simulate(

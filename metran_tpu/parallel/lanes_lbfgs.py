@@ -246,9 +246,11 @@ def make_step(vg_fn, obj_fn, ls_steps: Tuple[float, ...], maxiter: int,
             stalled = jnp.zeros_like(state.frozen)
         else:
             # <= so a zero threshold still freezes zero-improvement
-            # lanes; the relative part tracks the CURRENT value
-            thresh = (stall_tol or 0.0) + stall_rtol * jnp.abs(
-                state.value
+            # lanes; the relative part tracks the CURRENT value with
+            # scipy's max(|f|, 1) floor (factr * eps * max(|f|, 1)) so
+            # near-zero deviances keep a resolvable threshold
+            thresh = (stall_tol or 0.0) + stall_rtol * jnp.maximum(
+                jnp.abs(state.value), 1.0
             )
             small = (state.value - value_new) <= thresh
             stall = jnp.where(small, state.stall + 1, 0)

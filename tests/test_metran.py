@@ -297,6 +297,13 @@ def test_accelerator_default_solver_selection(series_list, monkeypatch):
     m.solve(report=False)
     assert captured["cls"] == "JaxSolve"
 
+    # ...and re-qualifies symmetrically once the table is standard again
+    monkeypatch.setattr(
+        metran_tpu.Metran, "set_init_parameters", orig_init
+    )
+    m.solve(report=False)
+    assert captured["cls"] == "LanesSolve"
+
     def init_with_custom_bound(self, **kw):
         orig_init(self, **kw)
         self.parameters.loc[self.parameters.index[0], "pmax"] = 500.0

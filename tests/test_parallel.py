@@ -571,6 +571,36 @@ def test_fleet_stderr_chunked_matches_unchunked(rng):
     )
 
 
+def test_multistart_fit_fleet(rng):
+    """Per-model winners are at least as good as the base start for
+    every model (the whole point), winner selection indexes correctly,
+    and n_starts=1 reduces to the plain fit."""
+    from metran_tpu.parallel import autocorr_init_params, multistart_fit_fleet
+
+    fleet, _, _ = _random_fleet(rng, [4, 3, 4], t=100)
+    kwargs = dict(maxiter=30, chunk=10, layout="lanes", remat_seg=32,
+                  stall_tol=1e-8)
+    best, dev = multistart_fit_fleet(fleet, n_starts=3, **kwargs)
+    assert dev.shape == (3, 3)
+    # the winner's deviance equals the per-model minimum of the table
+    np.testing.assert_allclose(
+        np.asarray(best.deviance), np.asarray(dev).min(axis=1), rtol=0
+    )
+    # never worse than the base (column 0) start
+    assert (np.asarray(best.deviance)
+            <= np.asarray(dev)[:, 0] + 1e-9).all()
+
+    single, dev1 = multistart_fit_fleet(fleet, n_starts=1, **kwargs)
+    plain = fit_fleet(fleet, p0=autocorr_init_params(fleet), **kwargs)
+    np.testing.assert_allclose(
+        np.asarray(single.deviance), np.asarray(plain.deviance), rtol=1e-12
+    )
+    np.testing.assert_allclose(
+        np.asarray(single.params), np.asarray(plain.params), rtol=1e-12
+    )
+    assert dev1.shape == (3, 1)
+
+
 def test_fleet_stderr_lanes_fd_matches_exact(rng):
     """The lane-layout central-difference Hessian (TPU-fast path, all
     2P perturbations riding the lane axis) reproduces the exact
