@@ -619,8 +619,12 @@ class Metran:
             ):
                 self.fit = desired(mt=self)
                 self._fit_auto = True
-        elif self.fit is None or not isinstance(self.fit, solver):
-            self.fit = solver(mt=self)
+        else:
+            if self.fit is None or not isinstance(self.fit, solver):
+                self.fit = solver(mt=self)
+            # an explicit request always pins the choice, even when the
+            # cached instance already matches (it may have been cached
+            # by auto-selection)
             self._fit_auto = False
         self.settings["solver"] = self.fit._name
 

@@ -38,6 +38,26 @@ from .kalman import FilterResult, SmootherResult
 from .statespace import StateSpace
 
 
+AUTO_BLOCK = 512  # block size picked when block="auto" resolves at long T
+AUTO_BLOCK_MIN_T = 2048  # full-length scan below this (small programs)
+
+
+def _resolve_block(block, t: int):
+    """``"auto"`` -> ``AUTO_BLOCK`` for long series, else full-length.
+
+    The full-length combine tree's HLO grows with ``log2(T)`` levels of
+    progressively-sliced ops; beyond a few thousand steps it is slow to
+    compile everywhere and has crashed XLA:CPU outright (segfault in
+    ``backend_compile_and_load`` at T=6,255 on a 1-core host, round 4).
+    ``"auto"`` keeps exact full-length semantics for short series and
+    switches to the blocked decomposition when compile size starts to
+    matter.
+    """
+    if block == "auto":
+        return AUTO_BLOCK if t > AUTO_BLOCK_MIN_T else None
+    return block
+
+
 def _masked_obs(ss: StateSpace, mask_t, dtype):
     """Static-shape masked observation model for one timestep.
 
@@ -202,7 +222,7 @@ def _filter_combine(e1, e2):
 
 @functools.partial(jax.jit, static_argnames=("block",))
 def parallel_filter(ss: StateSpace, y: jnp.ndarray, mask: jnp.ndarray,
-                    block: int = None) -> FilterResult:
+                    block="auto") -> FilterResult:
     """Kalman filter with O(log T) depth via ``lax.associative_scan``.
 
     Returns the same :class:`FilterResult` as the sequential
@@ -213,9 +233,12 @@ def parallel_filter(ss: StateSpace, y: jnp.ndarray, mask: jnp.ndarray,
     ``block`` routes the combine through
     :func:`blocked_associative_scan` (numerically equivalent results;
     compile time scales with ``log(block)`` instead of ``log(T)`` —
-    essential at T >~ 10k, see docs/performance.md).  Default:
-    full-length scan.
+    essential at T >~ 10k, see docs/performance.md).  Default
+    ``"auto"``: full-length below ``AUTO_BLOCK_MIN_T`` steps, blocked
+    above; ``None`` forces the full-length scan (required when the
+    TIME axis itself is sharded, :func:`sequence_sharded_filter`).
     """
+    block = _resolve_block(block, y.shape[0])
     dtype = ss.q.dtype
     mask = jnp.asarray(mask, bool)
     # zero out masked slots: unlike the sequential engines (whose gains
@@ -300,11 +323,12 @@ def _smoother_combine(later, earlier):
 
 @functools.partial(jax.jit, static_argnames=("block",))
 def parallel_smoother(ss: StateSpace, filtered: FilterResult,
-                      block: int = None) -> SmootherResult:
+                      block="auto") -> SmootherResult:
     """RTS smoother with O(log T) depth via reverse associative scan.
 
     ``block`` as in :func:`parallel_filter` (blocked combine tree,
     numerically equivalent results, O(log block) compile)."""
+    block = _resolve_block(block, filtered.mean_f.shape[0])
     t_steps = filtered.mean_f.shape[0]
     last = jnp.arange(t_steps) == t_steps - 1
     # dummy next-step moments for the final element (unused: last flag)
@@ -332,7 +356,7 @@ def parallel_smoother(ss: StateSpace, filtered: FilterResult,
 @functools.partial(jax.jit, static_argnames=("warmup", "block"))
 def parallel_deviance(
     ss: StateSpace, y: jnp.ndarray, mask: jnp.ndarray, warmup: int = 1,
-    block: int = None,
+    block="auto",
 ) -> jnp.ndarray:
     """-2 log L evaluated with the parallel filter (reference semantics).
 
@@ -366,8 +390,11 @@ def sequence_sharded_filter(
 
     y = put(jnp.asarray(y, ss.q.dtype))
     mask = put(jnp.asarray(mask))
-    filtered = parallel_filter(ss, y, mask)
-    smoothed = parallel_smoother(ss, filtered)
+    # full-length scan (block=None): the blocked decomposition reshapes
+    # time into (blocks, block) and runs a sequential cross-block scan,
+    # which would serialize — and reshard — the very axis being sharded
+    filtered = parallel_filter(ss, y, mask, block=None)
+    smoothed = parallel_smoother(ss, filtered, block=None)
     return filtered, smoothed
 
 

@@ -1070,7 +1070,9 @@ def multistart_fit_fleet(
     big = jax.tree.map(lambda a: jnp.repeat(a, n_starts, axis=0), fleet)
     fit = fit_fleet(big, p0=p0_all, **fit_kwargs)
     dev = fit.deviance.reshape(b, n_starts)
-    flat = jnp.argmin(dev, axis=1) + jnp.arange(b) * n_starts
+    # a diverged start must lose, not win: argmin would select NaN
+    finite_dev = jnp.where(jnp.isfinite(dev), dev, jnp.inf)
+    flat = jnp.argmin(finite_dev, axis=1) + jnp.arange(b) * n_starts
     best = FleetFit(*(
         None if f is None else jnp.take(f, flat, axis=0) for f in fit
     ))

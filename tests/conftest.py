@@ -6,6 +6,29 @@ section 4); parity tests need float64 like the reference.
 """
 
 import os
+import resource
+
+# XLA:CPU's compiler recurses deeply on large programs (scan
+# transposes, associative-scan combine trees): at the common 8 MB
+# default stack soft limit it has segfaulted inside LLVM mid-suite
+# (round 4, exit 139 in backend_compile_and_load).  Raise the limit
+# BEFORE jax initializes — the main thread's growable stack obeys the
+# current limit, and XLA's worker threads size their stacks from it at
+# backend-init time.
+_soft, _hard = resource.getrlimit(resource.RLIMIT_STACK)
+_want = 512 * 1024 * 1024
+if _soft != resource.RLIM_INFINITY and _soft < _want:
+    try:
+        resource.setrlimit(
+            resource.RLIMIT_STACK,
+            (
+                _want if _hard == resource.RLIM_INFINITY
+                else min(_want, _hard),
+                _hard,
+            ),
+        )
+    except (ValueError, OSError):  # pragma: no cover - locked-down hosts
+        pass
 
 # Force CPU: the ambient environment may point JAX at a tunneled TPU
 # (JAX_PLATFORMS=axon); unit tests must run on the virtual CPU mesh.
@@ -35,11 +58,9 @@ import pytest  # noqa: E402
 EXAMPLE_DATA = Path(__file__).resolve().parents[1] / "examples" / "data"
 
 
-@pytest.fixture(scope="session")
-def series_list():
-    """The five groundwater residual series used by the reference tests."""
-    if not EXAMPLE_DATA.exists():
-        pytest.skip("example data not available")
+def load_example_series():
+    """The five groundwater residual series (vendored example data),
+    importable so subprocess-isolated tests rebuild identical input."""
     series = []
     for fi in sorted(EXAMPLE_DATA.glob("*_res.csv")):
         s = pd.read_csv(
@@ -52,6 +73,14 @@ def series_list():
         ).squeeze()
         series.append(s)
     return series
+
+
+@pytest.fixture(scope="session")
+def series_list():
+    """The five groundwater residual series used by the reference tests."""
+    if not EXAMPLE_DATA.exists():
+        pytest.skip("example data not available")
+    return load_example_series()
 
 
 @pytest.fixture(scope="session")
@@ -77,3 +106,28 @@ def random_ssm(rng, n_series=5, n_factors=1, t=200, missing=0.3):
 @pytest.fixture()
 def rng():
     return np.random.default_rng(42)
+
+
+def run_python_subprocess(script: str, timeout: float = 900.0):
+    """Run ``script`` in a fresh CPU-pinned interpreter.
+
+    Isolation shield for the suite's largest XLA programs: XLA:CPU's
+    compiler has segfaulted (exit 139 inside
+    ``backend_compile_and_load``) when a big compile lands late in a
+    long-lived pytest process with hundreds of prior compilations,
+    while the identical program compiles fine in a fresh interpreter
+    (round 4).  The subprocess also neutralizes any ambient TPU-plugin
+    autoregistration, so these tests cannot hang on a wedged tunnel.
+    """
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    repo = str(Path(__file__).resolve().parents[1])
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True,
+        timeout=timeout, env=env,
+    )

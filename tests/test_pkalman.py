@@ -155,11 +155,32 @@ def test_sequence_sharded_matches_unsharded(ssm):
 
 def test_metran_solve_parallel_engine(series_list):
     """End-to-end: Metran.solve with the parallel engine reproduces the
-    sequential golden objective on the reference example data."""
-    from metran_tpu.models.metran import Metran
+    sequential golden objective on the reference example data.
 
-    mt = Metran(series_list, engine="parallel")
-    mt.solve(report=False)
-    assert mt.fit.obj_func == pytest.approx(2332.327, abs=0.05)
-    sim = mt.get_simulation(mt.snames[0], alpha=0.05)
-    assert sim.shape[1] == 3
+    Runs in a SUBPROCESS: this is the suite's single largest XLA
+    program (T=6,255 associative-scan smoother), and XLA:CPU's compiler
+    has segfaulted on it when invoked late in a long-lived pytest
+    process with hundreds of prior compilations — while the identical
+    flow passes in a fresh interpreter (round 4, exit 139 in
+    ``backend_compile_and_load``).  Process isolation keeps an upstream
+    compiler bug from taking down the whole suite.
+    """
+    from tests.conftest import run_python_subprocess
+
+    script = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+from metran_tpu.models.metran import Metran
+from tests.conftest import load_example_series
+
+mt = Metran(load_example_series(), engine="parallel")
+mt.solve(report=False)
+assert abs(mt.fit.obj_func - 2332.327) < 0.05, mt.fit.obj_func
+sim = mt.get_simulation(mt.snames[0], alpha=0.05)
+assert sim.shape[1] == 3, sim.shape
+print("PARALLEL_ENGINE_OK")
+"""
+    res = run_python_subprocess(script)
+    assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
+    assert "PARALLEL_ENGINE_OK" in res.stdout
