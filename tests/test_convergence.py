@@ -115,6 +115,24 @@ def test_fit_fleet_f64_defaults_unchanged(rng):
     assert np.asarray(fit.converged).any()
 
 
+def test_fit_fleet_stall_rtol_factr_stop(rng):
+    """An f64 lanes fit with only the RELATIVE stall criterion (scipy
+    factr semantics, evaluated at the current objective on device)
+    terminates converged-with-stalled-flag at the same optimum as an
+    unbounded run."""
+    fleet = _small_fleet(rng, np.float64)
+    ref = fit_fleet(fleet, maxiter=120, layout="lanes")
+    fit = fit_fleet(
+        fleet, maxiter=120, layout="lanes", stall_rtol=2.3e-9,
+    )
+    assert np.asarray(fit.converged).all()
+    assert np.asarray(fit.stalled).any()
+    assert (np.asarray(fit.iterations) <= np.asarray(ref.iterations)).all()
+    np.testing.assert_allclose(
+        np.asarray(fit.deviance), np.asarray(ref.deviance), rtol=1e-7
+    )
+
+
 def test_run_lbfgs_divergence_not_converged():
     """An objective that blows up must never report success — the
     finiteness guard runs before the factr-style stop (a NaN/inf chunk
