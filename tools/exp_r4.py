@@ -1,0 +1,130 @@
+"""One-command on-chip session for every measurement queued in round 4.
+
+The round-3/4 tunnel wedge taught a protocol (BASELINE.md): when a chip
+becomes available, capture the bench FIRST, then run exploratory
+experiments, keeping every phase in its own subprocess with a generous
+timeout (a hang must not block later phases, and killing a client
+mid-dispatch is what wedges the pool — timeouts here are sized well past
+any sane phase duration so they only fire on a truly dead tunnel).
+
+Phases, in priority order:
+1. ``bench.py`` — the driver-comparable headline artifact
+   (platform=tpu fit number, post-fit products, per-lap timings).
+2. ``tools/exp_compact.py`` — tail-compaction + chunk ablation.
+3. blocked-scan compile measurement at T=32,768 (the round-3 finding
+   was 188.8 s full-length XLA compile; ``block=512/1024`` is the
+   round-4 mitigation whose on-chip number BASELINE.md still owes).
+
+Everything is logged to ``bench_artifacts/exp_r4_<ts>.log`` plus the
+bench JSON to ``bench_artifacts/BENCH_onchip_r4.json``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+ART = os.path.join(REPO, "bench_artifacts")
+
+BLOCKED_SCAN_SCRIPT = r"""
+import time
+import numpy as np
+import jax
+from metran_tpu.ops import dfm_statespace
+from metran_tpu.ops.pkalman import parallel_deviance
+
+print("platform", jax.devices()[0].platform, flush=True)
+rng = np.random.default_rng(3)
+n, k, t = 20, 1, 32768
+ld = np.asarray(rng.uniform(0.3, 0.8, (n, k)), np.float32)
+ss = dfm_statespace(np.float32(rng.uniform(5, 40, n)),
+                    np.float32(rng.uniform(5, 40, k)), ld)
+y = np.asarray(rng.normal(size=(t, n)), np.float32)
+mask = rng.uniform(size=(t, n)) > 0.3
+mask[0] = False
+y = np.where(mask, y, 0.0).astype(np.float32)
+# blocked variants FIRST (small compiles, low wedge risk); the
+# full-length compile that measured 188.8 s in round 3 goes last
+for block in (512, 1024, None):
+    t0 = time.time()
+    d = float(parallel_deviance(ss, y, mask, block=block))
+    first = time.time() - t0
+    t0 = time.time()
+    d2 = float(parallel_deviance(ss, y, mask, block=block))
+    lap = time.time() - t0
+    print(f"RESULT block={block} first_s={first:.1f} lap_s={lap:.2f} "
+          f"dev={d:.1f}", flush=True)
+"""
+
+
+def main() -> None:
+    os.makedirs(ART, exist_ok=True)
+    ts = time.strftime("%Y%m%d_%H%M%S")
+    log_path = os.path.join(ART, f"exp_r4_{ts}.log")
+    bench_json = os.path.join(ART, "BENCH_onchip_r4.json")
+
+    def log(msg):
+        line = f"[{time.strftime('%H:%M:%S')}] {msg}"
+        print(line, flush=True)
+        with open(log_path, "a") as fh:
+            fh.write(line + "\n")
+
+    def phase(name, argv, timeout, out_path=None):
+        """Run one phase; returns True iff it wrote ``out_path`` (or,
+        when no out_path is expected, iff it exited zero)."""
+        log(f"phase {name} start: {' '.join(argv)}")
+        try:
+            res = subprocess.run(
+                argv, capture_output=True, text=True, timeout=timeout,
+                cwd=REPO,
+            )
+        except subprocess.TimeoutExpired as e:
+            # keep the partial output: it says how far the phase got
+            # before the tunnel hung — the wedge protocol's evidence
+            with open(log_path, "a") as fh:
+                for stream in (e.stdout, e.stderr):
+                    if stream:
+                        if isinstance(stream, bytes):
+                            stream = stream.decode(errors="replace")
+                        fh.write(stream[-20000:] + "\n")
+            log(f"phase {name} TIMED OUT after {timeout}s "
+                "(partial output kept above)")
+            return False
+        with open(log_path, "a") as fh:
+            fh.write(res.stdout[-20000:] + "\n" + res.stderr[-20000:] + "\n")
+        log(f"phase {name} done rc={res.returncode}")
+        if out_path is None:
+            return res.returncode == 0
+        if res.stdout.strip():
+            tail = res.stdout.strip().splitlines()[-1]
+            try:
+                json.loads(tail)
+                with open(out_path, "w") as fh:
+                    fh.write(tail + "\n")
+                log(f"phase {name} JSON -> {out_path}")
+                return True
+            except ValueError:
+                pass
+        log(f"phase {name} produced no JSON line")
+        return False
+
+    py = sys.executable
+    # never report a STALE file as this session's result
+    if os.path.exists(bench_json):
+        os.remove(bench_json)
+    if phase(
+        "bench", [py, os.path.join(REPO, "bench.py")], 1500.0, bench_json
+    ):
+        d = json.loads(open(bench_json).read())
+        log(f"bench headline: {d.get('value')} {d.get('unit')} "
+            f"platform={d.get('platform')}")
+    phase("exp_compact", [py, os.path.join(HERE, "exp_compact.py")], 1200.0)
+    phase("blocked_scan", [py, "-c", BLOCKED_SCAN_SCRIPT], 900.0)
+    log("session complete")
+
+
+if __name__ == "__main__":
+    main()
