@@ -9,6 +9,10 @@ package is the new design surface that scales Metran to TPU pods:
   likelihood engine;
 - :func:`fit_fleet` — on-device batched L-BFGS, optionally sharded over a
   :class:`jax.sharding.Mesh` (GSPMD or explicit ``shard_map``);
+- :func:`multistart_fit_fleet` — multi-start basin search with the extra
+  starts riding the lane axis;
+- :func:`fleet_stderr` / :func:`fleet_simulate` / :func:`fleet_decompose`
+  — batched post-fit inference products;
 - :func:`make_train_step` — first-order training step for mesh-sharded
   fleets;
 - :func:`make_mesh` and friends — mesh/sharding helpers.
