@@ -202,6 +202,13 @@ class JaxSolve(BaseSolver):
         import jax
         import jax.numpy as jnp
 
+        if kwargs.pop("n_starts", 1) > 1:
+            logger.warning(
+                "n_starts is a LanesSolve feature; JaxSolve runs a "
+                "single-start fit (this model fell back because some "
+                "parameters are fixed or carry custom bounds)"
+            )
+
         self._setup()
         idx = np.flatnonzero(self.vary)
         lower = np.array(
@@ -472,7 +479,16 @@ class LanesSolve(BaseSolver):
     def solve(self, maxiter: int = 100, tol: Optional[float] = None,
               stall_tol: Optional[float] = None,
               stall_rtol: Optional[float] = None, chunk: int = 8,
-              remat_seg: Optional[int] = 100, **kwargs):
+              remat_seg: Optional[int] = 100, n_starts: int = 1,
+              **kwargs):
+        """Minimize the deviance on the lanes engine.
+
+        ``n_starts > 1`` adds a multi-start basin search
+        (:func:`metran_tpu.parallel.multistart_fit_fleet`): the extra
+        initial points ride the lane axis, so the whole search is still
+        one compiled program per dispatch; the best basin's optimum is
+        returned (``nfev`` is the winning start's evaluation count).
+        """
         import jax.numpy as jnp
 
         from ..parallel import fleet as _fleet
@@ -498,11 +514,24 @@ class LanesSolve(BaseSolver):
             # stop is exactly this relative criterion and reports
             # success).  Evaluated per-iteration on device.
             stall_rtol = default_ftol(p0.dtype)
-        fit = _fleet.fit_fleet(
-            flt, p0=p0, maxiter=maxiter, tol=tol, stall_tol=stall_tol,
+        # multistart-only knobs: fit_fleet has a fixed signature, so
+        # they must never reach the single-start path
+        ms_kwargs = {
+            k: kwargs.pop(k) for k in ("seed", "spread") if k in kwargs
+        }
+        fit_kwargs = dict(
+            maxiter=maxiter, tol=tol, stall_tol=stall_tol,
             stall_rtol=stall_rtol or 0.0, chunk=chunk, layout="lanes",
             remat_seg=remat_seg, **kwargs
         )
+        if n_starts > 1:
+            # winner per basin; nfev reported is the winning start's
+            # count (per-start counts live in the discarded lanes)
+            fit, _ = _fleet.multistart_fit_fleet(
+                flt, n_starts=n_starts, p0=p0, **ms_kwargs, **fit_kwargs
+            )
+        else:
+            fit = _fleet.fit_fleet(flt, p0=p0, **fit_kwargs)
         params = np.asarray(fit.params[0], float)  # canonical order
         # stderr re-derives from the covariance diagonal in _finalize
         _, pcov_c = _fleet.fleet_stderr(

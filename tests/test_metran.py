@@ -248,6 +248,30 @@ def test_lanessolve_matches_golden(series_list, golden):
     assert "LanesSolve" in m.fit_report()
 
 
+def test_lanessolve_multistart_matches_golden(series_list, golden):
+    """n_starts>1 routes through the lane-axis multi-start search and
+    still lands on the reference optimum with success reported."""
+    import logging
+
+    m = metran_tpu.Metran(series_list, name="B21B0214")
+    records = []
+    handler = logging.Handler()
+    handler.emit = lambda r: records.append(r.getMessage())
+    logging.getLogger("metran_tpu").addHandler(handler)
+    try:
+        m.solve(solver=metran_tpu.LanesSolve, n_starts=3, report=False)
+    finally:
+        logging.getLogger("metran_tpu").removeHandler(handler)
+    assert m.fit.obj_func == pytest.approx(golden["obj_func"], rel=1e-5)
+    np.testing.assert_allclose(
+        m.parameters["optimal"].values.astype(float),
+        np.asarray(golden["optimal"], float),
+        rtol=1e-3,
+    )
+    # success reported: no "could not be estimated well" warning fired
+    assert not [r for r in records if "estimated" in r]
+
+
 def test_lanessolve_rejects_fixed_parameters(series_list):
     m = metran_tpu.Metran(series_list, name="B21B0214")
     m.get_factors(m.oseries)
