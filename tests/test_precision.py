@@ -40,10 +40,11 @@ regression (e.g. reintroducing the ``1 - phi^2`` cancellation that the
 ``expm1`` form fixes) still trips it.
 """
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from metran_tpu.ops import deviance, dfm_statespace
 
@@ -55,15 +56,11 @@ GRAD_RTOL_CAP = 1.1e-4  # cap regime: 10x measured (1.1e-5)
 GRAD_COS = 1 - 1e-8  # direction preserved (measured 1-cos <= 5.5e-11)
 
 
-@pytest.fixture(scope="module")
-def flagship():
-    """Flagship-shaped panel with a true common factor and 30% missing."""
-    return make_flagship()
-
-
+@functools.lru_cache(maxsize=1)
 def make_flagship():
-    """Deterministic flagship data (module-level so subprocess-isolated
-    tests can rebuild the identical panel by import)."""
+    """Deterministic flagship data (module-level + cached so
+    subprocess-isolated tests rebuild the identical panel once per
+    interpreter by import)."""
     rng = np.random.default_rng(0)
     loadings = rng.uniform(0.4, 0.8, (N, K))
     mask = rng.uniform(size=(T, N)) > 0.3
@@ -105,28 +102,26 @@ ALPHAS = {
 }
 
 
-@pytest.mark.parametrize("regime", list(ALPHAS))
-def test_f32_joint_matches_f64(flagship, regime):
-    y, mask, loadings = flagship
+def check_f32_joint(regime):
+    """Assert the joint-engine f32 bars for one alpha regime."""
+    y, mask, loadings = make_flagship()
     alpha = ALPHAS[regime]
     # the degenerate cap regime carries its own bar (module docstring)
     dev_rtol = DEV_RTOL_CAP if regime == "near_unit_root" else DEV_RTOL
     grad_rtol = GRAD_RTOL_CAP if regime == "near_unit_root" else GRAD_RTOL
     v64, g64 = _value_and_grad(alpha, y, mask, loadings, jnp.float64, "joint")
     v32, g32 = _value_and_grad(alpha, y, mask, loadings, jnp.float32, "joint")
-    assert abs(v32 - v64) / abs(v64) < dev_rtol
-    assert np.linalg.norm(g32 - g64) / np.linalg.norm(g64) < grad_rtol
+    assert abs(v32 - v64) / abs(v64) < dev_rtol, regime
+    assert np.linalg.norm(g32 - g64) / np.linalg.norm(g64) < grad_rtol, regime
     cos = np.dot(g32, g64) / (np.linalg.norm(g32) * np.linalg.norm(g64))
-    assert cos > GRAD_COS
+    assert cos > GRAD_COS, regime
 
 
-@pytest.mark.parametrize("regime", ["init", "near_unit_root"])
-def test_f32_lanes_matches_f64(flagship, regime):
-    """The lane-layout kernel (the TPU fleet hot path) meets the same
-    bars as the batch-layout engines it replaces."""
+def check_f32_lanes(regime):
+    """Assert the lanes-kernel f32 bars for one alpha regime."""
     from metran_tpu.ops import lanes_dfm_deviance
 
-    y, mask, loadings = flagship
+    y, mask, loadings = make_flagship()
     alpha = ALPHAS[regime]
     dev_rtol = DEV_RTOL_CAP if regime == "near_unit_root" else DEV_RTOL
     grad_rtol = GRAD_RTOL_CAP if regime == "near_unit_root" else GRAD_RTOL
@@ -147,8 +142,45 @@ def test_f32_lanes_matches_f64(flagship, regime):
 
     v64, g64 = vg(jnp.float64)
     v32, g32 = vg(jnp.float32)
-    assert abs(v32 - v64) / abs(v64) < dev_rtol
-    assert np.linalg.norm(g32 - g64) / np.linalg.norm(g64) < grad_rtol
+    assert abs(v32 - v64) / abs(v64) < dev_rtol, regime
+    assert np.linalg.norm(g32 - g64) / np.linalg.norm(g64) < grad_rtol, regime
+
+
+_SUBPROCESS_PREAMBLE = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+"""
+
+
+def _run_checks(calls):
+    """Run the given ``tests.test_precision`` check calls in ONE fresh
+    interpreter (see ``tests.conftest.run_python_subprocess``: these are
+    the suite's largest XLA:CPU compiles — T=5,000 flagship gradients —
+    and the compiler has segfaulted on whichever of them lands late in
+    a long-lived pytest process, round 4)."""
+    from tests.conftest import run_python_subprocess
+
+    body = "\n".join(f"tp.{c}; print('done', {c!r})" for c in calls)
+    res = run_python_subprocess(
+        _SUBPROCESS_PREAMBLE
+        + "import tests.test_precision as tp\n"
+        + body
+        + "\nprint('PRECISION_OK')\n",
+        timeout=600.0 * max(len(calls), 1),
+    )
+    assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
+    assert "PRECISION_OK" in res.stdout
+
+
+def test_f32_joint_matches_f64():
+    _run_checks([f"check_f32_joint({r!r})" for r in ALPHAS])
+
+
+def test_f32_lanes_matches_f64():
+    _run_checks([
+        "check_f32_lanes('init')", "check_f32_lanes('near_unit_root')",
+    ])
 
 
 def test_f32_parallel_matches_f64():
@@ -161,10 +193,7 @@ def test_f32_parallel_matches_f64():
     see ``tests.conftest.run_python_subprocess``."""
     from tests.conftest import run_python_subprocess
 
-    res = run_python_subprocess("""
-import jax
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_enable_x64", True)
+    res = run_python_subprocess(_SUBPROCESS_PREAMBLE + """
 import jax.numpy as jnp
 import numpy as np
 from tests.test_precision import (
@@ -184,13 +213,13 @@ print("F32_PARALLEL_OK")
     assert "F32_PARALLEL_OK" in res.stdout
 
 
-def test_f32_fleet_fit_reaches_f64_optimum(flagship):
+def check_f32_fleet_fit():
     """An f32 fleet fit lands within rtol 1e-3 of the f64 deviance
     optimum (the fit-quality guarantee behind the TPU-default policy)."""
     from metran_tpu.parallel import fit_fleet
     from metran_tpu.parallel.fleet import Fleet
 
-    y, mask, loadings = flagship
+    y, mask, loadings = make_flagship()
     y, mask = y[:1500], mask[:1500]
 
     def fleet_of(dtype):
@@ -208,3 +237,7 @@ def test_f32_fleet_fit_reaches_f64_optimum(flagship):
     d64 = float(np.asarray(fit64.deviance)[0])
     d32 = float(np.asarray(fit32.deviance)[0])
     assert abs(d32 - d64) / abs(d64) < 1e-3
+
+
+def test_f32_fleet_fit_reaches_f64_optimum():
+    _run_checks(["check_f32_fleet_fit()"])
