@@ -39,6 +39,22 @@ class LoadedFit:
         self.pcor = pcor
 
 
+def atomic_savez(path, **arrays) -> Path:
+    """Write ``arrays`` to ``path`` as an ``.npz``, atomically.
+
+    Writes to a ``.tmp.npz`` sibling (the suffix keeps ``np.savez``
+    from appending its own) and renames into place, so readers never
+    observe a half-written checkpoint.  Shared by the fleet-state
+    checkpoints below and the sweep runner's per-batch results
+    (``parallel/sweep.py``).
+    """
+    path = Path(path)
+    tmp = path.with_suffix(".tmp.npz")
+    np.savez(tmp, **arrays)
+    tmp.replace(path)
+    return path
+
+
 def _frame_to_dict(frame: pd.DataFrame) -> dict:
     return {
         "index": [str(i) for i in frame.index],
@@ -190,10 +206,7 @@ def save_fleet_state(path, theta, state, frozen, prev_value, meta: dict) -> Path
     arrays["meta_json"] = np.frombuffer(
         json.dumps(meta).encode(), dtype=np.uint8
     )
-    tmp = path.with_suffix(".tmp.npz")
-    np.savez(tmp, **arrays)
-    tmp.replace(path)
-    return path
+    return atomic_savez(path, **arrays)
 
 
 def load_fleet_state(path, like_theta, like_state, like_frozen):
@@ -235,6 +248,7 @@ def load_fleet_state(path, like_theta, like_state, like_frozen):
 
 
 __all__ = [
+    "atomic_savez",
     "FORMAT_VERSION",
     "LoadedFit",
     "load_fleet_state",

@@ -13,6 +13,9 @@ package is the new design surface that scales Metran to TPU pods:
   starts riding the lane axis;
 - :func:`fleet_stderr` / :func:`fleet_simulate` / :func:`fleet_decompose`
   — batched post-fit inference products;
+- :func:`sweep_fit` — populations larger than one device batch: a
+  sequence of bounded :func:`fit_fleet` calls with prefetch overlap of
+  host data work and per-batch checkpoint/resume;
 - :func:`make_train_step` — first-order training step for mesh-sharded
   fleets;
 - :func:`make_mesh` and friends — mesh/sharding helpers.
@@ -34,6 +37,10 @@ from .fleet import (
     fleet_value_and_grad,
     make_train_step,
     pack_fleet,
+)
+from .sweep import (
+    SweepResult,
+    sweep_fit,
 )
 from .mesh import (
     BATCH_AXIS,
@@ -64,4 +71,6 @@ __all__ = [
     "pack_fleet",
     "pad_to_multiple",
     "replicated",
+    "SweepResult",
+    "sweep_fit",
 ]

@@ -1,0 +1,217 @@
+"""Sweep runner: fit model populations larger than one device batch.
+
+The reference fits one model per process (`/root/reference/metran/
+metran.py:991`); a TPU-scale user has 10^4-10^5 independent models,
+which cannot ride a single :class:`Fleet` (HBM) or a single dispatch
+(tunneled workers crash on long executions).  :func:`sweep_fit` runs a
+population as a sequence of bounded :func:`fit_fleet` calls — one
+compile, the rest compile-cache hits — and adds the two things the
+per-batch loop cannot give you:
+
+- **Prefetch overlap.** A one-deep background thread materializes batch
+  ``i+1`` (data loading/generation, H2D transfer, anything else the
+  batch callable does) while batch ``i`` fits on device.  Measured on
+  the round-4 north-star workload (10,240 models, 20 batches) this
+  lifted end-to-end throughput 17.7 -> 33.1 fits/s with bit-identical
+  results (``bench_artifacts/northstar_{host,pipelined}_r4.jsonl``).
+- **Per-batch checkpointing.** Each completed batch's :class:`FleetFit`
+  is written to ``checkpoint_dir`` as a plain ``.npz``; a re-run with
+  the same directory loads finished batches instead of refitting them
+  (and never invokes their batch callables), so a preempted sweep
+  resumes at the first unfinished batch.  This composes with
+  :func:`fit_fleet`'s own intra-batch ``checkpoint`` for the currently
+  running batch.
+
+Results are aggregated into one :class:`SweepResult` with the same
+per-model fields as :class:`FleetFit`, concatenated in batch order.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Iterable, List, NamedTuple, Optional, Union
+
+import jax
+import numpy as np
+
+from ..io import atomic_savez
+from .fleet import Fleet, FleetFit, autocorr_init_params, fit_fleet
+
+logger = logging.getLogger(__name__)
+
+BatchSpec = Union[Fleet, Callable[[], Fleet]]
+
+_FIT_FIELDS = ("params", "deviance", "iterations", "converged",
+               "stalled", "nfev")
+
+
+class SweepResult(NamedTuple):
+    """Concatenated per-model results of a sweep, in batch order.
+
+    ``params``/``deviance``/``iterations``/``converged`` are always
+    present; ``stalled``/``nfev`` are ``None`` if any batch's layout did
+    not produce them (see :class:`FleetFit`).  ``batch_sizes`` maps each
+    model back to its source batch; ``loaded`` marks batches that were
+    restored from ``checkpoint_dir`` instead of fitted.
+    """
+
+    params: np.ndarray
+    deviance: np.ndarray
+    iterations: np.ndarray
+    converged: np.ndarray
+    stalled: Optional[np.ndarray]
+    nfev: Optional[np.ndarray]
+    batch_sizes: List[int]
+    loaded: List[bool]
+
+    @property
+    def total(self) -> int:
+        return int(self.params.shape[0])
+
+
+def _to_host(fit: FleetFit) -> dict:
+    out = {}
+    for f in _FIT_FIELDS:
+        v = getattr(fit, f)
+        out[f] = None if v is None else np.asarray(v)
+    return out
+
+
+def _ckpt_path(checkpoint_dir: str, i: int) -> str:
+    return os.path.join(checkpoint_dir, f"batch_{i:05d}.npz")
+
+
+def _save_batch(checkpoint_dir: str, i: int, rec: dict) -> None:
+    atomic_savez(_ckpt_path(checkpoint_dir, i),
+                 **{k: v for k, v in rec.items() if v is not None})
+
+
+def _load_batch(checkpoint_dir: str, i: int) -> Optional[dict]:
+    path = _ckpt_path(checkpoint_dir, i)
+    if not os.path.exists(path):
+        return None
+    with np.load(path) as z:
+        return {f: (z[f] if f in z.files else None) for f in _FIT_FIELDS}
+
+
+def _materialize(spec: BatchSpec) -> Fleet:
+    """Resolve a batch spec to a device-resident Fleet.
+
+    Called on the prefetch thread: invoking the callable (host IO /
+    generation) and forcing the H2D transfer here is exactly the work
+    being overlapped with the previous batch's fit.
+    """
+    fleet = spec() if callable(spec) else spec
+    jax.block_until_ready([x for x in fleet if x is not None])
+    return fleet
+
+
+def sweep_fit(
+    batches: Iterable[BatchSpec],
+    p0: Union[str, Callable[[Fleet], "jax.Array"], None] = "autocorr",
+    prefetch: bool = True,
+    checkpoint_dir: Optional[str] = None,
+    on_batch: Optional[Callable[[int, dict], None]] = None,
+    **fit_kw,
+) -> SweepResult:
+    """Fit every batch in ``batches`` and concatenate the results.
+
+    Parameters
+    ----------
+    batches : iterable of :class:`Fleet` or zero-argument callables
+        returning one.  Pass callables when materializing a batch is
+        expensive (file IO, synthesis, H2D of hundreds of MB): the
+        sweep invokes them lazily — on the prefetch thread when
+        ``prefetch`` is on, and never for checkpoint-restored batches.
+        Every array shape, the batch size included, is a traced shape
+        of the compiled program: batches must be uniform — same batch
+        size, series count, timesteps, factors — or each distinct
+        shape pays a fresh (expensive) compile.  Pad a remainder batch
+        with ``pack_fleet(..., pad_batch_to=...)`` instead of sending
+        it short.
+    p0 : per-batch initializer: ``"autocorr"`` (default, data-driven
+        lag-1 init), ``None`` (the reference's constant ``alpha=10``),
+        or a callable ``fleet -> (B, P)`` array.
+    prefetch : overlap batch ``i+1``'s materialization with batch
+        ``i``'s fit via a one-deep background thread.  Results are
+        independent of this flag.  The next batch's data is already
+        device-resident while the current one fits, so HBM must hold
+        TWO batches' ``y``/``mask``/``loadings`` on top of the solver
+        workspace — size batches with that headroom, or turn prefetch
+        off to trade the overlap for memory.
+    checkpoint_dir : directory for per-batch ``.npz`` results.  Existing
+        files are trusted and loaded by position; pass a fresh directory
+        when the batch definitions change.
+    on_batch : optional callback ``(index, record)`` after each batch
+        fitted THIS run (checkpoint-restored batches do not fire it —
+        their work happened in the run that saved them); ``record``
+        holds host arrays for the :class:`FleetFit` fields.
+    **fit_kw : forwarded to :func:`fit_fleet` (layout, chunk, tol, ...).
+    """
+    if isinstance(p0, str):
+        if p0 != "autocorr":
+            raise ValueError(f"unknown p0 mode {p0!r}")
+        p0_fn: Optional[Callable[[Fleet], "jax.Array"]] = autocorr_init_params
+    else:
+        p0_fn = p0
+    if checkpoint_dir is not None:
+        os.makedirs(checkpoint_dir, exist_ok=True)
+
+    specs = list(batches)
+    if not specs:
+        raise ValueError("sweep_fit needs at least one batch")
+
+    records: List[Optional[dict]] = [None] * len(specs)
+    loaded = [False] * len(specs)
+    if checkpoint_dir is not None:
+        for i in range(len(specs)):
+            rec = _load_batch(checkpoint_dir, i)
+            if rec is not None:
+                records[i] = rec
+                loaded[i] = True
+        if any(loaded):
+            logger.info("sweep: restored %d/%d batches from %s",
+                        sum(loaded), len(specs), checkpoint_dir)
+
+    todo = [i for i in range(len(specs)) if records[i] is None]
+    pool = ThreadPoolExecutor(max_workers=1) if prefetch and todo else None
+    try:
+        nxt = pool.submit(_materialize, specs[todo[0]]) if pool else None
+        for pos, i in enumerate(todo):
+            if pool:
+                fleet = nxt.result()
+                if pos + 1 < len(todo):
+                    nxt = pool.submit(_materialize, specs[todo[pos + 1]])
+            else:
+                fleet = _materialize(specs[i])
+            fit = fit_fleet(
+                fleet, p0=None if p0_fn is None else p0_fn(fleet), **fit_kw
+            )
+            rec = _to_host(fit)
+            records[i] = rec
+            if checkpoint_dir is not None:
+                _save_batch(checkpoint_dir, i, rec)
+            if on_batch is not None:
+                on_batch(i, rec)
+    finally:
+        if pool:
+            pool.shutdown(wait=False, cancel_futures=True)
+
+    def cat(field):
+        vals = [r[field] for r in records]
+        if any(v is None for v in vals):
+            return None
+        return np.concatenate([np.atleast_1d(v) for v in vals], axis=0)
+
+    return SweepResult(
+        params=cat("params"),
+        deviance=cat("deviance"),
+        iterations=cat("iterations"),
+        converged=cat("converged"),
+        stalled=cat("stalled"),
+        nfev=cat("nfev"),
+        batch_sizes=[int(r["params"].shape[0]) for r in records],
+        loaded=loaded,
+    )

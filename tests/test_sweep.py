@@ -1,0 +1,121 @@
+"""Sweep-runner tests: batched-population fits with prefetch + resume.
+
+The sweep is pure orchestration over :func:`fit_fleet`, so the contract
+is equality: same per-model results as fitting each batch directly,
+independent of prefetch, and independent of how many batches came from
+a checkpoint restore.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from metran_tpu import data as mdata
+from metran_tpu.parallel import fit_fleet, pack_fleet, sweep_fit
+from metran_tpu.parallel.fleet import autocorr_init_params
+
+FIT_KW = dict(maxiter=12, layout="lanes", chunk=6)
+
+
+def _panel(rng, n_series, t):
+    idx = pd.date_range("2001-01-01", periods=t, freq="D")
+    raw = rng.normal(size=(t, n_series))
+    raw[rng.uniform(size=raw.shape) < 0.2] = np.nan
+    frame = pd.DataFrame(
+        raw, index=idx, columns=[f"s{i}" for i in range(n_series)]
+    )
+    return mdata.pack_panel(frame)
+
+
+def _batch(rng, batch, n=3, t=80):
+    panels = [_panel(rng, n, t) for _ in range(batch)]
+    loadings = [rng.uniform(0.3, 0.8, (n, 1)) for _ in range(batch)]
+    return pack_fleet(panels, loadings)
+
+
+def _fleets(seed=0, sizes=(4, 4, 4)):
+    rng = np.random.default_rng(seed)
+    return [_batch(rng, b) for b in sizes]
+
+
+def test_sweep_matches_per_batch_fits(rng):
+    fleets = _fleets()
+    res = sweep_fit(fleets, prefetch=False, **FIT_KW)
+    assert res.total == 12 and res.batch_sizes == [4, 4, 4]
+    assert res.loaded == [False] * 3
+    off = 0
+    for fleet in fleets:
+        fit = fit_fleet(fleet, p0=autocorr_init_params(fleet), **FIT_KW)
+        b = fleet.batch
+        np.testing.assert_array_equal(
+            res.params[off:off + b], np.asarray(fit.params)
+        )
+        np.testing.assert_array_equal(
+            res.deviance[off:off + b], np.asarray(fit.deviance)
+        )
+        off += b
+
+
+def test_sweep_prefetch_invariance(rng):
+    fleets = _fleets(seed=1)
+    base = sweep_fit(fleets, prefetch=False, **FIT_KW)
+    pre = sweep_fit(fleets, prefetch=True, **FIT_KW)
+    np.testing.assert_array_equal(base.params, pre.params)
+    np.testing.assert_array_equal(base.deviance, pre.deviance)
+    np.testing.assert_array_equal(base.converged, pre.converged)
+
+
+def test_sweep_callables_lazy_and_resume(rng, tmp_path):
+    """Resume skips finished batches and never re-invokes their callables."""
+    fleets = _fleets(seed=2)
+    calls = []
+
+    def spec(i):
+        def make():
+            calls.append(i)
+            return fleets[i]
+        return make
+
+    ckpt = str(tmp_path / "sweep")
+    first = sweep_fit([spec(0), spec(1)], prefetch=False,
+                      checkpoint_dir=ckpt, **FIT_KW)
+    assert calls == [0, 1] and first.loaded == [False, False]
+
+    # Re-run over all three batches: 0 and 1 restore from disk (their
+    # callables stay un-invoked), 2 is fitted fresh.
+    seen = []
+    full = sweep_fit([spec(0), spec(1), spec(2)], prefetch=False,
+                     checkpoint_dir=ckpt,
+                     on_batch=lambda i, rec: seen.append(i), **FIT_KW)
+    assert calls == [0, 1, 2]
+    assert full.loaded == [True, True, False]
+    assert seen == [2]  # on_batch fires only for work done this run
+    assert full.total == 12
+
+    direct = sweep_fit(fleets, prefetch=False, **FIT_KW)
+    np.testing.assert_array_equal(full.params, direct.params)
+    np.testing.assert_array_equal(full.deviance, direct.deviance)
+    np.testing.assert_array_equal(full.stalled, direct.stalled)
+    np.testing.assert_array_equal(full.nfev, direct.nfev)
+
+
+def test_sweep_p0_modes(rng):
+    """p0 plumbing: "autocorr" == the callable it names; None differs.
+
+    (Optima are NOT compared across inits: on structure-free noise
+    panels different starts can legitimately land in different basins —
+    that is what multistart_fit_fleet is for.)
+    """
+    fleets = _fleets(seed=3, sizes=(4,))
+    const = sweep_fit(fleets, p0=None, prefetch=False, **FIT_KW)
+    auto = sweep_fit(fleets, p0="autocorr", prefetch=False, **FIT_KW)
+    custom = sweep_fit(fleets, p0=autocorr_init_params, prefetch=False,
+                       **FIT_KW)
+    np.testing.assert_array_equal(auto.params, custom.params)
+    np.testing.assert_array_equal(auto.deviance, custom.deviance)
+    assert np.all(np.isfinite(const.deviance))
+    assert np.all(np.isfinite(auto.deviance))
+    with pytest.raises(ValueError):
+        sweep_fit(fleets, p0="nope", **FIT_KW)
+    with pytest.raises(ValueError):
+        sweep_fit([], **FIT_KW)
