@@ -42,6 +42,7 @@ MODULES = [
     "metran_tpu.parallel.fleet",
     "metran_tpu.parallel.lanes_lbfgs",
     "metran_tpu.parallel.mesh",
+    "metran_tpu.parallel.sweep",
     "metran_tpu.data",
     "metran_tpu.io",
     "metran_tpu.config",
