@@ -4,17 +4,28 @@ The sweep is pure orchestration over :func:`fit_fleet`, so the contract
 is equality: same per-model results as fitting each batch directly,
 independent of prefetch, and independent of how many batches came from
 a checkpoint restore.
+
+The ``check_*`` bodies run in ONE fresh subprocess interpreter
+(``tests.conftest.run_python_subprocess``): each compiles a small lanes
+L-BFGS program, and XLA:CPU's compiler has segfaulted on exactly such
+compiles landing late in a long-lived pytest process (round 4 — this
+module originally crashed the full suite at ~80% while passing
+standalone).
 """
+
+import tempfile
 
 import numpy as np
 import pandas as pd
 import pytest
 
-from metran_tpu import data as mdata
-from metran_tpu.parallel import fit_fleet, pack_fleet, sweep_fit
-from metran_tpu.parallel.fleet import autocorr_init_params
-
 FIT_KW = dict(maxiter=12, layout="lanes", chunk=6)
+
+_SUBPROCESS_PREAMBLE = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+"""
 
 
 def _panel(rng, n_series, t):
@@ -24,10 +35,14 @@ def _panel(rng, n_series, t):
     frame = pd.DataFrame(
         raw, index=idx, columns=[f"s{i}" for i in range(n_series)]
     )
+    from metran_tpu import data as mdata
+
     return mdata.pack_panel(frame)
 
 
 def _batch(rng, batch, n=3, t=80):
+    from metran_tpu.parallel import pack_fleet
+
     panels = [_panel(rng, n, t) for _ in range(batch)]
     loadings = [rng.uniform(0.3, 0.8, (n, 1)) for _ in range(batch)]
     return pack_fleet(panels, loadings)
@@ -38,7 +53,10 @@ def _fleets(seed=0, sizes=(4, 4, 4)):
     return [_batch(rng, b) for b in sizes]
 
 
-def test_sweep_matches_per_batch_fits(rng):
+def check_matches_per_batch_fits():
+    from metran_tpu.parallel import fit_fleet, sweep_fit
+    from metran_tpu.parallel.fleet import autocorr_init_params
+
     fleets = _fleets()
     res = sweep_fit(fleets, prefetch=False, **FIT_KW)
     assert res.total == 12 and res.batch_sizes == [4, 4, 4]
@@ -56,7 +74,9 @@ def test_sweep_matches_per_batch_fits(rng):
         off += b
 
 
-def test_sweep_prefetch_invariance(rng):
+def check_prefetch_invariance():
+    from metran_tpu.parallel import sweep_fit
+
     fleets = _fleets(seed=1)
     base = sweep_fit(fleets, prefetch=False, **FIT_KW)
     pre = sweep_fit(fleets, prefetch=True, **FIT_KW)
@@ -65,8 +85,10 @@ def test_sweep_prefetch_invariance(rng):
     np.testing.assert_array_equal(base.converged, pre.converged)
 
 
-def test_sweep_callables_lazy_and_resume(rng, tmp_path):
+def check_callables_lazy_and_resume():
     """Resume skips finished batches and never re-invokes their callables."""
+    from metran_tpu.parallel import sweep_fit
+
     fleets = _fleets(seed=2)
     calls = []
 
@@ -76,17 +98,17 @@ def test_sweep_callables_lazy_and_resume(rng, tmp_path):
             return fleets[i]
         return make
 
-    ckpt = str(tmp_path / "sweep")
-    first = sweep_fit([spec(0), spec(1)], prefetch=False,
-                      checkpoint_dir=ckpt, **FIT_KW)
-    assert calls == [0, 1] and first.loaded == [False, False]
+    with tempfile.TemporaryDirectory() as d:
+        first = sweep_fit([spec(0), spec(1)], prefetch=False,
+                          checkpoint_dir=d, **FIT_KW)
+        assert calls == [0, 1] and first.loaded == [False, False]
 
-    # Re-run over all three batches: 0 and 1 restore from disk (their
-    # callables stay un-invoked), 2 is fitted fresh.
-    seen = []
-    full = sweep_fit([spec(0), spec(1), spec(2)], prefetch=False,
-                     checkpoint_dir=ckpt,
-                     on_batch=lambda i, rec: seen.append(i), **FIT_KW)
+        # Re-run over all three batches: 0 and 1 restore from disk
+        # (their callables stay un-invoked), 2 is fitted fresh.
+        seen = []
+        full = sweep_fit([spec(0), spec(1), spec(2)], prefetch=False,
+                         checkpoint_dir=d,
+                         on_batch=lambda i, rec: seen.append(i), **FIT_KW)
     assert calls == [0, 1, 2]
     assert full.loaded == [True, True, False]
     assert seen == [2]  # on_batch fires only for work done this run
@@ -99,13 +121,16 @@ def test_sweep_callables_lazy_and_resume(rng, tmp_path):
     np.testing.assert_array_equal(full.nfev, direct.nfev)
 
 
-def test_sweep_p0_modes(rng):
+def check_p0_modes():
     """p0 plumbing: "autocorr" == the callable it names; None differs.
 
     (Optima are NOT compared across inits: on structure-free noise
     panels different starts can legitimately land in different basins —
     that is what multistart_fit_fleet is for.)
     """
+    from metran_tpu.parallel import sweep_fit
+    from metran_tpu.parallel.fleet import autocorr_init_params
+
     fleets = _fleets(seed=3, sizes=(4,))
     const = sweep_fit(fleets, p0=None, prefetch=False, **FIT_KW)
     auto = sweep_fit(fleets, p0="autocorr", prefetch=False, **FIT_KW)
@@ -115,7 +140,31 @@ def test_sweep_p0_modes(rng):
     np.testing.assert_array_equal(auto.deviance, custom.deviance)
     assert np.all(np.isfinite(const.deviance))
     assert np.all(np.isfinite(auto.deviance))
+
+
+def test_sweep_error_paths():
+    """Cheap (no jit) error paths run in-process."""
+    from metran_tpu.parallel import sweep_fit
+
     with pytest.raises(ValueError):
-        sweep_fit(fleets, p0="nope", **FIT_KW)
+        sweep_fit([object()], p0="nope", **FIT_KW)
     with pytest.raises(ValueError):
         sweep_fit([], **FIT_KW)
+
+
+def test_sweep_checks_subprocess():
+    """All fit-compiling sweep checks, one fresh interpreter."""
+    from tests.conftest import run_python_subprocess
+
+    calls = ["check_matches_per_batch_fits()", "check_prefetch_invariance()",
+             "check_callables_lazy_and_resume()", "check_p0_modes()"]
+    body = "\n".join(f"ts.{c}; print('done', {c!r})" for c in calls)
+    res = run_python_subprocess(
+        _SUBPROCESS_PREAMBLE
+        + "import tests.test_sweep as ts\n"
+        + body
+        + "\nprint('SWEEP_OK')\n",
+        timeout=900.0,
+    )
+    assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
+    assert "SWEEP_OK" in res.stdout

@@ -1,0 +1,42 @@
+"""On-chip validation: the product sweep_fit path at the bench workload.
+
+Round-4 measurements (bench_artifacts/sweep_onchip_r4.jsonl), 2,048
+models as 4 x batch-512 host-data batches with prefetch: 32.1 fits/s
+solo (26.0 under full-suite host contention) vs 33.1 fits/s for the
+inline-thread experiment harness (tools/exp_northstar.py pipelined
+mode) — the productization costs nothing; both are bound by the
+tunnel's H2D (see BASELINE.md north-star table).
+"""
+import json, sys, time
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import jax
+from bench import BATCH, CHUNK, MAXITER, REMAT_SEG, SEED, STALL_TOL, TOL, make_workload
+from metran_tpu.parallel import sweep_fit
+from tools.exp_northstar import make_fleet
+
+print(json.dumps({"platform": jax.devices()[0].platform}), flush=True)
+rng = np.random.default_rng(SEED)
+def spec():
+    def make():
+        y, mask, loadings = make_workload(rng, BATCH)
+        return make_fleet(y, mask, loadings)
+    return make
+FIT_KW = dict(layout="lanes", remat_seg=REMAT_SEG, tol=TOL, stall_tol=STALL_TOL,
+              max_linesearch_steps=4, maxiter=MAXITER, chunk=CHUNK)
+# warm compile outside the timed sweep
+w = spec()()
+from metran_tpu.parallel.fleet import autocorr_init_params
+from metran_tpu.parallel import fit_fleet
+t0 = time.perf_counter()
+fit = fit_fleet(w, p0=autocorr_init_params(w), **FIT_KW)
+np.asarray(fit.params)
+print(json.dumps({"stage": "warm", "s": round(time.perf_counter()-t0, 1)}), flush=True)
+t0 = time.perf_counter()
+res = sweep_fit([spec() for _ in range(4)], prefetch=True, **FIT_KW)
+wall = time.perf_counter() - t0
+print(json.dumps({"stage": "sweep_done", "models": res.total,
+                  "wall_s": round(wall, 1),
+                  "fits_per_s": round(res.total/wall, 1),
+                  "converged_frac": round(float(res.converged.mean()), 3)}), flush=True)
