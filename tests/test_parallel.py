@@ -767,3 +767,33 @@ def test_fleet_simulate_filtered_path(rng):
             np.asarray(vars_f[i]), np.asarray(want_v), rtol=1e-10,
             atol=1e-12,
         )
+
+
+def test_lanes_tiny_fleet_padding(rng):
+    """On TPU, tiny lane fleets are padded to LANE_MIN_BATCH
+    (degenerate-width lane programs are ~6x slower there) and the
+    padding is invisible: a batch-2 fit equals the same two models
+    fitted inside a batch-8 fleet, every result field sliced back to
+    the true batch.  Forced on via ``lane_min_batch`` here (the CPU
+    default is no padding)."""
+    from metran_tpu.parallel.fleet import LANE_MIN_BATCH, Fleet
+
+    fleet8, _, _ = _random_fleet(rng, [4, 3, 4, 4, 3, 4, 4, 3], t=90)
+    fleet2 = Fleet(*(a[:2] for a in fleet8))
+    kw = dict(maxiter=10, layout="lanes", chunk=5,
+              lane_min_batch=LANE_MIN_BATCH)
+    p8 = default_init_params(fleet8)
+    fit8 = fit_fleet(fleet8, p0=p8, **kw)
+    fit2 = fit_fleet(fleet2, p0=p8[:2], **kw)
+    assert fit2.params.shape[0] == 2 and fit2.deviance.shape[0] == 2
+    assert fit2.nfev.shape[0] == 2
+    assert fleet2.batch < LANE_MIN_BATCH  # the padding path actually ran
+    np.testing.assert_array_equal(
+        np.asarray(fit2.params), np.asarray(fit8.params)[:2]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(fit2.deviance), np.asarray(fit8.deviance)[:2]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(fit2.converged), np.asarray(fit8.converged)[:2]
+    )
