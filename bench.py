@@ -360,10 +360,11 @@ def run_device_bench(out_path: str, budget_s: float,
     # cuts mean L-BFGS iterations ~25%, 11.5 -> 8.6); the jitted init
     # runs on device and is INSIDE the timed block, so the headline
     # measures the whole fit workflow
-    def timed_fit():
-        p0 = autocorr_init_params(fleet)
+    def timed_fit(fl=None):
+        fl = fleet if fl is None else fl
+        p0 = autocorr_init_params(fl)
         fit = fit_fleet(
-            fleet, p0=p0, maxiter=MAXITER, chunk=CHUNK, **fit_kwargs
+            fl, p0=p0, maxiter=MAXITER, chunk=CHUNK, **fit_kwargs
         )
         np.asarray(fit.params)
         return fit
@@ -405,6 +406,34 @@ def run_device_bench(out_path: str, budget_s: float,
     progress("fit_done", **{k: out["fit"][k] for k in
                             ("run_s", "fits_per_s", "lbfgs_iters_mean")})
     write_partial(out_path, out)
+
+    # ---- single-model fit latency -------------------------------------
+    # the per-user comparison against the CPU reference's one-model fit
+    # (cpu_baseline.fit_s); rides the TPU lane-width pad (tiny fleets
+    # replicated to 8 lanes — see fit_fleet lane_min_batch).  The pad
+    # shape is a fresh compile, so the stage is budget-gated like the
+    # other optional stages.
+    if left() > 180:
+        single = make_fleet(y[:1], mask[:1], loadings[:1])
+        t0 = time.perf_counter()
+        sfit = timed_fit(single)
+        s_compile = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        sfit = timed_fit(single)
+        s_run = time.perf_counter() - t0
+        s_plausible = s_run >= MIN_PLAUSIBLE_DISPATCH_S
+        if not s_plausible:
+            progress("implausible_timing", laps_s=[s_run],
+                     floor_s=MIN_PLAUSIBLE_DISPATCH_S)
+        out["single_fit"] = {
+            "compile_plus_first_run_s": round(s_compile, 1),
+            "fit_s": round(s_run, 4),
+            "plausible": s_plausible,
+            "iters": int(np.asarray(sfit.iterations)[0]),
+            "converged": bool(np.asarray(sfit.converged)[0]),
+        }
+        progress("single_fit_done", **out["single_fit"])
+        write_partial(out_path, out)
 
     # ---- post-fit products: stderr / simulate / decompose -------------
     # the batched inference products the reference computes per model
@@ -900,6 +929,11 @@ def main() -> None:
         cpu_fits_per_s = 1.0 / cpu["fit_s"]
         final["vs_baseline"] = round(fit["fits_per_s"] / cpu_fits_per_s, 1)
         detail["cpu_fit_s_measured"] = cpu["fit_s"]
+    single = device.get("single_fit")
+    if (single and single.get("fit_s") and single.get("plausible")
+            and cpu.get("fit_s")):
+        # one-model latency vs the CPU reference's one-model fit
+        single["vs_cpu_fit"] = round(cpu["fit_s"] / single["fit_s"], 1)
     progress("final", value=final["value"], vs_baseline=final["vs_baseline"])
     emit_and_exit(0 if final["value"] > 0 else 1)
 
