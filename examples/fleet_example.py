@@ -12,6 +12,7 @@ Run on CPU with a virtual mesh:
 
 import os
 import sys
+import tempfile
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))  # repo root
@@ -39,6 +40,7 @@ from metran_tpu.parallel import (
     make_mesh,
     pack_fleet,
     pad_to_multiple,
+    sweep_fit,
 )
 from metran_tpu.utils import ThroughputCounter
 
@@ -124,6 +126,32 @@ def main():
         float(np.nanmedian(np.asarray(stderr[:n_models]))).__round__(2),
         "| simulation grid:", tuple(means.shape),
     )
+
+    # populations larger than one batch: sweep_fit chains bounded
+    # fit_fleet calls (one compile), prefetches each next batch's host
+    # work behind the current fit, and checkpoints per batch so a rerun
+    # resumes at the first unfinished batch
+    def batch_spec(seed, batch=4):
+        def make():
+            r = np.random.default_rng(seed)
+            ps, lds = [], []
+            for _ in range(batch):
+                std, s_, m_ = mdata.standardize(synthetic_panel(r))
+                ps.append(mdata.pack_panel(std, std=s_, mean=m_))
+                lds.append(FactorAnalysis().solve(std))
+            return pack_fleet(ps, lds)
+        return make
+
+    # fresh checkpoint dir per run: sweep checkpoints restore by
+    # position with no fingerprint, so a stale dir would silently
+    # serve the previous run's results (see sweep_fit docstring)
+    res = sweep_fit(
+        [batch_spec(s) for s in (1, 2, 3)],
+        layout="lanes", maxiter=20, chunk=10, stall_tol=1e-4,
+        checkpoint_dir=tempfile.mkdtemp(prefix="fleet_sweep_"),
+    )
+    print("sweep:", res.total, "models in", len(res.batch_sizes),
+          "batches | converged:", int(res.converged.sum()))
 
 
 if __name__ == "__main__":

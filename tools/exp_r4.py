@@ -1,4 +1,11 @@
-"""One-command on-chip session for every measurement queued in round 4.
+"""One-command on-chip session for the round-4 queued measurements.
+
+STATUS: all three phases were executed interactively early in round 4
+when the tunnel recovered (see BASELINE.md, "Measured (round 4...)" —
+bench 49.2 fits/s (49.9 on the later rerun, BENCH_onchip_r4b.json),
+compaction +22%, blocked-scan compile 190.6->49.5 s; raw records in
+bench_artifacts/).  The script remains runnable as the
+one-command rerun for a future chip session.
 
 The round-3/4 tunnel wedge taught a protocol (BASELINE.md): when a chip
 becomes available, capture the bench FIRST, then run exploratory
