@@ -142,6 +142,24 @@ def check_p0_modes():
     assert np.all(np.isfinite(auto.deviance))
 
 
+def check_mesh_matches_unsharded():
+    """sweep_fit composes with a sharded fit (mesh in fit_kw).
+
+    Tolerances follow tests/test_parallel.py's sharded-vs-unsharded
+    precedent: the two runs execute different XLA programs, and
+    reduction-order FP differences in the line search can move the
+    L-BFGS stopping point slightly.
+    """
+    from metran_tpu.parallel import make_mesh, sweep_fit
+
+    fleets = _fleets(seed=4, sizes=(8, 8))
+    base = sweep_fit(fleets, prefetch=False, **FIT_KW)
+    mesh = sweep_fit(fleets, prefetch=False, mesh=make_mesh(8), **FIT_KW)
+    np.testing.assert_allclose(mesh.params, base.params,
+                               rtol=1e-3, atol=1e-6)
+    np.testing.assert_allclose(mesh.deviance, base.deviance, rtol=1e-8)
+
+
 def test_sweep_error_paths():
     """Cheap (no jit) error paths run in-process."""
     from metran_tpu.parallel import sweep_fit
@@ -168,3 +186,18 @@ def test_sweep_checks_subprocess():
     )
     assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
     assert "SWEEP_OK" in res.stdout
+
+
+def test_sweep_checks_mesh_subprocess():
+    """Sharded sweep equality check, one fresh interpreter."""
+    from tests.conftest import run_python_subprocess
+
+    res = run_python_subprocess(
+        _SUBPROCESS_PREAMBLE
+        + "import tests.test_sweep as ts\n"
+        + "ts.check_mesh_matches_unsharded()\n"
+        + "print('SWEEP_MESH_OK')\n",
+        timeout=900.0,
+    )
+    assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
+    assert "SWEEP_MESH_OK" in res.stdout
