@@ -157,7 +157,10 @@ def check_mesh_matches_unsharded():
     mesh = sweep_fit(fleets, prefetch=False, mesh=make_mesh(8), **FIT_KW)
     np.testing.assert_allclose(mesh.params, base.params,
                                rtol=1e-3, atol=1e-6)
-    np.testing.assert_allclose(mesh.deviance, base.deviance, rtol=1e-8)
+    # lanes sharded-vs-unsharded precedent (test_parallel.py); these
+    # capped (maxiter=12) fits stop mid-descent, so the deviance gap is
+    # first-order in the params gap — keep it loose
+    np.testing.assert_allclose(mesh.deviance, base.deviance, rtol=1e-6)
 
 
 def test_sweep_error_paths():

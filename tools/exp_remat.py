@@ -1,0 +1,83 @@
+"""On-chip experiment (QUEUED): remat segment size for the lanes adjoint.
+
+The analytical adjoint (`ops/lanes.py`) rematerializes the forward
+filter in segments of ``remat_seg`` steps; the bench default (100)
+was chosen for memory safety, not measured for speed.  Larger segments
+recompute less of the forward pass per backward step at the cost of
+storing more segment-boundary states (tiny at DFM state sizes), so the
+value+grad lap — the dominant per-iteration cost of the fleet fit —
+may have headroom here.
+
+Measures the flagship value+grad lap and one full fit per segment size.
+Written during the round-4 wedge (the batch-2048 remote-compile crash,
+BASELINE.md); run it on the next healthy chip session after bench.py.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".cache", "jax"),
+)
+
+import jax  # noqa: E402
+
+from bench import (  # noqa: E402
+    BATCH, CHUNK, MAXITER, SEED, STALL_TOL, TOL, make_workload,
+)
+from metran_tpu.parallel import fit_fleet, fleet_value_and_grad  # noqa: E402
+from metran_tpu.parallel.fleet import autocorr_init_params  # noqa: E402
+from tools.exp_northstar import make_fleet  # noqa: E402
+
+
+def log(**kw):
+    print(json.dumps(kw), flush=True)
+
+
+def main():
+    log(platform=jax.devices()[0].platform)
+    rng = np.random.default_rng(SEED)
+    y, mask, loadings = make_workload(rng, BATCH)
+    fleet = make_fleet(y, mask, loadings)
+    p0 = autocorr_init_params(fleet)
+    np.asarray(p0)
+
+    for seg in (100, 250, 500, 1000):
+        v, g = fleet_value_and_grad(p0, fleet, layout="lanes",
+                                    remat_seg=seg)
+        np.asarray(v), np.asarray(g)  # force forward AND backward
+        laps = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            v, g = fleet_value_and_grad(p0, fleet, layout="lanes",
+                                        remat_seg=seg)
+            np.asarray(v), np.asarray(g)
+            laps.append(round(time.perf_counter() - t0, 3))
+        log(stage="vg", remat_seg=seg, laps_s=laps)
+
+    kw = dict(layout="lanes", tol=TOL, stall_tol=STALL_TOL,
+              max_linesearch_steps=4, maxiter=MAXITER, chunk=CHUNK)
+    for seg in (100, 500):
+        t0 = time.perf_counter()
+        fit = fit_fleet(fleet, p0=p0, remat_seg=seg, **kw)
+        np.asarray(fit.params)
+        first = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        fit = fit_fleet(fleet, p0=p0, remat_seg=seg, **kw)
+        np.asarray(fit.params)
+        run = time.perf_counter() - t0
+        log(stage="fit", remat_seg=seg,
+            compile_plus_first_s=round(first, 1), run_s=round(run, 2),
+            fits_per_s=round(BATCH / run, 1),
+            dev_sum=float(np.asarray(fit.deviance).sum()))
+
+
+if __name__ == "__main__":
+    main()
