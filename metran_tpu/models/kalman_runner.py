@@ -94,6 +94,25 @@ class KalmanRunner:
         sim_means, sim_vars = project(jnp.asarray(observation_matrix), means, covs)
         return np.asarray(sim_means), np.asarray(sim_vars)
 
+    def forecast(self, observation_matrix, steps: int):
+        """h-step-ahead observation means/variances beyond the data end.
+
+        Uses the filtered state at the last timestep (the smoothed and
+        filtered moments coincide at ``T``) and the closed-form
+        diagonal-transition predictive recursion
+        (:mod:`metran_tpu.ops.forecast` — no scan, the reference has no
+        forecasting at all).  ``observation_matrix`` chooses the units
+        (standardized Z or std-scaled Z, as in :meth:`simulate`).
+        """
+        from ..ops.forecast import _forecast_from_filtered
+
+        filt = self.run_filter()
+        ss = self.ss._replace(z=jnp.asarray(observation_matrix))
+        means, variances = _forecast_from_filtered(
+            ss, filt.mean_f[-1], filt.cov_f[-1], int(steps)
+        )
+        return np.asarray(means), np.asarray(variances)
+
     def decompose(self, observation_matrix, method: str = "smoother"):
         means, _ = self._states(method)
         sdf, cdf = decompose_states(

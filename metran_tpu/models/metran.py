@@ -16,7 +16,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from pandas import DataFrame, Series, Timestamp, concat
+from pandas import DataFrame, Series, Timestamp, concat, date_range
 from scipy.stats import norm
 
 from .. import data as _data
@@ -527,6 +527,82 @@ class Metran:
         sim = concat([sim, sim - iv, sim + iv], axis=1)
         sim.columns = ["mean", "lower", "upper"]
         return sim
+
+    def _forecast_moments(self, steps, p=None, standardized=False):
+        self._run_kalman("filter", p=p)
+        if standardized:
+            observation_matrix = self.get_observation_matrix(p=p)
+            observation_means = np.zeros(self.nseries)
+        else:
+            observation_matrix = self.get_scaled_observation_matrix(p=p)
+            observation_means = self.oseries_mean
+        means, variances = self.kf.forecast(observation_matrix, steps)
+        index = date_range(
+            self.oseries.index[-1], periods=steps + 1,
+            freq=self.settings["freq"],
+        )[1:]
+        return means, variances, observation_means, index
+
+    def get_forecast_means(
+        self, steps: int, p=None, standardized: bool = False
+    ) -> DataFrame:
+        """Out-of-sample forecast means for every series, ``steps``
+        grid periods beyond the last observation.
+
+        A capability the reference does not have (its products end at
+        the data, `metran/kalmanfilter.py:569-644`):
+        closed-form h-step-ahead predictive moments from the filtered
+        state at ``T`` (:mod:`metran_tpu.ops.forecast`).  Forecasts
+        decay toward each series' unconditional mean with variances
+        growing to the stationary variance.
+        """
+        means, _, observation_means, index = self._forecast_moments(
+            steps, p=p, standardized=standardized
+        )
+        return (
+            DataFrame(means, index=index, columns=self.oseries.columns)
+            + observation_means
+        )
+
+    def get_forecast_variances(
+        self, steps: int, p=None, standardized: bool = False
+    ) -> DataFrame:
+        """Out-of-sample forecast variances (see :meth:`get_forecast_means`)."""
+        _, variances, _, index = self._forecast_moments(
+            steps, p=p, standardized=standardized
+        )
+        return DataFrame(variances, index=index, columns=self.oseries.columns)
+
+    def forecast(
+        self, name, steps: int = 30, p=None, alpha=0.05,
+        standardized: bool = False,
+    ):
+        """Forecast one series ``steps`` periods ahead, with a
+        ``(1 - alpha)`` prediction interval (same contract as
+        :meth:`get_simulation`; ``alpha=None`` returns the mean only).
+        """
+        if name not in self.oseries.columns:
+            logger.error("Unknown name: %s", name)
+            return None
+        if alpha is not None and not 0 < alpha < 1:
+            msg = "The value of alpha must be between 0 and 1."
+            logger.error(msg)
+            raise Exception(msg)
+        # one moments pass covers both the mean and the interval
+        means, variances, observation_means, index = self._forecast_moments(
+            steps, p=p, standardized=standardized
+        )
+        col = list(self.oseries.columns).index(name)
+        fc = Series(
+            means[:, col] + observation_means[col], index=index, name=name
+        )
+        if alpha is None:
+            return fc
+        z = norm.ppf(1 - alpha / 2.0)
+        iv = z * np.sqrt(variances[:, col])
+        fc = concat([fc, fc - iv, fc + iv], axis=1)
+        fc.columns = ["mean", "lower", "upper"]
+        return fc
 
     def decompose_simulation(
         self, name, p=None, standardized: bool = False, method: str = "smoother"

@@ -351,7 +351,7 @@ def default_ftol(dtype) -> float:
     when ``f_prev - f <= ftol * max(|f_prev|, |f|, 1)`` — with
     ``factr * eps`` scaled per dtype: ``1e7 * eps`` in float64 (scipy's
     default ``factr``, the stop the reference inherits,
-    ``/root/reference/metran/solver.py:252-256``) and ``1e2 * eps`` in
+    ``metran/solver.py:252-256``) and ``1e2 * eps`` in
     float32 (~1e-5 relative: just above the float32 objective
     resolution floor, where the gradient-norm test is unreachable and
     iterations stop producing any decrease).

@@ -12,6 +12,10 @@ from .kalman import (
     project,
     rts_smoother,
 )
+from .forecast import (
+    forecast_observation_moments,
+    forecast_state_moments,
+)
 from .lanes import (
     lanes_deviance_terms,
     lanes_dfm_deviance,
@@ -27,6 +31,8 @@ from .statespace import StateSpace, ar1_decay, dfm_statespace, scale_observation
 
 __all__ = [
     "FilterResult",
+    "forecast_observation_moments",
+    "forecast_state_moments",
     "SmootherResult",
     "StateSpace",
     "ar1_decay",

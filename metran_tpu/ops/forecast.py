@@ -1,0 +1,92 @@
+"""Closed-form out-of-sample forecasting for diagonal-transition models.
+
+The reference has no forecasting at all — its grid ends at the last
+observation (`metran/metran.py:571`, `kalmanfilter.py`
+stores nothing beyond ``T``).  For a diagonal transition matrix the
+h-step-ahead predictive moments need no filter iteration, so the whole
+forecast horizon is one vectorized expression instead of a scan —
+exactly the shape XLA/TPU wants:
+
+With ``x_{T+h} | y_{1:T} ~ N(m_h, P_h)`` and diagonal ``Phi``,
+
+    m_h      = phi^h * m_T                                (elementwise)
+    P_h[i,j] = (phi_i phi_j)^h P_T[i,j]
+               + q[i,j] (1 - (phi_i phi_j)^h) / (1 - phi_i phi_j)
+
+(the second term is the geometric accumulation of process noise; its
+``phi_i phi_j -> 1`` limit is ``h q[i,j]``, guarded explicitly).  The
+DFM's AR(1) states always have ``|phi| < 1``, so forecasts decay to the
+stationary prior — mean 0, the standardized series' unconditional
+level — with variances growing to the stationary variance.
+
+Observation-space forecasts are the usual projection ``Z m_h`` with
+variances ``diag(Z P_h Z') + r``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .statespace import StateSpace
+
+
+@jax.jit
+def forecast_state_moments(
+    ss: StateSpace, mean_last: jnp.ndarray, cov_last: jnp.ndarray,
+    horizons: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """h-step-ahead state means (H, n) and covariances (H, n, n).
+
+    Parameters
+    ----------
+    mean_last, cov_last : filtered state moments at the last timestep,
+        ``E[x_T | y_{1:T}]`` and its covariance (``FilterResult.mean_f[-1]``,
+        ``cov_f[-1]``).
+    horizons : (H,) integer steps ahead (typically ``1..H``); vectorized,
+        no sequential dependence between horizons.
+    """
+    h = jnp.asarray(horizons, mean_last.dtype)[:, None]  # (H, 1)
+    phi = ss.phi
+    mean_h = phi[None, :] ** h * mean_last[None, :]
+
+    pp = phi[:, None] * phi[None, :]  # (n, n) pairwise decay
+    hb = h[:, :, None]  # (H, 1, 1)
+    # expm1 form of (1 - pp^h) / (1 - pp): the literal difference
+    # cancels catastrophically near unit root (pp -> 1, the alpha ~ 3e4
+    # regime) in float32 — same guard statespace.py uses for q.  The
+    # pp == 1 limit of the ratio is h.
+    log_pp = jnp.log(pp)
+    pp_h = jnp.exp(hb * log_pp[None])  # (H, n, n)
+    denom = jnp.expm1(log_pp)
+    at_one = denom == 0
+    geom = jnp.where(
+        at_one[None],
+        hb * jnp.ones_like(pp)[None],
+        jnp.expm1(hb * log_pp[None]) / jnp.where(at_one, 1.0, denom)[None],
+    )
+    cov_h = pp_h * cov_last[None] + geom * ss.q[None]
+    return mean_h, cov_h
+
+
+@jax.jit
+def forecast_observation_moments(
+    ss: StateSpace, mean_last: jnp.ndarray, cov_last: jnp.ndarray,
+    horizons: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """h-step-ahead observation means (H, N) and variances (H, N)."""
+    mean_h, cov_h = forecast_state_moments(ss, mean_last, cov_last, horizons)
+    means = mean_h @ ss.z.T
+    variances = jnp.einsum("ij,hjk,ik->hi", ss.z, cov_h, ss.z) + ss.r[None]
+    return means, jnp.maximum(variances, 0.0)
+
+
+@functools.partial(jax.jit, static_argnames=("steps",))
+def _forecast_from_filtered(ss, mean_f_last, cov_f_last, steps: int):
+    horizons = jnp.arange(1, steps + 1)
+    return forecast_observation_moments(
+        ss, mean_f_last, cov_f_last, horizons
+    )

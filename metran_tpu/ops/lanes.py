@@ -9,7 +9,7 @@ latency-bound.  This module keeps the **fleet axis in the 128-wide lane
 dimension** instead: covariances are ``(n, n, B)``, every filter op is an
 elementwise/broadcast op across models at full lane utilization, and the
 update is the reference's sequential processing (rank-1, no Cholesky —
-``/root/reference/metran/kalmanfilter.py:315-378`` is the behavioral
+``metran/kalmanfilter.py:315-378`` is the behavioral
 spec).  Measured on TPU v5e for the 20-series/5k-step fleet workload:
 ~15-45x faster per pass than the batch-leading layout.
 

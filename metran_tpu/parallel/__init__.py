@@ -12,7 +12,7 @@ package is the new design surface that scales Metran to TPU pods:
 - :func:`multistart_fit_fleet` — multi-start basin search with the extra
   starts riding the lane axis;
 - :func:`fleet_stderr` / :func:`fleet_simulate` / :func:`fleet_decompose`
-  — batched post-fit inference products;
+  / :func:`fleet_forecast` — batched post-fit inference products;
 - :func:`sweep_fit` — populations larger than one device batch: a
   sequence of bounded :func:`fit_fleet` calls with prefetch overlap of
   host data work and per-batch checkpoint/resume;
@@ -32,6 +32,7 @@ from .fleet import (
     multistart_fit_fleet,
     fleet_decompose,
     fleet_deviance,
+    fleet_forecast,
     fleet_simulate,
     fleet_stderr,
     fleet_value_and_grad,
@@ -63,6 +64,7 @@ __all__ = [
     "multistart_fit_fleet",
     "fleet_decompose",
     "fleet_deviance",
+    "fleet_forecast",
     "fleet_simulate",
     "fleet_stderr",
     "fleet_value_and_grad",

@@ -22,7 +22,7 @@ than around a single-problem optimizer lifted with ``vmap``:
   shares the batch.
 
 The reference's optimizer is scipy's single-problem L-BFGS-B driven by
-finite differences (``/root/reference/metran/solver.py:222-288``); this
+finite differences (``metran/solver.py:222-288``); this
 module is its fleet-scale TPU equivalent (exact gradients via autodiff,
 hundreds to thousands of concurrent problems per chip).
 

@@ -1,6 +1,6 @@
 """Sweep runner: fit model populations larger than one device batch.
 
-The reference fits one model per process (`/root/reference/metran/
+The reference fits one model per process (`metran/
 metran.py:991`); a TPU-scale user has 10^4-10^5 independent models,
 which cannot ride a single :class:`Fleet` (HBM) or a single dispatch
 (tunneled workers crash on long executions).  :func:`sweep_fit` runs a

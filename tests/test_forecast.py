@@ -1,0 +1,171 @@
+"""Out-of-sample forecasting: closed-form vs brute-force, and the API.
+
+The reference has no forecasting (`metran/
+kalmanfilter.py` products end at the data); these tests pin the new
+capability to the textbook predict recursion and the accessor
+contracts.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from metran_tpu import data as mdata
+from metran_tpu.ops import (
+    dfm_statespace,
+    forecast_observation_moments,
+    forecast_state_moments,
+    kalman_filter,
+)
+
+
+def _ssm(rng, n=4, k=1, t=100):
+    loadings = jnp.asarray(rng.uniform(0.3, 0.8, (n, k)) / np.sqrt(k))
+    ss = dfm_statespace(
+        jnp.asarray(rng.uniform(5, 40, n)),
+        jnp.asarray(rng.uniform(10, 60, k)),
+        loadings, 1.0,
+    )
+    y = rng.normal(size=(t, n))
+    mask = rng.uniform(size=y.shape) > 0.3
+    y = np.where(mask, y, 0.0)
+    return ss, jnp.asarray(y), jnp.asarray(mask)
+
+
+def test_forecast_matches_bruteforce_predict(rng):
+    """The closed form equals iterating the textbook predict step
+    x -> Phi x, P -> Phi P Phi' + Q with full matrices."""
+    ss, y, mask = _ssm(rng)
+    filt = kalman_filter(ss, y, mask, engine="sequential")
+    m = np.asarray(filt.mean_f[-1])
+    P = np.asarray(filt.cov_f[-1])
+    phi = np.diag(np.asarray(ss.phi))
+    q = np.asarray(ss.q)
+    H = 12
+    want_m, want_P = [], []
+    for _ in range(H):
+        m = phi @ m
+        P = phi @ P @ phi.T + q
+        want_m.append(m.copy())
+        want_P.append(P.copy())
+    got_m, got_P = forecast_state_moments(
+        ss, filt.mean_f[-1], filt.cov_f[-1], jnp.arange(1, H + 1)
+    )
+    np.testing.assert_allclose(np.asarray(got_m), np.array(want_m),
+                               rtol=1e-10, atol=1e-12)
+    np.testing.assert_allclose(np.asarray(got_P), np.array(want_P),
+                               rtol=1e-10, atol=1e-12)
+
+    # observation space: Z m, diag(Z P Z') + r
+    om, ov = forecast_observation_moments(
+        ss, filt.mean_f[-1], filt.cov_f[-1], jnp.arange(1, H + 1)
+    )
+    z = np.asarray(ss.z)
+    np.testing.assert_allclose(
+        np.asarray(om), np.array(want_m) @ z.T, rtol=1e-10, atol=1e-12
+    )
+    np.testing.assert_allclose(
+        np.asarray(ov),
+        np.einsum("ij,hjk,ik->hi", z, np.array(want_P), z)
+        + np.asarray(ss.r)[None],
+        rtol=1e-10, atol=1e-12,
+    )
+
+
+def test_forecast_limits(rng):
+    """Long-horizon moments converge to the stationary prior (mean 0,
+    variance = stationary state variance), and variances grow
+    monotonically toward it."""
+    ss, y, mask = _ssm(rng)
+    filt = kalman_filter(ss, y, mask, engine="sequential")
+    mh, Ph = forecast_state_moments(
+        ss, filt.mean_f[-1], filt.cov_f[-1], jnp.asarray([1, 10, 100, 5000])
+    )
+    np.testing.assert_allclose(np.asarray(mh[-1]), 0.0, atol=1e-8)
+    stationary = np.diag(np.asarray(ss.q)) / (1 - np.asarray(ss.phi) ** 2)
+    np.testing.assert_allclose(
+        np.diagonal(np.asarray(Ph[-1])), stationary, rtol=1e-6
+    )
+    diag = np.diagonal(np.asarray(Ph), axis1=-2, axis2=-1)
+    assert (np.diff(diag, axis=0) >= -1e-12).all()
+
+
+def _small_model(rng, n=3, t=90):
+    idx = pd.date_range("2015-01-01", periods=t, freq="D")
+    # a true AR(1) common factor so FA reliably picks one factor (the
+    # fleet test stacks parameter vectors, which requires a common k)
+    phi = 0.9
+    common = np.zeros(t)
+    for i in range(1, t):
+        common[i] = phi * common[i - 1] + rng.normal() * np.sqrt(1 - phi**2)
+    raw = 0.8 * common[:, None] + 0.6 * rng.normal(size=(t, n))
+    raw[rng.uniform(size=raw.shape) < 0.15] = np.nan
+    frame = pd.DataFrame(raw, index=idx, columns=[f"s{i}" for i in range(n)])
+    from metran_tpu.models.metran import Metran
+
+    mt = Metran(frame, name="fc")
+    mt.get_factors(mt.oseries)
+    mt.set_init_parameters()  # rebuild the table with the cdf rows
+    return mt
+
+
+def test_metran_forecast_api(rng):
+    mt = _small_model(rng)
+    steps = 20
+    fc = mt.forecast("s1", steps=steps, alpha=0.05)
+    assert list(fc.columns) == ["mean", "lower", "upper"]
+    assert len(fc) == steps
+    # the forecast index continues the daily grid
+    assert fc.index[0] == mt.get_observations().index[-1] + pd.Timedelta("1D")
+    assert (fc["upper"] >= fc["lower"]).all()
+    # intervals widen with horizon (variances are monotone)
+    width = (fc["upper"] - fc["lower"]).to_numpy()
+    assert (np.diff(width) >= -1e-9).all()
+    # alpha=None -> mean series only, equal to the means frame column
+    mean_only = mt.forecast("s1", steps=steps, alpha=None)
+    np.testing.assert_allclose(
+        mean_only.to_numpy(), mt.get_forecast_means(steps)["s1"].to_numpy()
+    )
+    # unknown name -> None (reference accessor convention)
+    assert mt.forecast("nope", steps=3) is None
+    with pytest.raises(Exception):
+        mt.forecast("s1", steps=3, alpha=2.0)
+    # standardized forecast decays to 0; unstandardized to the series mean
+    m_std = mt.get_forecast_means(4000, standardized=True)
+    np.testing.assert_allclose(m_std.to_numpy()[-1], 0.0, atol=1e-6)
+    m_raw = mt.get_forecast_means(4000)
+    np.testing.assert_allclose(
+        m_raw.to_numpy()[-1], np.asarray(mt.oseries_mean, float), atol=1e-5
+    )
+
+
+def test_fleet_forecast_matches_single(rng):
+    """Batched forecasts equal the per-model accessor (standardized)."""
+    from metran_tpu.parallel import fleet_forecast, pack_fleet
+
+    steps = 8
+    models, panels, loadings = [], [], []
+    for _ in range(3):
+        mt = _small_model(rng)
+        models.append(mt)
+        panels.append(mt._active_panel())
+        loadings.append(mt.factors)
+    fleet = pack_fleet(panels, loadings)
+    params = jnp.stack(
+        [jnp.asarray(m._param_array(m.get_parameters(initial=True)))
+         for m in models]
+    )
+    means, variances = fleet_forecast(
+        params, fleet, steps, engine="sequential", batch_chunk=2
+    )
+    for i, mt in enumerate(models):
+        p = mt.get_parameters(initial=True)
+        want_m = mt.get_forecast_means(steps, p=p, standardized=True)
+        want_v = mt.get_forecast_variances(steps, p=p, standardized=True)
+        np.testing.assert_allclose(
+            np.asarray(means[i]), want_m.to_numpy(), rtol=1e-8, atol=1e-10
+        )
+        np.testing.assert_allclose(
+            np.asarray(variances[i]), want_v.to_numpy(), rtol=1e-8, atol=1e-10
+        )

@@ -35,6 +35,7 @@ MODULES = [
     "metran_tpu.models.plots",
     "metran_tpu.models.kalman_runner",
     "metran_tpu.ops.statespace",
+    "metran_tpu.ops.forecast",
     "metran_tpu.ops.kalman",
     "metran_tpu.ops.pkalman",
     "metran_tpu.ops.lanes",
