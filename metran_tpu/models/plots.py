@@ -148,6 +148,46 @@ class MetranPlot:
             fig.tight_layout()
         return ax
 
+    def forecast(self, name, steps=90, alpha=0.05, context=365, ax=None):
+        """In-sample simulation continued by the out-of-sample forecast.
+
+        No reference counterpart (the reference has no forecasting):
+        the last ``context`` grid periods of the simulation, the
+        observation dots, and the ``steps``-period forecast mean with
+        its widening prediction interval beyond the data's end (marked
+        by a vertical line).
+        """
+        sim = self.mt.get_simulation(name, alpha=alpha)
+        fc = self.mt.forecast(name, steps=steps, alpha=alpha)
+        obs = self.mt.get_observations(
+            standardized=False,
+            masked=self.mt.masked_observations is not None,
+        )[name]
+
+        fig = None
+        if ax is None:
+            fig, ax = plt.subplots(figsize=(_PANEL_W, 4))
+        sim = sim.iloc[-int(context):]
+        if alpha is None:  # point values only — sim/fc are Series
+            ax.plot(sim.index, np.asarray(sim), label=f"simulation {name}")
+            ax.plot(fc.index, np.asarray(fc), ls="--",
+                    label=f"forecast {name}")
+        else:
+            ax.plot(sim.index, sim["mean"], label=f"simulation {name}")
+            ax.plot(fc.index, fc["mean"], ls="--", label=f"forecast {name}")
+            ax.fill_between(
+                fc.index, fc["lower"], fc["upper"], color="gray",
+                alpha=0.5, label=f"{1 - alpha:.0%}-prediction interval",
+            )
+        obs = obs.loc[sim.index[0]:]
+        ax.plot(obs.index, obs, ls="none", marker=".", ms=3, color="k",
+                label="observations")
+        ax.axvline(obs.index[-1], color="k", lw=0.8, ls=":")
+        _decorate(ax)
+        if fig is not None:
+            fig.tight_layout()
+        return ax
+
     def simulations(self, alpha=0.05, tmin=None, tmax=None):
         """One simulation panel per observed series, shared axes."""
         def draw(name, ax):

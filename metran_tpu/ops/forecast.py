@@ -78,10 +78,11 @@ def forecast_observation_moments(
     horizons: jnp.ndarray,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """h-step-ahead observation means (H, N) and variances (H, N)."""
+    from .kalman import project
+
     mean_h, cov_h = forecast_state_moments(ss, mean_last, cov_last, horizons)
-    means = mean_h @ ss.z.T
-    variances = jnp.einsum("ij,hjk,ik->hi", ss.z, cov_h, ss.z) + ss.r[None]
-    return means, jnp.maximum(variances, 0.0)
+    means, variances = project(ss.z, mean_h, cov_h)
+    return means, variances + ss.r[None]
 
 
 @functools.partial(jax.jit, static_argnames=("steps",))

@@ -55,6 +55,11 @@ class Fleet(NamedTuple):
     loadings : (B, N, K) factor loadings (0 rows/cols for padded slots).
     dt : (B,) grid step in days per model.
     n_series : (B,) true series count per model (before padding).
+    t_steps : (B,) true timestep count per model (before time padding);
+        ``None`` (the default, for hand-built fleets) means every
+        member spans the full grid.  Only forecasting consults it —
+        the filter itself treats padded rows as ordinary all-missing
+        timesteps.
     """
 
     y: jnp.ndarray
@@ -62,6 +67,7 @@ class Fleet(NamedTuple):
     loadings: jnp.ndarray
     dt: jnp.ndarray
     n_series: jnp.ndarray
+    t_steps: Optional[jnp.ndarray] = None
 
     @property
     def batch(self) -> int:
@@ -135,6 +141,7 @@ def pack_fleet(
     lds = np.zeros((bp, n, k), dtype)
     dt = np.ones(bp, dtype)
     n_series = np.full(bp, n, np.int32)
+    t_steps = np.full(bp, t, np.int32)
     for i, (panel, ld) in enumerate(zip(panels, loadings)):
         ti, ni = panel.n_timesteps, panel.n_series
         ld = np.atleast_2d(np.asarray(ld, dtype))
@@ -143,12 +150,14 @@ def pack_fleet(
         lds[i, :ni, : ld.shape[1]] = ld
         dt[i] = panel.dt
         n_series[i] = ni
+        t_steps[i] = ti
     return Fleet(
         y=jnp.asarray(y),
         mask=jnp.asarray(mask),
         loadings=jnp.asarray(lds),
         dt=jnp.asarray(dt),
         n_series=jnp.asarray(n_series),
+        t_steps=jnp.asarray(t_steps),
     )
 
 
@@ -893,7 +902,10 @@ def fit_fleet(
         pad_lanes = mesh is None and b_orig < lane_min_batch
         if pad_lanes:
             idx = jnp.arange(lane_min_batch) % b_orig
-            fleet = Fleet(*(jnp.take(a, idx, axis=0) for a in fleet))
+            fleet = Fleet(*(
+                None if a is None else jnp.take(a, idx, axis=0)
+                for a in fleet
+            ))
             p0 = jnp.take(jnp.asarray(p0), idx, axis=0)
         fit = _fit_fleet_lanes(
             fleet, p0, warmup, maxiter, tol, mesh, chunk,
@@ -1187,7 +1199,11 @@ def fleet_forecast(
     of :func:`fleet_simulate`.
     """
     run = _make_forecast_runner(engine, int(steps))
-    return _run_chunked(run, params, fleet, batch_chunk)
+    t_last = (
+        jnp.full(fleet.batch, fleet.y.shape[1], jnp.int32)
+        if fleet.t_steps is None else jnp.asarray(fleet.t_steps, jnp.int32)
+    )
+    return _run_chunked(run, params, fleet, batch_chunk, extras=(t_last,))
 
 
 @functools.lru_cache(maxsize=16)
@@ -1195,21 +1211,27 @@ def _make_forecast_runner(engine, steps):
     from ..ops import kalman_filter
     from ..ops.forecast import forecast_observation_moments
 
-    def one(p, y, mask, loadings, dt):
+    def one(p, y, mask, loadings, dt, t_last):
         n = loadings.shape[0]
         ss = dfm_statespace(p[:n], p[n:], loadings, dt)
         filt = kalman_filter(ss, y, mask, engine=engine)
+        # each member forecasts from ITS OWN data end (time padding
+        # appends all-masked rows the filter predict-propagates
+        # through; forecasting from the padded grid end would silently
+        # shift the origin by the padding length)
+        m0 = jnp.take(filt.mean_f, t_last - 1, axis=0)
+        P0 = jnp.take(filt.cov_f, t_last - 1, axis=0)
         horizons = jnp.arange(1, steps + 1)
-        return forecast_observation_moments(
-            ss, filt.mean_f[-1], filt.cov_f[-1], horizons
-        )
+        return forecast_observation_moments(ss, m0, P0, horizons)
 
     return jax.jit(jax.vmap(one))
 
 
-def _run_chunked(run, params, fleet, batch_chunk):
+def _run_chunked(run, params, fleet, batch_chunk, extras=()):
     """Host-driven loop of fixed-shape dispatches over the fleet axis;
-    outputs are concatenated on device and trimmed to the true batch."""
+    outputs are concatenated on device and trimmed to the true batch.
+    ``extras`` are additional (B, ...) arrays passed to ``run`` after
+    the standard fleet arguments."""
     b = fleet.batch
     chunk = b if batch_chunk is None else min(max(int(batch_chunk), 1), b)
 
@@ -1227,7 +1249,8 @@ def _run_chunked(run, params, fleet, batch_chunk):
 
     outs = [
         run(*(sliced(a, i) for a in (
-            params, fleet.y, fleet.mask, fleet.loadings, fleet.dt
+            params, fleet.y, fleet.mask, fleet.loadings, fleet.dt,
+            *extras,
         )))
         for i in range(0, b, chunk)
     ]

@@ -141,13 +141,15 @@ def test_metran_forecast_api(rng):
 
 
 def test_fleet_forecast_matches_single(rng):
-    """Batched forecasts equal the per-model accessor (standardized)."""
+    """Batched forecasts equal the per-model accessor (standardized) —
+    including a member with a SHORTER series, whose forecast must start
+    at its own data end, not the padded grid end."""
     from metran_tpu.parallel import fleet_forecast, pack_fleet
 
     steps = 8
     models, panels, loadings = [], [], []
-    for _ in range(3):
-        mt = _small_model(rng)
+    for t in (90, 90, 60):  # last member is time-padded in the fleet
+        mt = _small_model(rng, t=t)
         models.append(mt)
         panels.append(mt._active_panel())
         loadings.append(mt.factors)

@@ -108,3 +108,16 @@ def test_plots_after_masking(mt):
         assert len(ax.lines) == 2
     finally:
         mt.unmask_observations()
+
+
+def test_forecast_plot(mt):
+    ax = mt.plots.forecast(mt.snames[0], steps=30)
+    # simulation mean + forecast mean + observation dots, 1 PI band,
+    # plus the data-end marker line
+    assert len(ax.lines) == 4
+    assert len(ax.collections) == 1
+
+
+def test_forecast_plot_no_ci(mt):
+    ax = mt.plots.forecast(mt.snames[0], steps=10, alpha=None)
+    assert len(ax.collections) == 0
