@@ -171,3 +171,19 @@ def test_fleet_forecast_matches_single(rng):
         np.testing.assert_allclose(
             np.asarray(variances[i]), want_v.to_numpy(), rtol=1e-8, atol=1e-10
         )
+
+
+def test_forecast_respects_masking(rng):
+    """Masking observations changes the filtered state at T and hence
+    the forecast (the counterfactual workflow extends beyond the data);
+    unmasking restores the original forecast exactly."""
+    mt = _small_model(rng)
+    base = mt.get_forecast_means(10)
+    mask = np.zeros(mt.oseries.shape, dtype=bool)
+    mask[-20:, 0] = True  # hide the end of series 0
+    mt.mask_observations(mask)
+    masked = mt.get_forecast_means(10)
+    mt.unmask_observations()
+    restored = mt.get_forecast_means(10)
+    assert (masked.to_numpy() != base.to_numpy()).any()
+    np.testing.assert_allclose(restored.to_numpy(), base.to_numpy())
