@@ -35,6 +35,7 @@ from metran_tpu.models.factoranalysis import FactorAnalysis
 from metran_tpu.parallel import (
     autocorr_init_params,
     fit_fleet,
+    fleet_forecast,
     fleet_simulate,
     fleet_stderr,
     make_mesh,
@@ -121,6 +122,10 @@ def main():
     stderr, _ = fleet_stderr(fit.params, fleet, method="lanes-fd",
                              batch_chunk=8)
     means, variances = fleet_simulate(fit.params, fleet, batch_chunk=8)
+    # out-of-sample: 30-day forecasts for the whole fleet at once
+    fmeans, fvars = fleet_forecast(fit.params, fleet, steps=30,
+                                   batch_chunk=8)
+    print("forecast grid (models, steps, series):", tuple(fmeans.shape))
     print(
         "median stderr(alpha):",
         float(np.nanmedian(np.asarray(stderr[:n_models]))).__round__(2),
