@@ -91,8 +91,8 @@ def test_forecast_limits(rng):
     assert (np.diff(diag, axis=0) >= -1e-12).all()
 
 
-def _small_model(rng, n=3, t=90):
-    idx = pd.date_range("2015-01-01", periods=t, freq="D")
+def _small_model(rng, n=3, t=90, freq="D", prefix="s", missing=0.15):
+    idx = pd.date_range("2015-01-01", periods=t, freq=freq)
     # a true AR(1) common factor so FA reliably picks one factor (the
     # fleet test stacks parameter vectors, which requires a common k)
     phi = 0.9
@@ -100,11 +100,13 @@ def _small_model(rng, n=3, t=90):
     for i in range(1, t):
         common[i] = phi * common[i - 1] + rng.normal() * np.sqrt(1 - phi**2)
     raw = 0.8 * common[:, None] + 0.6 * rng.normal(size=(t, n))
-    raw[rng.uniform(size=raw.shape) < 0.15] = np.nan
-    frame = pd.DataFrame(raw, index=idx, columns=[f"s{i}" for i in range(n)])
+    raw[rng.uniform(size=raw.shape) < missing] = np.nan
+    frame = pd.DataFrame(
+        raw, index=idx, columns=[f"{prefix}{i}" for i in range(n)]
+    )
     from metran_tpu.models.metran import Metran
 
-    mt = Metran(frame, name="fc")
+    mt = Metran(frame, name="fc", freq=None if freq == "D" else freq)
     mt.get_factors(mt.oseries)
     mt.set_init_parameters()  # rebuild the table with the cdf rows
     return mt
@@ -187,3 +189,28 @@ def test_forecast_respects_masking(rng):
     restored = mt.get_forecast_means(10)
     assert (masked.to_numpy() != base.to_numpy()).any()
     np.testing.assert_allclose(restored.to_numpy(), base.to_numpy())
+
+
+def test_forecast_nondaily_freq(rng):
+    """On a weekly grid the forecast index steps by 7 days and the
+    decay uses the grid dt (phi = exp(-7/alpha) per step)."""
+    t, n = 80, 3
+    mt = _small_model(rng, n=n, t=t, freq="7D", prefix="w", missing=0.0)
+    idx = mt.oseries.index
+    fc = mt.forecast("w0", steps=5)
+    assert (fc.index[1:] - fc.index[:-1] == pd.Timedelta("7D")).all()
+    assert fc.index[0] == idx[-1] + pd.Timedelta("7D")
+    # decay per step matches exp(-dt/alpha) with dt = 7 days
+    m = mt.get_forecast_means(2, standardized=True).to_numpy()
+    alphas = mt._param_array(mt.get_parameters(initial=True))
+    ss = mt._statespace(mt.get_parameters(initial=True))
+    np.testing.assert_allclose(
+        np.asarray(ss.phi), np.exp(-7.0 / alphas), rtol=1e-12
+    )
+    # the h=2 forecast is the h=1 forecast decayed one more step
+    state1, _ = mt.kf._states("filter")
+    z = np.asarray(ss.z)
+    x_last = np.asarray(state1[-1])
+    phi_d = np.asarray(ss.phi)
+    np.testing.assert_allclose(m[0], z @ (phi_d * x_last), atol=1e-10)
+    np.testing.assert_allclose(m[1], z @ (phi_d**2 * x_last), atol=1e-10)
