@@ -1,0 +1,85 @@
+"""On-chip experiment: post-fit product ``batch_chunk`` ablation.
+
+Round-4 bench records show ``fleet_simulate`` at 0.35 models/s on TPU
+(batch_chunk=4) while ``fleet_decompose`` runs 7.2 models/s on the same
+smoother work — the gap is the smoothed-covariance recursion XLA
+dead-code-eliminates from the means-only decompose program.  At chunk 4
+that backward covariance scan is latency-bound (5,000 sequential steps
+of (4, n, n) ops); the covariance storage is only ~9 MB/model, so far
+wider chunks fit trivially in HBM.  This harness measures simulate /
+decompose / stderr(lanes-fd) throughput across chunk widths to pick the
+bench default, keeping each dispatch bounded well under the tunnel's
+~60 s kill threshold by probing narrow chunks first.
+
+Usage: python tools/exp_prodchunk.py [n_models]
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+sys.path.insert(0, _HERE)
+
+import jax  # noqa: E402
+from exp_init import log, make_fleet  # noqa: E402
+
+from bench import REMAT_SEG, SEED, make_workload  # noqa: E402
+from metran_tpu.parallel import (  # noqa: E402
+    fleet_decompose, fleet_forecast, fleet_simulate, fleet_stderr,
+)
+from metran_tpu.parallel.fleet import autocorr_init_params  # noqa: E402
+
+
+def measure(name, fn, p, fleet, kw, reps=2):
+    t0 = time.perf_counter()
+    jax.tree.map(np.asarray, fn(p, fleet, **kw))
+    compile_s = time.perf_counter() - t0
+    runs = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.tree.map(np.asarray, fn(p, fleet, **kw))
+        runs.append(round(time.perf_counter() - t0, 2))
+    run_s = float(np.median(runs))
+    log(label=name, batch_chunk=kw.get("batch_chunk"),
+        models=fleet.batch, compile_plus_first_s=round(compile_s, 1),
+        runs_s=runs, models_per_s=round(fleet.batch / run_s, 2))
+    return run_s
+
+
+def main():
+    n_models = int(sys.argv[1]) if len(sys.argv) > 1 else 32
+    log(label="devices", devices=str(jax.devices()))
+    rng = np.random.default_rng(SEED)
+    y, mask, loadings = make_workload(rng, n_models)
+    fleet = make_fleet(y, mask, loadings)
+    # forecast-origin panels all end at the padded grid end here
+    p = autocorr_init_params(fleet)
+    log(label="workload_ready", models=n_models)
+
+    # probe narrow first: every dispatch must stay << 60 s on-tunnel
+    for chunk in (4, 8, 16, 32):
+        r = measure("simulate", fleet_simulate, p, fleet,
+                    dict(smooth=True, batch_chunk=chunk))
+        # projected single-dispatch time at the next width; bail before
+        # a dispatch could approach the tunnel kill threshold
+        if r / max(1, n_models // chunk) > 25.0:
+            log(label="simulate_stop", reason="dispatch budget")
+            break
+    for chunk in (4, 16, 32):
+        measure("decompose", fleet_decompose, p, fleet,
+                dict(smooth=True, batch_chunk=chunk))
+    for chunk in (4, 16, 32):
+        measure("stderr_lanes_fd", fleet_stderr, p, fleet,
+                dict(remat_seg=REMAT_SEG, batch_chunk=chunk,
+                     method="lanes-fd"))
+    for chunk in (4, 16, 32):
+        measure("forecast30", fleet_forecast, p, fleet,
+                dict(steps=30, batch_chunk=chunk))
+
+
+if __name__ == "__main__":
+    main()
