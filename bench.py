@@ -279,6 +279,20 @@ def run_device_bench(out_path: str, budget_s: float,
 
     import jax.numpy as jnp
 
+    # executed-matmul probe: the round-4 r4d wedge showed jax.devices()
+    # returning instantly while the first real dispatch hung >900 s, so
+    # init success alone proves nothing about tunnel health.  Running
+    # (and materializing) one tiny matmul here gives the parent a
+    # definite "this tunnel executes" marker; if it never appears the
+    # parent bails after METRAN_TPU_BENCH_EXEC_TIMEOUT_S instead of
+    # burning the whole device budget on a hung dispatch.
+    t0 = time.perf_counter()
+    probe = jnp.ones((128, 128), jnp.float32)
+    float(jnp.sum(probe @ probe))
+    out["device_exec_probe_s"] = round(time.perf_counter() - t0, 1)
+    progress("device_exec_probe", s=out["device_exec_probe_s"])
+    write_partial(out_path, out)
+
     from metran_tpu.parallel import fit_fleet, fleet_value_and_grad
     from metran_tpu.parallel.fleet import (
         Fleet, autocorr_init_params, default_init_params,
@@ -772,8 +786,16 @@ def _wait(proc, timeout: float, label: str) -> bool:
 def _wait_device(proc, out_path: str, deadline: float,
                  init_timeout: float) -> bool:
     """Wait for the device child, killing it EARLY if device init never
-    completes (wedged tunnel) so the CPU fallback gets real budget."""
+    completes — or if init succeeds but the executed-matmul probe never
+    lands (the round-4 r4d wedge: instant jax.devices(), first dispatch
+    hung >900 s) — so the retry/CPU fallback gets real budget.  The
+    child being killed here is already hung mid-dispatch; the kill does
+    not make the pool state worse (the dispatch is lost either way)."""
+    exec_timeout = float(
+        os.environ.get("METRAN_TPU_BENCH_EXEC_TIMEOUT_S", "90")
+    )
     init_deadline = time.monotonic() + init_timeout
+    init_seen_at = None
     while True:
         try:
             proc.wait(timeout=5.0)
@@ -783,8 +805,17 @@ def _wait_device(proc, out_path: str, deadline: float,
         now = time.monotonic()
         part = _read_json(out_path)
         initialized = part is not None and "device_init_s" in part
+        executed = part is not None and "device_exec_probe_s" in part
+        if initialized and init_seen_at is None:
+            init_seen_at = now
         if not initialized and now > init_deadline:
             progress("device_init_timeout", timeout_s=round(init_timeout, 0))
+            proc.kill()
+            proc.wait()
+            return False
+        if (initialized and not executed and init_seen_at is not None
+                and now > init_seen_at + exec_timeout):
+            progress("device_exec_timeout", timeout_s=round(exec_timeout, 0))
             proc.kill()
             proc.wait()
             return False
@@ -871,9 +902,10 @@ def main() -> None:
         # a recovered tunnel initializes in seconds — give the retry a
         # short init window so a still-wedged device hands the remaining
         # budget to the CPU fallback instead of burning another full
-        # init_timeout.  If the first attempt initialized fine (it died
-        # later, in forward/fit), keep the operator's full init window.
-        first_inited = "device_init_s" in first_attempt
+        # init_timeout.  Only an attempt that also EXECUTED its probe
+        # counts as healthy (init alone can succeed on a wedged tunnel);
+        # an exec-hung first attempt gets the short window too.
+        first_inited = "device_exec_probe_s" in first_attempt
         _wait_device(
             dev_proc, dev_path, time.monotonic() + retry_budget,
             init_timeout if first_inited else min(init_timeout, 120.0),

@@ -15,8 +15,11 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(_HERE))
 sys.path.insert(0, _HERE)
 
-import jax  # noqa: E402
+# exp_init sets JAX_COMPILATION_CACHE_DIR; it must run before jax
+# initializes or the persistent cache is silently disabled
 from exp_init import log, make_fleet  # noqa: E402  (shared harness bits)
+
+import jax  # noqa: E402
 
 from bench import (  # noqa: E402
     BATCH, MAXITER, REMAT_SEG, SEED, STALL_TOL, TOL, make_workload,

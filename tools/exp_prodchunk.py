@@ -24,8 +24,11 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(_HERE))
 sys.path.insert(0, _HERE)
 
-import jax  # noqa: E402
+# exp_init sets JAX_COMPILATION_CACHE_DIR; it must run before jax
+# initializes or the persistent cache is silently disabled
 from exp_init import log, make_fleet  # noqa: E402
+
+import jax  # noqa: E402
 
 from bench import REMAT_SEG, SEED, make_workload  # noqa: E402
 from metran_tpu.parallel import (  # noqa: E402
