@@ -784,7 +784,7 @@ def _wait(proc, timeout: float, label: str) -> bool:
 
 
 def _wait_device(proc, out_path: str, deadline: float,
-                 init_timeout: float) -> bool:
+                 init_timeout: float, poll_s: float = 5.0) -> bool:
     """Wait for the device child, killing it EARLY if device init never
     completes — or if init succeeds but the executed-matmul probe never
     lands (the round-4 r4d wedge: instant jax.devices(), first dispatch
@@ -798,7 +798,7 @@ def _wait_device(proc, out_path: str, deadline: float,
     init_seen_at = None
     while True:
         try:
-            proc.wait(timeout=5.0)
+            proc.wait(timeout=poll_s)
             return proc.returncode == 0
         except subprocess.TimeoutExpired:
             pass
@@ -813,7 +813,7 @@ def _wait_device(proc, out_path: str, deadline: float,
             proc.kill()
             proc.wait()
             return False
-        if (initialized and not executed and init_seen_at is not None
+        if (initialized and not executed
                 and now > init_seen_at + exec_timeout):
             progress("device_exec_timeout", timeout_s=round(exec_timeout, 0))
             proc.kill()
@@ -905,10 +905,10 @@ def main() -> None:
         # init_timeout.  Only an attempt that also EXECUTED its probe
         # counts as healthy (init alone can succeed on a wedged tunnel);
         # an exec-hung first attempt gets the short window too.
-        first_inited = "device_exec_probe_s" in first_attempt
+        first_executed = "device_exec_probe_s" in first_attempt
         _wait_device(
             dev_proc, dev_path, time.monotonic() + retry_budget,
-            init_timeout if first_inited else min(init_timeout, 120.0),
+            init_timeout if first_executed else min(init_timeout, 120.0),
         )
         device = _read_json(dev_path) or {}
         if first_attempt:

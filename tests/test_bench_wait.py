@@ -1,0 +1,90 @@
+"""Regression tests for bench.py's device-child supervision.
+
+The round-4 r4d wedge showed a tunnel whose ``jax.devices()`` returns
+instantly while the first real dispatch hangs >900 s; ``_wait_device``
+must kill such a child once the executed-matmul probe marker fails to
+appear (``device_exec_timeout``), while leaving healthy children and
+probe-passed children on their normal deadlines.  These tests drive the
+supervisor directly with dummy ``sleep`` children and hand-written
+partial-result files — no device, no jax; ``poll_s`` is shrunk from the
+production 5 s so the timeout paths resolve in well under a second.
+"""
+
+import json
+import subprocess
+import time
+from pathlib import Path
+
+import importlib.util
+
+
+def _load_bench():
+    path = Path(__file__).resolve().parents[1] / "bench.py"
+    spec = importlib.util.spec_from_file_location("bench_under_test", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _supervise(bench, out, deadline_s, init_timeout):
+    proc = subprocess.Popen(["sleep", "300"])
+    try:
+        t0 = time.monotonic()
+        ok = bench._wait_device(
+            proc, str(out), time.monotonic() + deadline_s,
+            init_timeout=init_timeout, poll_s=0.2,
+        )
+        return ok, time.monotonic() - t0, proc.returncode
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait()
+
+
+def test_exec_probe_timeout_kills_initialized_but_hung_child(
+    tmp_path, monkeypatch
+):
+    bench = _load_bench()
+    monkeypatch.setenv("METRAN_TPU_BENCH_EXEC_TIMEOUT_S", "0.5")
+    out = tmp_path / "dev.json"
+    out.write_text(json.dumps({"device_init_s": 0.1}))  # no exec probe
+    ok, elapsed, rc = _supervise(bench, out, deadline_s=60, init_timeout=30)
+    assert ok is False
+    # killed at the exec deadline (~0.5 s + poll rounds), not at 60 s
+    assert elapsed < 10
+    assert rc != 0
+
+
+def test_exec_probe_present_runs_to_normal_deadline(tmp_path, monkeypatch):
+    bench = _load_bench()
+    monkeypatch.setenv("METRAN_TPU_BENCH_EXEC_TIMEOUT_S", "0.5")
+    out = tmp_path / "dev.json"
+    out.write_text(
+        json.dumps({"device_init_s": 0.1, "device_exec_probe_s": 0.4})
+    )
+    ok, elapsed, rc = _supervise(bench, out, deadline_s=3, init_timeout=30)
+    assert ok is False
+    # the tight exec timeout must NOT fire once the probe marker exists:
+    # the child lives until the overall 3 s deadline, not ~0.5 s
+    assert elapsed >= 2.5
+
+
+def test_healthy_child_exit_is_success(tmp_path):
+    bench = _load_bench()
+    out = tmp_path / "dev.json"
+    out.write_text(
+        json.dumps({"device_init_s": 0.1, "device_exec_probe_s": 0.4})
+    )
+    proc = subprocess.Popen(["sleep", "0.5"])
+    ok = bench._wait_device(
+        proc, str(out), time.monotonic() + 30, init_timeout=30, poll_s=0.2
+    )
+    assert ok is True
+
+
+def test_init_timeout_still_fires_without_any_markers(tmp_path):
+    bench = _load_bench()
+    out = tmp_path / "dev.json"  # never written: init never completed
+    ok, elapsed, rc = _supervise(bench, out, deadline_s=60, init_timeout=0.5)
+    assert ok is False
+    assert elapsed < 10
