@@ -772,6 +772,52 @@ def _spawn(phase: str, out_path: str, budget: float, extra_env=None):
     )
 
 
+def _last_known_good_tpu():
+    """Summarize the newest committed on-chip bench record, if any.
+
+    When the capture-time device is wedged and this run falls back to
+    CPU, the artifact still carries a machine-readable pointer to the
+    most recent REAL TPU record in ``bench_artifacts/`` — clearly
+    labeled as builder-side provenance (captured by an earlier run of
+    this same benchmark while the tunnel was alive), NOT a measurement
+    of this run.  Readers wanting the raw evidence follow ``file``.
+    """
+    art_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "bench_artifacts")
+    try:
+        names = [n for n in os.listdir(art_dir)
+                 if n.startswith("BENCH_onchip") and n.endswith(".json")]
+        # newest first by mtime (lexicographic order breaks across
+        # rounds: "r10" sorts before "r4c"); stop at the first record
+        # that is actually a TPU capture with a fit number
+        names.sort(key=lambda n: os.path.getmtime(os.path.join(art_dir, n)),
+                   reverse=True)
+        for name in names:
+            try:
+                rec = _read_json(os.path.join(art_dir, name))
+                if not rec or rec.get("platform") != "tpu":
+                    continue
+                fit = rec["detail"]["device"]["fit"]
+                if not fit.get("fits_per_s"):
+                    continue
+            except Exception:  # malformed/shape-unexpected artifact:
+                continue       # this path must never sink the fallback
+            return {
+                "file": f"bench_artifacts/{name}",
+                "fits_per_s": fit["fits_per_s"],
+                "converged_frac": fit.get("converged_frac"),
+                "batch": fit.get("batch"),
+                "provenance": (
+                    "builder-side record from an earlier run of this "
+                    "benchmark on the live tunnel; NOT captured by "
+                    "this (fallback) run"
+                ),
+            }
+    except Exception:
+        pass
+    return None
+
+
 def _wait(proc, timeout: float, label: str) -> bool:
     try:
         proc.wait(timeout=max(timeout, 1.0))
@@ -927,6 +973,7 @@ def main() -> None:
         fallback = _read_json(fb_path) or {}
         if "fit" in fallback or "forward" in fallback:
             fallback["tpu_attempt"] = device or {"error": "no output"}
+            fallback["last_known_good_tpu"] = _last_known_good_tpu()
             device = fallback
 
     cpu = _read_json(cpu_path) or {}

@@ -17,8 +17,11 @@ from pathlib import Path
 
 import importlib.util
 
+import pytest
 
-def _load_bench():
+
+@pytest.fixture(scope="module")
+def bench():
     path = Path(__file__).resolve().parents[1] / "bench.py"
     spec = importlib.util.spec_from_file_location("bench_under_test", path)
     mod = importlib.util.module_from_spec(spec)
@@ -42,9 +45,8 @@ def _supervise(bench, out, deadline_s, init_timeout):
 
 
 def test_exec_probe_timeout_kills_initialized_but_hung_child(
-    tmp_path, monkeypatch
+    bench, tmp_path, monkeypatch
 ):
-    bench = _load_bench()
     monkeypatch.setenv("METRAN_TPU_BENCH_EXEC_TIMEOUT_S", "0.5")
     out = tmp_path / "dev.json"
     out.write_text(json.dumps({"device_init_s": 0.1}))  # no exec probe
@@ -55,8 +57,9 @@ def test_exec_probe_timeout_kills_initialized_but_hung_child(
     assert rc != 0
 
 
-def test_exec_probe_present_runs_to_normal_deadline(tmp_path, monkeypatch):
-    bench = _load_bench()
+def test_exec_probe_present_runs_to_normal_deadline(
+    bench, tmp_path, monkeypatch
+):
     monkeypatch.setenv("METRAN_TPU_BENCH_EXEC_TIMEOUT_S", "0.5")
     out = tmp_path / "dev.json"
     out.write_text(
@@ -69,8 +72,7 @@ def test_exec_probe_present_runs_to_normal_deadline(tmp_path, monkeypatch):
     assert elapsed >= 2.5
 
 
-def test_healthy_child_exit_is_success(tmp_path):
-    bench = _load_bench()
+def test_healthy_child_exit_is_success(bench, tmp_path):
     out = tmp_path / "dev.json"
     out.write_text(
         json.dumps({"device_init_s": 0.1, "device_exec_probe_s": 0.4})
@@ -82,8 +84,7 @@ def test_healthy_child_exit_is_success(tmp_path):
     assert ok is True
 
 
-def test_init_timeout_still_fires_without_any_markers(tmp_path):
-    bench = _load_bench()
+def test_init_timeout_still_fires_without_any_markers(bench, tmp_path):
     out = tmp_path / "dev.json"  # never written: init never completed
     ok, elapsed, rc = _supervise(bench, out, deadline_s=60, init_timeout=0.5)
     assert ok is False
