@@ -113,6 +113,18 @@ class KalmanRunner:
         )
         return np.asarray(means), np.asarray(variances)
 
+    def innovations(self, standardized: bool = True):
+        """One-step-ahead prediction residuals
+        (:func:`metran_tpu.ops.innovations`), reusing the cached filter
+        pass; NaN where no observation is present."""
+        from ..ops import innovations as _innovations
+
+        v, f = _innovations(
+            self.ss, self.y, self.mask, filt=self.run_filter(),
+            standardized=standardized,
+        )
+        return np.asarray(v), np.asarray(f)
+
     def decompose(self, observation_matrix, method: str = "smoother"):
         means, _ = self._states(method)
         sdf, cdf = decompose_states(

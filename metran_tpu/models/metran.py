@@ -528,6 +528,30 @@ class Metran:
         sim.columns = ["mean", "lower", "upper"]
         return sim
 
+    def get_innovations(self, p=None, standardized: bool = True) -> DataFrame:
+        """One-step-ahead prediction residuals per series.
+
+        The whiteness diagnostic for the fitted model (no reference
+        equivalent): standardized innovations of a well-specified model
+        are ~N(0, 1) and serially uncorrelated, so structure left in
+        them (drift, autocorrelation, fat tails, a single outlying
+        date) localizes what the model misses.  Masked/missing dates
+        are NaN.
+
+        Parameters
+        ----------
+        p : optional parameter array; defaults to the fitted (or
+            initial) parameters, like the other accessors.
+        standardized : divide each residual by its predicted standard
+            deviation (scale-free, the diagnostic default).  With
+            ``False``, residuals are in standardized-observation units
+            (the units the filter runs in; multiply by
+            ``oseries_std`` for the original units).
+        """
+        self._run_kalman("filter", p=p)
+        v, _ = self.kf.innovations(standardized=standardized)
+        return DataFrame(v, index=self.oseries.index, columns=self.oseries.columns)
+
     def _forecast_moments(self, steps, p=None, standardized=False):
         self._run_kalman("filter", p=p)
         if standardized:

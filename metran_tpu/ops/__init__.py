@@ -7,6 +7,7 @@ from .kalman import (
     decompose_states,
     deviance,
     deviance_terms,
+    innovations,
     kalman_filter,
     log_likelihood,
     project,
@@ -31,6 +32,7 @@ from .statespace import StateSpace, ar1_decay, dfm_statespace, scale_observation
 
 __all__ = [
     "FilterResult",
+    "innovations",
     "forecast_observation_moments",
     "forecast_state_moments",
     "SmootherResult",

@@ -383,6 +383,56 @@ def rts_smoother(
     return SmootherResult(mean_s, cov_s)
 
 
+@functools.partial(jax.jit, static_argnames=("standardized", "engine"))
+def innovations(
+    ss: StateSpace,
+    y: jnp.ndarray,
+    mask: jnp.ndarray,
+    filt: Optional[FilterResult] = None,
+    standardized: bool = True,
+    engine: str = "joint",
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One-step-ahead prediction residuals and their variances.
+
+    The classic state-space misspecification diagnostic (no reference
+    equivalent — ``metran`` exposes no residual accessor at all): for a
+    well-specified model at the fitted parameters the standardized
+    innovations are white noise (zero mean, unit variance, serially
+    uncorrelated), so departures localize WHERE and WHEN the model
+    fails.
+
+    Joint (vector) definition: ``v_t = y_t - Z x_{t|t-1}`` with
+    variances ``F_t = diag(Z P_{t|t-1} Z') + r`` from the
+    time-predicted moments — NOT the sequential-processing per-scalar
+    innovations (which condition each series on the ones updated before
+    it at the same timestep and therefore depend on series order).
+
+    Parameters
+    ----------
+    ss, y, mask : model matrices and masked observations, as for
+        :func:`kalman_filter`.
+    filt : optionally a precomputed ``store=True`` filter result (the
+        predicted moments are reused; nothing is re-run).
+    standardized : return ``v_t / sqrt(F_t)`` (scale-free) instead of
+        raw residuals in observation units.
+    engine : filter engine when ``filt`` is not supplied.
+
+    Returns
+    -------
+    v : (T, n_obs) innovations, NaN where no observation is present.
+    f : (T, n_obs) innovation variances, NaN at the same positions.
+    """
+    if filt is None:
+        filt = kalman_filter(ss, y, mask, engine=engine)
+    pred_means, pred_vars = project(ss.z, filt.mean_p, filt.cov_p)
+    f = pred_vars + ss.r
+    v = y - pred_means
+    if standardized:
+        v = v / jnp.sqrt(jnp.maximum(f, jnp.finfo(f.dtype).tiny))
+    nan = jnp.asarray(jnp.nan, v.dtype)
+    return jnp.where(mask, v, nan), jnp.where(mask, f, nan)
+
+
 @jax.jit
 def project(
     z: jnp.ndarray, means: jnp.ndarray, covs: jnp.ndarray

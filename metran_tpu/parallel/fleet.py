@@ -1206,6 +1206,40 @@ def fleet_forecast(
     return _run_chunked(run, params, fleet, batch_chunk, extras=(t_last,))
 
 
+def fleet_innovations(
+    params: jnp.ndarray,
+    fleet: Fleet,
+    standardized: bool = True,
+    engine: str = "joint",
+    batch_chunk: Optional[int] = None,
+):
+    """One-step-ahead innovations for every fleet member.
+
+    The fleet analog of :meth:`Metran.get_innovations` (see
+    :func:`metran_tpu.ops.innovations`; the reference exposes no
+    residual diagnostic at all).  Returns ``(v, f)`` of shape
+    (B, T, N): residuals and their predicted variances, NaN at
+    masked/padded positions.  Chunking semantics are those of
+    :func:`fleet_simulate`.
+    """
+    run = _make_innovations_runner(engine, bool(standardized))
+    return _run_chunked(run, params, fleet, batch_chunk)
+
+
+@functools.lru_cache(maxsize=16)
+def _make_innovations_runner(engine, standardized):
+    from ..ops import innovations as _innovations
+
+    def one(p, y, mask, loadings, dt):
+        n = loadings.shape[0]
+        ss = dfm_statespace(p[:n], p[n:], loadings, dt)
+        return _innovations(
+            ss, y, mask, standardized=standardized, engine=engine
+        )
+
+    return jax.jit(jax.vmap(one))
+
+
 @functools.lru_cache(maxsize=16)
 def _make_forecast_runner(engine, steps):
     from ..ops import kalman_filter
