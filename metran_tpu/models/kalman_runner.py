@@ -113,15 +113,16 @@ class KalmanRunner:
         )
         return np.asarray(means), np.asarray(variances)
 
-    def innovations(self, standardized: bool = True):
+    def innovations(self, standardized: bool = True, warmup: int = 0):
         """One-step-ahead prediction residuals
         (:func:`metran_tpu.ops.innovations`), reusing the cached filter
-        pass; NaN where no observation is present."""
+        pass; NaN where no observation is present or within the first
+        ``warmup`` steps."""
         from ..ops import innovations as _innovations
 
         v, f = _innovations(
             self.ss, self.y, self.mask, filt=self.run_filter(),
-            standardized=standardized,
+            standardized=standardized, warmup=int(warmup),
         )
         return np.asarray(v), np.asarray(f)
 

@@ -528,7 +528,9 @@ class Metran:
         sim.columns = ["mean", "lower", "upper"]
         return sim
 
-    def get_innovations(self, p=None, standardized: bool = True) -> DataFrame:
+    def get_innovations(
+        self, p=None, standardized: bool = True, warmup: int = 0
+    ) -> DataFrame:
         """One-step-ahead prediction residuals per series.
 
         The whiteness diagnostic for the fitted model (no reference
@@ -547,9 +549,15 @@ class Metran:
             ``False``, residuals are in standardized-observation units
             (the units the filter runs in; multiply by
             ``oseries_std`` for the original units).
+        warmup : NaN out the first ``warmup`` timesteps.  The filter
+            starts from mean 0 / covariance I rather than the
+            stationary prior, so the earliest dates can sit outside
+            the N(0, 1) band purely from the initialization transient
+            (a stretch of the order of the longest ``alpha`` time
+            scale); pass e.g. ``warmup=50`` when that matters.
         """
         self._run_kalman("filter", p=p)
-        v, _ = self.kf.innovations(standardized=standardized)
+        v, _ = self.kf.innovations(standardized=standardized, warmup=warmup)
         return DataFrame(v, index=self.oseries.index, columns=self.oseries.columns)
 
     def _forecast_moments(self, steps, p=None, standardized=False):

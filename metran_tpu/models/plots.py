@@ -12,13 +12,18 @@ the code is organized around small layout/style helpers (`_stack`,
 
 from __future__ import annotations
 
+from logging import getLogger
+
 from typing import List, NamedTuple, Optional, Sequence, Tuple
 
 import matplotlib.pyplot as plt
 import numpy as np
 from pandas import DataFrame, Timestamp
+from scipy.stats import norm
 
 from ..utils import get_height_ratios
+
+logger = getLogger(__name__)
 
 _PANEL_W = 10.0  # house figure width
 _PANEL_H = 2.0  # per-panel height in stacked figures
@@ -183,6 +188,50 @@ class MetranPlot:
         ax.plot(obs.index, obs, ls="none", marker=".", ms=3, color="k",
                 label="observations")
         ax.axvline(obs.index[-1], color="k", lw=0.8, ls=":")
+        _decorate(ax)
+        if fig is not None:
+            fig.tight_layout()
+        return ax
+
+    def innovations(self, name=None, alpha=0.05, tmin=None, tmax=None,
+                    warmup=0, ax=None):
+        """Standardized one-step-ahead innovations with N(0,1) bands.
+
+        No reference counterpart (the reference exposes no residuals):
+        the whiteness diagnostic view of :meth:`Metran.get_innovations`
+        — residual dots for ``name`` (or every series when ``name`` is
+        None) against the two-sided ``alpha`` normal band; points
+        outside the band flag dates the fitted model does not explain
+        at that confidence.  The earliest dates can exceed the band
+        from the filter's initialization transient alone; ``warmup``
+        hides the first that-many steps (see
+        :meth:`Metran.get_innovations`).
+        """
+        innov = self.mt.get_innovations(warmup=warmup)
+        cols = list(innov.columns) if name is None else [name]
+        if any(c not in innov.columns for c in cols):
+            logger.error("Unknown name: %s", name)
+            return None
+        fig = None
+        if ax is None:
+            fig, ax = plt.subplots(figsize=(_PANEL_W, 4))
+        lo, hi = _window(innov.index, tmin, tmax)
+        window = innov.loc[lo:hi]
+        for col in cols:
+            s = window[col].dropna()
+            ax.plot(s.index, s, ls="none", marker=".", ms=3, label=col)
+        if alpha is not None:
+            z = norm.ppf(1 - alpha / 2.0)
+            for b in (-z, z):
+                ax.axhline(b, color="k", lw=0.8, ls=":")
+            if len(window.index):  # empty window: bands only, no label
+                ax.text(
+                    window.index[0], z,
+                    f" ±{z:.2f} ({1 - alpha:.0%} band)",
+                    va="bottom", fontsize=8,
+                )
+        ax.axhline(0.0, color="k", lw=0.8)
+        ax.set_ylabel("standardized innovation")
         _decorate(ax)
         if fig is not None:
             fig.tight_layout()

@@ -383,7 +383,9 @@ def rts_smoother(
     return SmootherResult(mean_s, cov_s)
 
 
-@functools.partial(jax.jit, static_argnames=("standardized", "engine"))
+@functools.partial(
+    jax.jit, static_argnames=("standardized", "engine", "warmup")
+)
 def innovations(
     ss: StateSpace,
     y: jnp.ndarray,
@@ -391,6 +393,7 @@ def innovations(
     filt: Optional[FilterResult] = None,
     standardized: bool = True,
     engine: str = "joint",
+    warmup: int = 0,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """One-step-ahead prediction residuals and their variances.
 
@@ -416,6 +419,15 @@ def innovations(
     standardized : return ``v_t / sqrt(F_t)`` (scale-free) instead of
         raw residuals in observation units.
     engine : filter engine when ``filt`` is not supplied.
+    warmup : NaN out the first ``warmup`` timesteps.  The filter
+        initializes at mean 0 / covariance I rather than the stationary
+        prior, so the earliest standardized residuals are mildly
+        miscalibrated (typically over-dispersed) until the filter
+        forgets the init — a transient of the order of the longest
+        ``alpha`` time scale, NOT the deviance path's ``warmup=1``.
+        Default 0: all steps returned; pass e.g. ``warmup=50`` for
+        calibration-sensitive uses (the whiteness test in
+        ``tests/test_innovations.py`` does exactly this).
 
     Returns
     -------
@@ -429,8 +441,9 @@ def innovations(
     v = y - pred_means
     if standardized:
         v = v / jnp.sqrt(jnp.maximum(f, jnp.finfo(f.dtype).tiny))
+    keep = mask & (jnp.arange(y.shape[0])[:, None] >= warmup)
     nan = jnp.asarray(jnp.nan, v.dtype)
-    return jnp.where(mask, v, nan), jnp.where(mask, f, nan)
+    return jnp.where(keep, v, nan), jnp.where(keep, f, nan)
 
 
 @jax.jit

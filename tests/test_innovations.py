@@ -64,10 +64,12 @@ def test_innovations_white_on_true_model(rng, missing):
     serially uncorrelated — the calibration that makes them a
     diagnostic."""
     ss, y, mask = _model_data(rng, t=3000, missing=missing)
-    v, _ = innovations(ss, y, mask, standardized=True)
-    # drop the spin-up: the filter initializes at mean 0 / cov I, not
-    # the stationary prior, so early steps are mildly miscalibrated
-    v = np.asarray(v)[100:]
+    # warmup drops the spin-up: the filter initializes at mean 0 /
+    # cov I, not the stationary prior, so early steps are mildly
+    # miscalibrated (the parameter exists for exactly this use)
+    v, _ = innovations(ss, y, mask, standardized=True, warmup=100)
+    v = np.asarray(v)
+    assert np.isnan(v[:100]).all()
     flat = v[np.isfinite(v)]
     assert abs(flat.mean()) < 0.05
     assert abs(flat.std() - 1.0) < 0.05

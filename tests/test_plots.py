@@ -121,3 +121,23 @@ def test_forecast_plot(mt):
 def test_forecast_plot_no_ci(mt):
     ax = mt.plots.forecast(mt.snames[0], steps=10, alpha=None)
     assert len(ax.collections) == 0
+
+
+def test_innovations_plot(mt):
+    ax = mt.plots.innovations(mt.snames[0])
+    # one residual dot series + two band lines + the zero line
+    assert len(ax.lines) == 4
+    assert ax.get_ylabel() == "standardized innovation"
+
+
+def test_innovations_plot_all_series_no_band(mt):
+    ax = mt.plots.innovations(alpha=None)
+    # one dot series per observed series + the zero line, no band
+    assert len(ax.lines) == mt.nseries + 1
+    assert mt.plots.innovations("nope") is None
+
+
+def test_innovations_plot_empty_window(mt):
+    # a window past the data must not crash (band label is skipped)
+    ax = mt.plots.innovations(mt.snames[0], tmin="2100-01-01")
+    assert len(ax.texts) == 0
