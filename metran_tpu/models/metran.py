@@ -560,6 +560,38 @@ class Metran:
         v, _ = self.kf.innovations(standardized=standardized, warmup=warmup)
         return DataFrame(v, index=self.oseries.index, columns=self.oseries.columns)
 
+    def test_whiteness(
+        self, p=None, lags: int = 20, warmup: int = 50,
+        alpha: float = 0.05, n_params: int = 0,
+    ) -> DataFrame:
+        """Ljung-Box whiteness test on the standardized innovations.
+
+        The quantitative companion of :meth:`get_innovations` /
+        ``plots.innovations`` (no reference equivalent): one row per
+        series with the portmanteau Q statistic over ``lags`` lags, its
+        p-value, and the boolean verdict at ``alpha``.  A False
+        ``white`` flags serial structure the fitted model does not
+        capture in that series.  ``warmup`` (default 50) excludes the
+        filter's initialization transient; ``n_params`` optionally
+        corrects the degrees of freedom for fitted parameters (see
+        :func:`metran_tpu.diagnostics.ljung_box`).
+        """
+        from ..diagnostics import whiteness_table
+
+        innov = self.get_innovations(p=p, warmup=warmup)
+        table = whiteness_table(
+            innov, lags=lags, n_params=n_params, alpha=alpha
+        )
+        # nullable boolean: <NA> means "not testable", which is
+        # neither passing nor failing
+        failing = [str(s) for s in table.index[table["white"].eq(False).fillna(False)]]
+        if failing:
+            logger.info(
+                "Ljung-Box rejects whiteness at alpha=%g for: %s",
+                alpha, ", ".join(failing),
+            )
+        return table
+
     def _forecast_moments(self, steps, p=None, standardized=False):
         self._run_kalman("filter", p=p)
         if standardized:

@@ -383,9 +383,7 @@ def rts_smoother(
     return SmootherResult(mean_s, cov_s)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("standardized", "engine", "warmup")
-)
+@functools.partial(jax.jit, static_argnames=("standardized", "engine"))
 def innovations(
     ss: StateSpace,
     y: jnp.ndarray,
@@ -427,7 +425,8 @@ def innovations(
         ``alpha`` time scale, NOT the deviance path's ``warmup=1``.
         Default 0: all steps returned; pass e.g. ``warmup=50`` for
         calibration-sensitive uses (the whiteness test in
-        ``tests/test_innovations.py`` does exactly this).
+        ``tests/test_innovations.py`` does exactly this).  Traced, not
+        static: sweeping warmup values does not recompile.
 
     Returns
     -------

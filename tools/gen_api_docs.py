@@ -45,6 +45,7 @@ MODULES = [
     "metran_tpu.parallel.mesh",
     "metran_tpu.parallel.sweep",
     "metran_tpu.data",
+    "metran_tpu.diagnostics",
     "metran_tpu.io",
     "metran_tpu.config",
     "metran_tpu.native",
