@@ -100,6 +100,28 @@ def ljung_box(
     return LjungBoxResult(q, pv, dof, nobs)
 
 
+def fleet_whiteness(
+    v, lags: int = 20, n_params: int = 0
+) -> LjungBoxResult:
+    """Ljung-Box over a fleet of innovation panels.
+
+    ``v`` is the (B, T, N) residual array — the FIRST element of the
+    ``(v, f)`` pair :func:`metran_tpu.parallel.fleet_innovations`
+    returns (standardized, NaN at missing/padded positions).  Returns
+    a :class:`LjungBoxResult` whose arrays have shape (B, N) — one
+    verdict per model and series.
+    Padded series slots are all-NaN and come back NaN (untestable),
+    matching the fleet padding convention.
+    """
+    v = np.asarray(v, float)
+    if v.ndim != 3:
+        raise ValueError(f"expected (B, T, N) innovations, got {v.shape}")
+    b, t, n = v.shape
+    flat = np.moveaxis(v, 1, 0).reshape(t, b * n)
+    res = ljung_box(flat, lags=lags, n_params=n_params)
+    return LjungBoxResult(*(a.reshape(b, n) for a in res))
+
+
 def whiteness_table(
     innovations_frame, lags: int = 20, n_params: int = 0,
     alpha: float = 0.05,

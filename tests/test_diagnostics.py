@@ -105,3 +105,29 @@ def test_metran_test_whiteness_detects_basin_failure(rng):
     assert good["white"].all()
     wt = whiteness_table(mt.get_innovations(warmup=30), lags=10)
     np.testing.assert_allclose(wt["Q"], good["Q"])
+
+
+def test_fleet_whiteness(rng):
+    from metran_tpu.diagnostics import fleet_whiteness
+
+    b, t, n = 3, 800, 2
+    v = rng.normal(size=(b, t, n))
+    v[:, :, :][rng.uniform(size=v.shape) < 0.15] = np.nan
+    # model 1 series 0: strong AR(1) -> must be flagged
+    phi = 0.7
+    for i in range(1, t):
+        if np.isfinite(v[1, i, 0]) and np.isfinite(v[1, i - 1, 0]):
+            v[1, i, 0] = phi * v[1, i - 1, 0] + np.sqrt(1 - phi**2) * v[1, i, 0]
+    # model 2 series 1: padded slot (all NaN) -> untestable
+    v[2, :, 1] = np.nan
+    res = fleet_whiteness(v, lags=10)
+    assert res.q.shape == (b, n)
+    assert res.pvalue[1, 0] < 1e-4          # the planted AR structure
+    assert np.isnan(res.pvalue[2, 1])       # padded slot untestable
+    white = np.delete(res.pvalue.ravel(), [1 * n + 0, 2 * n + 1])
+    assert (white > 0.01).all()             # everything else passes
+    # agrees with the per-series path
+    single = ljung_box(v[0], lags=10)
+    np.testing.assert_allclose(res.q[0], single.q)
+    with pytest.raises(ValueError):
+        fleet_whiteness(v[0], lags=10)

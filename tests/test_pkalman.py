@@ -110,12 +110,14 @@ def test_parallel_smoother_matches_sequential(ssm):
     np.testing.assert_allclose(np.asarray(got.mean_s), mean_np, atol=1e-8)
 
 
-def test_parallel_gradient_matches_sequential(ssm):
+def check_parallel_gradient_matches_sequential():
     """Autodiff through the associative scan agrees with the sequential
     engine's gradient (both exact)."""
     from metran_tpu.ops import dfm_statespace
 
-    _, y, mask = ssm
+    rng = np.random.default_rng(42)
+    _, y, mask = random_ssm(rng, n_series=5, n_factors=2, t=120,
+                            missing=0.3)
     rng = np.random.default_rng(7)
     n, k = 5, 2
     loadings = jnp.asarray(rng.uniform(0.3, 0.8, (n, k)) / np.sqrt(k))
@@ -128,6 +130,23 @@ def test_parallel_gradient_matches_sequential(ssm):
     g_seq = jax.grad(lambda a: dev(a, "sequential"))(alpha)
     g_par = jax.grad(lambda a: dev(a, "parallel"))(alpha)
     np.testing.assert_allclose(np.asarray(g_par), np.asarray(g_seq), rtol=1e-7)
+
+
+def test_parallel_gradient_matches_sequential():
+    """Subprocess-isolated: the grad-of-associative-scan compile is
+    among the suite's largest and hit the known XLA:CPU late-compile
+    segfault when suite growth shifted it later in the process's
+    compile order (round 4, main-process crash at 41% of the suite;
+    see run_python_subprocess)."""
+    from tests.conftest import run_python_subprocess
+
+    res = run_python_subprocess("""
+import tests.test_pkalman as tp
+tp.check_parallel_gradient_matches_sequential()
+print("PAR_GRAD_OK")
+""")
+    assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
+    assert "PAR_GRAD_OK" in res.stdout
 
 
 def check_sequence_sharded_matches_unsharded():
