@@ -777,6 +777,28 @@ class Metran:
         if not success:
             logger.warning("Model parameters could not be estimated well.")
 
+        # basin-failure guard: from some starting points (notably the
+        # constant init on panels whose specific parts are near-white)
+        # L-BFGS slides EVERY alpha to the lower bound, a local optimum
+        # where the model explains nothing — innovations then inherit
+        # the data's full autocorrelation (tests/test_diagnostics.py
+        # reproduces this).  Detectable, so say it.
+        opt = np.asarray(optimal, float)
+        if np.isfinite(opt).all() and (opt < 0.1).all():
+            remedy = (
+                "Retry with solve(init='autocorr') (data-driven "
+                "starting point)"
+                if init != "autocorr" else
+                "The data-driven init also landed here — try explicit "
+                "initial values (parameters['initial']) or a different "
+                "solver"
+            )
+            logger.warning(
+                "All AR time scales collapsed to the lower bound — this "
+                "is typically a local optimum where the model explains "
+                "nothing.  %s, and check test_whiteness().", remedy,
+            )
+
         if report:
             output = report if isinstance(report, str) else "full"
             print("\n" + self.fit_report(output=output))

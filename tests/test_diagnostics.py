@@ -131,3 +131,23 @@ def test_fleet_whiteness(rng):
     np.testing.assert_allclose(res.q[0], single.q)
     with pytest.raises(ValueError):
         fleet_whiteness(v[0], lags=10)
+
+
+def test_solve_warns_on_alpha_collapse(rng, caplog):
+    """The basin-failure guard: a solve that slides every alpha to the
+    lower bound logs the collapsed-fit warning with the remedy; the
+    autocorr-init re-solve does not."""
+    import logging
+
+    from test_forecast import _small_model
+
+    mt = _small_model(rng, n=3, t=400, missing=0.1)
+    with caplog.at_level(logging.WARNING, logger="metran_tpu.models.metran"):
+        mt.solve(report=False)
+    assert any("collapsed to the lower bound" in r.message
+               for r in caplog.records)
+    caplog.clear()
+    with caplog.at_level(logging.WARNING, logger="metran_tpu.models.metran"):
+        mt.solve(report=False, init="autocorr")
+    assert not any("collapsed to the lower bound" in r.message
+                   for r in caplog.records)
