@@ -856,7 +856,9 @@ class Metran:
             "AIC": f"{self.fit.aic:.2f}",
             "": "",
         }
-        parameters = self.parameters.loc[:, ["optimal", "stderr", "initial", "vary"]].copy()
+        parameters = self.parameters.loc[
+            :, ["optimal", "stderr", "initial", "vary"]
+        ].copy()
         stderr_pct = parameters["stderr"] / parameters["optimal"]
         parameters["stderr"] = "-"
         parameters.loc[parameters["vary"].astype(bool), "stderr"] = (
@@ -923,7 +925,9 @@ class Metran:
         transition = DataFrame(np.array([phi, q]).T, index=names, columns=["phi", "q"])
         idx_width = max(len(n) for n in transition.index)
 
-        communality = Series(self.get_communality(), index=self.oseries.columns, name="")
+        communality = Series(
+            self.get_communality(), index=self.oseries.columns, name=""
+        )
         communality.index = [str(i).ljust(idx_width) for i in communality.index]
         communality = communality.apply("{:.2%}".format).to_frame()
 
