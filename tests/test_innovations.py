@@ -132,3 +132,20 @@ def test_fleet_innovations_matches_single(rng):
         np.testing.assert_allclose(
             np.asarray(f_b)[i], np.asarray(f1), rtol=1e-5, atol=1e-8
         )
+
+
+def test_innovations_engine_parity(rng):
+    """All three filter engines yield the same predicted moments, so
+    innovations must agree to f64 tolerance across engines."""
+    ss, y, mask = _model_data(rng, t=150, missing=0.3)
+    v_seq, f_seq = innovations(ss, y, mask, engine="sequential")
+    for engine in ("joint", "parallel"):
+        v_e, f_e = innovations(ss, y, mask, engine=engine)
+        m = np.isfinite(np.asarray(v_seq))
+        np.testing.assert_allclose(
+            np.asarray(v_e)[m], np.asarray(v_seq)[m], atol=1e-8
+        )
+        np.testing.assert_allclose(
+            np.asarray(f_e)[m], np.asarray(f_seq)[m], atol=1e-8
+        )
+        assert (np.isfinite(np.asarray(v_e)) == m).all()
