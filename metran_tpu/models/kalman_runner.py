@@ -126,6 +126,21 @@ class KalmanRunner:
         )
         return np.asarray(v), np.asarray(f)
 
+    def sample_states(self, key, n_draws: int, draw_chunk: int = 8):
+        """Joint posterior state-path draws
+        (:func:`metran_tpu.ops.sample_states`), reusing the cached
+        smoother pass for the data side; the parallel engine falls back
+        to "joint" for the per-draw passes (identical results, without
+        the associative scan's compile cost per draw)."""
+        from ..ops import sample_states as _sample_states
+
+        engine = self.engine if self.engine != "parallel" else "joint"
+        return np.asarray(_sample_states(
+            self.ss, self.y, self.mask, key, n_draws=n_draws,
+            engine=engine, sm_data=self.run_smoother().mean_s,
+            draw_chunk=draw_chunk,
+        ))
+
     def decompose(self, observation_matrix, method: str = "smoother"):
         means, _ = self._states(method)
         sdf, cdf = decompose_states(

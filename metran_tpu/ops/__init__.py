@@ -12,6 +12,7 @@ from .kalman import (
     log_likelihood,
     project,
     rts_smoother,
+    sample_states,
 )
 from .forecast import (
     forecast_observation_moments,
@@ -51,6 +52,7 @@ __all__ = [
     "parallel_filter",
     "parallel_smoother",
     "project",
+    "sample_states",
     "sequence_sharded_filter",
     "rts_smoother",
     "scale_observation_matrix",

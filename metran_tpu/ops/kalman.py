@@ -32,6 +32,7 @@ from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from .statespace import StateSpace
@@ -381,6 +382,102 @@ def rts_smoother(
     mean_s = jnp.concatenate([means, mean_f[-1:]], axis=0)
     cov_s = jnp.concatenate([covs, cov_f[-1:]], axis=0)
     return SmootherResult(mean_s, cov_s)
+
+
+def sample_states(
+    ss: StateSpace,
+    y: jnp.ndarray,
+    mask: jnp.ndarray,
+    key,
+    n_draws: int = 1,
+    engine: str = "joint",
+    sm_data: Optional[jnp.ndarray] = None,
+    draw_chunk: int = 8,
+) -> jnp.ndarray:
+    """Joint posterior draws of the state paths (simulation smoother).
+
+    The RTS smoother returns per-timestep marginals; for stochastic
+    gap filling or any functional of a whole path, the *joint*
+    posterior is what matters.  This is the Durbin-Koopman
+    mean-correction simulation smoother: draw an unconditional state
+    path ``x*`` from the model's own prior (the filter's pre-sample
+    ``N(0, I)``, then ``x_t = phi x_{t-1} + w_t`` with the DFM's
+    diagonal ``Q``), build its pseudo-observations ``y* = Z x*`` (plus
+    measurement noise when ``r > 0``) ON THE SAME missing pattern,
+    smooth both, and return ``m_s(y) + (x* - m_s(y*))`` — exactly
+    distributed as ``x | y`` because ``x* - m_s(y*)`` has the posterior
+    covariance and zero mean, independent of the data.  One smoothing
+    of the data is shared; each draw adds one filter+smoother pass, and
+    draws ride ``vmap``.  No reference counterpart (the reference has
+    no sampling at all).
+
+    ``sm_data`` optionally supplies the precomputed smoothed state
+    means of the data (``rts_smoother(...).mean_s``) so a caller with a
+    cached smoother pass does not pay it again.  Draws are evaluated in
+    ``draw_chunk``-sized vmapped batches (``lax.map``): peak memory is
+    O(draw_chunk · T · n²) filter/smoother moments, not O(n_draws · …).
+
+    Returns (n_draws, T, n_state).  With ``r = 0`` the projection
+    ``Z x`` of every draw reproduces the observed entries exactly —
+    draws only spread where the data has gaps.
+
+    The process-noise draw is elementwise, exploiting the DFM's
+    diagonal ``Q`` (ops/statespace.py); a non-diagonal ``Q`` would make
+    the returned "posterior" silently mis-correlated, so concrete
+    non-diagonal inputs are rejected loudly.
+    """
+    q = ss.q
+    if not isinstance(q, jax.core.Tracer):
+        q_np = np.asarray(q)
+        if np.abs(q_np - np.diag(np.diagonal(q_np))).max() > 0.0:
+            raise ValueError(
+                "sample_states draws process noise elementwise and "
+                "requires a diagonal transition covariance Q (the DFM "
+                "builder's form); got off-diagonal entries"
+            )
+    return _sample_states(
+        ss, y, mask, key, sm_data, n_draws=int(n_draws), engine=engine,
+        draw_chunk=max(1, min(int(draw_chunk), int(n_draws))),
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_draws", "engine", "draw_chunk")
+)
+def _sample_states(ss, y, mask, key, sm_data, *, n_draws, engine,
+                   draw_chunk):
+    dtype = ss.q.dtype
+    y = jnp.asarray(y, dtype)
+    mask = jnp.asarray(mask, bool)
+    t_steps, n = y.shape[0], ss.phi.shape[0]
+    if sm_data is None:
+        sm_data = rts_smoother(
+            ss, kalman_filter(ss, y, mask, engine=engine), engine=engine
+        ).mean_s
+    # clip guards exact-zero variances (communality 1) against -0.0
+    q_sd = jnp.sqrt(jnp.clip(jnp.diagonal(ss.q), 0.0))
+    r_sd = jnp.sqrt(jnp.clip(ss.r, 0.0))
+
+    def one(k):
+        k0, kw, ke = jax.random.split(k, 3)
+        x0 = jax.random.normal(k0, (n,), dtype)
+        w = jax.random.normal(kw, (t_steps, n), dtype) * q_sd
+
+        def step(x, w_t):
+            x = ss.phi * x + w_t
+            return x, x
+
+        _, xs = lax.scan(step, x0, w)
+        y_star = xs @ ss.z.T + jax.random.normal(ke, y.shape, dtype) * r_sd
+        sm_star = rts_smoother(
+            ss, kalman_filter(ss, y_star, mask, engine=engine),
+            engine=engine,
+        ).mean_s
+        return sm_data + xs - sm_star
+
+    return lax.map(
+        one, jax.random.split(key, n_draws), batch_size=draw_chunk
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("standardized", "engine"))
