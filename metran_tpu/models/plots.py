@@ -237,6 +237,40 @@ class MetranPlot:
             fig.tight_layout()
         return ax
 
+    def sample_paths(self, name, n_draws=32, seed=0, tmin=None, tmax=None,
+                     ax=None):
+        """Joint posterior path fan for one series with observations.
+
+        No reference counterpart (the reference has no sampling): thin
+        overlaid draws from :meth:`Metran.sample_simulation` — each
+        passes exactly through the observed dots and spreads only in
+        the gaps, so a masked stretch shows the genuine joint
+        uncertainty of the reconstruction (unlike the marginal CI
+        band, neighboring dates within one path move together).
+        """
+        paths = self.mt.sample_simulation(name, n_draws=n_draws, seed=seed)
+        if paths is None:
+            return None
+        obs = self.mt.get_observations(
+            masked=self.mt.masked_observations is not None,
+        )[name]
+        fig = None
+        if ax is None:
+            fig, ax = plt.subplots(figsize=(_PANEL_W, 4))
+        lo, hi = _window(paths.index, tmin, tmax)
+        window = paths.loc[lo:hi]
+        ax.plot(window.index, window.to_numpy(), color="C0", lw=0.6,
+                alpha=0.25)
+        ax.plot([], [], color="C0", lw=1.2,
+                label=f"{n_draws} posterior paths {name}")
+        obs = obs.loc[lo:hi]
+        ax.plot(obs.index, obs, ls="none", marker=".", ms=3, color="k",
+                label="observations")
+        _decorate(ax)
+        if fig is not None:
+            fig.tight_layout()
+        return ax
+
     def simulations(self, alpha=0.05, tmin=None, tmax=None):
         """One simulation panel per observed series, shared axes."""
         def draw(name, ax):

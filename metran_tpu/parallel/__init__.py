@@ -12,8 +12,8 @@ package is the new design surface that scales Metran to TPU pods:
 - :func:`multistart_fit_fleet` — multi-start basin search with the extra
   starts riding the lane axis;
 - :func:`fleet_stderr` / :func:`fleet_simulate` / :func:`fleet_decompose`
-  / :func:`fleet_forecast` / :func:`fleet_innovations` — batched
-  post-fit inference products;
+  / :func:`fleet_forecast` / :func:`fleet_innovations` /
+  :func:`fleet_sample` — batched post-fit inference products;
 - :func:`sweep_fit` — populations larger than one device batch: a
   sequence of bounded :func:`fit_fleet` calls with prefetch overlap of
   host data work and per-batch checkpoint/resume;
@@ -35,6 +35,7 @@ from .fleet import (
     fleet_deviance,
     fleet_forecast,
     fleet_innovations,
+    fleet_sample,
     fleet_simulate,
     fleet_stderr,
     fleet_value_and_grad,
@@ -68,6 +69,7 @@ __all__ = [
     "fleet_deviance",
     "fleet_forecast",
     "fleet_innovations",
+    "fleet_sample",
     "fleet_simulate",
     "fleet_stderr",
     "fleet_value_and_grad",

@@ -1226,6 +1226,62 @@ def fleet_innovations(
     return _run_chunked(run, params, fleet, batch_chunk)
 
 
+def fleet_sample(
+    params: jnp.ndarray,
+    fleet: Fleet,
+    n_draws: int = 16,
+    seed: int = 0,
+    engine: str = "joint",
+    batch_chunk: Optional[int] = None,
+    draw_chunk: int = 8,
+    project: bool = True,
+):
+    """Joint posterior path draws for every fleet member.
+
+    The fleet analog of :meth:`Metran.sample_simulation`
+    (:func:`metran_tpu.ops.sample_states` — Durbin-Koopman simulation
+    smoother; the reference has no sampling).  Each member gets an
+    independent key derived from ``seed``.  Returns observation-space
+    draws (B, n_draws, T, N) in standardized units when ``project``
+    (each path passes exactly through that member's observed entries),
+    or state draws (B, n_draws, T, n_state) when ``project=False``.
+    Padded members/slots produce prior draws (nothing to condition on)
+    — slice them off as with the other products.  Chunking semantics
+    are those of :func:`fleet_simulate`; memory adds a factor
+    ``draw_chunk`` of live filter/smoother moments per member.
+    """
+    run = _make_sample_runner(
+        engine, int(n_draws), int(draw_chunk), bool(project)
+    )
+    keys = jax.random.split(
+        jax.random.PRNGKey(int(seed)), fleet.batch
+    )
+    (draws,) = _run_chunked(
+        run, params, fleet, batch_chunk, extras=(keys,)
+    )
+    return draws
+
+
+@functools.lru_cache(maxsize=16)
+def _make_sample_runner(engine, n_draws, draw_chunk, project):
+    from ..ops.kalman import _sample_states
+
+    def one(p, y, mask, loadings, dt, key):
+        n = loadings.shape[0]
+        # dfm_statespace emits diagonal Q by construction, which the
+        # elementwise process-noise draw in _sample_states requires
+        ss = dfm_statespace(p[:n], p[n:], loadings, dt)
+        xs = _sample_states(
+            ss, y, mask, key, None, n_draws=n_draws, engine=engine,
+            draw_chunk=draw_chunk,
+        )
+        # 1-tuple: _run_chunked concatenates per-output, and a bare
+        # array would be iterated over its first axis
+        return (xs @ ss.z.T if project else xs,)
+
+    return jax.jit(jax.vmap(one))
+
+
 @functools.lru_cache(maxsize=16)
 def _make_innovations_runner(engine, standardized):
     from ..ops import innovations as _innovations

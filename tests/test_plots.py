@@ -141,3 +141,10 @@ def test_innovations_plot_empty_window(mt):
     # a window past the data must not crash (band label is skipped)
     ax = mt.plots.innovations(mt.snames[0], tmin="2100-01-01")
     assert len(ax.texts) == 0
+
+
+def test_sample_paths_plot(mt):
+    ax = mt.plots.sample_paths(mt.snames[0], n_draws=8)
+    # 8 path lines + 1 legend proxy + observation dots
+    assert len(ax.lines) == 10
+    assert mt.plots.sample_paths("nope") is None
