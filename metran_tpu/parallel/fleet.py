@@ -1251,7 +1251,9 @@ def fleet_sample(
     ``draw_chunk`` of live filter/smoother moments per member.
     """
     run = _make_sample_runner(
-        engine, int(n_draws), int(draw_chunk), bool(project)
+        engine, int(n_draws),
+        max(1, min(int(draw_chunk), int(n_draws))),  # same clamp as
+        bool(project),                               # sample_states
     )
     keys = jax.random.split(
         jax.random.PRNGKey(int(seed)), fleet.batch
