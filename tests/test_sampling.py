@@ -4,11 +4,19 @@ Sharp exactness checks: with the DFM's zero observation noise the
 projection of every draw must reproduce the observed entries exactly
 and spread only in the gaps; across many draws the sample mean and
 per-timestep variance must match the RTS smoother's marginals.
+
+The compile-heavy checks run in ONE subprocess-isolated bundle: the
+sampler's filter+smoother-under-``lax.map`` program hit the known
+XLA:CPU late-compile segfault when it compiled after hundreds of prior
+suite compilations (round 4, crash in ``test_draws_reproduce_observed_
+exactly`` during the full-suite run while the same test passes alone —
+see ``run_python_subprocess``).
 """
 
+import numpy as np
 import jax
 import jax.numpy as jnp
-import numpy as np
+import pytest
 
 from metran_tpu.ops import (
     kalman_filter,
@@ -16,10 +24,11 @@ from metran_tpu.ops import (
     sample_states,
 )
 
-from test_innovations import _model_data
+from tests.test_innovations import _model_data
 
 
-def test_draws_reproduce_observed_exactly(rng):
+def check_draws_reproduce_observed_exactly():
+    rng = np.random.default_rng(42)
     ss, y, mask = _model_data(rng, n=4, k=1, t=200, missing=0.3)
     draws = sample_states(ss, y, mask, jax.random.PRNGKey(0), n_draws=8)
     proj = np.asarray(draws @ ss.z.T)  # (draws, T, N)
@@ -32,7 +41,8 @@ def test_draws_reproduce_observed_exactly(rng):
     assert (gap_spread > 1e-4).mean() > 0.9
 
 
-def test_draw_moments_match_smoother_marginals(rng):
+def check_draw_moments_match_smoother_marginals():
+    rng = np.random.default_rng(42)
     ss, y, mask = _model_data(rng, n=3, k=1, t=150, missing=0.4)
     n_draws = 400
     draws = np.asarray(
@@ -53,19 +63,32 @@ def test_draw_moments_match_smoother_marginals(rng):
     assert (np.abs(rel - 1.0) < 0.6).mean() > 0.99
 
 
-def test_determinism_and_seed_variation(rng):
+def check_determinism_seed_variation_and_chunking():
+    rng = np.random.default_rng(42)
     ss, y, mask = _model_data(rng, n=3, k=1, t=60, missing=0.2)
-    a = sample_states(ss, y, mask, jax.random.PRNGKey(7), n_draws=3)
-    b = sample_states(ss, y, mask, jax.random.PRNGKey(7), n_draws=3)
+    key = jax.random.PRNGKey(7)
+    a = sample_states(ss, y, mask, key, n_draws=3)
+    b = sample_states(ss, y, mask, key, n_draws=3)
     c = sample_states(ss, y, mask, jax.random.PRNGKey(8), n_draws=3)
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     assert np.abs(np.asarray(a) - np.asarray(c)).max() > 1e-3
+    # chunked draw evaluation is bit-identical to one vmapped batch,
+    # including a non-divisible chunk, and the precomputed-sm_data path
+    key = jax.random.PRNGKey(5)
+    a = sample_states(ss, y, mask, key, n_draws=7, draw_chunk=2)
+    b = sample_states(ss, y, mask, key, n_draws=7, draw_chunk=7)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-10)
+    sm = rts_smoother(ss, kalman_filter(ss, y, mask, engine="joint"))
+    c = sample_states(ss, y, mask, key, n_draws=7, sm_data=sm.mean_s)
+    np.testing.assert_allclose(np.asarray(c), np.asarray(a), atol=1e-10)
 
 
-def test_metran_sample_simulation(rng):
-    from test_forecast import _small_model
+def check_metran_sample_simulation():
+    from numpy.random import default_rng
 
-    mt = _small_model(rng, n=3, t=120, missing=0.2)
+    from tests.test_forecast import _small_model
+
+    mt = _small_model(default_rng(42), n=3, t=120, missing=0.2)
     name = "s1"
     paths = mt.sample_simulation(name, n_draws=16, seed=3)
     obs = mt.get_observations()[name]
@@ -85,33 +108,11 @@ def test_metran_sample_simulation(rng):
     assert mt.sample_simulation("nope") is None
 
 
-def test_nondiagonal_q_rejected(rng):
-    ss, y, mask = _model_data(rng, n=3, k=1, t=40)
-    q = np.asarray(ss.q).copy()
-    q[0, 1] = q[1, 0] = 0.01
-    import pytest
-
-    with pytest.raises(ValueError, match="diagonal"):
-        sample_states(ss._replace(q=jnp.asarray(q)), y, mask,
-                      jax.random.PRNGKey(0), n_draws=2)
-
-
-def test_draw_chunking_matches_unchunked(rng):
-    ss, y, mask = _model_data(rng, n=3, k=1, t=60, missing=0.2)
-    key = jax.random.PRNGKey(5)
-    a = sample_states(ss, y, mask, key, n_draws=7, draw_chunk=2)
-    b = sample_states(ss, y, mask, key, n_draws=7, draw_chunk=7)
-    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-10)
-    # precomputed sm_data path is identical too
-    sm = rts_smoother(ss, kalman_filter(ss, y, mask, engine="joint"))
-    c = sample_states(ss, y, mask, key, n_draws=7, sm_data=sm.mean_s)
-    np.testing.assert_allclose(np.asarray(c), np.asarray(a), atol=1e-10)
-
-
-def test_fleet_sample_matches_single(rng):
+def check_fleet_sample_matches_single():
     from metran_tpu.parallel import fleet_sample
     from metran_tpu.parallel.fleet import Fleet
 
+    rng = np.random.default_rng(42)
     models = [_model_data(rng, n=3, k=1, t=50, missing=0.3)
               for _ in range(3)]
     params = jnp.asarray(np.stack([
@@ -139,3 +140,33 @@ def test_fleet_sample_matches_single(rng):
             np.testing.assert_allclose(
                 np.asarray(draws)[i, d][m], np.asarray(y)[m], atol=1e-6
             )
+
+
+def test_nondiagonal_q_rejected(rng):
+    # host-side guard: raises before any compile, safe to run inline
+    ss, y, mask = _model_data(rng, n=3, k=1, t=40)
+    q = np.asarray(ss.q).copy()
+    q[0, 1] = q[1, 0] = 0.01
+    with pytest.raises(ValueError, match="diagonal"):
+        sample_states(ss._replace(q=jnp.asarray(q)), y, mask,
+                      jax.random.PRNGKey(0), n_draws=2)
+
+
+def test_sampling_suite_subprocess():
+    """All compile-heavy sampling checks in one fresh interpreter (the
+    sampler's compiles land late in a full-suite run and have hit the
+    known XLA:CPU late-compile segfault there)."""
+    from tests.conftest import run_python_subprocess
+
+    res = run_python_subprocess("""
+import tests.conftest  # noqa: F401  (pins cpu + x64 before jax runs)
+import tests.test_sampling as ts
+ts.check_draws_reproduce_observed_exactly()
+ts.check_draw_moments_match_smoother_marginals()
+ts.check_determinism_seed_variation_and_chunking()
+ts.check_metran_sample_simulation()
+ts.check_fleet_sample_matches_single()
+print("SAMPLING_OK")
+""")
+    assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
+    assert "SAMPLING_OK" in res.stdout
