@@ -31,11 +31,14 @@ if not os.environ.get("METRAN_TPU_EXAMPLE_TPU"):
     jax.config.update("jax_enable_x64", True)
 
 from metran_tpu import data as mdata
+from metran_tpu.diagnostics import fleet_whiteness
 from metran_tpu.models.factoranalysis import FactorAnalysis
 from metran_tpu.parallel import (
     autocorr_init_params,
     fit_fleet,
     fleet_forecast,
+    fleet_innovations,
+    fleet_sample,
     fleet_simulate,
     fleet_stderr,
     make_mesh,
@@ -126,6 +129,14 @@ def main():
     fmeans, fvars = fleet_forecast(fit.params, fleet, steps=30,
                                    batch_chunk=8)
     print("forecast grid (models, steps, series):", tuple(fmeans.shape))
+    # adequacy + joint-path products for the whole fleet
+    v, _ = fleet_innovations(fit.params, fleet, batch_chunk=8)
+    wh = fleet_whiteness(np.asarray(v)[:n_models, 50:, :], lags=10)
+    ok = np.isfinite(wh.pvalue)  # padded/untestable cells are NaN
+    frac = float(np.mean(wh.pvalue[ok] >= 0.05))
+    print("whiteness pass fraction (model, series cells):", round(frac, 2))
+    draws = fleet_sample(fit.params, fleet, n_draws=4, batch_chunk=8)
+    print("posterior path draws:", tuple(np.asarray(draws).shape))
     print(
         "median stderr(alpha):",
         float(np.nanmedian(np.asarray(stderr[:n_models]))).__round__(2),
