@@ -824,8 +824,13 @@ class Metran:
         # where the model explains nothing — innovations then inherit
         # the data's full autocorrelation (tests/test_diagnostics.py
         # reproduces this).  Detectable, so say it.
+        # "collapsed" = the AR decay is effectively white at this grid:
+        # phi = exp(-dt/alpha) < e^-10 ~ 5e-5, i.e. alpha < dt/10 — tied
+        # to the actual grid step rather than a fixed constant so the
+        # guard tracks pmin/dt if either changes
         opt = np.asarray(optimal, float)
-        if np.isfinite(opt).all() and (opt < 0.1).all():
+        collapse_thresh = float(self._dt) / 10.0
+        if np.isfinite(opt).all() and (opt < collapse_thresh).all():
             remedy = (
                 "Retry with solve(init='autocorr') (data-driven "
                 "starting point)"

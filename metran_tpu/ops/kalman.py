@@ -427,14 +427,21 @@ def sample_states(
     non-diagonal inputs are rejected loudly.
     """
     q = ss.q
-    if not isinstance(q, jax.core.Tracer):
+    try:
+        # tracers cannot be concretized; skipping the check under a
+        # trace is fine (the DFM builder only emits diagonal Q).  The
+        # try/except avoids touching the internal jax.core namespace.
         q_np = np.asarray(q)
-        if np.abs(q_np - np.diag(np.diagonal(q_np))).max() > 0.0:
-            raise ValueError(
-                "sample_states draws process noise elementwise and "
-                "requires a diagonal transition covariance Q (the DFM "
-                "builder's form); got off-diagonal entries"
-            )
+    except Exception:
+        q_np = None
+    if q_np is not None and np.abs(
+        q_np - np.diag(np.diagonal(q_np))
+    ).max() > 0.0:
+        raise ValueError(
+            "sample_states draws process noise elementwise and "
+            "requires a diagonal transition covariance Q (the DFM "
+            "builder's form); got off-diagonal entries"
+        )
     return _sample_states(
         ss, y, mask, key, sm_data, n_draws=int(n_draws), engine=engine,
         draw_chunk=max(1, min(int(draw_chunk), int(n_draws))),

@@ -81,16 +81,30 @@ def _adj_series_update(carry, xs, dtype):
     return (m, p, sigma, detf), (d, f_safe, v)
 
 
-def _adj_step(phi, q, z, r, carry, y_t, m_t, eye):
-    dtype = phi.dtype
-    b = phi.shape[1]
+def _predict_step(phi, q, carry, eye):
+    """Time-propagate the lane carry: diagonal transition + diagonal Q."""
     mean, cov = carry
     mean_p = phi * mean
     cov_p = phi[:, None, :] * cov * phi[None, :, :] + eye * q[None]
-    (m_f, p_f, sig, det), res = lax.scan(
+    return mean_p, cov_p
+
+
+def _update_scan(z, r, mean_p, cov_p, y_t, m_t, dtype):
+    """Sequential (per-series) measurement update of the predicted lane
+    moments; returns the updated carry with accumulated (sigma, detf) and
+    the per-series (d, f_safe, v) residuals."""
+    b = mean_p.shape[-1]
+    return lax.scan(
         lambda c, xs: _adj_series_update(c, xs, dtype),
         (mean_p, cov_p, jnp.zeros(b, dtype), jnp.zeros(b, dtype)),
         (y_t, m_t, z, r),
+    )
+
+
+def _adj_step(phi, q, z, r, carry, y_t, m_t, eye):
+    mean_p, cov_p = _predict_step(phi, q, carry, eye)
+    (m_f, p_f, sig, det), res = _update_scan(
+        z, r, mean_p, cov_p, y_t, m_t, phi.dtype
     )
     return (m_f, p_f), (sig, det), res
 

@@ -1,0 +1,371 @@
+"""Lane-layout post-fit products: smoother, projections, innovations.
+
+The fit hot path runs in lane layout (:mod:`metran_tpu.ops.lanes`) at
+~50 fits/s/chip; through round 4 the post-fit products (smoother /
+simulate / decompose / innovations) still ran batch-leading and measured
+5-6 models/s on-chip — for a fit+products workflow the products were the
+wall.  This module gives them the same lane treatment as the fit:
+
+- **Smoother**: the Durbin-Koopman *univariate-treatment* backward
+  recursion on the adjoints ``(r_t, N_t)`` (Durbin & Koopman 2012,
+  section 6.4; the sequential-processing dual of the forward filter in
+  ``ops/lanes.py``), NOT the RTS gain form.  The RTS gain needs a
+  per-step (n, n) Cholesky solve, which XLA serializes per model; the
+  D-K recursion is rank-1 elementwise/broadcast updates across the lane
+  axis throughout — nothing the TPU can't tile.  On the same filter it
+  produces the same smoothed moments as the reference's ``kalmansmoother``
+  (``metran/kalmanfilter.py:403-476``); parity vs :func:`ops.rts_smoother`
+  is pinned by tests/test_lanes_products.py.
+- **Memory** follows the adjoint-score discipline of ``ops/lanes.py``:
+  the forward pass stores segment-boundary carries only; the backward
+  replays one segment at a time, so peak residual memory is
+  O(seg * N * n * B) instead of O(T * n^2 * B).
+- **Innovations** use the joint (vector) definition from the
+  time-predicted moments — identical semantics to :func:`ops.innovations`
+  (series-order independent), emitted by a forward-only lane scan.
+
+Shapes follow ops/lanes.py: the fleet axis B is LAST everywhere.
+    phi, q   (n, B)     diagonal transition / process noise
+    z        (N, n, B)  observation rows
+    r        (N, B)     measurement noise
+    y, mask  (T, N, B)  observations / observed flags
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .lanes import (
+    _adj_init_carry,
+    _adj_step,
+    _predict_step,
+    _segment,
+    _update_scan,
+)
+
+
+def _series_bwd(carry, xs, want_cov: bool):
+    """One reverse series update of the D-K adjoints.
+
+    With ``k_i = d_i / f_i`` and ``L_i = I - k_i z_i'``:
+
+        r  <-  z_i v_i / f_i + L_i' r
+        N  <-  z_i z_i' / f_i + L_i' N L_i
+
+    expanded to rank-1 broadcast form (no matmuls):
+
+        L_i' r      = r - z_i (k_i . r)
+        L_i' N L_i  = N - z_i (k'N) - (N k) z_i' + z_i z_i' (k'N k)
+    """
+    r_adj, n_adj = carry
+    d, f, v, z_i, mask_i = xs
+    obs = mask_i > 0
+    k = d / f
+    kr = jnp.sum(k * r_adj, axis=0)  # (B,)
+    r_new = r_adj + z_i * (v / f - kr)
+    r_adj = jnp.where(obs, r_new, r_adj)
+    if want_cov:
+        nk = jnp.sum(n_adj * k[None, :, :], axis=1)  # N k   (n, B)
+        kn = jnp.sum(n_adj * k[:, None, :], axis=0)  # N' k  (n, B)
+        knk = jnp.sum(k * nk, axis=0)  # (B,)
+        n_new = (
+            n_adj
+            - z_i[:, None, :] * kn[None, :, :]
+            - nk[:, None, :] * z_i[None, :, :]
+            + z_i[:, None, :] * z_i[None, :, :] * (knk + 1.0 / f)
+        )
+        n_adj = jnp.where(obs, n_new, n_adj)
+    return (r_adj, n_adj), None
+
+
+def _smooth_emit(phi, z, rn, mean_p, cov_p, want_cov: bool):
+    """Smoothed moments at one timestep from the predicted moments and
+    the post-series adjoints ``r_{t,0} / N_{t,0}``:
+
+        m_s = m_p + P_p r ;  P_s = P_p - P_p N P_p
+
+    emitting the observation-space projections directly (``Z m_s``,
+    ``diag(Z P_s Z')``) so the (n, n, B) smoothed covariance is never
+    materialized across time.  Returns the transitioned adjoints for
+    t-1 plus the per-step outputs."""
+    r_adj, n_adj = rn
+    mean_s = mean_p + jnp.sum(cov_p * r_adj[None, :, :], axis=1)
+    pm = jnp.einsum("iaB,aB->iB", z, mean_s)
+    if want_cov:
+        dp = jnp.einsum("iaB,ajB->ijB", z, cov_p)  # rows Z P_p  (N, n, B)
+        pv = jnp.einsum("ijB,ijB->iB", z, dp) - jnp.einsum(
+            "iaB,abB,ibB->iB", dp, n_adj, dp
+        )
+        pv = jnp.maximum(pv, 0.0)
+    else:
+        pv = jnp.zeros_like(pm)
+    # transition the adjoints across the (diagonal) state recursion
+    r_adj = phi * r_adj
+    if want_cov:
+        n_adj = phi[:, None, :] * n_adj * phi[None, :, :]
+    return (r_adj, n_adj), (mean_s, pm, pv)
+
+
+@functools.partial(jax.jit, static_argnames=("seg", "want_cov"))
+def lanes_smooth(
+    phi: jnp.ndarray,
+    q: jnp.ndarray,
+    z: jnp.ndarray,
+    r: jnp.ndarray,
+    y: jnp.ndarray,
+    mask: jnp.ndarray,
+    seg: int = 100,
+    want_cov: bool = True,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Smoothed states and observation-space projections, lane layout.
+
+    Returns ``(mean_s, proj_mean, proj_var)`` of shapes
+    (T, n, B), (T, N, B), (T, N, B) — the lane analog of
+    ``rts_smoother`` + ``project`` (reference ``kalmansmoother`` +
+    ``simulate``, ``metran/kalmanfilter.py:403-476,569-603``).  With
+    ``want_cov=False`` the N recursion is skipped entirely (about 3x
+    cheaper) and ``proj_var`` is zeros — for consumers that need
+    smoothed means only (decompose, the simulation smoother).
+    """
+    t_steps = y.shape[0]
+    dtype = phi.dtype
+    n, b = phi.shape
+    eye = jnp.eye(n, dtype=dtype)[:, :, None]
+    y_seg, m_seg = _segment(y, mask, seg, dtype)
+    n_seg = y_seg.shape[0]
+
+    # forward: keep segment-boundary carries only
+    def fwd_body(c, xs):
+        def inner(cc, t_xs):
+            cc2, _, _ = _adj_step(phi, q, z, r, cc, *t_xs, eye)
+            return cc2, None
+
+        c2, _ = lax.scan(inner, c, xs)
+        return c2, c
+
+    _, bounds = lax.scan(
+        fwd_body, _adj_init_carry(phi, eye), (y_seg, m_seg)
+    )
+
+    def seg_replay(carry, ys, ms):
+        """Replay one segment, storing per-step predicted moments and
+        series residuals for the backward sweep."""
+
+        def body(c, xs):
+            mean_p, cov_p = _predict_step(phi, q, c, eye)
+            (m_f, p_f, _, _), res = _update_scan(
+                z, r, mean_p, cov_p, *xs, dtype
+            )
+            return (m_f, p_f), (mean_p, cov_p) + res
+
+        return lax.scan(body, carry, (ys, ms))[1]
+
+    def step_bwd(rn, stored, m_t):
+        mean_p, cov_p, d_all, f_all, v_all = stored
+        rn, _ = lax.scan(
+            functools.partial(_series_bwd, want_cov=want_cov),
+            rn,
+            (d_all, f_all, v_all, z, m_t),
+            reverse=True,
+        )
+        return _smooth_emit(phi, z, rn, mean_p, cov_p, want_cov)
+
+    def seg_bwd(rn, seg_idx):
+        stored = seg_replay(
+            jax.tree.map(lambda a: a[seg_idx], bounds),
+            y_seg[seg_idx],
+            m_seg[seg_idx],
+        )
+        m_s = m_seg[seg_idx]
+
+        def body(c, t):
+            return step_bwd(
+                c, jax.tree.map(lambda a: a[t], stored), m_s[t]
+            )
+
+        return lax.scan(body, rn, jnp.arange(seg), reverse=True)
+
+    rn0 = (
+        jnp.zeros((n, b), dtype),
+        # mean-only consumers skip the N recursion: a scalar dummy keeps
+        # the (n, n, B) adjoint out of every scan carry
+        jnp.zeros((n, n, b), dtype) if want_cov
+        else jnp.zeros((), dtype),
+    )
+    _, (mean_s, pm, pv) = lax.scan(
+        seg_bwd, rn0, jnp.arange(n_seg), reverse=True
+    )
+    t_pad = n_seg * seg
+    n_obs = y.shape[1]
+    return (
+        mean_s.reshape(t_pad, n, b)[:t_steps],
+        pm.reshape(t_pad, n_obs, b)[:t_steps],
+        pv.reshape(t_pad, n_obs, b)[:t_steps],
+    )
+
+
+@jax.jit
+def lanes_filter_project(
+    phi: jnp.ndarray,
+    q: jnp.ndarray,
+    z: jnp.ndarray,
+    r: jnp.ndarray,
+    y: jnp.ndarray,
+    mask: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Filtered states and observation-space projections, lane layout.
+
+    Returns ``(mean_f, proj_mean, proj_var)`` — the ``smooth=False``
+    analog of :func:`lanes_smooth` (reference ``simulate`` on the
+    filtered moments).  Forward-only scan, no segment storage."""
+    dtype = phi.dtype
+    n = phi.shape[0]
+    eye = jnp.eye(n, dtype=dtype)[:, :, None]
+    maskf = jnp.asarray(mask, dtype)
+
+    def step(c, xs):
+        c2, _, _ = _adj_step(phi, q, z, r, c, *xs, eye)
+        m_f, p_f = c2
+        pm = jnp.einsum("iaB,aB->iB", z, m_f)
+        pv = jnp.maximum(
+            jnp.einsum("iaB,abB,ibB->iB", z, p_f, z), 0.0
+        )
+        return c2, (m_f, pm, pv)
+
+    _, outs = lax.scan(step, _adj_init_carry(phi, eye), (y, maskf))
+    return outs
+
+
+@functools.partial(jax.jit, static_argnames=("standardized",))
+def lanes_innovations(
+    phi: jnp.ndarray,
+    q: jnp.ndarray,
+    z: jnp.ndarray,
+    r: jnp.ndarray,
+    y: jnp.ndarray,
+    mask: jnp.ndarray,
+    standardized: bool = True,
+    warmup: int = 0,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One-step-ahead innovations in lane layout, (T, N, B).
+
+    Joint (vector) definition from the time-predicted moments —
+    ``v_t = y_t - Z m_{t|t-1}``, ``F_t = diag(Z P_{t|t-1} Z') + r`` —
+    identical semantics to :func:`metran_tpu.ops.innovations`
+    (series-order independent, unlike the sequential per-scalar
+    updates the filter itself runs).  NaN where unobserved or before
+    ``warmup`` (traced, no recompile across warmup values)."""
+    dtype = phi.dtype
+    n = phi.shape[0]
+    eye = jnp.eye(n, dtype=dtype)[:, :, None]
+    maskf = jnp.asarray(mask, dtype)
+
+    def step(c, xs):
+        y_t, m_t = xs
+        mean_p, cov_p = _predict_step(phi, q, c, eye)
+        pm = jnp.einsum("iaB,aB->iB", z, mean_p)
+        # clip like ops.project: with r = 0 a tight posterior can round
+        # z'P_p z slightly negative in f32, which would blow up the
+        # standardized residual
+        pv = jnp.maximum(
+            jnp.einsum("iaB,abB,ibB->iB", z, cov_p, z), 0.0
+        )
+        v = y_t - pm
+        f = pv + r
+        (m_f, p_f, _, _), _ = _update_scan(
+            z, r, mean_p, cov_p, y_t, m_t, dtype
+        )
+        return (m_f, p_f), (v, f)
+
+    _, (v, f) = lax.scan(step, _adj_init_carry(phi, eye), (y, maskf))
+    if standardized:
+        v = v / jnp.sqrt(jnp.maximum(f, jnp.finfo(dtype).tiny))
+    keep = (jnp.asarray(mask, bool)) & (
+        jnp.arange(y.shape[0])[:, None, None] >= warmup
+    )
+    nan = jnp.asarray(jnp.nan, dtype)
+    return jnp.where(keep, v, nan), jnp.where(keep, f, nan)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_draws", "seg", "project")
+)
+def lanes_sample(
+    phi: jnp.ndarray,
+    q: jnp.ndarray,
+    z: jnp.ndarray,
+    r: jnp.ndarray,
+    y: jnp.ndarray,
+    mask: jnp.ndarray,
+    keys,
+    n_draws: int = 16,
+    seg: int = 100,
+    project: bool = True,
+) -> jnp.ndarray:
+    """Durbin-Koopman simulation smoother with draws riding the lanes.
+
+    The lane analog of :func:`metran_tpu.ops.sample_states`: one
+    mean-only smoothing of the data (B lanes), then every (model, draw)
+    pair becomes its own lane — the unconditional path draw, its
+    pseudo-observations on the same missing pattern, and the pseudo
+    smoothing all run as ONE (B * n_draws)-lane pass instead of a
+    per-model ``lax.map`` over draws.  ``keys`` is one PRNG key per
+    model (B,): each model's draws are a function of ITS key only, so
+    results are invariant to how a caller chunks the fleet axis.
+    Returns (n_draws, T, N, B) observation-space draws when ``project``
+    (passing exactly through each model's observed entries when r = 0)
+    or (n_draws, T, n, B) state draws otherwise."""
+    dtype = phi.dtype
+    t_steps, n_obs, b = y.shape
+    n = phi.shape[0]
+
+    sm_data, _, _ = lanes_smooth(
+        phi, q, z, r, y, mask, seg=seg, want_cov=False
+    )  # (T, n, B)
+
+    def rep(a):
+        return jnp.tile(a, (1,) * (a.ndim - 1) + (n_draws,))
+
+    phi_l, q_l, z_l, r_l = rep(phi), rep(q), rep(z), rep(r)
+    bl = b * n_draws
+    # per-model normals (chunk-invariant), rearranged so lane = d*B + m
+    # matches the tile() cycling of the model arrays above
+    def model_normals(key, shape):
+        # (B,) keys -> (*shape, n_draws) per model -> (*shape, D*B)
+        draws = jax.vmap(
+            lambda k: jax.random.normal(k, shape + (n_draws,), dtype)
+        )(key)  # (B, *shape, D)
+        moved = jnp.moveaxis(draws, 0, -1)  # (*shape, D, B)
+        return moved.reshape(shape + (bl,))
+
+    ks = jax.vmap(lambda k: jax.random.split(k, 3))(keys)  # (B, 3, 2)
+    # unconditional state path from the filter's own prior: x0 ~ N(0, I),
+    # then the diagonal AR recursion with diagonal Q (elementwise lanes)
+    q_sd = jnp.sqrt(jnp.clip(q_l, 0.0))
+    x0 = model_normals(ks[:, 0], (n,))
+    w = model_normals(ks[:, 1], (t_steps, n)) * q_sd[None]
+
+    def ar_step(x, w_t):
+        x = phi_l * x + w_t
+        return x, x
+
+    _, xs = lax.scan(ar_step, x0, w)  # (T, n, BL)
+    y_star = jnp.einsum("iaB,taB->tiB", z_l, xs)
+    r_sd = jnp.sqrt(jnp.clip(r_l, 0.0))
+    y_star = y_star + model_normals(ks[:, 2], (t_steps, n_obs)) * r_sd
+    mask_l = rep(jnp.asarray(mask, dtype))
+    sm_star, _, _ = lanes_smooth(
+        phi_l, q_l, z_l, r_l, y_star, mask_l, seg=seg, want_cov=False
+    )
+    draws = rep(sm_data) + xs - sm_star  # (T, n, BL)
+    if project:
+        draws = jnp.einsum("iaB,taB->tiB", z_l, draws)
+    # (T, *, B*D) -> (D, T, *, B): tile() cycles the fleet fastest, so
+    # lane index = d * B + model
+    d = draws.reshape(t_steps, -1, n_draws, b)
+    return jnp.transpose(d, (2, 0, 1, 3))

@@ -1137,28 +1137,42 @@ def fleet_simulate(
     engine: str = "joint",
     smooth: bool = True,
     batch_chunk: Optional[int] = None,
+    layout: str = "lanes",
+    seg: int = 100,
 ):
     """Observation-space projections for every fleet member.
 
     The fleet analog of the reference's per-model ``simulate``
-    (``metran/kalmanfilter.py:569-603``): run the masked filter (and RTS
+    (``metran/kalmanfilter.py:569-603``): run the masked filter (and
     smoother when ``smooth``), then project states onto the observation
     space — per-timestep means ``Z x_t`` and variances ``diag(Z P_t Z')``
-    — for the whole fleet in vmapped dispatches.  Returns
-    ``(means, variances)`` of shape (B, T, N), in standardized units
-    (multiply by each model's series std to rescale, as
-    ``Metran.get_scaled_observation_matrix`` does).
+    — for the whole fleet.  Returns ``(means, variances)`` of shape
+    (B, T, N), in standardized units (multiply by each model's series
+    std to rescale, as ``Metran.get_scaled_observation_matrix`` does).
 
-    The smoother stores O(T n^2) covariances per model, so the fleet is
-    advanced in a host-driven loop of ``batch_chunk``-model dispatches
-    (default: everything in one dispatch) — that bounds the smoother
-    intermediates at O(batch_chunk T n^2); the (B, T, N) outputs
-    themselves stay on device and are concatenated there.  A short tail
-    is padded with inert all-masked models (one compiled shape per
-    configuration, no tail recompile).  Padded series slots/models
-    produce inert zero-mean projections.
+    ``layout="lanes"`` (default) runs the products with the fleet axis
+    in the 128-wide lane dimension like the fit hot path: the smoother
+    is the Durbin-Koopman univariate backward recursion
+    (:func:`metran_tpu.ops.lanes_products.lanes_smooth` — rank-1
+    elementwise ops, no per-model Cholesky), memory bounded by
+    ``seg``-step segment replay.  ``engine`` is ignored there
+    (sequential-processing semantics, like the fit).  Pass
+    ``layout="batch"`` for the vmapped batch-leading pipeline
+    (honors ``engine``); both layouts agree to float rounding
+    (tests/test_lanes_products.py).
+
+    The fleet is advanced in a host-driven loop of ``batch_chunk``-model
+    dispatches (default: everything in one dispatch); outputs stay on
+    device and are concatenated there.  A short tail is padded with
+    edge-replicated models (one compiled shape per configuration, no
+    tail recompile).  Padded series slots/models produce inert zero-mean
+    projections.
     """
-    run = _make_simulate_runner(engine, smooth)
+    _check_layout(layout)
+    if layout == "lanes":
+        run = _make_lanes_simulate_runner(smooth, False, seg)
+    else:
+        run = _make_simulate_runner(engine, smooth)
     return _run_chunked(run, params, fleet, batch_chunk)
 
 
@@ -1168,16 +1182,23 @@ def fleet_decompose(
     engine: str = "joint",
     smooth: bool = True,
     batch_chunk: Optional[int] = None,
+    layout: str = "lanes",
+    seg: int = 100,
 ):
     """Per-member decomposition into specific and common contributions.
 
     The fleet analog of the reference's ``decompose``
     (``metran/kalmanfilter.py:605-644``): smoothed (or filtered) states
     split into the specific part ``Z[:, :N] x[:N]`` (B, T, N) and the
-    per-factor parts (B, K, T, N).  Chunking semantics are those of
-    :func:`fleet_simulate`.
+    per-factor parts (B, K, T, N).  Chunking and ``layout`` semantics
+    are those of :func:`fleet_simulate`; the lanes path needs smoothed
+    means only, so it skips the covariance recursion entirely.
     """
-    run = _make_simulate_runner(engine, smooth, decompose=True)
+    _check_layout(layout)
+    if layout == "lanes":
+        run = _make_lanes_simulate_runner(smooth, True, seg)
+    else:
+        run = _make_simulate_runner(engine, smooth, decompose=True)
     return _run_chunked(run, params, fleet, batch_chunk)
 
 
@@ -1212,6 +1233,8 @@ def fleet_innovations(
     standardized: bool = True,
     engine: str = "joint",
     batch_chunk: Optional[int] = None,
+    layout: str = "lanes",
+    warmup: int = 0,
 ):
     """One-step-ahead innovations for every fleet member.
 
@@ -1219,11 +1242,24 @@ def fleet_innovations(
     :func:`metran_tpu.ops.innovations`; the reference exposes no
     residual diagnostic at all).  Returns ``(v, f)`` of shape
     (B, T, N): residuals and their predicted variances, NaN at
-    masked/padded positions.  Chunking semantics are those of
-    :func:`fleet_simulate`.
+    masked/padded positions.  ``warmup`` NaNs out the first timesteps
+    (the filter's init transient — pass e.g. 50 before feeding
+    :func:`fleet_whiteness`, matching :meth:`Metran.test_whiteness`'s
+    default).  Chunking and ``layout`` semantics are those of
+    :func:`fleet_simulate`; both layouts emit the same joint (vector)
+    innovations from the time-predicted moments.
     """
-    run = _make_innovations_runner(engine, bool(standardized))
-    return _run_chunked(run, params, fleet, batch_chunk)
+    _check_layout(layout)
+    if layout == "lanes":
+        base = _make_lanes_innovations_runner(bool(standardized))
+    else:
+        base = _make_innovations_runner(engine, bool(standardized))
+    # warmup rides as a traced argument (both underlying ops take it
+    # traced), so sweeping warmup values does not recompile the runner
+    w = jnp.asarray(int(warmup), jnp.int32)
+    return _run_chunked(
+        lambda *args: base(*args, w), params, fleet, batch_chunk
+    )
 
 
 def fleet_sample(
@@ -1235,6 +1271,8 @@ def fleet_sample(
     batch_chunk: Optional[int] = None,
     draw_chunk: int = 8,
     project: bool = True,
+    layout: str = "lanes",
+    seg: int = 100,
 ):
     """Joint posterior path draws for every fleet member.
 
@@ -1246,15 +1284,26 @@ def fleet_sample(
     (each path passes exactly through that member's observed entries),
     or state draws (B, n_draws, T, n_state) when ``project=False``.
     Padded members/slots produce prior draws (nothing to condition on)
-    — slice them off as with the other products.  Chunking semantics
-    are those of :func:`fleet_simulate`; memory adds a factor
-    ``draw_chunk`` of live filter/smoother moments per member.
+    — slice them off as with the other products.  Chunking and
+    ``layout`` semantics are those of :func:`fleet_simulate`: with
+    ``layout="lanes"`` every (member, draw) pair rides its own lane
+    (:func:`metran_tpu.ops.lanes_products.lanes_sample` — one
+    mean-only data smoothing plus one ``B*n_draws``-lane pseudo
+    smoothing; ``draw_chunk`` is unused and memory scales with
+    ``n_draws`` lanes, so chunk the batch for very large draw counts).
+    The two layouts draw from the same posterior but with different
+    RNG streams — draw-for-draw equality across layouts is not a
+    contract, the distribution is.
     """
-    run = _make_sample_runner(
-        engine, int(n_draws),
-        max(1, min(int(draw_chunk), int(n_draws))),  # same clamp as
-        bool(project),                               # sample_states
-    )
+    _check_layout(layout)
+    if layout == "lanes":
+        run = _make_lanes_sample_runner(int(n_draws), seg, bool(project))
+    else:
+        run = _make_sample_runner(
+            engine, int(n_draws),
+            max(1, min(int(draw_chunk), int(n_draws))),  # same clamp as
+            bool(project),                               # sample_states
+        )
     keys = jax.random.split(
         jax.random.PRNGKey(int(seed)), fleet.batch
     )
@@ -1288,14 +1337,107 @@ def _make_sample_runner(engine, n_draws, draw_chunk, project):
 def _make_innovations_runner(engine, standardized):
     from ..ops import innovations as _innovations
 
-    def one(p, y, mask, loadings, dt):
+    def one(p, y, mask, loadings, dt, warmup):
         n = loadings.shape[0]
         ss = dfm_statespace(p[:n], p[n:], loadings, dt)
         return _innovations(
-            ss, y, mask, standardized=standardized, engine=engine
+            ss, y, mask, standardized=standardized, engine=engine,
+            warmup=warmup,
         )
 
-    return jax.jit(jax.vmap(one))
+    return jax.jit(
+        jax.vmap(one, in_axes=(0, 0, 0, 0, 0, None))
+    )
+
+
+def _check_layout(layout):
+    if layout not in ("lanes", "batch"):
+        raise ValueError(
+            f"unknown layout {layout!r}; expected 'lanes' or 'batch'"
+        )
+
+
+def _lanes_ss_chunk(p, loadings, dt):
+    """Lane-layout state space from a batch-leading chunk (shared by the
+    lanes product runners; transposition happens inside the jitted
+    runner so _run_chunked's batch-leading slicing applies unchanged)."""
+    from ..ops.lanes import lanes_statespace
+
+    return lanes_statespace(
+        p.T, jnp.transpose(loadings, (1, 2, 0)), dt
+    )
+
+
+@functools.lru_cache(maxsize=16)
+def _make_lanes_simulate_runner(smooth, decompose, seg):
+    """Lane-layout simulate/decompose runner: Durbin-Koopman univariate
+    smoother (``ops.lanes_products``) with the fleet axis riding the
+    lanes — the same layout treatment that took the fit from ~1 to ~50
+    models/s/chip, applied to the post-fit products."""
+    from ..ops.lanes_products import lanes_filter_project, lanes_smooth
+
+    def run(p, y, mask, loadings, dt):
+        phi, q, z, r = _lanes_ss_chunk(p, loadings, dt)
+        y_l = jnp.transpose(y, (1, 2, 0))
+        m_l = jnp.transpose(mask, (1, 2, 0))
+        if smooth:
+            ms, pm, pv = lanes_smooth(
+                phi, q, z, r, y_l, m_l, seg=seg,
+                want_cov=not decompose,
+            )
+        else:
+            ms, pm, pv = lanes_filter_project(phi, q, z, r, y_l, m_l)
+        if decompose:
+            n = y.shape[2]
+            # z = [I | loadings]: the specific block of the projection
+            # is the first n smoothed states themselves
+            sdf = jnp.transpose(ms[:, :n, :], (2, 0, 1))
+            ld_l = jnp.transpose(loadings, (1, 2, 0))
+            cdf = jnp.einsum("ikB,tkB->Bkti", ld_l, ms[:, n:, :])
+            return sdf, cdf
+        return (
+            jnp.transpose(pm, (2, 0, 1)),
+            jnp.transpose(pv, (2, 0, 1)),
+        )
+
+    return jax.jit(run)
+
+
+@functools.lru_cache(maxsize=16)
+def _make_lanes_innovations_runner(standardized):
+    from ..ops.lanes_products import lanes_innovations
+
+    def run(p, y, mask, loadings, dt, warmup):
+        phi, q, z, r = _lanes_ss_chunk(p, loadings, dt)
+        v, f = lanes_innovations(
+            phi, q, z, r,
+            jnp.transpose(y, (1, 2, 0)),
+            jnp.transpose(mask, (1, 2, 0)),
+            standardized=standardized, warmup=warmup,
+        )
+        return jnp.transpose(v, (2, 0, 1)), jnp.transpose(f, (2, 0, 1))
+
+    return jax.jit(run)
+
+
+@functools.lru_cache(maxsize=16)
+def _make_lanes_sample_runner(n_draws, seg, project):
+    from ..ops.lanes_products import lanes_sample
+
+    def run(p, y, mask, loadings, dt, keys):
+        phi, q, z, r = _lanes_ss_chunk(p, loadings, dt)
+        # per-model keys: draws are a function of each member's key
+        # only, so chunking the fleet axis does not change results
+        draws = lanes_sample(
+            phi, q, z, r,
+            jnp.transpose(y, (1, 2, 0)),
+            jnp.transpose(mask, (1, 2, 0)),
+            keys, n_draws=n_draws, seg=seg, project=project,
+        )  # (D, T, *, B)
+        # 1-tuple: _run_chunked concatenates per-output
+        return (jnp.transpose(draws, (3, 0, 1, 2)),)
+
+    return jax.jit(run)
 
 
 @functools.lru_cache(maxsize=16)

@@ -1,0 +1,239 @@
+"""Parity tests for the lane-layout post-fit products.
+
+The lanes smoother is the Durbin-Koopman univariate backward recursion;
+the batch-leading smoother is the RTS gain form (Cholesky solve).  Both
+compute the same smoothed moments in exact arithmetic, so parity at
+~1e-9 in float64 pins the implementation (VERDICT r4 item 2: products
+ported to lane layout, parity-tested vs the batch layout).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metran_tpu.ops import dfm_statespace, kalman_filter, project, rts_smoother
+from metran_tpu.ops.lanes import lanes_statespace
+from metran_tpu.ops.lanes_products import (
+    lanes_filter_project,
+    lanes_innovations,
+    lanes_sample,
+    lanes_smooth,
+)
+from metran_tpu.parallel import (
+    Fleet,
+    fleet_decompose,
+    fleet_innovations,
+    fleet_sample,
+    fleet_simulate,
+)
+
+
+def make_fleet(rng, b=3, n=4, k=2, t=60, missing=0.3):
+    y = rng.normal(size=(b, t, n))
+    mask = rng.uniform(size=(b, t, n)) > missing
+    mask[:, 0] = False  # no-observation leading timestep
+    mask[1, 5:9] = False  # an all-missing stretch
+    y = np.where(mask, y, 0.0)
+    loadings = rng.uniform(0.3, 0.8, (b, n, k)) / np.sqrt(k)
+    dt = rng.uniform(0.5, 2.0, b)
+    return Fleet(
+        y=jnp.asarray(y),
+        mask=jnp.asarray(mask),
+        loadings=jnp.asarray(loadings),
+        dt=jnp.asarray(dt),
+        n_series=jnp.full(b, n, jnp.int32),
+    )
+
+
+@pytest.fixture()
+def fleet(rng):
+    return make_fleet(rng)
+
+
+@pytest.fixture()
+def params(rng, fleet):
+    b = fleet.batch
+    return jnp.asarray(
+        rng.uniform(5.0, 40.0, (b, fleet.n_params))
+    )
+
+
+def lanes_ss(params, fleet):
+    return lanes_statespace(
+        params.T, jnp.transpose(fleet.loadings, (1, 2, 0)), fleet.dt
+    )
+
+
+def test_lanes_smooth_matches_rts_single_model(rng):
+    """Direct parity of the D-K univariate smoother vs rts_smoother."""
+    fleet = make_fleet(rng, b=2)
+    params = jnp.asarray(rng.uniform(5.0, 40.0, (2, fleet.n_params)))
+    phi, q, z, r = lanes_ss(params, fleet)
+    y_l = jnp.transpose(fleet.y, (1, 2, 0))
+    m_l = jnp.transpose(fleet.mask, (1, 2, 0))
+    mean_s, pm, pv = lanes_smooth(phi, q, z, r, y_l, m_l, seg=16)
+    for i in range(fleet.batch):
+        n = fleet.loadings.shape[1]
+        p = params[i]
+        ss = dfm_statespace(p[:n], p[n:], fleet.loadings[i], fleet.dt[i])
+        filt = kalman_filter(ss, fleet.y[i], fleet.mask[i])
+        sm = rts_smoother(ss, filt)
+        ref_pm, ref_pv = project(ss.z, sm.mean_s, sm.cov_s)
+        np.testing.assert_allclose(
+            mean_s[:, :, i], sm.mean_s, rtol=1e-9, atol=1e-9
+        )
+        np.testing.assert_allclose(
+            pm[:, :, i], ref_pm, rtol=1e-9, atol=1e-9
+        )
+        np.testing.assert_allclose(
+            pv[:, :, i], ref_pv, rtol=1e-8, atol=1e-9
+        )
+
+
+def test_lanes_smooth_mean_only_matches(rng):
+    fleet = make_fleet(rng, b=2)
+    params = jnp.asarray(rng.uniform(5.0, 40.0, (2, fleet.n_params)))
+    phi, q, z, r = lanes_ss(params, fleet)
+    y_l = jnp.transpose(fleet.y, (1, 2, 0))
+    m_l = jnp.transpose(fleet.mask, (1, 2, 0))
+    full = lanes_smooth(phi, q, z, r, y_l, m_l, seg=16, want_cov=True)
+    mean_only = lanes_smooth(
+        phi, q, z, r, y_l, m_l, seg=16, want_cov=False
+    )
+    np.testing.assert_allclose(mean_only[0], full[0], rtol=1e-12)
+    assert np.all(np.asarray(mean_only[2]) == 0.0)
+
+
+def test_fleet_simulate_layouts_agree(params, fleet):
+    pm_l, pv_l = fleet_simulate(params, fleet, layout="lanes", seg=16)
+    pm_b, pv_b = fleet_simulate(params, fleet, layout="batch")
+    np.testing.assert_allclose(pm_l, pm_b, rtol=1e-9, atol=1e-9)
+    np.testing.assert_allclose(pv_l, pv_b, rtol=1e-8, atol=1e-9)
+
+
+def test_fleet_simulate_filtered_layouts_agree(params, fleet):
+    pm_l, pv_l = fleet_simulate(
+        params, fleet, smooth=False, layout="lanes"
+    )
+    pm_b, pv_b = fleet_simulate(
+        params, fleet, smooth=False, layout="batch"
+    )
+    np.testing.assert_allclose(pm_l, pm_b, rtol=1e-9, atol=1e-9)
+    np.testing.assert_allclose(pv_l, pv_b, rtol=1e-8, atol=1e-9)
+
+
+def test_fleet_decompose_layouts_agree(params, fleet):
+    sdf_l, cdf_l = fleet_decompose(params, fleet, layout="lanes", seg=16)
+    sdf_b, cdf_b = fleet_decompose(params, fleet, layout="batch")
+    np.testing.assert_allclose(sdf_l, sdf_b, rtol=1e-9, atol=1e-9)
+    np.testing.assert_allclose(cdf_l, cdf_b, rtol=1e-9, atol=1e-9)
+
+
+def test_fleet_innovations_layouts_agree(params, fleet):
+    v_l, f_l = fleet_innovations(params, fleet, layout="lanes")
+    v_b, f_b = fleet_innovations(params, fleet, layout="batch")
+    np.testing.assert_allclose(v_l, v_b, rtol=1e-9, atol=1e-9)
+    np.testing.assert_allclose(f_l, f_b, rtol=1e-9, atol=1e-9)
+
+
+def test_fleet_innovations_warmup(params, fleet):
+    v, _ = fleet_innovations(params, fleet, warmup=10)
+    assert np.all(np.isnan(np.asarray(v)[:, :10, :]))
+    # beyond warmup, observed entries are finite
+    obs = np.asarray(fleet.mask)[:, 10:, :]
+    assert np.all(np.isfinite(np.asarray(v)[:, 10:, :][obs]))
+
+
+def test_fleet_innovations_batch_warmup(params, fleet):
+    v, _ = fleet_innovations(params, fleet, warmup=10, layout="batch")
+    assert np.all(np.isnan(np.asarray(v)[:, :10, :]))
+
+
+def test_chunked_lanes_matches_unchunked(params, fleet):
+    pm1, pv1 = fleet_simulate(params, fleet, layout="lanes", seg=16)
+    pm2, pv2 = fleet_simulate(
+        params, fleet, layout="lanes", seg=16, batch_chunk=2
+    )
+    np.testing.assert_allclose(pm1, pm2, rtol=1e-12)
+    np.testing.assert_allclose(pv1, pv2, rtol=1e-12)
+
+
+def test_lanes_sample_conditioning_and_moments(rng):
+    """Draws pass through observed entries (r=0) and match the smoothed
+    mean in expectation."""
+    fleet = make_fleet(rng, b=2, n=3, k=1, t=40)
+    params = jnp.asarray(rng.uniform(5.0, 40.0, (2, fleet.n_params)))
+    draws = fleet_sample(
+        params, fleet, n_draws=200, seed=7, layout="lanes", seg=16
+    )  # (B, D, T, N)
+    y, mask = np.asarray(fleet.y), np.asarray(fleet.mask)
+    d = np.asarray(draws)
+    # exact interpolation at observed entries
+    for i in range(2):
+        np.testing.assert_allclose(
+            np.broadcast_to(y[i], d[i].shape)[:, mask[i]],
+            d[i][:, mask[i]],
+            atol=1e-7,
+        )
+    # draw mean approaches the smoothed projection in the gaps
+    pm, pv = fleet_simulate(params, fleet, layout="lanes", seg=16)
+    mean_err = np.abs(d.mean(axis=1) - np.asarray(pm))
+    sd = np.sqrt(np.maximum(np.asarray(pv), 0.0))
+    # CLT bound: 200 draws, allow 5 sigma/sqrt(200) + slack
+    assert np.all(mean_err <= 5.0 * sd / np.sqrt(200) + 1e-6)
+
+
+def test_lanes_sample_chunk_invariant(rng):
+    """Draws depend on each member's key only, not on chunking."""
+    fleet = make_fleet(rng, b=3, n=3, k=1, t=30)
+    params = jnp.asarray(rng.uniform(5.0, 40.0, (3, fleet.n_params)))
+    d1 = fleet_sample(params, fleet, n_draws=2, seed=3, layout="lanes",
+                      seg=16)
+    d2 = fleet_sample(params, fleet, n_draws=2, seed=3, layout="lanes",
+                      seg=16, batch_chunk=2)
+    np.testing.assert_allclose(d1, d2, rtol=1e-12, atol=1e-12)
+
+
+def test_unknown_layout_raises(params, fleet):
+    with pytest.raises(ValueError, match="unknown layout"):
+        fleet_simulate(params, fleet, layout="lane")
+    with pytest.raises(ValueError, match="unknown layout"):
+        fleet_innovations(params, fleet, layout="Lanes")
+
+
+def test_lanes_sample_states_shape(rng):
+    fleet = make_fleet(rng, b=2, n=3, k=1, t=30)
+    params = jnp.asarray(rng.uniform(5.0, 40.0, (2, fleet.n_params)))
+    draws = fleet_sample(
+        params, fleet, n_draws=3, layout="lanes", seg=16, project=False
+    )
+    assert draws.shape == (2, 3, 30, fleet.n_params)
+
+
+def test_lanes_innovations_direct_vs_ops(rng):
+    """lanes_innovations against ops.innovations on one model."""
+    from metran_tpu.ops import innovations as ops_innovations
+
+    fleet = make_fleet(rng, b=2)
+    params = jnp.asarray(rng.uniform(5.0, 40.0, (2, fleet.n_params)))
+    phi, q, z, r = lanes_ss(params, fleet)
+    v_l, f_l = lanes_innovations(
+        phi, q, z, r,
+        jnp.transpose(fleet.y, (1, 2, 0)),
+        jnp.transpose(fleet.mask, (1, 2, 0)),
+        warmup=5,
+    )
+    n = fleet.loadings.shape[1]
+    for i in range(2):
+        p = params[i]
+        ss = dfm_statespace(p[:n], p[n:], fleet.loadings[i], fleet.dt[i])
+        v_b, f_b = ops_innovations(
+            ss, fleet.y[i], fleet.mask[i], warmup=5
+        )
+        np.testing.assert_allclose(
+            v_l[:, :, i], v_b, rtol=1e-9, atol=1e-9
+        )
+        np.testing.assert_allclose(
+            f_l[:, :, i], f_b, rtol=1e-9, atol=1e-9
+        )
