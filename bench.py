@@ -327,12 +327,45 @@ def run_device_bench(out_path: str, budget_s: float,
                      floor_s=MIN_PLAUSIBLE_DISPATCH_S)
         return laps, plausible
 
-    batch = min(4, BATCH) if force_cpu else BATCH
+    # budget-driven batch choice (VERDICT r4 item 5): memory-bound on
+    # directly-attached hardware, capped at the battle-tested 512 when
+    # the device is reached through the axon tunnel (whose remote
+    # compile service crashed on a batch-2048 compile in round 4).  The
+    # full selection reasoning lands in the artifact.
+    from metran_tpu.parallel.fleet import choose_fleet_batch
+
+    hbm = None
+    try:
+        stats = devices[0].memory_stats()
+        if stats:
+            hbm = stats.get("bytes_limit")
+    except Exception:
+        pass
+    # tunneled=None auto-detects via PALLAS_AXON_POOL_IPS, so the 512
+    # cap applies on this rig's tunnel but lifts on directly-attached
+    # TPU hardware
+    sel = choose_fleet_batch(
+        N_SERIES, N_FACTORS, T_STEPS, remat_seg=REMAT_SEG or 100,
+        hbm_bytes=hbm, tunneled=None,
+    )
+    batch = min(4, BATCH) if force_cpu else sel["batch"]
+    # applied_batch records what this run actually used (the CPU
+    # fallback overrides the selection with a tiny batch)
+    sel["applied_batch"] = batch
+    out["batch_selection"] = sel
+    progress("batch_selected", **sel)
     rng = np.random.default_rng(SEED)
-    # always generate the full-batch workload and slice, so model 0 is
-    # identical across the device run, the CPU fallback and the CPU
-    # baseline (deviances comparable)
+    # always generate the canonical BATCH-model workload first, so
+    # model 0 is identical across the device run, the CPU fallback and
+    # the CPU baseline (deviances comparable) regardless of the chosen
+    # batch; extra models (batch > BATCH) come from a second stream
     y, mask, loadings = make_workload(rng, BATCH)
+    if batch > BATCH:
+        rng2 = np.random.default_rng(SEED + 1)
+        y2, mask2, loadings2 = make_workload(rng2, batch - BATCH)
+        y = np.concatenate([y, y2])
+        mask = np.concatenate([mask, mask2])
+        loadings = np.concatenate([loadings, loadings2])
     fleet = make_fleet(y[:batch], mask[:batch], loadings[:batch])
     params0 = default_init_params(fleet)
     progress("workload_ready", batch=batch)

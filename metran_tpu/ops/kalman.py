@@ -430,9 +430,11 @@ def sample_states(
     try:
         # tracers cannot be concretized; skipping the check under a
         # trace is fine (the DFM builder only emits diagonal Q).  The
-        # try/except avoids touching the internal jax.core namespace.
+        # public jax.errors types replace the old jax.core.Tracer
+        # isinstance check; any OTHER conversion failure still raises.
         q_np = np.asarray(q)
-    except Exception:
+    except (jax.errors.TracerArrayConversionError,
+            jax.errors.ConcretizationTypeError):
         q_np = None
     if q_np is not None and np.abs(
         q_np - np.diag(np.diagonal(q_np))

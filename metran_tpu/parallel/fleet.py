@@ -709,6 +709,72 @@ def _fit_fleet_lanes(fleet, p0, warmup, maxiter, tol, mesh,
     )
 
 
+def choose_fleet_batch(
+    n_series: int,
+    n_factors: int,
+    t_steps: int,
+    itemsize: int = 4,
+    hbm_bytes: Optional[int] = None,
+    hbm_frac: float = 0.5,
+    remat_seg: int = 100,
+    tunneled: Optional[bool] = None,
+    min_batch: int = 128,
+    max_batch: int = 4096,
+) -> dict:
+    """Pick the fleet batch size from a memory budget, not a constant.
+
+    Round 4 measured batch 1024 at +14% fit throughput over the
+    hardcoded 512 but kept 512 because a 2048 probe crashed the
+    *tunneled* rig's remote-compile service (BASELINE.md).  This makes
+    the choice budget-driven: the largest power-of-2 batch whose
+    estimated peak HBM footprint fits in ``hbm_frac`` of device memory,
+    capped at 512 only when the device is reached through the axon
+    tunnel (``tunneled=None`` auto-detects via ``PALLAS_AXON_POOL_IPS``;
+    the cap is operational fragility, not a hardware limit — it lifts
+    automatically on directly-attached hardware).
+
+    The memory model covers the lanes fit path's dominant terms per
+    model-lane (see ops/lanes.py): panel data (y + float mask + their
+    segment-padded copies), the segment-boundary carries, and ~3 live
+    copies of one segment's backward residuals
+    (carry mean/cov + per-series d/f/v) under value_and_grad, with a
+    1.5x slack factor for XLA temporaries.  It is deliberately
+    conservative; the point is an order-of-magnitude-correct default
+    with the reasoning RECORDED (the returned dict goes into bench
+    artifacts), not a tight bound.
+
+    Returns a dict with ``batch`` plus every number that went into the
+    choice.
+    """
+    n_state = n_series + n_factors
+    data = 4 * t_steps * n_series * itemsize
+    bounds = -(-t_steps // remat_seg) * (n_state + n_state**2) * itemsize
+    seg_res = remat_seg * (
+        n_state + n_state**2 + n_series * (n_state + 2)
+    ) * itemsize
+    per_model = int(1.5 * (data + bounds + 3 * seg_res))
+    if hbm_bytes is None:
+        hbm_bytes = 16 * 1024**3  # v5e default; pass device stats to refine
+    budget = int(hbm_bytes * hbm_frac)
+    batch = min_batch
+    while batch * 2 <= max_batch and (batch * 2) * per_model <= budget:
+        batch *= 2
+    if tunneled is None:
+        import os
+
+        tunneled = bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
+    chosen = min(batch, 512) if tunneled else batch
+    return {
+        "batch": chosen,
+        "memory_batch": batch,
+        "per_model_bytes": per_model,
+        "hbm_bytes": int(hbm_bytes),
+        "hbm_frac": hbm_frac,
+        "tunneled": bool(tunneled),
+        "tunnel_cap": 512,
+    }
+
+
 def _fleet_fingerprint(*arrays):
     """Cheap content fingerprint: shapes + low-order moments, enough to
     reject a checkpoint from different data/init of the same shape.
@@ -1168,7 +1234,7 @@ def fleet_simulate(
     tail recompile).  Padded series slots/models produce inert zero-mean
     projections.
     """
-    _check_layout(layout)
+    _check_layout(layout, engine)
     if layout == "lanes":
         run = _make_lanes_simulate_runner(smooth, False, seg)
     else:
@@ -1194,7 +1260,7 @@ def fleet_decompose(
     are those of :func:`fleet_simulate`; the lanes path needs smoothed
     means only, so it skips the covariance recursion entirely.
     """
-    _check_layout(layout)
+    _check_layout(layout, engine)
     if layout == "lanes":
         run = _make_lanes_simulate_runner(smooth, True, seg)
     else:
@@ -1249,7 +1315,7 @@ def fleet_innovations(
     :func:`fleet_simulate`; both layouts emit the same joint (vector)
     innovations from the time-predicted moments.
     """
-    _check_layout(layout)
+    _check_layout(layout, engine)
     if layout == "lanes":
         base = _make_lanes_innovations_runner(bool(standardized))
     else:
@@ -1295,7 +1361,7 @@ def fleet_sample(
     RNG streams — draw-for-draw equality across layouts is not a
     contract, the distribution is.
     """
-    _check_layout(layout)
+    _check_layout(layout, engine)
     if layout == "lanes":
         run = _make_lanes_sample_runner(int(n_draws), seg, bool(project))
     else:
@@ -1350,10 +1416,19 @@ def _make_innovations_runner(engine, standardized):
     )
 
 
-def _check_layout(layout):
+def _check_layout(layout, engine="joint"):
     if layout not in ("lanes", "batch"):
         raise ValueError(
             f"unknown layout {layout!r}; expected 'lanes' or 'batch'"
+        )
+    if layout == "lanes" and engine != "joint":
+        # loud, not silent: the lanes products always use sequential-
+        # processing semantics (same numbers, different layout), so an
+        # explicitly requested engine would otherwise be a no-op
+        logger.warning(
+            "engine=%r is ignored with layout='lanes' (lane products "
+            "use sequential-processing semantics); pass layout='batch' "
+            "to honor the engine choice", engine,
         )
 
 

@@ -28,6 +28,7 @@ per-model fields as :class:`FleetFit`, concatenated in batch order.
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 from concurrent.futures import ThreadPoolExecutor
@@ -37,7 +38,13 @@ import jax
 import numpy as np
 
 from ..io import atomic_savez
-from .fleet import Fleet, FleetFit, autocorr_init_params, fit_fleet
+from .fleet import (
+    Fleet,
+    FleetFit,
+    _fleet_fingerprint,
+    autocorr_init_params,
+    fit_fleet,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -83,17 +90,32 @@ def _ckpt_path(checkpoint_dir: str, i: int) -> str:
     return os.path.join(checkpoint_dir, f"batch_{i:05d}.npz")
 
 
-def _save_batch(checkpoint_dir: str, i: int, rec: dict) -> None:
+def _save_batch(checkpoint_dir: str, i: int, rec: dict,
+                fingerprint) -> None:
     atomic_savez(_ckpt_path(checkpoint_dir, i),
+                 fingerprint=json.dumps(fingerprint),
                  **{k: v for k, v in rec.items() if v is not None})
 
 
-def _load_batch(checkpoint_dir: str, i: int) -> Optional[dict]:
+def _load_batch(checkpoint_dir: str, i: int):
+    """Returns ``(record, fingerprint)`` or ``None``; ``fingerprint`` is
+    ``None`` for pre-round-5 checkpoints that did not store one."""
     path = _ckpt_path(checkpoint_dir, i)
     if not os.path.exists(path):
         return None
     with np.load(path) as z:
-        return {f: (z[f] if f in z.files else None) for f in _FIT_FIELDS}
+        rec = {f: (z[f] if f in z.files else None) for f in _FIT_FIELDS}
+        fp = (json.loads(str(z["fingerprint"]))
+              if "fingerprint" in z.files else None)
+    return rec, fp
+
+
+def _batch_fingerprint(fleet: Fleet):
+    """Content fingerprint of one batch's defining data (same scheme as
+    fit_fleet's intra-batch checkpoints, fleet.py _fleet_fingerprint)."""
+    return _fleet_fingerprint(
+        fleet.y, fleet.mask, fleet.loadings, fleet.dt
+    )
 
 
 def _materialize(spec: BatchSpec) -> Fleet:
@@ -114,6 +136,7 @@ def sweep_fit(
     prefetch: bool = True,
     checkpoint_dir: Optional[str] = None,
     on_batch: Optional[Callable[[int, dict], None]] = None,
+    verify_restore: bool = False,
     **fit_kw,
 ) -> SweepResult:
     """Fit every batch in ``batches`` and concatenate the results.
@@ -141,9 +164,19 @@ def sweep_fit(
         TWO batches' ``y``/``mask``/``loadings`` on top of the solver
         workspace — size batches with that headroom, or turn prefetch
         off to trade the overlap for memory.
-    checkpoint_dir : directory for per-batch ``.npz`` results.  Existing
-        files are trusted and loaded by position; pass a fresh directory
-        when the batch definitions change.
+    checkpoint_dir : directory for per-batch ``.npz`` results.  Each
+        file stores a content fingerprint of its batch's data; on
+        restore, a checkpoint whose fingerprint does not match the
+        batch at that position is DISCARDED (warning logged) and the
+        batch refitted — a changed batch list can no longer silently
+        resume wrong results.  Fingerprints of batches passed as
+        concrete :class:`Fleet` objects are always checked; callable
+        specs are only checked when ``verify_restore=True`` (checking
+        requires materializing, which is what lazy restore avoids).
+        Pre-fingerprint checkpoints restore as before (by position).
+    verify_restore : materialize CALLABLE batch specs on restore to
+        verify their fingerprints too (default False: callables are
+        trusted by position, keeping restores lazy).
     on_batch : optional callback ``(index, record)`` after each batch
         fitted THIS run (checkpoint-restored batches do not fire it —
         their work happened in the run that saved them); ``record``
@@ -165,12 +198,32 @@ def sweep_fit(
 
     records: List[Optional[dict]] = [None] * len(specs)
     loaded = [False] * len(specs)
+    fingerprints: List[Optional[list]] = [None] * len(specs)
     if checkpoint_dir is not None:
         for i in range(len(specs)):
-            rec = _load_batch(checkpoint_dir, i)
-            if rec is not None:
-                records[i] = rec
-                loaded[i] = True
+            found = _load_batch(checkpoint_dir, i)
+            if found is None:
+                continue
+            rec, fp_saved = found
+            spec = specs[i]
+            check = fp_saved is not None and (
+                not callable(spec) or verify_restore
+            )
+            if check:
+                fleet_i = _materialize(spec) if callable(spec) else spec
+                fp_now = _batch_fingerprint(fleet_i)
+                fingerprints[i] = fp_now
+                if fp_now != fp_saved:
+                    logger.warning(
+                        "sweep: checkpoint %s holds results for "
+                        "DIFFERENT data than batch %d — discarding it "
+                        "and refitting (the batch list changed since "
+                        "the checkpoint was written)",
+                        _ckpt_path(checkpoint_dir, i), i,
+                    )
+                    continue
+            records[i] = rec
+            loaded[i] = True
         if any(loaded):
             logger.info("sweep: restored %d/%d batches from %s",
                         sum(loaded), len(specs), checkpoint_dir)
@@ -192,7 +245,8 @@ def sweep_fit(
             rec = _to_host(fit)
             records[i] = rec
             if checkpoint_dir is not None:
-                _save_batch(checkpoint_dir, i, rec)
+                fp = fingerprints[i] or _batch_fingerprint(fleet)
+                _save_batch(checkpoint_dir, i, rec, fp)
             if on_batch is not None:
                 on_batch(i, rec)
     finally:

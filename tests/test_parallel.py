@@ -797,3 +797,27 @@ def test_lanes_tiny_fleet_padding(rng):
     np.testing.assert_array_equal(
         np.asarray(fit2.converged), np.asarray(fit8.converged)[:2]
     )
+
+
+def test_choose_fleet_batch():
+    """Budget-driven batch sizing: memory-bound untunneled, 512-capped
+    on the tunnel, selection reasoning recorded."""
+    from metran_tpu.parallel.fleet import choose_fleet_batch
+
+    sel = choose_fleet_batch(20, 1, 5000, tunneled=False)
+    assert sel["batch"] >= 1024  # the measured +14% regime is reachable
+    assert sel["batch"] * sel["per_model_bytes"] <= (
+        sel["hbm_bytes"] * sel["hbm_frac"]
+    )
+    capped = choose_fleet_batch(20, 1, 5000, tunneled=True)
+    assert capped["batch"] == 512 and capped["tunneled"]
+    # a tiny memory budget binds below the tunnel cap
+    tight = choose_fleet_batch(
+        20, 1, 5000, hbm_bytes=2 * 1024**3, hbm_frac=0.25, tunneled=True
+    )
+    assert tight["batch"] <= 512
+    # either the budget binds, or the choice sits at the min_batch floor
+    assert (
+        tight["memory_batch"] * tight["per_model_bytes"]
+        <= 2 * 1024**3 * 0.25
+    ) or tight["memory_batch"] == 128

@@ -121,6 +121,43 @@ def check_callables_lazy_and_resume():
     np.testing.assert_array_equal(full.nfev, direct.nfev)
 
 
+def check_fingerprint_rejects_changed_batches():
+    """A changed batch list invalidates the restore instead of silently
+    resuming wrong results (VERDICT r4 weak #3)."""
+    from metran_tpu.parallel import sweep_fit
+
+    fleets = _fleets(seed=3, sizes=(4, 4))
+    other = _fleets(seed=9, sizes=(4, 4))
+    with tempfile.TemporaryDirectory() as d:
+        first = sweep_fit(fleets, prefetch=False, checkpoint_dir=d,
+                          **FIT_KW)
+        assert first.loaded == [False, False]
+        # same positions, different data: both checkpoints must be
+        # discarded and refitted
+        swapped = sweep_fit(other, prefetch=False, checkpoint_dir=d,
+                            **FIT_KW)
+        assert swapped.loaded == [False, False]
+        direct = sweep_fit(other, prefetch=False, **FIT_KW)
+        np.testing.assert_array_equal(swapped.params, direct.params)
+        # the refit overwrote the stale checkpoints: a third run with
+        # the new list restores cleanly
+        again = sweep_fit(other, prefetch=False, checkpoint_dir=d,
+                          **FIT_KW)
+        assert again.loaded == [True, True]
+        np.testing.assert_array_equal(again.params, direct.params)
+
+        # callables are trusted by position by default (lazy restore)
+        # but checked with verify_restore=True
+        res = sweep_fit([lambda: fleets[0], lambda: fleets[1]],
+                        prefetch=False, checkpoint_dir=d,
+                        verify_restore=True, **FIT_KW)
+        assert res.loaded == [False, False]  # mismatch vs `other` ckpts
+        np.testing.assert_array_equal(
+            res.params, sweep_fit(fleets, prefetch=False,
+                                  **FIT_KW).params
+        )
+
+
 def check_p0_modes():
     """p0 plumbing: "autocorr" == the callable it names; None differs.
 
@@ -178,7 +215,9 @@ def test_sweep_checks_subprocess():
     from tests.conftest import run_python_subprocess
 
     calls = ["check_matches_per_batch_fits()", "check_prefetch_invariance()",
-             "check_callables_lazy_and_resume()", "check_p0_modes()"]
+             "check_callables_lazy_and_resume()",
+             "check_fingerprint_rejects_changed_batches()",
+             "check_p0_modes()"]
     body = "\n".join(f"ts.{c}; print('done', {c!r})" for c in calls)
     res = run_python_subprocess(
         _SUBPROCESS_PREAMBLE

@@ -30,7 +30,7 @@ EOF
     echo "[watch] probe OK $(date -u +%FT%TZ) -> bench.py" >> "$LOG"
     # stdout carries only the final artifact JSON line; stage log to stderr
     timeout 1800 python bench.py \
-      > "bench_artifacts/BENCH_onchip_r5_$(date -u +%H%M).json" \
+      > "bench_artifacts/BENCH_onchip_r5_$(date -u +%F_%H%M).json" \
       2>> "bench_artifacts/bench_onchip_r5_stages.jsonl"
     echo "[watch] bench rc=$? $(date -u +%FT%TZ)" >> "$LOG"
     bench_runs=$((bench_runs + 1))
