@@ -762,11 +762,13 @@ class Metran:
         engine : str, optional
             Kalman engine override ("sequential"/"joint"/"parallel"; the
             reference's "numba"/"numpy" map to "sequential").
-        init : str, optional
+        init : str or None, optional
             Initial-parameter strategy: "reference" (constant alpha=10,
-            reference parity) or "autocorr" (data-driven lag-1
+            reference parity), "autocorr" (data-driven lag-1
             autocorrelation seed — same optimum, fewer iterations; see
-            :meth:`set_init_parameters`).
+            :meth:`set_init_parameters`), or ``None`` to keep a
+            hand-edited ``parameters["initial"]`` table (warm starts;
+            built with the default method first if the table is empty).
         **kwargs
             Passed through to the solver's minimize call.
         """
@@ -774,7 +776,16 @@ class Metran:
         if factors is None:
             return
         self._init_kalmanfilter(engine=engine)
-        self.set_init_parameters(method=init)
+        if init is not None:
+            self.set_init_parameters(method=init)
+        elif self.parameters is None or len(self.parameters) != (
+            self.nseries + self.nfactors
+        ):
+            # init=None promises "keep my hand-edited table", but the
+            # table is absent or inconsistent with the factor structure
+            # (__init__ seeds sdf rows before factors exist, so "non-
+            # empty" alone is not "usable") — build the default one
+            self.set_init_parameters()
 
         if solver is None:
             from ..config import is_accelerator

@@ -23,6 +23,13 @@ from .lanes import (
     lanes_dfm_deviance,
     lanes_statespace,
 )
+from .lanes_products import (
+    lanes_filter_project,
+    lanes_forecast,
+    lanes_innovations,
+    lanes_sample,
+    lanes_smooth,
+)
 from .pkalman import (
     parallel_deviance,
     parallel_filter,
@@ -46,6 +53,11 @@ __all__ = [
     "kalman_filter",
     "lanes_deviance_terms",
     "lanes_dfm_deviance",
+    "lanes_filter_project",
+    "lanes_forecast",
+    "lanes_innovations",
+    "lanes_sample",
+    "lanes_smooth",
     "lanes_statespace",
     "log_likelihood",
     "parallel_deviance",

@@ -292,6 +292,73 @@ def lanes_innovations(
     return jnp.where(keep, v, nan), jnp.where(keep, f, nan)
 
 
+@functools.partial(jax.jit, static_argnames=("steps",))
+def lanes_forecast(
+    phi: jnp.ndarray,
+    q: jnp.ndarray,
+    z: jnp.ndarray,
+    r: jnp.ndarray,
+    y: jnp.ndarray,
+    mask: jnp.ndarray,
+    t_last: jnp.ndarray,
+    steps: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Out-of-sample observation forecasts in lane layout.
+
+    The lane analog of :mod:`metran_tpu.ops.forecast` (no reference
+    equivalent): one forward filter pass that LATCHES each lane's
+    filtered moments at its own ``t_last`` (members forecast from their
+    own data end, not the padded grid end), then the closed-form
+    diagonal-transition h-step moments vectorized over horizons — same
+    ``expm1``-guarded geometric accumulation as
+    ``forecast_state_moments``, with the lanes' diagonal ``q``.
+    Returns ``(means, variances)`` of shape (steps, N, B)."""
+    dtype = phi.dtype
+    n, b = phi.shape
+    eye = jnp.eye(n, dtype=dtype)[:, :, None]
+    maskf = jnp.asarray(mask, dtype)
+    t_steps = y.shape[0]
+    t_last = jnp.asarray(t_last, jnp.int32)
+
+    def step(carry, xs):
+        state, latch = carry
+        t, y_t, m_t = xs
+        state2, _, _ = _adj_step(phi, q, z, r, state, y_t, m_t, eye)
+        hit = t == (t_last - 1)  # (B,)
+        lm = jnp.where(hit[None, :], state2[0], latch[0])
+        lp = jnp.where(hit[None, None, :], state2[1], latch[1])
+        return (state2, (lm, lp)), None
+
+    init = _adj_init_carry(phi, eye)
+    (_, (m0, p0)), _ = lax.scan(
+        step, (init, init),
+        (jnp.arange(t_steps), y, maskf),
+    )
+
+    h1 = jnp.arange(1, steps + 1, dtype=dtype)[:, None, None]  # (H,1,1)
+    h2 = h1[..., None]  # (H,1,1,1)
+    phih = phi[None] ** h1  # (H, n, B)
+    mean_h = phih * m0[None]
+    log_pp = jnp.log(phi[:, None, :] * phi[None, :, :])  # (n, n, B)
+    pp_h = jnp.exp(h2 * log_pp[None])  # (H, n, n, B)
+    # expm1 form of (1 - pp^h)/(1 - pp); the pp == 1 limit is h (same
+    # guard as forecast_state_moments)
+    denom = jnp.expm1(log_pp)
+    at_one = denom == 0
+    geom = jnp.where(
+        at_one[None],
+        h2 * jnp.ones_like(log_pp)[None],
+        jnp.expm1(h2 * log_pp[None])
+        / jnp.where(at_one, 1.0, denom)[None],
+    )
+    cov_h = pp_h * p0[None] + geom * (eye * q[None])[None]
+    pm = jnp.einsum("iaB,haB->hiB", z, mean_h)
+    pv = jnp.maximum(
+        jnp.einsum("iaB,habB,ibB->hiB", z, cov_h, z), 0.0
+    )
+    return pm, pv + r[None]
+
+
 @functools.partial(
     jax.jit, static_argnames=("n_draws", "seg", "project")
 )

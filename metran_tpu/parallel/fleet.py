@@ -1274,18 +1274,24 @@ def fleet_forecast(
     steps: int,
     engine: str = "joint",
     batch_chunk: Optional[int] = None,
+    layout: str = "lanes",
 ):
     """Out-of-sample forecasts for every fleet member.
 
     The fleet analog of ``Metran.get_forecast_means/variances`` — a
     capability the reference lacks entirely.  Runs the masked filter to
-    the last timestep, then the closed-form diagonal-transition
-    h-step-ahead moments (:mod:`metran_tpu.ops.forecast`; vectorized
-    over horizons, no scan).  Returns ``(means, variances)`` of shape
-    (B, steps, N) in standardized units.  Chunking semantics are those
-    of :func:`fleet_simulate`.
+    the last timestep (each member forecasts from ITS OWN data end),
+    then the closed-form diagonal-transition h-step-ahead moments
+    (:mod:`metran_tpu.ops.forecast`; vectorized over horizons, no
+    scan).  Returns ``(means, variances)`` of shape (B, steps, N) in
+    standardized units.  Chunking and ``layout`` semantics are those of
+    :func:`fleet_simulate`.
     """
-    run = _make_forecast_runner(engine, int(steps))
+    _check_layout(layout, engine)
+    if layout == "lanes":
+        run = _make_lanes_forecast_runner(int(steps))
+    else:
+        run = _make_forecast_runner(engine, int(steps))
     t_last = (
         jnp.full(fleet.batch, fleet.y.shape[1], jnp.int32)
         if fleet.t_steps is None else jnp.asarray(fleet.t_steps, jnp.int32)
@@ -1491,6 +1497,23 @@ def _make_lanes_innovations_runner(standardized):
             standardized=standardized, warmup=warmup,
         )
         return jnp.transpose(v, (2, 0, 1)), jnp.transpose(f, (2, 0, 1))
+
+    return jax.jit(run)
+
+
+@functools.lru_cache(maxsize=16)
+def _make_lanes_forecast_runner(steps):
+    from ..ops.lanes_products import lanes_forecast
+
+    def run(p, y, mask, loadings, dt, t_last):
+        phi, q, z, r = _lanes_ss_chunk(p, loadings, dt)
+        pm, pv = lanes_forecast(
+            phi, q, z, r,
+            jnp.transpose(y, (1, 2, 0)),
+            jnp.transpose(mask, (1, 2, 0)),
+            t_last, steps,
+        )
+        return jnp.transpose(pm, (2, 0, 1)), jnp.transpose(pv, (2, 0, 1))
 
     return jax.jit(run)
 

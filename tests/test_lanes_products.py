@@ -202,6 +202,24 @@ def test_unknown_layout_raises(params, fleet):
         fleet_innovations(params, fleet, layout="Lanes")
 
 
+def test_fleet_forecast_layouts_agree(rng):
+    """Lanes forecast == batch forecast, including per-member t_last
+    (time-padded members forecast from their own data end)."""
+    from metran_tpu.parallel import fleet_forecast
+
+    fleet = make_fleet(rng, b=3, n=4, k=1, t=50)
+    # heterogeneous true lengths: member 1 ends early
+    fleet = fleet._replace(
+        t_steps=jnp.asarray([50, 35, 50], jnp.int32),
+        mask=fleet.mask.at[1, 35:].set(False),
+    )
+    params = jnp.asarray(rng.uniform(5.0, 40.0, (3, fleet.n_params)))
+    pm_l, pv_l = fleet_forecast(params, fleet, steps=12, layout="lanes")
+    pm_b, pv_b = fleet_forecast(params, fleet, steps=12, layout="batch")
+    np.testing.assert_allclose(pm_l, pm_b, rtol=1e-9, atol=1e-10)
+    np.testing.assert_allclose(pv_l, pv_b, rtol=1e-9, atol=1e-10)
+
+
 def test_lanes_sample_states_shape(rng):
     fleet = make_fleet(rng, b=2, n=3, k=1, t=30)
     params = jnp.asarray(rng.uniform(5.0, 40.0, (2, fleet.n_params)))

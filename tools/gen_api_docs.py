@@ -39,6 +39,7 @@ MODULES = [
     "metran_tpu.ops.kalman",
     "metran_tpu.ops.pkalman",
     "metran_tpu.ops.lanes",
+    "metran_tpu.ops.lanes_products",
     "metran_tpu.ops.fa",
     "metran_tpu.parallel.fleet",
     "metran_tpu.parallel.lanes_lbfgs",
