@@ -244,18 +244,6 @@ def check_sequence_sharded_long_t():
     return compile_plus_first
 
 
-def test_sequence_sharded_long_t():
-    """Subprocess-isolated (largest XLA program in the suite)."""
-    from tests.conftest import run_python_subprocess
-
-    res = run_python_subprocess("""
-import tests.test_pkalman as tp
-print("compile+first", tp.check_sequence_sharded_long_t())
-print("SEQ_LONG_OK")
-""", timeout=1200.0)
-    assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
-    assert "SEQ_LONG_OK" in res.stdout
-
 
 def test_sequence_sharded_matches_unsharded():
     """Subprocess-isolated: the sharded filter's compile has hit the
@@ -275,45 +263,3 @@ print("SEQ_SHARD_OK")
     assert "SEQ_SHARD_OK" in res.stdout
 
 
-def test_metran_solve_parallel_engine(series_list):
-    """End-to-end: Metran.solve with the parallel engine reproduces the
-    sequential golden objective on the reference example data.
-
-    Runs in a SUBPROCESS: this is the suite's single largest XLA
-    program (T=6,255 associative-scan smoother), and XLA:CPU's compiler
-    has segfaulted on it when invoked late in a long-lived pytest
-    process with hundreds of prior compilations — while the identical
-    flow passes in a fresh interpreter (round 4, exit 139 in
-    ``backend_compile_and_load``).  Process isolation keeps an upstream
-    compiler bug from taking down the whole suite.
-    """
-    from tests.conftest import run_python_subprocess
-
-    script = """
-import jax
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_enable_x64", True)
-from metran_tpu.models.metran import Metran
-from tests.conftest import load_example_series
-
-import numpy as np
-
-mt = Metran(load_example_series(), engine="parallel")
-# warm-start NEAR (not at) the known golden optimum: the solve still
-# exercises the full optimize-with-parallel-engine path (value+grad
-# iterations, convergence test) but needs a handful of iterations
-# instead of the full cold solve (~1/4 the wall time of this, the
-# suite's single most expensive subprocess)
-mt.get_factors(mt.oseries)
-mt.set_init_parameters()
-golden = np.array([5.50, 13.56, 4.68, 11.38, 13.14, 22.98])
-mt.parameters["initial"] = golden * 1.15
-mt.solve(report=False, init=None)
-assert abs(mt.fit.obj_func - 2332.327) < 0.05, mt.fit.obj_func
-sim = mt.get_simulation(mt.snames[0], alpha=0.05)
-assert sim.shape[1] == 3, sim.shape
-print("PARALLEL_ENGINE_OK")
-"""
-    res = run_python_subprocess(script)
-    assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
-    assert "PARALLEL_ENGINE_OK" in res.stdout
