@@ -1,0 +1,98 @@
+"""Sequence parallelism: filtering a series too long for one device.
+
+The reference's Kalman loop is O(T) sequential (its numba recursion,
+``metran/kalmanfilter.py:236-400``) and everything lives on one host.
+``metran_tpu`` reformulates the filter/smoother as associative scans
+(``ops/pkalman.py``), which makes the TIME axis shardable: each device
+filters its own contiguous chunk of the series, and the devices
+exchange ONE combine element each — the cross-device traffic is
+O(n_devices), independent of T.
+
+This example runs on the CPU backend with 8 virtual devices (the same
+environment the test suite uses), so it works anywhere; on real
+hardware the mesh axis maps onto TPU chips over ICI and the per-shard
+arrays live in each chip's own HBM — sequences that overflow one
+chip's memory simply shard further.
+
+Run:  python examples/long_context_example.py
+"""
+
+import os
+import sys
+
+# runnable from a clean checkout without installing the package
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if "xla_force_host_platform_device_count" not in os.environ.get(
+    "XLA_FLAGS", ""
+):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from metran_tpu.ops import (
+    deviance_terms,
+    dfm_statespace,
+    sequence_sharded_filter,
+)
+
+
+def main():
+    # respect a pre-existing device-count flag: T must divide the mesh
+    n_devices = len(jax.devices())
+    n, k, t = 8, 1, 32_768  # 32k steps: the regime blocking exists for
+    t -= t % n_devices
+    rng = np.random.default_rng(0)
+
+    ss = dfm_statespace(
+        rng.uniform(5.0, 40.0, n),
+        rng.uniform(10.0, 60.0, k),
+        rng.uniform(0.3, 0.8, (n, k)) / np.sqrt(k),
+        1.0,
+    )
+    mask = rng.uniform(size=(t, n)) > 0.3
+    y = np.where(mask, rng.normal(size=(t, n)), 0.0)
+
+    mesh = Mesh(np.asarray(jax.devices()[:n_devices]), ("seq",))
+    print(f"mesh: {n_devices} devices on axis 'seq'; T = {t:,} steps "
+          f"({t // n_devices:,} per device)")
+
+    t0 = time.monotonic()
+    filt, smooth = sequence_sharded_filter(
+        ss, y, mask, mesh, axis="seq", block=512
+    )
+    jax.block_until_ready((filt.mean_f, smooth.mean_s))
+    print(f"compile + first run: {time.monotonic() - t0:.1f} s "
+          "(the unsharded full-length combine tree took 188 s to "
+          "compile on TPU and crashed XLA:CPU at this length)")
+
+    t0 = time.monotonic()
+    filt, smooth = sequence_sharded_filter(
+        ss, y, mask, mesh, axis="seq", block=512
+    )
+    jax.block_until_ready((filt.mean_f, smooth.mean_s))
+    print(f"steady run (filter + smoother): {time.monotonic() - t0:.2f} s")
+
+    dev = float(deviance_terms(filt.sigma, filt.detf, jnp.asarray(mask)))
+    print(f"deviance over the sharded axis: {dev:.3f}")
+
+    # the smoothed states interpolate through the 30% gaps
+    m = np.asarray(smooth.mean_s)
+    print("smoothed state grid:", m.shape,
+          f"finite: {np.isfinite(m).all()}")
+
+
+if __name__ == "__main__":
+    main()

@@ -195,55 +195,6 @@ def check_sequence_sharded_matches_unsharded():
     )
 
 
-def check_sequence_sharded_long_t():
-    """The round-5 criterion: sequence sharding must work in the long-T
-    regime it exists for — T = 32k over the virtual 8-device mesh, with
-    the within-shard BLOCKED scan composed with the sharded time axis
-    (round 4's full-length tree took 188 s to compile on TPU and
-    segfaulted XLA:CPU at T=6,255).  Parity vs the sequential engine
-    (whose O(T) scan compiles in seconds at any T)."""
-    import time
-
-    from jax.sharding import Mesh
-
-    from metran_tpu.ops import (
-        deviance_terms,
-        kalman_filter,
-        rts_smoother,
-        sequence_sharded_filter,
-    )
-
-    rng = np.random.default_rng(11)
-    ss, y, mask = random_ssm(rng, n_series=5, n_factors=1, t=32768,
-                             missing=0.3)
-    mesh = Mesh(np.array(jax.devices()[:8]), ("seq",))
-    t0 = time.monotonic()
-    filt_s, smooth_s = sequence_sharded_filter(
-        ss, y, mask, mesh, axis="seq", block=512
-    )
-    jax.block_until_ready((filt_s.mean_f, smooth_s.mean_s))
-    compile_plus_first = time.monotonic() - t0
-    filt = kalman_filter(ss, y, mask, engine="sequential")
-    smooth = rts_smoother(ss, filt, engine="sequential")
-    np.testing.assert_allclose(
-        np.asarray(filt_s.mean_f), np.asarray(filt.mean_f), atol=1e-8
-    )
-    np.testing.assert_allclose(
-        np.asarray(smooth_s.mean_s), np.asarray(smooth.mean_s),
-        atol=1e-8,
-    )
-    dev_s = deviance_terms(filt_s.sigma, filt_s.detf, mask)
-    dev = deviance_terms(filt.sigma, filt.detf, mask)
-    np.testing.assert_allclose(
-        float(dev_s), float(dev), rtol=1e-10
-    )
-    # the compile-size guard this path exists for: the full-length tree
-    # was 188 s on TPU and a segfault here; allow generous headroom for
-    # contended single-core hosts while still distinguishing regressions
-    assert compile_plus_first < 180.0, compile_plus_first
-    return compile_plus_first
-
-
 
 def test_sequence_sharded_matches_unsharded():
     """Subprocess-isolated: the sharded filter's compile has hit the
