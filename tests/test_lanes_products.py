@@ -159,6 +159,31 @@ def test_chunked_lanes_matches_unchunked(params, fleet):
     np.testing.assert_allclose(pv1, pv2, rtol=1e-12)
 
 
+def test_lanes_products_padded_fleet_matches_batch(rng):
+    """Heterogeneous fleets (padded series slots, padded members, time
+    padding) produce identical products in both layouts — the padding
+    semantics the fit path guarantees extend to the products."""
+    from metran_tpu.parallel import pack_fleet
+    from tests.test_parallel import _random_panel
+
+    panels = [_random_panel(rng, n, 50) for n in (4, 2, 3)]
+    loadings = [rng.uniform(0.3, 0.8, (n, 1)) for n in (4, 2, 3)]
+    fleet = pack_fleet(panels, loadings, pad_batch_to=4)
+    params = jnp.asarray(rng.uniform(5.0, 40.0, (4, fleet.n_params)))
+    pm_l, pv_l = fleet_simulate(params, fleet, layout="lanes", seg=16)
+    pm_b, pv_b = fleet_simulate(params, fleet, layout="batch")
+    np.testing.assert_allclose(pm_l, pm_b, rtol=1e-9, atol=1e-9)
+    np.testing.assert_allclose(pv_l, pv_b, rtol=1e-8, atol=1e-9)
+    v_l, f_l = fleet_innovations(params, fleet, layout="lanes")
+    v_b, f_b = fleet_innovations(params, fleet, layout="batch")
+    np.testing.assert_allclose(v_l, v_b, rtol=1e-9, atol=1e-9)
+    np.testing.assert_allclose(f_l, f_b, rtol=1e-9, atol=1e-9)
+    sdf_l, cdf_l = fleet_decompose(params, fleet, layout="lanes", seg=16)
+    sdf_b, cdf_b = fleet_decompose(params, fleet, layout="batch")
+    np.testing.assert_allclose(sdf_l, sdf_b, rtol=1e-9, atol=1e-9)
+    np.testing.assert_allclose(cdf_l, cdf_b, rtol=1e-9, atol=1e-9)
+
+
 def test_lanes_sample_conditioning_and_moments(rng):
     """Draws pass through observed entries (r=0) and match the smoothed
     mean in expectation."""
