@@ -7,6 +7,7 @@ section 4); parity tests need float64 like the reference.
 
 import os
 import resource
+from pathlib import Path
 
 # XLA:CPU's compiler recurses deeply on large programs (scan
 # transposes, associative-scan combine trees): at the common 8 MB
@@ -41,13 +42,20 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Persistent compile cache for the suite (CPU children only — never
+# shared with TPU runs; see bench.py's SIGILL note on mixing backends).
+# Repeat suite runs skip most XLA:CPU compiles, which both speeds them
+# up and shrinks the cumulative-compiler-state exposure behind the
+# known late-compile segfault.
+_CACHE = str(Path(__file__).resolve().parents[1] / ".cache" / "jax-tests")
+if not os.environ.get("METRAN_TPU_TEST_TPU"):
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _CACHE)
+
 import jax  # noqa: E402
 
 if not os.environ.get("METRAN_TPU_TEST_TPU"):
     jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
-
-from pathlib import Path  # noqa: E402
 
 import numpy as np  # noqa: E402
 import pandas as pd  # noqa: E402
