@@ -14,12 +14,7 @@ import pytest
 
 from metran_tpu.ops import dfm_statespace, kalman_filter, project, rts_smoother
 from metran_tpu.ops.lanes import lanes_statespace
-from metran_tpu.ops.lanes_products import (
-    lanes_filter_project,
-    lanes_innovations,
-    lanes_sample,
-    lanes_smooth,
-)
+from metran_tpu.ops.lanes_products import lanes_innovations, lanes_smooth
 from metran_tpu.parallel import (
     Fleet,
     fleet_decompose,
