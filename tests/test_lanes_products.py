@@ -28,7 +28,8 @@ def make_fleet(rng, b=3, n=4, k=2, t=60, missing=0.3):
     y = rng.normal(size=(b, t, n))
     mask = rng.uniform(size=(b, t, n)) > missing
     mask[:, 0] = False  # no-observation leading timestep
-    mask[1, 5:9] = False  # an all-missing stretch
+    if b > 1 and t > 9:
+        mask[1, 5:9] = False  # an all-missing stretch
     y = np.where(mask, y, 0.0)
     loadings = rng.uniform(0.3, 0.8, (b, n, k)) / np.sqrt(k)
     dt = rng.uniform(0.5, 2.0, b)
@@ -177,6 +178,34 @@ def test_lanes_products_padded_fleet_matches_batch(rng):
     sdf_b, cdf_b = fleet_decompose(params, fleet, layout="batch")
     np.testing.assert_allclose(sdf_l, sdf_b, rtol=1e-9, atol=1e-9)
     np.testing.assert_allclose(cdf_l, cdf_b, rtol=1e-9, atol=1e-9)
+
+
+@pytest.mark.parametrize(
+    "b,n,k,t",
+    [
+        (1, 4, 1, 30),   # single member (no lane-min pad in products)
+        (3, 4, 1, 1),    # single timestep
+        (3, 4, 1, 10),   # T < seg (whole series in one padded segment)
+        (2, 2, 3, 25),   # more factors than series
+    ],
+)
+def test_lanes_products_edge_shapes_match_batch(rng, b, n, k, t):
+    fleet = make_fleet(rng, b=b, n=n, k=k, t=t)
+    if t == 1:
+        # make_fleet masks timestep 0; a 1-step panel needs data
+        fleet = fleet._replace(
+            mask=jnp.ones((b, t, n), bool),
+            y=jnp.asarray(rng.normal(size=(b, t, n))),
+        )
+    params = jnp.asarray(rng.uniform(5.0, 40.0, (b, fleet.n_params)))
+    pm_l, pv_l = fleet_simulate(params, fleet, layout="lanes", seg=16)
+    pm_b, pv_b = fleet_simulate(params, fleet, layout="batch")
+    np.testing.assert_allclose(pm_l, pm_b, rtol=1e-9, atol=1e-9)
+    np.testing.assert_allclose(pv_l, pv_b, rtol=1e-8, atol=1e-9)
+    v_l, f_l = fleet_innovations(params, fleet, layout="lanes")
+    v_b, f_b = fleet_innovations(params, fleet, layout="batch")
+    np.testing.assert_allclose(v_l, v_b, rtol=1e-9, atol=1e-9)
+    np.testing.assert_allclose(f_l, f_b, rtol=1e-9, atol=1e-9)
 
 
 def test_lanes_sample_conditioning_and_moments(rng):
