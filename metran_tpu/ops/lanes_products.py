@@ -49,6 +49,16 @@ from .lanes import (
 )
 
 
+def _zdot(z, m):
+    """Observation-space means ``Z m`` in lane layout: (N, B)."""
+    return jnp.einsum("iaB,aB->iB", z, m)
+
+
+def _zcovz(z, cov):
+    """Projected variances ``diag(Z C Z')`` in lane layout: (N, B)."""
+    return jnp.einsum("iaB,abB,ibB->iB", z, cov, z)
+
+
 def _series_bwd(carry, xs, want_cov: bool):
     """One reverse series update of the D-K adjoints.
 
@@ -70,12 +80,14 @@ def _series_bwd(carry, xs, want_cov: bool):
     r_new = r_adj + z_i * (v / f - kr)
     r_adj = jnp.where(obs, r_new, r_adj)
     if want_cov:
+        # N is symmetric throughout the recursion (starts at 0; the
+        # rank-1 update and the diagonal transition both preserve
+        # symmetry), so N'k == Nk — one reduction instead of two
         nk = jnp.sum(n_adj * k[None, :, :], axis=1)  # N k   (n, B)
-        kn = jnp.sum(n_adj * k[:, None, :], axis=0)  # N' k  (n, B)
         knk = jnp.sum(k * nk, axis=0)  # (B,)
         n_new = (
             n_adj
-            - z_i[:, None, :] * kn[None, :, :]
+            - z_i[:, None, :] * nk[None, :, :]
             - nk[:, None, :] * z_i[None, :, :]
             + z_i[:, None, :] * z_i[None, :, :] * (knk + 1.0 / f)
         )
@@ -95,13 +107,12 @@ def _smooth_emit(phi, z, rn, mean_p, cov_p, want_cov: bool):
     t-1 plus the per-step outputs."""
     r_adj, n_adj = rn
     mean_s = mean_p + jnp.sum(cov_p * r_adj[None, :, :], axis=1)
-    pm = jnp.einsum("iaB,aB->iB", z, mean_s)
+    pm = _zdot(z, mean_s)
     if want_cov:
         dp = jnp.einsum("iaB,ajB->ijB", z, cov_p)  # rows Z P_p  (N, n, B)
-        pv = jnp.einsum("ijB,ijB->iB", z, dp) - jnp.einsum(
-            "iaB,abB,ibB->iB", dp, n_adj, dp
+        pv = jnp.maximum(
+            jnp.einsum("ijB,ijB->iB", z, dp) - _zcovz(dp, n_adj), 0.0
         )
-        pv = jnp.maximum(pv, 0.0)
     else:
         pv = jnp.zeros_like(pm)
     # transition the adjoints across the (diagonal) state recursion
@@ -231,10 +242,8 @@ def lanes_filter_project(
     def step(c, xs):
         c2, _, _ = _adj_step(phi, q, z, r, c, *xs, eye)
         m_f, p_f = c2
-        pm = jnp.einsum("iaB,aB->iB", z, m_f)
-        pv = jnp.maximum(
-            jnp.einsum("iaB,abB,ibB->iB", z, p_f, z), 0.0
-        )
+        pm = _zdot(z, m_f)
+        pv = jnp.maximum(_zcovz(z, p_f), 0.0)
         return c2, (m_f, pm, pv)
 
     _, outs = lax.scan(step, _adj_init_carry(phi, eye), (y, maskf))
@@ -268,13 +277,11 @@ def lanes_innovations(
     def step(c, xs):
         y_t, m_t = xs
         mean_p, cov_p = _predict_step(phi, q, c, eye)
-        pm = jnp.einsum("iaB,aB->iB", z, mean_p)
+        pm = _zdot(z, mean_p)
         # clip like ops.project: with r = 0 a tight posterior can round
         # z'P_p z slightly negative in f32, which would blow up the
         # standardized residual
-        pv = jnp.maximum(
-            jnp.einsum("iaB,abB,ibB->iB", z, cov_p, z), 0.0
-        )
+        pv = jnp.maximum(_zcovz(z, cov_p), 0.0)
         v = y_t - pm
         f = pv + r
         (m_f, p_f, _, _), _ = _update_scan(

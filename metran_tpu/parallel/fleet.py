@@ -171,6 +171,11 @@ def _model_deviance(p, y, mask, loadings, dt, warmup, engine,
     )
 
 
+def _to_lanes(a):
+    """(B, x, y) -> (x, y, B): move the fleet axis into the lane dim."""
+    return jnp.transpose(a, (1, 2, 0))
+
+
 def _lanes_args(params, fleet):
     """Transpose (params, fleet data) so the fleet axis is LAST.
 
@@ -184,9 +189,9 @@ def _lanes_args(params, fleet):
     """
     return (
         params.T,  # (P, B)
-        jnp.transpose(fleet.y, (1, 2, 0)),  # (T, N, B)
-        jnp.transpose(fleet.mask, (1, 2, 0)),
-        jnp.transpose(fleet.loadings, (1, 2, 0)),  # (N, K, B)
+        _to_lanes(fleet.y),  # (T, N, B)
+        _to_lanes(fleet.mask),
+        _to_lanes(fleet.loadings),  # (N, K, B)
         fleet.dt,  # (B,) — rank 1, axis -1 == axis 0
     )
 
@@ -1444,9 +1449,7 @@ def _lanes_ss_chunk(p, loadings, dt):
     runner so _run_chunked's batch-leading slicing applies unchanged)."""
     from ..ops.lanes import lanes_statespace
 
-    return lanes_statespace(
-        p.T, jnp.transpose(loadings, (1, 2, 0)), dt
-    )
+    return lanes_statespace(p.T, _to_lanes(loadings), dt)
 
 
 @functools.lru_cache(maxsize=16)
@@ -1459,8 +1462,8 @@ def _make_lanes_simulate_runner(smooth, decompose, seg):
 
     def run(p, y, mask, loadings, dt):
         phi, q, z, r = _lanes_ss_chunk(p, loadings, dt)
-        y_l = jnp.transpose(y, (1, 2, 0))
-        m_l = jnp.transpose(mask, (1, 2, 0))
+        y_l = _to_lanes(y)
+        m_l = _to_lanes(mask)
         if smooth:
             ms, pm, pv = lanes_smooth(
                 phi, q, z, r, y_l, m_l, seg=seg,
@@ -1473,8 +1476,9 @@ def _make_lanes_simulate_runner(smooth, decompose, seg):
             # z = [I | loadings]: the specific block of the projection
             # is the first n smoothed states themselves
             sdf = jnp.transpose(ms[:, :n, :], (2, 0, 1))
-            ld_l = jnp.transpose(loadings, (1, 2, 0))
-            cdf = jnp.einsum("ikB,tkB->Bkti", ld_l, ms[:, n:, :])
+            cdf = jnp.einsum(
+                "ikB,tkB->Bkti", _to_lanes(loadings), ms[:, n:, :]
+            )
             return sdf, cdf
         return (
             jnp.transpose(pm, (2, 0, 1)),
@@ -1491,9 +1495,7 @@ def _make_lanes_innovations_runner(standardized):
     def run(p, y, mask, loadings, dt, warmup):
         phi, q, z, r = _lanes_ss_chunk(p, loadings, dt)
         v, f = lanes_innovations(
-            phi, q, z, r,
-            jnp.transpose(y, (1, 2, 0)),
-            jnp.transpose(mask, (1, 2, 0)),
+            phi, q, z, r, _to_lanes(y), _to_lanes(mask),
             standardized=standardized, warmup=warmup,
         )
         return jnp.transpose(v, (2, 0, 1)), jnp.transpose(f, (2, 0, 1))
@@ -1508,10 +1510,7 @@ def _make_lanes_forecast_runner(steps):
     def run(p, y, mask, loadings, dt, t_last):
         phi, q, z, r = _lanes_ss_chunk(p, loadings, dt)
         pm, pv = lanes_forecast(
-            phi, q, z, r,
-            jnp.transpose(y, (1, 2, 0)),
-            jnp.transpose(mask, (1, 2, 0)),
-            t_last, steps,
+            phi, q, z, r, _to_lanes(y), _to_lanes(mask), t_last, steps,
         )
         return jnp.transpose(pm, (2, 0, 1)), jnp.transpose(pv, (2, 0, 1))
 
@@ -1527,9 +1526,7 @@ def _make_lanes_sample_runner(n_draws, seg, project):
         # per-model keys: draws are a function of each member's key
         # only, so chunking the fleet axis does not change results
         draws = lanes_sample(
-            phi, q, z, r,
-            jnp.transpose(y, (1, 2, 0)),
-            jnp.transpose(mask, (1, 2, 0)),
+            phi, q, z, r, _to_lanes(y), _to_lanes(mask),
             keys, n_draws=n_draws, seg=seg, project=project,
         )  # (D, T, *, B)
         # 1-tuple: _run_chunked concatenates per-output
@@ -1682,11 +1679,9 @@ def _make_stderr_lanes_runner(warmup, remat_seg):
         )  # (B, 2P, P): model-major, matching jnp.repeat below
         reps = 2 * n_p
         alpha_t = pert.reshape(b * reps, n_p).T  # (P, B*2P)
-        y_l = jnp.repeat(jnp.transpose(y, (1, 2, 0)), reps, axis=-1)
-        mask_l = jnp.repeat(jnp.transpose(mask, (1, 2, 0)), reps, axis=-1)
-        ld_l = jnp.repeat(
-            jnp.transpose(loadings, (1, 2, 0)), reps, axis=-1
-        )
+        y_l = jnp.repeat(_to_lanes(y), reps, axis=-1)
+        mask_l = jnp.repeat(_to_lanes(mask), reps, axis=-1)
+        ld_l = jnp.repeat(_to_lanes(loadings), reps, axis=-1)
         dt_l = jnp.repeat(dt, reps)
 
         val, vjp = jax.vjp(
