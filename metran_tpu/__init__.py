@@ -19,6 +19,7 @@ __all__ = [
     "load_model",
     "save_model",
     "ops",
+    "serve",
     "utils",
     "show_versions",
     "__version__",
@@ -36,4 +37,12 @@ def __getattr__(name):
         from .models import solver
 
         return getattr(solver, name)
+    if name in ("serve", "MetranService", "ModelRegistry",
+                "PosteriorState"):
+        # importlib, not `from . import serve`: the latter re-enters
+        # this __getattr__ for the not-yet-bound submodule attribute
+        import importlib
+
+        serve = importlib.import_module(".serve", __name__)
+        return serve if name == "serve" else getattr(serve, name)
     raise AttributeError(f"module 'metran_tpu' has no attribute {name!r}")

@@ -88,5 +88,73 @@ def is_accelerator() -> bool:
         return False
 
 
+def shard_map_compat(f, mesh, in_specs, out_specs, check_vma: bool = False):
+    """``jax.shard_map`` across jax versions.
+
+    The top-level ``jax.shard_map`` (with ``check_vma``) only exists
+    from jax 0.6; earlier versions ship it as
+    ``jax.experimental.shard_map.shard_map`` with the equivalent switch
+    named ``check_rep``.  One wrapper so the sharded filter and the
+    explicit-SPMD fleet path run on both.
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
+
+
+# ----------------------------------------------------------------------
+# serving defaults (metran_tpu.serve)
+# ----------------------------------------------------------------------
+SERVE_FLUSH_DEADLINE_S = 0.005  # micro-batch coalescing window
+SERVE_MAX_BATCH = 256  # a batch this full dispatches immediately
+SERVE_BUCKET_MULTIPLE = 8  # shape-bucket rounding for (n_series, n_state)
+SERVE_MAX_COMPILED = 32  # LRU capacity for compiled serve kernels
+
+
+def serve_defaults() -> dict:
+    """Serving-layer knobs, each overridable via ``METRAN_TPU_SERVE_*``.
+
+    ``flush_deadline_s`` trades tail latency for batch occupancy (the
+    classic micro-batching dial); ``bucket_multiple`` trades padding
+    FLOPs for executable reuse across heterogeneous models.  Read at
+    :class:`~metran_tpu.serve.ModelRegistry` /
+    :class:`~metran_tpu.serve.MetranService` construction.
+    """
+
+    def _env(name, cast, default):
+        raw = os.environ.get(name)
+        if raw is None or raw == "":
+            return default
+        try:
+            return cast(raw)
+        except ValueError:
+            logger.warning("ignoring unparsable %s=%r", name, raw)
+            return default
+
+    return {
+        "flush_deadline_s": _env(
+            "METRAN_TPU_SERVE_FLUSH_DEADLINE_S", float,
+            SERVE_FLUSH_DEADLINE_S,
+        ),
+        "max_batch": _env(
+            "METRAN_TPU_SERVE_MAX_BATCH", int, SERVE_MAX_BATCH
+        ),
+        "bucket_multiple": _env(
+            "METRAN_TPU_SERVE_BUCKET_MULTIPLE", int, SERVE_BUCKET_MULTIPLE
+        ),
+        "max_compiled": _env(
+            "METRAN_TPU_SERVE_MAX_COMPILED", int, SERVE_MAX_COMPILED
+        ),
+    }
+
+
 if os.environ.get("METRAN_TPU_X64", "").lower() in ("1", "true", "yes"):
     enable_x64(True)

@@ -42,16 +42,33 @@ class LoadedFit:
 def atomic_savez(path, **arrays) -> Path:
     """Write ``arrays`` to ``path`` as an ``.npz``, atomically.
 
-    Writes to a ``.tmp.npz`` sibling (the suffix keeps ``np.savez``
-    from appending its own) and renames into place, so readers never
-    observe a half-written checkpoint.  Shared by the fleet-state
-    checkpoints below and the sweep runner's per-batch results
-    (``parallel/sweep.py``).
+    Writes to a uniquely-named temp sibling (pid + random suffix; a
+    FIXED tmp name let two concurrent writers — e.g. a fleet checkpoint
+    and a serve posterior-state flush in the same directory — clobber
+    each other's half-written file), fsyncs so the rename can never
+    publish an empty/partial file after a crash, then renames into
+    place, so readers never observe a half-written checkpoint.  The
+    ``.npz`` suffix on the temp name keeps ``np.savez`` from appending
+    its own.  Shared by the fleet-state checkpoints below, the sweep
+    runner's per-batch results (``parallel/sweep.py``) and the serving
+    layer's posterior states (``serve/state.py``).
     """
+    import os
+    import uuid
+
     path = Path(path)
-    tmp = path.with_suffix(".tmp.npz")
-    np.savez(tmp, **arrays)
-    tmp.replace(path)
+    tmp = path.with_name(
+        f".{path.name}.{os.getpid()}-{uuid.uuid4().hex[:8]}.tmp.npz"
+    )
+    try:
+        with open(tmp, "wb") as fh:
+            np.savez(fh, **arrays)
+            fh.flush()
+            os.fsync(fh.fileno())
+        tmp.replace(path)
+    finally:
+        if tmp.exists():  # only on a failed write/rename
+            tmp.unlink()
     return path
 
 
@@ -233,13 +250,27 @@ def load_fleet_state(path, like_theta, like_state, like_frozen):
         if n_stored != len(leaves) or any(k not in data for k in keys):
             return None
         stored = [data[k] for k in keys]
+
         # shape AND dtype must match the live template: a checkpoint
         # written under a different precision mode (e.g. jax_enable_x64
-        # flipped) would otherwise silently promote the resumed fit
-        if any(
-            s.shape != np.shape(l) or s.dtype != np.result_type(l)
-            for s, l in zip(stored, leaves)
-        ):
+        # flipped) would otherwise silently promote the resumed fit.
+        # Integer width is the one tolerated drift: optax narrows some
+        # counter leaves (e.g. ``info.num_linesearch_steps``) to int32
+        # inside an update step while a fresh x64 ``opt.init`` template
+        # carries a WEAK-typed int64, so a checkpoint written mid-run
+        # never dtype-matches the restore template exactly.  The stored
+        # dtype is kept (it is exactly what an uninterrupted run's carry
+        # holds); only the int-vs-int compatibility is checked.
+        def compatible(s, l):
+            if s.shape != np.shape(l):
+                return False
+            want = np.result_type(l)
+            return s.dtype == want or (
+                np.issubdtype(s.dtype, np.integer)
+                and np.issubdtype(want, np.integer)
+            )
+
+        if any(not compatible(s, l) for s, l in zip(stored, leaves)):
             return None
         theta, state, frozen = jax.tree_util.tree_unflatten(treedef, stored)
         prev_value = data["prev_value"]

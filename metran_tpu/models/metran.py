@@ -878,6 +878,15 @@ class Metran:
 
         return _io.load_model(path, cls=cls)
 
+    def to_posterior_state(self, model_id=None, p=None):
+        """Freeze this model into a serving :class:`~metran_tpu.serve.
+        PosteriorState` (filtered posterior at the last timestep plus
+        matrices and scaler stats) for the online-assimilation service;
+        see :mod:`metran_tpu.serve`."""
+        from ..serve.state import posterior_state_from_metran
+
+        return posterior_state_from_metran(self, model_id=model_id, p=p)
+
     # ------------------------------------------------------------------
     # reports
     # ------------------------------------------------------------------

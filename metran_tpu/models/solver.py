@@ -280,6 +280,39 @@ def jax_sigmoid(x):
     return jax.nn.sigmoid(x)
 
 
+def tree_norm(tree):
+    """Global l2 norm of a pytree.
+
+    ``optax.tree_utils.tree_norm`` where available (>= 0.2.5), falling
+    back to the older ``tree_l2_norm`` name so a pinned-down environment
+    still solves.
+    """
+    import optax.tree_utils as otu
+
+    fn = getattr(otu, "tree_norm", None) or otu.tree_l2_norm
+    return fn(tree)
+
+
+def zoom_linesearch(max_linesearch_steps: int):
+    """Zoom line search restarting each search at step length 1.
+
+    ``initial_guess_strategy="one"`` is optax's own default for
+    ``optax.lbfgs()`` but only exists as a kwarg from 0.2.4; older
+    versions hardcode the equivalent behavior, so just drop it there.
+    """
+    import optax
+
+    try:
+        return optax.scale_by_zoom_linesearch(
+            max_linesearch_steps=max_linesearch_steps,
+            initial_guess_strategy="one",
+        )
+    except TypeError:
+        return optax.scale_by_zoom_linesearch(
+            max_linesearch_steps=max_linesearch_steps
+        )
+
+
 def lbfgs_advance(objective, opt, theta, state, tol, maxiter, max_new_iters,
                   nfev=0):
     """Advance an optax L-BFGS run by up to ``max_new_iters`` iterations.
@@ -319,7 +352,7 @@ def lbfgs_advance(objective, opt, theta, state, tol, maxiter, max_new_iters,
     def cond(carry):
         _, state, _ = carry
         count = otu.tree_get(state, "count")
-        err = otu.tree_norm(otu.tree_get(state, "grad"))
+        err = tree_norm(otu.tree_get(state, "grad"))
         return (
             ((count == 0) | (err >= tol))
             & (count < maxiter)
@@ -407,7 +440,7 @@ def run_lbfgs(objective, theta0, maxiter: int = 200,
         theta, state, nfev = advance(theta, state, nfev)
         value = float(otu.tree_get(state, "value"))
         count = int(otu.tree_get(state, "count"))
-        gnorm = float(otu.tree_norm(otu.tree_get(state, "grad")))
+        gnorm = float(tree_norm(otu.tree_get(state, "grad")))
         if not _np.isfinite(value):
             break  # diverged — never report success
         if gnorm < tol:

@@ -7,6 +7,8 @@ from .kalman import (
     decompose_states,
     deviance,
     deviance_terms,
+    filter_append,
+    filter_update,
     innovations,
     kalman_filter,
     log_likelihood,
@@ -50,6 +52,8 @@ __all__ = [
     "deviance",
     "deviance_terms",
     "dfm_statespace",
+    "filter_append",
+    "filter_update",
     "kalman_filter",
     "lanes_deviance_terms",
     "lanes_dfm_deviance",

@@ -229,6 +229,84 @@ def kalman_filter(
     return FilterResult(mean_T, cov_T, mean_T, cov_T, steps.sigma, steps.detf)
 
 
+@functools.partial(jax.jit, static_argnames=("engine",))
+def filter_update(
+    ss: StateSpace,
+    mean: jnp.ndarray,
+    cov: jnp.ndarray,
+    y_t: jnp.ndarray,
+    mask_t: jnp.ndarray,
+    engine: str = "sequential",
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One online-assimilation step from an arbitrary carried posterior.
+
+    Exactly the predict+update body of one :func:`kalman_filter`
+    timestep (the same ``_make_core_step`` the scan uses, so the two
+    cannot drift apart), but exposed as a standalone entry point: given
+    the filtered posterior ``N(mean, cov)`` at time ``t-1`` and one new
+    observation row, return the filtered posterior at ``t`` plus that
+    step's likelihood terms.  This is what turns the filter into an
+    incremental service — appending an observation costs one step, not
+    a full-history refilter (``serve/engine.py`` builds on it).
+
+    Returns ``(mean_f, cov_f, sigma, detf)``; ``sigma``/``detf`` are the
+    step's ``v^2/f`` and ``log f`` sums (zero when ``mask_t`` is all
+    False, matching the scan's no-op semantics for missing rows).
+    """
+    dtype = ss.q.dtype
+    core = _make_core_step(ss, engine, dtype)
+    _, _, mean_f, cov_f, sigma, detf = core(
+        jnp.asarray(mean, dtype), jnp.asarray(cov, dtype),
+        jnp.asarray(y_t, dtype), jnp.asarray(mask_t, bool),
+    )
+    return mean_f, cov_f, sigma, detf
+
+
+@functools.partial(jax.jit, static_argnames=("engine",))
+def filter_append(
+    ss: StateSpace,
+    mean: jnp.ndarray,
+    cov: jnp.ndarray,
+    y_new: jnp.ndarray,
+    mask_new: jnp.ndarray,
+    engine: str = "sequential",
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Assimilate ``k`` appended observation rows from a carried posterior.
+
+    Runs ONLY the new timesteps through the filter recursion, starting
+    from the filtered posterior ``N(mean, cov)`` at the last already-
+    assimilated timestep — the incremental-update path of the serving
+    layer.  Equivalent (to float tolerance) to refiltering the full
+    history and reading the final carry, at O(k) cost instead of O(T).
+
+    Parameters
+    ----------
+    y_new : (k, n_obs) appended observations (masked entries ignored).
+    mask_new : (k, n_obs) bool, True where a real observation is present.
+
+    Returns
+    -------
+    ``(mean_T, cov_T, sigma, detf)``: the filtered posterior after the
+    last appended step and the per-step (k,) likelihood-term arrays.
+    """
+    dtype = ss.q.dtype
+    y_new = jnp.atleast_2d(jnp.asarray(y_new, dtype))
+    mask_new = jnp.atleast_2d(jnp.asarray(mask_new, bool))
+    core = _make_core_step(ss, engine, dtype)
+
+    def step(carry, xs):
+        m, p = carry
+        y_t, mask_t = xs
+        _, _, mean_f, cov_f, sigma, detf = core(m, p, y_t, mask_t)
+        return (mean_f, cov_f), (sigma, detf)
+
+    (mean_T, cov_T), (sigma, detf) = lax.scan(
+        step, (jnp.asarray(mean, dtype), jnp.asarray(cov, dtype)),
+        (y_new, mask_new),
+    )
+    return mean_T, cov_T, sigma, detf
+
+
 def deviance_terms(
     sigma: jnp.ndarray, detf: jnp.ndarray, mask: jnp.ndarray, warmup: int = 1
 ) -> jnp.ndarray:

@@ -413,8 +413,9 @@ def _sharded_associative_scan(combine, elements, mesh, axis, block,
     by the mesh axis size (pad with masked steps first; the filter
     treats them as ordinary all-missing timesteps).
     """
-    from jax import shard_map
     from jax.sharding import PartitionSpec
+
+    from ..config import shard_map_compat as shard_map
 
     n_dev = mesh.shape[axis]
     t = jax.tree.leaves(elements)[0].shape[0]

@@ -444,11 +444,13 @@ def _solve_chunk(theta, state, frozen, y, mask, loadings, dt, warmup,
 def _chunk_outputs(theta, state, tol, theta_cap):
     import optax.tree_utils as otu
 
+    from ..models.solver import tree_norm
+
     return (
         _theta_to_alpha(theta, theta_cap),
         otu.tree_get(state, "value"),
         otu.tree_get(state, "count"),
-        otu.tree_norm(otu.tree_get(state, "grad")) < tol,
+        tree_norm(otu.tree_get(state, "grad")) < tol,
     )
 
 
@@ -463,13 +465,11 @@ def _make_chunk_runner(warmup, engine, tol, chunk, maxiter,
     """
     import optax
 
-    opt = optax.lbfgs(
-        linesearch=optax.scale_by_zoom_linesearch(
-            max_linesearch_steps=max_linesearch_steps,
-            # optax.lbfgs()'s default: restart each linesearch at step 1
-            initial_guess_strategy="one",
-        )
-    )
+    from ..models.solver import zoom_linesearch
+
+    # optax.lbfgs()'s default behavior: restart each linesearch at step
+    # 1 (the compat wrapper drops the kwarg on optax < 0.2.4)
+    opt = optax.lbfgs(linesearch=zoom_linesearch(max_linesearch_steps))
 
     def advance(theta, state, frozen, y, mask, loadings, dt):
         return _solve_chunk(
@@ -1020,15 +1020,17 @@ def fit_fleet(
                 tree,
             )
 
+        from ..config import shard_map_compat
+
         carry_spec = (bspec(theta), bspec(state))
-        advance = jax.jit(jax.shard_map(
+        advance = jax.jit(shard_map_compat(
             advance, mesh=mesh,
             in_specs=(carry_spec[0], carry_spec[1], bspec(frozen))
             + tuple(bspec(a) for a in data_args),
             out_specs=carry_spec, check_vma=False,
         ))
         out_shapes = jax.eval_shape(outputs, theta, state)
-        outputs = jax.jit(jax.shard_map(
+        outputs = jax.jit(shard_map_compat(
             outputs, mesh=mesh, in_specs=carry_spec,
             out_specs=bspec(out_shapes), check_vma=False,
         ))

@@ -1,0 +1,49 @@
+"""Online assimilation & batched forecast serving for fitted Metran DFMs.
+
+The fitting stack (``models``, ``parallel``) ends at a fitted model or
+fleet; this subsystem turns those into a query-able service that never
+refilters history:
+
+- :mod:`~metran_tpu.serve.state` — :class:`PosteriorState`, the
+  versioned warm handle (filtered posterior at T + matrices + scaler
+  stats), persisted one-``.npz``-per-model;
+- :mod:`~metran_tpu.serve.engine` — jitted, vmap-batched incremental
+  update (O(k) per k appended observations) and closed-form forecast
+  (O(1) in history);
+- :mod:`~metran_tpu.serve.registry` — :class:`ModelRegistry`: disk/
+  memory state storage, shape buckets so one compiled executable serves
+  many heterogeneous models, LRU of compiled kernels;
+- :mod:`~metran_tpu.serve.batching` — :class:`MicroBatcher`: deadline/
+  size-bounded coalescing of concurrent requests into single device
+  dispatches;
+- :mod:`~metran_tpu.serve.service` — :class:`MetranService`, the
+  in-process ``update``/``forecast`` API with latency and occupancy
+  telemetry.
+
+See the "Online assimilation & serving" section of docs/concepts.md.
+"""
+
+from .batching import MicroBatcher
+from .engine import forecast_bucket, stack_bucket, update_bucket
+from .registry import CompiledFnCache, ModelRegistry
+from .service import Forecast, MetranService, ServeMetrics
+from .state import (
+    PosteriorState,
+    posterior_state_from_metran,
+    posterior_states_from_fleet,
+)
+
+__all__ = [
+    "CompiledFnCache",
+    "Forecast",
+    "MetranService",
+    "MicroBatcher",
+    "ModelRegistry",
+    "PosteriorState",
+    "ServeMetrics",
+    "forecast_bucket",
+    "posterior_state_from_metran",
+    "posterior_states_from_fleet",
+    "stack_bucket",
+    "update_bucket",
+]

@@ -1,0 +1,202 @@
+"""Micro-batching: coalesce concurrent requests into one device dispatch.
+
+Serving-heavy traffic means many small concurrent requests against many
+models; dispatching each alone wastes the accelerator (a (1, ...) batch
+pays the same launch latency as a (256, ...) one).  The
+:class:`MicroBatcher` holds each incoming request for at most
+``flush_deadline`` seconds, grouping by *batch key* — (kind, shape
+bucket, horizon/k) — so everything in a group is servable by ONE
+compiled executable, then hands the whole group to the dispatch
+callback as a single batch.  A group also flushes early the moment it
+reaches ``max_batch``.
+
+The batcher is transport-agnostic: callers get ``concurrent.futures.
+Future``\\ s, the dispatch callback resolves them.  ``flush_deadline=
+None`` disables the background flusher entirely — requests then only
+move on explicit :meth:`flush` (deterministic mode: tests, and callers
+that already aggregate upstream).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from logging import getLogger
+from typing import Any, Callable, Dict, Hashable, List, Optional
+
+logger = getLogger(__name__)
+
+
+@dataclass
+class Request:
+    """One queued request; ``payload`` is opaque to the batcher."""
+
+    model_id: str
+    payload: Any
+    future: Future = field(default_factory=Future)
+    enqueued_at: float = field(default_factory=time.monotonic)
+
+
+@dataclass
+class _Group:
+    requests: List[Request] = field(default_factory=list)
+    first_at: float = 0.0
+
+
+class MicroBatcher:
+    """Deadline/size-bounded request coalescing (see module docstring).
+
+    Parameters
+    ----------
+    dispatch : ``dispatch(batch_key, requests) -> list`` returning one
+        result per request IN ORDER (or raising — the exception then
+        fails every future in the batch).
+    flush_deadline : seconds a request may wait for co-batching
+        (``None``: manual :meth:`flush` only, no background thread).
+    max_batch : a group reaching this size flushes immediately.
+    """
+
+    def __init__(
+        self,
+        dispatch: Callable[[Hashable, List[Request]], List[Any]],
+        flush_deadline: Optional[float] = 0.005,
+        max_batch: int = 256,
+    ):
+        self._dispatch = dispatch
+        self.flush_deadline = flush_deadline
+        self.max_batch = int(max_batch)
+        self._groups: Dict[Hashable, _Group] = {}
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._closed = False
+        self._worker: Optional[threading.Thread] = None
+        if flush_deadline is not None:
+            self._worker = threading.Thread(
+                target=self._run, name="metran-serve-batcher", daemon=True
+            )
+            self._worker.start()
+
+    # ------------------------------------------------------------------
+    def submit(self, batch_key: Hashable, model_id: str, payload) -> Future:
+        """Enqueue one request; resolve via the returned future."""
+        req = Request(model_id=model_id, payload=payload)
+        flush_now = None
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            group = self._groups.get(batch_key)
+            if group is None:
+                group = self._groups[batch_key] = _Group(
+                    first_at=req.enqueued_at
+                )
+            group.requests.append(req)
+            if len(group.requests) >= self.max_batch:
+                flush_now = self._groups.pop(batch_key)
+            else:
+                self._wake.notify()
+        if flush_now is not None:
+            # size-triggered flush runs on the submitting thread: the
+            # batch is already as full as it is allowed to get, waiting
+            # for the worker would only add deadline latency
+            self._fire(batch_key, flush_now.requests)
+        return req.future
+
+    def flush(self, batch_key: Optional[Hashable] = None) -> int:
+        """Dispatch pending group(s) now; returns requests dispatched."""
+        with self._lock:
+            if batch_key is not None:
+                groups = (
+                    {batch_key: self._groups.pop(batch_key)}
+                    if batch_key in self._groups else {}
+                )
+            else:
+                groups, self._groups = self._groups, {}
+        n = 0
+        for key, group in groups.items():
+            self._fire(key, group.requests)
+            n += len(group.requests)
+        return n
+
+    def pending(self) -> int:
+        with self._lock:
+            return sum(len(g.requests) for g in self._groups.values())
+
+    def close(self) -> None:
+        """Flush everything and stop the background worker."""
+        with self._lock:
+            self._closed = True
+            self._wake.notify_all()
+        if self._worker is not None:
+            self._worker.join(timeout=5.0)
+        self.flush()
+
+    def __enter__(self) -> "MicroBatcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _resolve_future(future: Future, result=None, exc=None) -> None:
+        """Set a future's outcome, tolerating caller-side cancellation.
+
+        Callers hold standard futures and may cancel a queued request;
+        an unguarded ``set_result`` on a cancelled future raises
+        ``InvalidStateError`` on the flusher thread — which would kill
+        it and hang every subsequent request.
+        """
+        try:
+            if exc is not None:
+                if not future.done():
+                    future.set_exception(exc)
+            elif future.set_running_or_notify_cancel():
+                future.set_result(result)
+        except Exception:  # cancelled/raced: the caller gave up on it
+            logger.debug("dropping result for a cancelled request")
+
+    def _fire(self, batch_key, requests: List[Request]) -> None:
+        try:
+            results = self._dispatch(batch_key, requests)
+            if len(results) != len(requests):
+                raise RuntimeError(
+                    f"dispatch returned {len(results)} results for "
+                    f"{len(requests)} requests (key {batch_key})"
+                )
+        except BaseException as exc:  # noqa: BLE001 — fail the futures
+            for req in requests:
+                self._resolve_future(req.future, exc=exc)
+            return
+        for req, res in zip(requests, results):
+            self._resolve_future(req.future, result=res)
+
+    def _run(self) -> None:
+        """Background flusher: wake at the earliest group deadline."""
+        while True:
+            due: List = []
+            with self._lock:
+                while not self._closed:
+                    now = time.monotonic()
+                    deadlines = [
+                        g.first_at + self.flush_deadline
+                        for g in self._groups.values()
+                    ]
+                    if deadlines and min(deadlines) <= now:
+                        break
+                    self._wake.wait(
+                        timeout=(min(deadlines) - now) if deadlines else None
+                    )
+                if self._closed:
+                    return
+                now = time.monotonic()
+                for key in list(self._groups):
+                    group = self._groups[key]
+                    if group.first_at + self.flush_deadline <= now:
+                        due.append((key, self._groups.pop(key)))
+            for key, group in due:
+                self._fire(key, group.requests)
+
+
+__all__ = ["MicroBatcher", "Request"]

@@ -1,0 +1,178 @@
+"""Jitted serving kernels: batched incremental update and forecast.
+
+One compiled executable per *shape bucket* serves every model padded
+into that bucket: the bucket's models are stacked along a leading batch
+axis and the per-model computation — :func:`metran_tpu.ops.
+filter_append` for assimilation, :func:`metran_tpu.ops.
+forecast_observation_moments` for forecasts — rides ``vmap``.  Both
+kernels are O(k)/O(1) in the model's history length: the whole point of
+serving from a :class:`~metran_tpu.serve.state.PosteriorState` is that
+the observation history never enters the hot path.
+
+Padding semantics (the same contract the fleet layer verifies for its
+padded slots, ``parallel/fleet.py``): a padded observation slot is
+masked False at every appended timestep and carries zero factor
+loadings, so it never touches the gain, the likelihood terms or the
+real slots' moments; a padded state slot starts at the filter's
+``N(0, 1)`` init with zero cross-covariance and stays decoupled.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import filter_append, forecast_observation_moments
+from ..ops.statespace import StateSpace, dfm_statespace
+
+
+class BucketBatch(NamedTuple):
+    """A shape bucket's models stacked for one device dispatch.
+
+    Every leaf leads with the batch axis B; ``ss`` is a
+    :class:`StateSpace` whose leaves are (B, ...) stacked matrices.
+    """
+
+    ss: StateSpace
+    mean: jnp.ndarray  # (B, S)
+    cov: jnp.ndarray  # (B, S, S)
+
+
+def state_slot_index(n_series: int, n_factors: int, n_obs_pad: int) -> np.ndarray:
+    """Indices of a model's true state slots inside the padded layout.
+
+    The padded state ordering is ``[sdf_0..sdf_{N-1}, cdf_0..]`` with N
+    = ``n_obs_pad``, so a model with ``n_series`` real series and
+    ``n_factors`` real factors occupies slots ``[0:n_series]`` and
+    ``[n_obs_pad : n_obs_pad + n_factors]``.
+    """
+    return np.concatenate(
+        [np.arange(n_series), n_obs_pad + np.arange(n_factors)]
+    )
+
+
+def pad_state_arrays(state, bucket: Tuple[int, int], dtype=None):
+    """Pad one PosteriorState's arrays into bucket shape ``(N, S)``.
+
+    Returns ``(alpha_sdf (N,), alpha_cdf (S-N,), loadings (N, S-N),
+    mean (S,), cov (S, S))`` host-side arrays.  Padded alphas are 1.0
+    (a harmless fast-decay AR(1) nobody observes), padded loadings are
+    zero, padded mean/cov slots carry the filter's ``N(0, I)`` init
+    with zero cross-covariance — all invisible to the real slots (see
+    module docstring).
+    """
+    n_pad, s_pad = bucket
+    n, k = state.n_series, state.n_factors
+    if n > n_pad or k > s_pad - n_pad:
+        raise ValueError(
+            f"model {state.model_id!r} shape ({n}, {n + k}) does not fit "
+            f"bucket {bucket} (padded layout [sdf*{n_pad} | cdf*{s_pad - n_pad}])"
+        )
+    if dtype is None:
+        dtype = state.dtype
+    k_pad = s_pad - n_pad
+    alpha = np.ones(s_pad, dtype)
+    alpha[:n] = state.params[:n]
+    alpha[n_pad:n_pad + k] = state.params[n:]
+    loadings = np.zeros((n_pad, k_pad), dtype)
+    loadings[:n, :k] = state.loadings
+    idx = state_slot_index(n, k, n_pad)
+    mean = np.zeros(s_pad, dtype)
+    mean[idx] = state.mean
+    cov = np.eye(s_pad, dtype=dtype)
+    cov[np.ix_(idx, idx)] = state.cov
+    return alpha[:n_pad], alpha[n_pad:], loadings, mean, cov
+
+
+def stack_bucket(states: List, bucket: Tuple[int, int], dtype=None) -> BucketBatch:
+    """Stack heterogeneous same-bucket models into one :class:`BucketBatch`.
+
+    The state-space build itself (``dfm_statespace``) runs vmapped on
+    device, so the host only stacks small parameter arrays.
+    """
+    if dtype is None:
+        dtype = states[0].dtype
+    padded = [pad_state_arrays(st, bucket, dtype) for st in states]
+    a_sdf, a_cdf, lds, means, covs = (
+        jnp.asarray(np.stack(part)) for part in zip(*padded)
+    )
+    dts = jnp.asarray(np.array([st.dt for st in states], dtype))
+    ss = _build_statespace(a_sdf, a_cdf, lds, dts)
+    return BucketBatch(ss=ss, mean=means, cov=covs)
+
+
+@jax.jit
+def _build_statespace(alpha_sdf, alpha_cdf, loadings, dt) -> StateSpace:
+    """(B,)-batched DFM state-space build (leaves lead with B)."""
+    return jax.vmap(dfm_statespace)(alpha_sdf, alpha_cdf, loadings, dt)
+
+
+def make_update_fn(engine: str = "joint"):
+    """A fresh jitted batched incremental-update kernel.
+
+    ``fn(ss, mean, cov, y_new, mask_new) -> (mean_T, cov_T, sigma,
+    detf)`` with every argument batch-leading; ``y_new``/``mask_new``
+    are (B, k, N).  A *fresh* ``jax.jit`` wrapper per call site so the
+    registry's LRU eviction actually frees the underlying executables
+    (a module-level jit would pin every bucket's compilation forever).
+    """
+
+    @jax.jit
+    def fn(ss, mean, cov, y_new, mask_new):
+        return jax.vmap(
+            lambda s, m, c, y, k: filter_append(s, m, c, y, k, engine=engine)
+        )(ss, mean, cov, y_new, mask_new)
+
+    return fn
+
+
+def make_forecast_fn(steps: int):
+    """A fresh jitted batched forecast kernel.
+
+    ``fn(ss, mean, cov) -> (means, variances)`` of shape (B, steps, N),
+    standardized units.  Closed form over horizons (no scan) — see
+    :mod:`metran_tpu.ops.forecast`.
+    """
+    horizons = jnp.arange(1, int(steps) + 1)
+
+    @jax.jit
+    def fn(ss, mean, cov):
+        return jax.vmap(
+            lambda s, m, c: forecast_observation_moments(s, m, c, horizons)
+        )(ss, mean, cov)
+
+    return fn
+
+
+# Module-level conveniences for direct (registry-less) use.  They go
+# through the SAME factories (single source of the kernel bodies) via a
+# small bounded cache, so heavy bucket churn cannot pin unbounded
+# executables — the registry's LRU remains the right tool for serving.
+_update_fn_cached = functools.lru_cache(maxsize=8)(make_update_fn)
+_forecast_fn_cached = functools.lru_cache(maxsize=8)(make_forecast_fn)
+
+
+def update_bucket(ss, mean, cov, y_new, mask_new, engine: str = "joint"):
+    """Batched incremental update (see :func:`make_update_fn`)."""
+    return _update_fn_cached(engine)(ss, mean, cov, y_new, mask_new)
+
+
+def forecast_bucket(ss, mean, cov, steps: int):
+    """Batched closed-form forecast (see :func:`make_forecast_fn`)."""
+    return _forecast_fn_cached(int(steps))(ss, mean, cov)
+
+
+__all__ = [
+    "BucketBatch",
+    "forecast_bucket",
+    "make_forecast_fn",
+    "make_update_fn",
+    "pad_state_arrays",
+    "stack_bucket",
+    "state_slot_index",
+    "update_bucket",
+]

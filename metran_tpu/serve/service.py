@@ -1,0 +1,332 @@
+"""`MetranService`: the in-process serving API over the whole subsystem.
+
+Request flow::
+
+    update(model_id, new_obs) ─┐                       ┌─> engine.update
+                               ├─> MicroBatcher ──────>┤   (one dispatch
+    forecast(model_id, steps) ─┘    (group by          └─> engine.forecast
+                                     bucket+horizon)        per group)
+
+- Requests take/return **data units**; standardization happens at the
+  boundary with each model's stored scaler constants.
+- ``update`` assimilates ``k`` new observation rows (NaN = missing)
+  through the incremental filter — O(k), never a history refilter —
+  and bumps the model's :class:`PosteriorState` version (write-through
+  to disk unless ``persist_updates=False``).
+- ``forecast`` returns closed-form h-step-ahead predictive means and
+  variances from the warm posterior — O(1) in history length.
+- Per-request latency and per-dispatch batch occupancy are recorded in
+  :mod:`metran_tpu.utils.profiling` instruments (``service.metrics``).
+
+The service is thread-safe for concurrent ``update``/``forecast``
+callers; dispatches for the same shape bucket coalesce into single
+device executions (``serve/batching.py``).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from logging import getLogger
+from typing import NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from ..utils.profiling import LatencyRecorder, OccupancyCounter
+from .batching import MicroBatcher
+from .registry import ModelRegistry
+from .state import PosteriorState
+
+logger = getLogger(__name__)
+
+
+def _transfer(src: Future, dst: Future) -> None:
+    """Mirror one future's outcome onto another (chained submissions)."""
+    if dst.done():
+        return
+    if src.cancelled():
+        dst.cancel()
+    elif src.exception() is not None:
+        dst.set_exception(src.exception())
+    else:
+        dst.set_result(src.result())
+
+
+class Forecast(NamedTuple):
+    """Forecast of one model, data units.
+
+    ``means``/``variances`` are (steps, n_series); ``names`` the series
+    column order; ``version`` the posterior version it was served from.
+    """
+
+    means: np.ndarray
+    variances: np.ndarray
+    names: Tuple[str, ...]
+    version: int
+
+
+@dataclass
+class ServeMetrics:
+    """Request/dispatch telemetry (see ``utils/profiling.py``)."""
+
+    update_latency: LatencyRecorder = field(
+        default_factory=lambda: LatencyRecorder()
+    )
+    forecast_latency: LatencyRecorder = field(
+        default_factory=lambda: LatencyRecorder()
+    )
+    occupancy: OccupancyCounter = field(default_factory=OccupancyCounter)
+
+    def summary(self) -> str:
+        return (
+            f"updates {self.update_latency.summary()} | "
+            f"forecasts {self.forecast_latency.summary()} | "
+            f"{self.occupancy.summary()}"
+        )
+
+
+class MetranService:
+    """Query-able, incrementally-updatable serving front end.
+
+    Parameters
+    ----------
+    registry : model storage + shape buckets + compiled-kernel cache.
+    flush_deadline : seconds a request may wait to co-batch (``None``
+        disables the background flusher — requests dispatch on
+        :meth:`flush`, the deterministic mode the tests use).  Default
+        from :func:`metran_tpu.config.serve_defaults`.
+    max_batch : dispatch immediately once a group is this full.
+    persist_updates : write updated posterior states through to the
+        registry's disk root (ignored for in-memory registries).
+    """
+
+    def __init__(
+        self,
+        registry: ModelRegistry,
+        flush_deadline: Optional[float] = "default",
+        max_batch: Optional[int] = None,
+        persist_updates: bool = True,
+    ):
+        from ..config import serve_defaults
+
+        defaults = serve_defaults()
+        if flush_deadline == "default":
+            flush_deadline = defaults["flush_deadline_s"]
+        if max_batch is None:
+            max_batch = defaults["max_batch"]
+        self.registry = registry
+        self.persist_updates = persist_updates
+        self.metrics = ServeMetrics()
+        # updates are registry read-modify-writes; dispatches can run on
+        # SEVERAL threads at once (background flusher + size-triggered
+        # submitter threads, with same-model requests possibly split
+        # across batch keys by differing k).  One lock around the whole
+        # assimilation round keeps every model's chain sequential —
+        # forecasts stay lock-free (read-only).
+        self._update_lock = threading.Lock()
+        # per-model ordering across batch keys: serialization alone
+        # does not fix ORDER (a later-submitted k=2 group can fire
+        # before an earlier k=1 group whose deadline started later), so
+        # a model's update is deferred behind its unresolved
+        # predecessor whenever their batch keys differ (_order_lock
+        # guards the bookkeeping; same-key duplicates are ordered by
+        # the rounds logic inside one dispatch)
+        self._order_lock = threading.Lock()
+        self._last_update: dict = {}  # model_id -> (batch_key, Future)
+        self.batcher = MicroBatcher(
+            self._dispatch, flush_deadline=flush_deadline,
+            max_batch=max_batch,
+        )
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+    def forecast(self, model_id: str, steps: int) -> Forecast:
+        """Predictive means/variances ``steps`` grid periods ahead."""
+        return self._resolve(self.forecast_async(model_id, steps))
+
+    def forecast_async(self, model_id: str, steps: int) -> "Future[Forecast]":
+        state = self.registry.get(model_id)
+        bucket = self.registry.bucket_of(state)
+        return self.batcher.submit(
+            ("forecast", bucket, int(steps)), model_id, None
+        )
+
+    def update(self, model_id: str, new_obs) -> PosteriorState:
+        """Assimilate ``new_obs`` ((k, n_series), data units, NaN =
+        missing) and return the bumped :class:`PosteriorState`."""
+        return self._resolve(self.update_async(model_id, new_obs))
+
+    def _resolve(self, fut: Future):
+        """Wait for a sync call's future; in manual-flush mode
+        (``flush_deadline=None``) nobody else will dispatch it, so
+        flush inline first instead of blocking forever."""
+        if self.batcher.flush_deadline is None and not fut.done():
+            self.batcher.flush()
+        return fut.result()
+
+    def update_async(self, model_id: str, new_obs) -> "Future[PosteriorState]":
+        state = self.registry.get(model_id)
+        new_obs = np.atleast_2d(np.asarray(new_obs, float))
+        if new_obs.shape[1] != state.n_series:
+            raise ValueError(
+                f"new_obs has {new_obs.shape[1]} series, model "
+                f"{model_id!r} has {state.n_series}"
+            )
+        mask = np.isfinite(new_obs)
+        # standardize at the boundary; masked slots go to 0 like the
+        # panel packer does (ignored under mask either way)
+        y_std = np.where(
+            mask, (new_obs - state.scaler_mean) / state.scaler_std, 0.0
+        )
+        bucket = self.registry.bucket_of(state)
+        key = ("update", bucket, new_obs.shape[0])
+        payload = (y_std, mask)
+        with self._order_lock:
+            prior = self._last_update.get(model_id)
+            if prior is not None and prior[0] != key and not prior[1].done():
+                # different-k groups flush independently, in no
+                # particular order; enqueue this one only once the
+                # model's earlier update resolved so observations
+                # assimilate in submission order
+                fut: Future = Future()
+
+                def _enqueue(_prior_done):
+                    try:
+                        inner = self.batcher.submit(key, model_id, payload)
+                    except BaseException as exc:  # e.g. batcher closed
+                        if not fut.done():
+                            fut.set_exception(exc)
+                        return
+                    inner.add_done_callback(lambda f: _transfer(f, fut))
+
+                prior[1].add_done_callback(_enqueue)
+            else:
+                fut = self.batcher.submit(key, model_id, payload)
+            self._last_update[model_id] = (key, fut)
+        return fut
+
+    def flush(self) -> int:
+        """Dispatch everything pending now (manual/deterministic mode).
+
+        Drains to empty: resolving one batch can enqueue deferred
+        same-model follow-ups (see :meth:`update_async`), which a
+        single batcher flush would leave behind."""
+        total = 0
+        while True:
+            n = self.batcher.flush()
+            total += n
+            if n == 0:
+                return total
+
+    def close(self) -> None:
+        self.batcher.close()
+
+    def __enter__(self) -> "MetranService":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------------------
+    # dispatch (runs on the batcher's flushing thread)
+    # ------------------------------------------------------------------
+    def _dispatch(self, batch_key, requests):
+        import time
+
+        kind, bucket, horizon = batch_key
+        if kind == "forecast":
+            results = self._run_forecast(bucket, int(horizon), requests)
+            latency = self.metrics.forecast_latency
+        elif kind == "update":
+            # a coalesced batch may hold SEVERAL updates for one model;
+            # they must chain (each assimilating from its predecessor's
+            # posterior), not all apply to the same base state with the
+            # last write winning.  Dispatch in rounds: round r carries
+            # each model's r-th request, so every round is still one
+            # batched device execution and per-model submission order is
+            # kept (duplicates in one batch are rare; the common case
+            # stays a single round).
+            rounds: list = []
+            seen: dict = {}
+            for pos, req in enumerate(requests):
+                r = seen.get(req.model_id, 0)
+                seen[req.model_id] = r + 1
+                while len(rounds) <= r:
+                    rounds.append([])
+                rounds[r].append(pos)
+            results = [None] * len(requests)
+            with self._update_lock:
+                for positions in rounds:
+                    round_results = self._run_update(
+                        bucket, int(horizon),
+                        [requests[p] for p in positions],
+                    )
+                    for p, res in zip(positions, round_results):
+                        results[p] = res
+            latency = self.metrics.update_latency
+        else:  # pragma: no cover - batch keys are service-constructed
+            raise ValueError(f"unknown dispatch kind {kind!r}")
+        self.metrics.occupancy.record(len(requests))
+        now = time.monotonic()  # Request.enqueued_at is monotonic too
+        for req in requests:
+            # queueing time + dispatch time, as the caller experienced it
+            latency.record(now - req.enqueued_at)
+        return results
+
+    def _run_forecast(self, bucket, steps: int, requests):
+        from .engine import stack_bucket
+
+        states = [self.registry.get(r.model_id) for r in requests]
+        batch = stack_bucket(states, bucket)
+        fn = self.registry.forecast_fn(bucket, steps)
+        means, variances = fn(batch.ss, batch.mean, batch.cov)
+        means, variances = np.asarray(means), np.asarray(variances)
+        results = []
+        for i, st in enumerate(states):
+            n = st.n_series
+            results.append(Forecast(
+                means=means[i, :, :n] * st.scaler_std + st.scaler_mean,
+                variances=variances[i, :, :n] * st.scaler_std**2,
+                names=st.names,
+                version=st.version,
+            ))
+        return results
+
+    def _run_update(self, bucket, k: int, requests):
+        """One batched assimilation over distinct-model requests; reads
+        each model's CURRENT registry state, writes the bumped one.
+        Callers must hold ``_update_lock`` across the read→compute→put
+        so concurrent dispatches cannot interleave on a model."""
+        from .engine import stack_bucket, state_slot_index
+
+        states = [self.registry.get(r.model_id) for r in requests]
+        batch = stack_bucket(states, bucket)
+        n_pad = bucket[0]
+        y = np.zeros((len(states), k, n_pad))
+        m = np.zeros((len(states), k, n_pad), bool)
+        for i, (st, req) in enumerate(zip(states, requests)):
+            y_std, mask = req.payload
+            y[i, :, : st.n_series] = y_std
+            m[i, :, : st.n_series] = mask
+        fn = self.registry.update_fn(bucket, k)
+        mean_t, cov_t, _sigma, _detf = fn(
+            batch.ss, batch.mean, batch.cov, y, m
+        )
+        mean_t, cov_t = np.asarray(mean_t), np.asarray(cov_t)
+        results = []
+        for i, st in enumerate(states):
+            idx = state_slot_index(st.n_series, st.n_factors, n_pad)
+            new_state = st._replace(
+                version=st.version + 1,
+                t_seen=st.t_seen + k,
+                mean=mean_t[i][idx].astype(st.dtype),
+                cov=cov_t[i][np.ix_(idx, idx)].astype(st.dtype),
+            )
+            self.registry.put(new_state, persist=self.persist_updates)
+            results.append(new_state)
+        return results
+
+
+__all__ = ["Forecast", "MetranService", "ServeMetrics"]

@@ -1,0 +1,259 @@
+"""Versioned posterior serving state: the warm handle on a fitted model.
+
+A fitted DFM's serving answer needs only the filtered posterior
+``N(mean, cov)`` at the last assimilated timestep plus the (static)
+model matrices and scaler constants — not the observation history.
+:class:`PosteriorState` packages exactly that, versioned and
+persistable, so a service process can answer forecasts in O(1) and
+assimilate new observations in O(k) (``serve/engine.py``) without ever
+reloading or refiltering history.
+
+Extraction paths:
+
+- :func:`posterior_state_from_metran` / ``Metran.to_posterior_state()``
+  — one fitted (or initialized) model;
+- :func:`posterior_states_from_fleet` — every member of a fitted fleet
+  in one batched filter pass.
+
+Persistence is one ``.npz`` per model via :func:`metran_tpu.io.
+atomic_savez` (crash-safe rename; concurrent writers cannot clobber
+each other), round-tripping bit-identically.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..io import atomic_savez
+
+STATE_FORMAT_VERSION = 1
+
+
+class PosteriorState(NamedTuple):
+    """Everything needed to serve one model, frozen at assimilation time T.
+
+    Attributes
+    ----------
+    model_id : registry key (defaults to the model name).
+    version : assimilation version, +1 per :meth:`MetranService.update`
+        batch applied (optimistic-concurrency token for writers).
+    t_seen : number of grid timesteps assimilated so far.
+    mean : (n_state,) filtered state mean ``E[x_T | y_{1:T}]``.
+    cov : (n_state, n_state) filtered state covariance at T.
+    params : (n_series + n_factors,) fitted alphas in the canonical
+        ``[sdf..., cdf...]`` state ordering.
+    loadings : (n_series, n_factors) factor loadings.
+    dt : grid step in days.
+    scaler_mean, scaler_std : per-series standardization constants
+        (original data units), so the service can accept/return data
+        units while the engine runs standardized.
+    names : series names, column order.
+    """
+
+    model_id: str
+    version: int
+    t_seen: int
+    mean: np.ndarray
+    cov: np.ndarray
+    params: np.ndarray
+    loadings: np.ndarray
+    dt: float
+    scaler_mean: np.ndarray
+    scaler_std: np.ndarray
+    names: Tuple[str, ...]
+
+    @property
+    def n_series(self) -> int:
+        return int(self.loadings.shape[0])
+
+    @property
+    def n_factors(self) -> int:
+        return int(self.loadings.shape[1])
+
+    @property
+    def n_state(self) -> int:
+        return int(self.mean.shape[0])
+
+    @property
+    def dtype(self):
+        return np.asarray(self.mean).dtype
+
+    def statespace(self):
+        """The model's :class:`~metran_tpu.ops.StateSpace` (standardized
+        units — the units the filter and forecasts run in)."""
+        from ..ops import dfm_statespace
+
+        n = self.n_series
+        return dfm_statespace(
+            self.params[:n], self.params[n:], self.loadings, self.dt
+        )
+
+    def save(self, path) -> Path:
+        """Persist to one ``.npz``, atomically (see module docstring)."""
+        return atomic_savez(
+            Path(path),
+            format_version=np.int64(STATE_FORMAT_VERSION),
+            model_id=np.str_(self.model_id),
+            version=np.int64(self.version),
+            t_seen=np.int64(self.t_seen),
+            mean=np.asarray(self.mean),
+            cov=np.asarray(self.cov),
+            params=np.asarray(self.params),
+            loadings=np.asarray(self.loadings),
+            dt=np.float64(self.dt),
+            scaler_mean=np.asarray(self.scaler_mean),
+            scaler_std=np.asarray(self.scaler_std),
+            names=np.asarray(list(self.names), dtype=np.str_),
+        )
+
+    @classmethod
+    def load(cls, path) -> "PosteriorState":
+        """Restore a state saved with :meth:`save`, bit-identically."""
+        with np.load(Path(path), allow_pickle=False) as data:
+            fmt = int(data["format_version"])
+            if fmt != STATE_FORMAT_VERSION:
+                raise ValueError(
+                    f"unsupported posterior-state format {fmt} "
+                    f"(expected {STATE_FORMAT_VERSION}) in {path}"
+                )
+            return cls(
+                model_id=str(data["model_id"]),
+                version=int(data["version"]),
+                t_seen=int(data["t_seen"]),
+                mean=data["mean"],
+                cov=data["cov"],
+                params=data["params"],
+                loadings=data["loadings"],
+                dt=float(data["dt"]),
+                scaler_mean=data["scaler_mean"],
+                scaler_std=data["scaler_std"],
+                names=tuple(str(n) for n in data["names"]),
+            )
+
+
+def posterior_state_from_metran(
+    mt, model_id: Optional[str] = None, p=None
+) -> PosteriorState:
+    """Extract the serving state from a (fitted) :class:`Metran` model.
+
+    Runs one filter pass over the model's current (possibly masked)
+    observations at parameters ``p`` (default: fitted optimum, falling
+    back to the initial table like every other accessor) and freezes
+    the filtered posterior at the last timestep.  Factor loadings must
+    exist (call ``solve()`` or ``get_factors()`` first).
+    """
+    if mt.factors is None:
+        raise ValueError(
+            "model has no factor loadings; call solve() or "
+            "get_factors() before extracting a posterior state"
+        )
+    if len(mt.parameters) != mt.nseries + mt.nfactors:
+        # get_factors() without solve(): the __init__-time table predates
+        # the factor structure (same consistency guard solve() applies)
+        mt.set_init_parameters()
+    mt._run_kalman("filter", p=p)
+    filt = mt.kf.run_filter()
+    params = mt._param_array(p if p is not None else mt.get_parameters())
+    return PosteriorState(
+        model_id=str(model_id if model_id is not None else mt.name),
+        version=0,
+        t_seen=int(mt.kf.y.shape[0]),
+        mean=np.asarray(filt.mean_f[-1]),
+        cov=np.asarray(filt.cov_f[-1]),
+        params=np.asarray(params, float),
+        loadings=np.asarray(mt.factors, float),
+        dt=float(mt._dt),
+        scaler_mean=np.asarray(mt.oseries_mean, float),
+        scaler_std=np.asarray(mt.oseries_std, float),
+        names=tuple(mt.snames),
+    )
+
+
+def posterior_states_from_fleet(
+    params,
+    fleet,
+    model_ids: Optional[Sequence[str]] = None,
+    scaler_mean=None,
+    scaler_std=None,
+    engine: str = "joint",
+) -> list:
+    """Extract one :class:`PosteriorState` per fleet member.
+
+    One vmapped filter pass over the whole fleet; each member's
+    posterior is read at ITS OWN last true timestep (``fleet.t_steps``),
+    not the padded grid end — padded trailing steps are all-masked
+    no-ops for the likelihood but would keep applying the predict decay
+    to the carry.  Padded series/factor slots are sliced off using
+    ``fleet.n_series``, so the states are bucket-ready but unpadded.
+
+    ``scaler_mean``/``scaler_std`` are (B, N) per-member standardization
+    constants (default: 0/1 — members already standardized).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import dfm_statespace, kalman_filter
+
+    params = jnp.asarray(params)
+    b = fleet.batch
+    n_pad = fleet.loadings.shape[1]
+
+    def one(p, y, mask, loadings, dt):
+        n = loadings.shape[0]
+        ss = dfm_statespace(p[:n], p[n:], loadings, dt)
+        res = kalman_filter(ss, y, mask, engine=engine)
+        return res.mean_f, res.cov_f
+
+    means, covs = jax.jit(jax.vmap(one))(
+        params, fleet.y, fleet.mask, fleet.loadings, fleet.dt
+    )
+    t_steps = (
+        np.full(b, fleet.y.shape[1], np.int64)
+        if fleet.t_steps is None
+        else np.asarray(fleet.t_steps)
+    )
+    n_series = np.asarray(fleet.n_series)
+    means, covs = np.asarray(means), np.asarray(covs)
+    p_np = np.asarray(params)
+    lds = np.asarray(fleet.loadings)
+    dts = np.asarray(fleet.dt)
+    if scaler_mean is None:
+        scaler_mean = np.zeros((b, n_pad))
+    if scaler_std is None:
+        scaler_std = np.ones((b, n_pad))
+    from .engine import state_slot_index
+
+    states = []
+    for i in range(b):
+        ti, ni = int(t_steps[i]), int(n_series[i])
+        ld = lds[i, :ni]
+        keep_f = np.flatnonzero(np.any(ld != 0, axis=0))
+        ki = int(keep_f.max()) + 1 if keep_f.size else 0
+        sl = state_slot_index(ni, ki, n_pad)
+        states.append(PosteriorState(
+            model_id=(
+                str(model_ids[i]) if model_ids is not None else f"model{i}"
+            ),
+            version=0,
+            t_seen=ti,
+            mean=means[i, ti - 1][sl],
+            cov=covs[i, ti - 1][np.ix_(sl, sl)],
+            params=p_np[i][sl],
+            loadings=ld[:, :ki],
+            dt=float(dts[i]),
+            scaler_mean=np.asarray(scaler_mean[i][:ni], float),
+            scaler_std=np.asarray(scaler_std[i][:ni], float),
+            names=tuple(f"series{j}" for j in range(ni)),
+        ))
+    return states
+
+
+__all__ = [
+    "STATE_FORMAT_VERSION",
+    "PosteriorState",
+    "posterior_state_from_metran",
+    "posterior_states_from_fleet",
+]

@@ -109,10 +109,18 @@ def show_versions() -> None:
     print(msg)
 
 
-from .profiling import ThroughputCounter, annotate, trace  # noqa: E402,F401
+from .profiling import (  # noqa: E402,F401
+    LatencyRecorder,
+    OccupancyCounter,
+    ThroughputCounter,
+    annotate,
+    trace,
+)
 
 __all__ = [
     "ILLEGAL_NAME_CHARS",
+    "LatencyRecorder",
+    "OccupancyCounter",
     "ThroughputCounter",
     "annotate",
     "freq_to_days",

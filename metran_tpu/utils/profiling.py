@@ -89,4 +89,104 @@ class ThroughputCounter:
         )
 
 
-__all__ = ["ThroughputCounter", "annotate", "trace"]
+@dataclass
+class LatencyRecorder:
+    """Per-request latency samples with percentile summaries.
+
+    The serving layer's request-path instrument (``metran_tpu.serve``):
+    record wall seconds per request, read p50/p99 — the numbers a
+    latency SLO is written against.  Bounded memory: beyond ``maxlen``
+    samples the oldest half is dropped (quantiles then describe recent
+    traffic, which is what an operator wants from a live service).
+    """
+
+    unit: str = "s"
+    maxlen: int = 100_000
+    samples: List[float] = field(default_factory=list)
+    total: int = 0
+
+    def record(self, seconds: float) -> None:
+        self.samples.append(float(seconds))
+        self.total += 1
+        if len(self.samples) > self.maxlen:
+            del self.samples[: len(self.samples) // 2]
+
+    @contextlib.contextmanager
+    def measure(self) -> Iterator[None]:
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(time.perf_counter() - start)
+
+    def percentile(self, q: float) -> float:
+        """q in [0, 100]; 0.0 when nothing has been recorded."""
+        if not self.samples:
+            return 0.0
+        ordered = sorted(self.samples)
+        idx = min(
+            len(ordered) - 1, max(0, round(q / 100.0 * (len(ordered) - 1)))
+        )
+        return ordered[idx]
+
+    @property
+    def p50(self) -> float:
+        return self.percentile(50.0)
+
+    @property
+    def p99(self) -> float:
+        return self.percentile(99.0)
+
+    @property
+    def mean(self) -> float:
+        return sum(self.samples) / len(self.samples) if self.samples else 0.0
+
+    def summary(self) -> str:
+        return (
+            f"{self.total} samples: p50={self.p50 * 1e3:.2f}ms "
+            f"p99={self.p99 * 1e3:.2f}ms mean={self.mean * 1e3:.2f}ms"
+        )
+
+
+@dataclass
+class OccupancyCounter:
+    """Batch-occupancy accounting for the micro-batching queue.
+
+    How full device dispatches actually run — the efficiency half of
+    the serving telemetry (latency being the other): ``mean_occupancy``
+    near 1 means the batcher coalesces nothing and each request pays a
+    full dispatch.  Totals are running counters (exact over the whole
+    lifetime); ``batches`` keeps only the most recent ``maxlen`` sizes,
+    bounded like :class:`LatencyRecorder` for long-lived services.
+    """
+
+    maxlen: int = 100_000
+    batches: List[int] = field(default_factory=list)
+    dispatches: int = 0
+    requests: int = 0
+
+    def record(self, size: int) -> None:
+        self.batches.append(int(size))
+        self.dispatches += 1
+        self.requests += int(size)
+        if len(self.batches) > self.maxlen:
+            del self.batches[: len(self.batches) // 2]
+
+    @property
+    def mean_occupancy(self) -> float:
+        return self.requests / self.dispatches if self.dispatches else 0.0
+
+    def summary(self) -> str:
+        return (
+            f"{self.requests} requests over {self.dispatches} dispatches "
+            f"(mean occupancy {self.mean_occupancy:.1f})"
+        )
+
+
+__all__ = [
+    "LatencyRecorder",
+    "OccupancyCounter",
+    "ThroughputCounter",
+    "annotate",
+    "trace",
+]

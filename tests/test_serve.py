@@ -1,0 +1,439 @@
+"""Online assimilation & serving (`metran_tpu.serve`).
+
+Pins the subsystem's three contracts:
+
+1. incremental update ≡ full refilter — appending k observations via
+   the serving engine lands on the same filtered posterior as a
+   from-scratch filter over the whole history;
+2. `PosteriorState` persistence round-trips bit-identically, and so do
+   forecasts computed from the restored state;
+3. a shape bucket of ≥ 64 heterogeneous models serves forecasts through
+   ONE compiled kernel in ONE device dispatch (compile-count and
+   occupancy assertions) — the executable-reuse property the whole
+   registry design exists for.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metran_tpu.ops import (
+    dfm_statespace,
+    filter_append,
+    filter_update,
+    forecast_observation_moments,
+    kalman_filter,
+)
+from metran_tpu.serve import (
+    MetranService,
+    MicroBatcher,
+    ModelRegistry,
+    PosteriorState,
+)
+
+from tests.conftest import random_ssm
+
+
+def _make_state(rng, model_id="m0", n=5, k=1, t=150, dt=1.0, engine="joint"):
+    """A PosteriorState plus the raw model/data it was frozen from."""
+    loadings = rng.uniform(0.3, 0.8, (n, k)) / np.sqrt(k)
+    alpha_sdf = rng.uniform(5.0, 40.0, n)
+    alpha_cdf = rng.uniform(10.0, 60.0, k)
+    ss = dfm_statespace(alpha_sdf, alpha_cdf, loadings, dt)
+    y = rng.normal(size=(t, n))
+    mask = rng.uniform(size=(t, n)) > 0.3
+    y = np.where(mask, y, 0.0)
+    res = kalman_filter(ss, y, mask, engine=engine)
+    state = PosteriorState(
+        model_id=model_id,
+        version=0,
+        t_seen=t,
+        mean=np.asarray(res.mean_f[-1]),
+        cov=np.asarray(res.cov_f[-1]),
+        params=np.concatenate([alpha_sdf, alpha_cdf]),
+        loadings=loadings,
+        dt=dt,
+        scaler_mean=rng.normal(size=n),
+        scaler_std=rng.uniform(0.5, 2.0, n),
+        names=tuple(f"s{j}" for j in range(n)),
+    )
+    return state, ss, y, mask
+
+
+# ----------------------------------------------------------------------
+# 1. incremental update == full refilter
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("engine", ["sequential", "joint"])
+def test_filter_append_equals_full_refilter(rng, engine):
+    ss, y, mask = random_ssm(rng)
+    t, k = y.shape[0], 13
+    full = kalman_filter(ss, y, mask, engine=engine)
+    part = kalman_filter(ss, y[: t - k], mask[: t - k], engine=engine)
+    mean_t, cov_t, sigma, detf = filter_append(
+        ss, part.mean_f[-1], part.cov_f[-1], y[t - k:], mask[t - k:],
+        engine=engine,
+    )
+    np.testing.assert_allclose(mean_t, full.mean_f[-1], rtol=1e-12, atol=1e-13)
+    np.testing.assert_allclose(cov_t, full.cov_f[-1], rtol=1e-12, atol=1e-13)
+    # the appended steps' likelihood terms are the full filter's too
+    np.testing.assert_allclose(sigma, full.sigma[t - k:], rtol=1e-12, atol=1e-13)
+    np.testing.assert_allclose(detf, full.detf[t - k:], rtol=1e-12, atol=1e-13)
+
+
+def test_filter_update_single_step(rng):
+    ss, y, mask = random_ssm(rng)
+    t = y.shape[0]
+    full = kalman_filter(ss, y, mask, engine="sequential")
+    part = kalman_filter(ss, y[:-1], mask[:-1], engine="sequential")
+    mean_f, cov_f, sigma, detf = filter_update(
+        ss, part.mean_f[-1], part.cov_f[-1], y[-1], mask[-1]
+    )
+    np.testing.assert_allclose(mean_f, full.mean_f[-1], rtol=1e-12)
+    np.testing.assert_allclose(cov_f, full.cov_f[-1], rtol=1e-12)
+    np.testing.assert_allclose(sigma, full.sigma[-1], rtol=1e-12)
+    np.testing.assert_allclose(detf, full.detf[-1], rtol=1e-12)
+
+
+def test_service_update_matches_full_refilter(rng, tmp_path):
+    """End to end through the service: standardization boundary, NaN
+    masking, version bump, persistence — posterior equals refilter."""
+    state, ss, y, mask = _make_state(rng)
+    reg = ModelRegistry(root=tmp_path)
+    reg.put(state)
+    k = 9
+    new_std = rng.normal(size=(k, state.n_series))
+    new_std[rng.uniform(size=new_std.shape) > 0.7] = np.nan
+    with MetranService(reg, flush_deadline=None) as svc:
+        new_state = svc.update(
+            "m0", new_std * state.scaler_std + state.scaler_mean
+        )
+    assert new_state.version == state.version + 1
+    assert new_state.t_seen == state.t_seen + k
+
+    mask_new = np.isfinite(new_std)
+    y_full = np.concatenate([y, np.where(mask_new, new_std, 0.0)])
+    mask_full = np.concatenate([mask, mask_new])
+    res = kalman_filter(ss, y_full, mask_full, engine="joint")
+    np.testing.assert_allclose(
+        new_state.mean, res.mean_f[-1], rtol=1e-10, atol=1e-12
+    )
+    np.testing.assert_allclose(
+        new_state.cov, res.cov_f[-1], rtol=1e-10, atol=1e-12
+    )
+    # the write-through persisted the bumped version
+    assert PosteriorState.load(reg.path_for("m0")).version == 1
+
+
+def test_cancelled_request_does_not_break_batch():
+    """A caller cancelling a queued future must not blow up the
+    dispatch (an unguarded set_result on a cancelled future would kill
+    the background flusher thread and hang all later requests)."""
+    batcher = MicroBatcher(
+        lambda key, reqs: [r.model_id for r in reqs], flush_deadline=None
+    )
+    f1 = batcher.submit(("g",), "a", None)
+    assert f1.cancel()
+    f2 = batcher.submit(("g",), "b", None)
+    batcher.flush()
+    assert f2.result(timeout=5) == "b"
+    assert f1.cancelled()
+    batcher.close()
+
+
+def test_coalesced_same_model_updates_chain(rng, tmp_path):
+    """Two updates for one model coalesced into one micro-batch must
+    chain (second assimilates from the first's posterior), not both
+    apply to the same base with the last write winning."""
+    state, ss, y, mask = _make_state(rng)
+    reg = ModelRegistry(root=tmp_path)
+    reg.put(state)
+    obs = rng.normal(size=(2, 1, state.n_series))
+    with MetranService(reg, flush_deadline=None) as svc:
+        f1 = svc.update_async(
+            "m0", obs[0] * state.scaler_std + state.scaler_mean
+        )
+        f2 = svc.update_async(
+            "m0", obs[1] * state.scaler_std + state.scaler_mean
+        )
+        svc.flush()
+        s1, s2 = f1.result(), f2.result()
+    assert (s1.version, s2.version) == (1, 2)
+    assert s2.t_seen == state.t_seen + 2
+    y_full = np.concatenate([y, obs[0], obs[1]])
+    mask_full = np.concatenate([mask, np.ones((2, state.n_series), bool)])
+    res = kalman_filter(ss, y_full, mask_full, engine="joint")
+    np.testing.assert_allclose(
+        s2.mean, res.mean_f[-1], rtol=1e-10, atol=1e-12
+    )
+    np.testing.assert_allclose(
+        s2.cov, res.cov_f[-1], rtol=1e-10, atol=1e-12
+    )
+    # registry holds the final chained state
+    assert reg.get("m0").version == 2
+
+
+def test_different_k_same_model_updates_apply_in_order(rng, tmp_path):
+    """Updates with different row counts land in different batch
+    groups; the service must still assimilate them in submission order
+    (the Kalman recursion is order-dependent)."""
+    state, ss, y, mask = _make_state(rng)
+    reg = ModelRegistry(root=tmp_path)
+    reg.put(state)
+    first = rng.normal(size=(1, state.n_series))
+    second = rng.normal(size=(2, state.n_series))
+    with MetranService(reg, flush_deadline=None) as svc:
+        f1 = svc.update_async(
+            "m0", first * state.scaler_std + state.scaler_mean
+        )
+        f2 = svc.update_async(
+            "m0", second * state.scaler_std + state.scaler_mean
+        )
+        assert svc.flush() == 2  # drains the deferred k=2 follow-up too
+        s1, s2 = f1.result(timeout=5), f2.result(timeout=5)
+    assert (s1.version, s2.version) == (1, 2)
+    assert s2.t_seen == state.t_seen + 3
+    y_full = np.concatenate([y, first, second])
+    mask_full = np.concatenate([mask, np.ones((3, state.n_series), bool)])
+    res = kalman_filter(ss, y_full, mask_full, engine="joint")
+    np.testing.assert_allclose(
+        s2.mean, res.mean_f[-1], rtol=1e-10, atol=1e-12
+    )
+
+
+def test_registry_rejects_unstorable_model_ids(rng, tmp_path):
+    state, *_ = _make_state(rng, model_id="site/A")
+    reg = ModelRegistry(root=tmp_path)
+    with pytest.raises(ValueError, match="not storable"):
+        reg.put(state)
+    with pytest.raises(ValueError, match="not storable"):
+        reg.path_for("../escape")
+    assert list(tmp_path.iterdir()) == []  # nothing written
+
+
+# ----------------------------------------------------------------------
+# 2. persistence round-trip
+# ----------------------------------------------------------------------
+def test_posterior_state_roundtrip_bit_identical(rng, tmp_path):
+    state, ss, _, _ = _make_state(rng)
+    path = state.save(tmp_path / "m0.npz")
+    loaded = PosteriorState.load(path)
+
+    for a, b in zip(state, loaded):
+        if isinstance(a, np.ndarray):
+            np.testing.assert_array_equal(a, b)  # bit-identical
+        else:
+            assert a == b
+
+    # forecasts from the restored state are bit-identical as well
+    horizons = jnp.arange(1, 25)
+    want = forecast_observation_moments(
+        ss, jnp.asarray(state.mean), jnp.asarray(state.cov), horizons
+    )
+    got = forecast_observation_moments(
+        loaded.statespace(), jnp.asarray(loaded.mean),
+        jnp.asarray(loaded.cov), horizons,
+    )
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(np.asarray(w), np.asarray(g))
+
+
+def test_posterior_state_format_version_guard(rng, tmp_path):
+    state, *_ = _make_state(rng)
+    path = state.save(tmp_path / "m0.npz")
+    with np.load(path, allow_pickle=False) as data:
+        payload = {k: data[k] for k in data.files}
+    payload["format_version"] = np.int64(99)
+    np.savez(tmp_path / "bad.npz", **payload)
+    with pytest.raises(ValueError, match="unsupported posterior-state"):
+        PosteriorState.load(tmp_path / "bad.npz")
+
+
+def test_registry_loads_from_disk(rng, tmp_path):
+    state, *_ = _make_state(rng, model_id="diskmodel")
+    ModelRegistry(root=tmp_path).put(state)  # write-through
+    fresh = ModelRegistry(root=tmp_path)  # new process, cold memory
+    assert "diskmodel" in fresh.model_ids()
+    loaded = fresh.get("diskmodel")
+    np.testing.assert_array_equal(loaded.mean, state.mean)
+    with pytest.raises(KeyError):
+        fresh.get("nosuchmodel")
+
+
+def test_atomic_savez_unique_tmp_and_no_leftovers(tmp_path):
+    """Two interleaved writers in one directory cannot clobber each
+    other's temp file (the old fixed `.tmp.npz` sibling did)."""
+    from unittest import mock
+
+    from metran_tpu.io import atomic_savez
+
+    tmp_names = []
+    real_savez = np.savez
+
+    def spy(fh, **arrays):
+        tmp_names.append(fh.name)
+        return real_savez(fh, **arrays)
+
+    with mock.patch("metran_tpu.io.np.savez", side_effect=spy):
+        atomic_savez(tmp_path / "a.npz", x=np.arange(3))
+        atomic_savez(tmp_path / "a.npz", x=np.arange(4))
+        atomic_savez(tmp_path / "b.npz", x=np.arange(5))
+    assert len(set(tmp_names)) == 3  # unique temp per write
+    # nothing half-written left behind, and the final contents won
+    leftovers = [p for p in tmp_path.iterdir() if ".tmp" in p.name]
+    assert leftovers == []
+    with np.load(tmp_path / "a.npz") as data:
+        assert data["x"].shape == (4,)
+
+
+# ----------------------------------------------------------------------
+# 3. bucketed batched serving: one compile, one dispatch
+# ----------------------------------------------------------------------
+def test_bucket_batch_64_heterogeneous_single_compile(rng, tmp_path):
+    """≥ 64 models with different shapes/params/scalers, one shape
+    bucket, served by ONE compiled kernel in ONE device dispatch."""
+    n_models = 64
+    states, raw = [], {}
+    for i in range(n_models):
+        n = int(rng.integers(3, 8))  # heterogeneous: 3..7 series
+        st, ss, _, _ = _make_state(
+            rng, model_id=f"m{i}", n=n, k=1, t=80 + int(rng.integers(40))
+        )
+        states.append(st)
+        raw[st.model_id] = (st, ss)
+    reg = ModelRegistry(root=tmp_path, bucket_multiple=8)
+    for st in states:
+        reg.put(st)
+    buckets = {reg.bucket_of(st) for st in states}
+    assert len(buckets) == 1  # all coalesce into one (8, 16) bucket
+
+    steps = 12
+    with MetranService(reg, flush_deadline=None, max_batch=256) as svc:
+        futures = [svc.forecast_async(st.model_id, steps) for st in states]
+        svc.flush()
+        results = [f.result() for f in futures]
+
+    # single compiled kernel, single dispatch carrying all 64 requests
+    assert reg.compile_stats["misses"] == 1
+    assert svc.metrics.occupancy.batches == [n_models]
+    assert svc.metrics.forecast_latency.total == n_models
+    assert svc.metrics.forecast_latency.p99 >= svc.metrics.forecast_latency.p50
+
+    # every model's batched answer equals its solo closed-form forecast
+    horizons = jnp.arange(1, steps + 1)
+    for st, got in zip(states, results):
+        _, ss = raw[st.model_id]
+        want_m, want_v = forecast_observation_moments(
+            ss, jnp.asarray(st.mean), jnp.asarray(st.cov), horizons
+        )
+        assert got.means.shape == (steps, st.n_series)
+        np.testing.assert_allclose(
+            got.means,
+            np.asarray(want_m) * st.scaler_std + st.scaler_mean,
+            rtol=1e-9, atol=1e-10,
+        )
+        np.testing.assert_allclose(
+            got.variances, np.asarray(want_v) * st.scaler_std**2,
+            rtol=1e-9, atol=1e-10,
+        )
+
+
+def test_compiled_cache_lru_eviction(rng, tmp_path):
+    state, *_ = _make_state(rng)
+    reg = ModelRegistry(root=tmp_path, max_compiled=2)
+    bucket = reg.bucket_of(state)
+    reg.forecast_fn(bucket, 5)
+    reg.forecast_fn(bucket, 6)
+    reg.forecast_fn(bucket, 5)  # hit
+    assert reg.compile_stats == {"hits": 1, "misses": 2, "resident": 2}
+    reg.forecast_fn(bucket, 7)  # evicts steps=6 (LRU)
+    assert reg.compile_stats["resident"] == 2
+    reg.forecast_fn(bucket, 6)  # miss again after eviction
+    assert reg.compile_stats["misses"] == 4
+
+
+def test_microbatcher_deadline_and_size_flush(rng, tmp_path):
+    """Background flusher: a lone request dispatches within the
+    deadline; a full group dispatches immediately."""
+    state, *_ = _make_state(rng)
+    reg = ModelRegistry(root=tmp_path)
+    reg.put(state)
+    with MetranService(reg, flush_deadline=0.01, max_batch=2) as svc:
+        out = svc.forecast("m0", 4)  # deadline-triggered
+        assert out.means.shape == (4, state.n_series)
+        f1 = svc.forecast_async("m0", 4)
+        f2 = svc.forecast_async("m0", 4)  # second fills the group
+        assert f1.result(timeout=5).version == f2.result(timeout=5).version
+    assert svc.metrics.occupancy.requests == 3
+
+
+# ----------------------------------------------------------------------
+# model/fleet extraction
+# ----------------------------------------------------------------------
+def test_metran_to_posterior_state_forecast_parity(series_list):
+    """Service forecasts from the extracted state match the model's own
+    forecast accessors (same params, same filter, same scaling)."""
+    import metran_tpu
+
+    mt = metran_tpu.Metran(series_list, name="B21B0214")
+    mt.get_factors(mt.oseries)  # initial params suffice for parity
+    state = mt.to_posterior_state()
+    assert state.model_id == "B21B0214"
+    assert state.n_series == mt.nseries
+    assert state.t_seen == len(mt.oseries)
+
+    steps = 14
+    reg = ModelRegistry()  # in-memory
+    reg.put(state, persist=False)
+    with MetranService(reg, flush_deadline=None) as svc:
+        got = svc.forecast(state.model_id, steps)
+    want_means = mt.get_forecast_means(steps)
+    want_vars = mt.get_forecast_variances(steps)
+    np.testing.assert_allclose(got.means, want_means.values, rtol=1e-9)
+    np.testing.assert_allclose(got.variances, want_vars.values, rtol=1e-9)
+
+
+def test_posterior_states_from_fleet(rng):
+    from metran_tpu.parallel import pack_fleet
+    from metran_tpu.data import Panel
+    import pandas as pd
+
+    from metran_tpu.serve import posterior_states_from_fleet
+
+    panels, loadings, raw = [], [], []
+    for i in range(3):
+        n = 3 + i
+        t = 60 + 10 * i
+        values = rng.normal(size=(t, n))
+        mask = rng.uniform(size=(t, n)) > 0.3
+        panels.append(Panel(
+            values=np.where(mask, values, 0.0), mask=mask,
+            index=pd.date_range("2020-01-01", periods=t, freq="D"),
+            names=[f"s{j}" for j in range(n)],
+            std=np.ones(n), mean=np.zeros(n), dt=1.0,
+        ))
+        loadings.append(rng.uniform(0.3, 0.7, (n, 1)))
+    fleet = pack_fleet(panels, loadings)
+    params = np.concatenate([
+        rng.uniform(5, 40, (3, fleet.loadings.shape[1])),
+        rng.uniform(10, 60, (3, fleet.loadings.shape[2])),
+    ], axis=1)
+    states = posterior_states_from_fleet(
+        params, fleet, model_ids=["a", "b", "c"]
+    )
+    for i, st in enumerate(states):
+        n = panels[i].n_series
+        assert st.n_series == n
+        assert st.t_seen == panels[i].n_timesteps
+        # parity: solo filter over the member's true (unpadded) panel
+        ld = loadings[i]
+        ss = dfm_statespace(params[i, :n], params[i, [fleet.loadings.shape[1]]], ld, 1.0)
+        res = kalman_filter(
+            ss, panels[i].values, panels[i].mask, engine="joint"
+        )
+        np.testing.assert_allclose(
+            st.mean, res.mean_f[-1], rtol=1e-10, atol=1e-12
+        )
+        np.testing.assert_allclose(
+            st.cov, res.cov_f[-1], rtol=1e-10, atol=1e-12
+        )

@@ -45,6 +45,11 @@ MODULES = [
     "metran_tpu.parallel.lanes_lbfgs",
     "metran_tpu.parallel.mesh",
     "metran_tpu.parallel.sweep",
+    "metran_tpu.serve.state",
+    "metran_tpu.serve.engine",
+    "metran_tpu.serve.registry",
+    "metran_tpu.serve.batching",
+    "metran_tpu.serve.service",
     "metran_tpu.data",
     "metran_tpu.diagnostics",
     "metran_tpu.io",
