@@ -60,6 +60,12 @@ class Fleet(NamedTuple):
         member spans the full grid.  Only forecasting consults it —
         the filter itself treats padded rows as ordinary all-missing
         timesteps.
+    n_factors : (B,) true common-factor count per model (before factor
+        padding); ``None`` (hand-built fleets) makes consumers that
+        need it (serve-state extraction) fall back to inferring it from
+        nonzero loading columns — which silently drops a real factor
+        whose fitted loadings are exactly zero, so :func:`pack_fleet`
+        always records it explicitly.
     """
 
     y: jnp.ndarray
@@ -68,6 +74,7 @@ class Fleet(NamedTuple):
     dt: jnp.ndarray
     n_series: jnp.ndarray
     t_steps: Optional[jnp.ndarray] = None
+    n_factors: Optional[jnp.ndarray] = None
 
     @property
     def batch(self) -> int:
@@ -141,6 +148,7 @@ def pack_fleet(
     lds = np.zeros((bp, n, k), dtype)
     dt = np.ones(bp, dtype)
     n_series = np.full(bp, n, np.int32)
+    n_factors = np.full(bp, k, np.int32)
     t_steps = np.full(bp, t, np.int32)
     for i, (panel, ld) in enumerate(zip(panels, loadings)):
         ti, ni = panel.n_timesteps, panel.n_series
@@ -150,6 +158,7 @@ def pack_fleet(
         lds[i, :ni, : ld.shape[1]] = ld
         dt[i] = panel.dt
         n_series[i] = ni
+        n_factors[i] = ld.shape[1]
         t_steps[i] = ti
     return Fleet(
         y=jnp.asarray(y),
@@ -158,6 +167,7 @@ def pack_fleet(
         dt=jnp.asarray(dt),
         n_series=jnp.asarray(n_series),
         t_steps=jnp.asarray(t_steps),
+        n_factors=jnp.asarray(n_factors),
     )
 
 

@@ -43,6 +43,11 @@ class Request:
 class _Group:
     requests: List[Request] = field(default_factory=list)
     first_at: float = 0.0
+    # identity token handed to submit_tracked callers: a bare object()
+    # rather than the group itself, so holding a token (the service
+    # keeps one per model) cannot retain the whole batch of requests
+    # and their results after dispatch
+    token: object = field(default_factory=object)
 
 
 class MicroBatcher:
@@ -52,7 +57,11 @@ class MicroBatcher:
     ----------
     dispatch : ``dispatch(batch_key, requests) -> list`` returning one
         result per request IN ORDER (or raising — the exception then
-        fails every future in the batch).
+        fails every future in the batch).  A returned item that IS a
+        ``BaseException`` instance fails just that request's future:
+        the partial-failure channel for dispatches whose side effects
+        land per-request (an update batch where a later chained round
+        raises must not fail the earlier rounds it already applied).
     flush_deadline : seconds a request may wait for co-batching
         (``None``: manual :meth:`flush` only, no background thread).
     max_batch : a group reaching this size flushes immediately.
@@ -71,6 +80,7 @@ class MicroBatcher:
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         self._closed = False
+        self._stopping = False  # worker exits; submits still accepted
         self._worker: Optional[threading.Thread] = None
         if flush_deadline is not None:
             self._worker = threading.Thread(
@@ -79,14 +89,50 @@ class MicroBatcher:
             self._worker.start()
 
     # ------------------------------------------------------------------
-    def submit(self, batch_key: Hashable, model_id: str, payload) -> Future:
-        """Enqueue one request; resolve via the returned future."""
+    def submit(
+        self, batch_key: Hashable, model_id: str, payload,
+        enqueued_at: Optional[float] = None,
+    ) -> Future:
+        """Enqueue one request; resolve via the returned future.
+
+        ``enqueued_at`` backdates the request's queue timestamp (a
+        ``time.monotonic`` value) for callers that held it elsewhere
+        first — a deferred update chained behind a predecessor — so
+        latency telemetry covers the wait the caller actually saw.  A
+        group started by a backdated request may flush immediately
+        (its deadline is measured from the stamp), which only shortens
+        an already-long wait.
+        """
+        return self.submit_tracked(
+            batch_key, model_id, payload, enqueued_at=enqueued_at
+        )[0]
+
+    def submit_tracked(
+        self, batch_key: Hashable, model_id: str, payload, join=None,
+        enqueued_at: Optional[float] = None,
+    ):
+        """Enqueue like :meth:`submit` and also return the pending group
+        joined, as ``(future, group)`` with ``group`` an opaque identity
+        token.
+
+        With ``join`` set to a previously returned token, the request is
+        enqueued ONLY if it would land in exactly that still-pending
+        group (checked atomically under the batcher lock); otherwise
+        nothing is enqueued and ``(None, None)`` comes back.  This is
+        the primitive the service layer uses to decide whether two
+        same-model requests are provably co-batchable inside one
+        dispatch or must chain on each other's futures.
+        """
         req = Request(model_id=model_id, payload=payload)
+        if enqueued_at is not None:
+            req.enqueued_at = float(enqueued_at)
         flush_now = None
         with self._lock:
             if self._closed:
                 raise RuntimeError("batcher is closed")
             group = self._groups.get(batch_key)
+            if join is not None and (group is None or group.token is not join):
+                return None, None
             if group is None:
                 group = self._groups[batch_key] = _Group(
                     first_at=req.enqueued_at
@@ -101,7 +147,7 @@ class MicroBatcher:
             # batch is already as full as it is allowed to get, waiting
             # for the worker would only add deadline latency
             self._fire(batch_key, flush_now.requests)
-        return req.future
+        return req.future, group.token
 
     def flush(self, batch_key: Optional[Hashable] = None) -> int:
         """Dispatch pending group(s) now; returns requests dispatched."""
@@ -124,13 +170,23 @@ class MicroBatcher:
             return sum(len(g.requests) for g in self._groups.values())
 
     def close(self) -> None:
-        """Flush everything and stop the background worker."""
+        """Flush everything and stop the background worker.
+
+        Ordered so chained follow-ups still drain: first stop the
+        worker while KEEPING submits open (an in-flight dispatch's
+        done-callbacks may enqueue deferred successors — see the
+        service layer's per-model ordering), then flush to empty, and
+        only then refuse new submissions."""
         with self._lock:
-            self._closed = True
+            self._stopping = True
             self._wake.notify_all()
         if self._worker is not None:
             self._worker.join(timeout=5.0)
-        self.flush()
+        while self.flush():
+            pass  # each pass can enqueue deferred follow-ups
+        with self._lock:
+            self._closed = True
+        self.flush()  # anything that raced in between draining and closing
 
     def __enter__(self) -> "MicroBatcher":
         return self
@@ -141,43 +197,59 @@ class MicroBatcher:
     # ------------------------------------------------------------------
     @staticmethod
     def _resolve_future(future: Future, result=None, exc=None) -> None:
-        """Set a future's outcome, tolerating caller-side cancellation.
+        """Set a claimed future's outcome, tolerating races.
 
-        Callers hold standard futures and may cancel a queued request;
-        an unguarded ``set_result`` on a cancelled future raises
-        ``InvalidStateError`` on the flusher thread — which would kill
-        it and hang every subsequent request.
+        The future was claimed via ``set_running_or_notify_cancel``
+        before dispatch, so caller-side ``cancel()`` can no longer win;
+        the guards stay as a belt against anything that resolved it
+        another way — an unguarded setter raising on the flusher thread
+        would kill it and hang every subsequent request.
         """
         try:
+            if future.done():
+                return
             if exc is not None:
-                if not future.done():
-                    future.set_exception(exc)
-            elif future.set_running_or_notify_cancel():
+                future.set_exception(exc)
+            else:
                 future.set_result(result)
-        except Exception:  # cancelled/raced: the caller gave up on it
-            logger.debug("dropping result for a cancelled request")
+        except Exception:  # raced: someone else resolved it first
+            logger.debug("dropping result for an already-resolved request")
 
     def _fire(self, batch_key, requests: List[Request]) -> None:
+        # executor semantics: claim every future BEFORE dispatching.  A
+        # request whose caller already cancelled it is dropped here, so
+        # a successful cancel() guarantees the request produced no side
+        # effects (an update cancelled-but-still-applied would make the
+        # caller resubmit and assimilate the same observations twice).
+        live = [
+            req for req in requests
+            if req.future.set_running_or_notify_cancel()
+        ]
+        if not live:
+            return
         try:
-            results = self._dispatch(batch_key, requests)
-            if len(results) != len(requests):
+            results = self._dispatch(batch_key, live)
+            if len(results) != len(live):
                 raise RuntimeError(
                     f"dispatch returned {len(results)} results for "
-                    f"{len(requests)} requests (key {batch_key})"
+                    f"{len(live)} requests (key {batch_key})"
                 )
         except BaseException as exc:  # noqa: BLE001 — fail the futures
-            for req in requests:
+            for req in live:
                 self._resolve_future(req.future, exc=exc)
             return
-        for req, res in zip(requests, results):
-            self._resolve_future(req.future, result=res)
+        for req, res in zip(live, results):
+            if isinstance(res, BaseException):  # per-request failure
+                self._resolve_future(req.future, exc=res)
+            else:
+                self._resolve_future(req.future, result=res)
 
     def _run(self) -> None:
         """Background flusher: wake at the earliest group deadline."""
         while True:
             due: List = []
             with self._lock:
-                while not self._closed:
+                while not (self._closed or self._stopping):
                     now = time.monotonic()
                     deadlines = [
                         g.first_at + self.flush_deadline
@@ -188,7 +260,7 @@ class MicroBatcher:
                     self._wake.wait(
                         timeout=(min(deadlines) - now) if deadlines else None
                     )
-                if self._closed:
+                if self._closed or self._stopping:
                     return
                 now = time.monotonic()
                 for key in list(self._groups):

@@ -26,6 +26,7 @@ device executions (``serve/batching.py``).
 from __future__ import annotations
 
 import threading
+import time
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 from logging import getLogger
@@ -125,15 +126,17 @@ class MetranService:
         # assimilation round keeps every model's chain sequential —
         # forecasts stay lock-free (read-only).
         self._update_lock = threading.Lock()
-        # per-model ordering across batch keys: serialization alone
+        # per-model ordering across batch groups: serialization alone
         # does not fix ORDER (a later-submitted k=2 group can fire
         # before an earlier k=1 group whose deadline started later), so
-        # a model's update is deferred behind its unresolved
-        # predecessor whenever their batch keys differ (_order_lock
-        # guards the bookkeeping; same-key duplicates are ordered by
-        # the rounds logic inside one dispatch)
+        # a model's update chains on its unresolved predecessor unless
+        # the two provably share one pending batcher group (where the
+        # rounds logic inside a dispatch orders them).  _order_lock
+        # guards the bookkeeping; the entry's third element is the
+        # pending-group token the request joined (None once it was
+        # deferred — everything behind it must chain too).
         self._order_lock = threading.Lock()
-        self._last_update: dict = {}  # model_id -> (batch_key, Future)
+        self._last_update: dict = {}  # model_id -> (key, Future, group)
         self.batcher = MicroBatcher(
             self._dispatch, flush_deadline=flush_deadline,
             max_batch=max_batch,
@@ -161,9 +164,13 @@ class MetranService:
     def _resolve(self, fut: Future):
         """Wait for a sync call's future; in manual-flush mode
         (``flush_deadline=None``) nobody else will dispatch it, so
-        flush inline first instead of blocking forever."""
+        flush inline first instead of blocking forever.  The DRAINING
+        :meth:`flush`, not a single batcher flush: the future may be a
+        deferred update that only enters the batcher once its
+        predecessor resolves, which one batcher pass would leave
+        pending (and this call blocked) forever."""
         if self.batcher.flush_deadline is None and not fut.done():
-            self.batcher.flush()
+            self.flush()
         return fut.result()
 
     def update_async(self, model_id: str, new_obs) -> "Future[PosteriorState]":
@@ -183,29 +190,74 @@ class MetranService:
         bucket = self.registry.bucket_of(state)
         key = ("update", bucket, new_obs.shape[0])
         payload = (y_std, mask)
+        # latency telemetry measures from HERE, even for requests that
+        # spend time deferred behind a predecessor before they ever
+        # enter the batcher — that wait is part of what the caller sees
+        t_submit = time.monotonic()
         with self._order_lock:
             prior = self._last_update.get(model_id)
-            if prior is not None and prior[0] != key and not prior[1].done():
-                # different-k groups flush independently, in no
-                # particular order; enqueue this one only once the
-                # model's earlier update resolved so observations
-                # assimilate in submission order
-                fut: Future = Future()
+            entry = None
+            if prior is not None and not prior[1].done():
+                if prior[0] == key and prior[2] is not None:
+                    # the predecessor went straight into a batcher
+                    # group; join that very group if it is still
+                    # pending (atomic inside the batcher) — the rounds
+                    # logic in _dispatch then chains the duplicates
+                    inner, group = self.batcher.submit_tracked(
+                        key, model_id, payload, join=prior[2],
+                        enqueued_at=t_submit,
+                    )
+                    if inner is not None:
+                        entry = (key, inner, group)
+                if entry is None:
+                    # the predecessor is unresolved and not provably
+                    # co-batchable (different k, itself deferred, or
+                    # its group already dispatched): batch groups flush
+                    # in no particular order, so enqueue this one only
+                    # once the predecessor resolved — observations then
+                    # assimilate in submission order
+                    fut: Future = Future()
 
-                def _enqueue(_prior_done):
-                    try:
-                        inner = self.batcher.submit(key, model_id, payload)
-                    except BaseException as exc:  # e.g. batcher closed
-                        if not fut.done():
-                            fut.set_exception(exc)
-                        return
-                    inner.add_done_callback(lambda f: _transfer(f, fut))
+                    def _enqueue(_prior_done):
+                        # cancelled while deferred: it never reached
+                        # the batcher, so don't enqueue a side effect
+                        # the caller was told did not happen
+                        if fut.done():
+                            return
+                        try:
+                            inner = self.batcher.submit(
+                                key, model_id, payload,
+                                enqueued_at=t_submit,
+                            )
+                        except BaseException as exc:  # e.g. batcher closed
+                            if not fut.done():
+                                fut.set_exception(exc)
+                            return
+                        inner.add_done_callback(lambda f: _transfer(f, fut))
 
-                prior[1].add_done_callback(_enqueue)
+                    prior[1].add_done_callback(_enqueue)
+                    entry = (key, fut, None)
             else:
-                fut = self.batcher.submit(key, model_id, payload)
-            self._last_update[model_id] = (key, fut)
-        return fut
+                inner, group = self.batcher.submit_tracked(
+                    key, model_id, payload, enqueued_at=t_submit
+                )
+                entry = (key, inner, group)
+            self._last_update[model_id] = entry
+        out = entry[1]
+
+        # the entry is only ever consulted while its future is
+        # unresolved; drop it once done so a long-lived service does
+        # not pin one stale PosteriorState result per model forever.
+        # Registered OUTSIDE _order_lock: an already-done future runs
+        # the callback inline, and the lock is not reentrant.
+        def _gc(_f):
+            with self._order_lock:
+                cur = self._last_update.get(model_id)
+                if cur is not None and cur[1] is out:
+                    del self._last_update[model_id]
+
+        out.add_done_callback(_gc)
+        return out
 
     def flush(self) -> int:
         """Dispatch everything pending now (manual/deterministic mode).
@@ -221,6 +273,9 @@ class MetranService:
                 return total
 
     def close(self) -> None:
+        # batcher.close() drains to empty — including deferred chained
+        # updates that only enqueue from done-callbacks mid-drain —
+        # before it starts refusing submissions
         self.batcher.close()
 
     def __enter__(self) -> "MetranService":
@@ -233,8 +288,6 @@ class MetranService:
     # dispatch (runs on the batcher's flushing thread)
     # ------------------------------------------------------------------
     def _dispatch(self, batch_key, requests):
-        import time
-
         kind, bucket, horizon = batch_key
         if kind == "forecast":
             results = self._run_forecast(bucket, int(horizon), requests)
@@ -258,13 +311,29 @@ class MetranService:
                 rounds[r].append(pos)
             results = [None] * len(requests)
             with self._update_lock:
+                failed = None
                 for positions in rounds:
-                    round_results = self._run_update(
-                        bucket, int(horizon),
-                        [requests[p] for p in positions],
-                    )
-                    for p, res in zip(positions, round_results):
-                        results[p] = res
+                    if failed is None:
+                        try:
+                            round_results = self._run_update(
+                                bucket, int(horizon),
+                                [requests[p] for p in positions],
+                            )
+                        except BaseException as exc:  # noqa: BLE001
+                            failed = exc
+                    if failed is not None:
+                        # a failed round breaks every later round's
+                        # chain (round r+1's models all had a request
+                        # in round r), but earlier rounds' updates were
+                        # ALREADY applied and persisted — fail only the
+                        # unapplied requests, per-request (see the
+                        # MicroBatcher dispatch contract), so no caller
+                        # sees an exception for an update that happened
+                        for p in positions:
+                            results[p] = failed
+                    else:
+                        for p, res in zip(positions, round_results):
+                            results[p] = res
             latency = self.metrics.update_latency
         else:  # pragma: no cover - batch keys are service-constructed
             raise ValueError(f"unknown dispatch kind {kind!r}")

@@ -187,7 +187,11 @@ def posterior_states_from_fleet(
     not the padded grid end — padded trailing steps are all-masked
     no-ops for the likelihood but would keep applying the predict decay
     to the carry.  Padded series/factor slots are sliced off using
-    ``fleet.n_series``, so the states are bucket-ready but unpadded.
+    ``fleet.n_series``/``fleet.n_factors`` (the latter inferred from
+    nonzero loading columns only for hand-built fleets that predate the
+    explicit field — a real factor with exactly-zero fitted loadings is
+    indistinguishable from padding there).  A member with zero true
+    timesteps has no filtered posterior and raises ``ValueError``.
 
     ``scaler_mean``/``scaler_std`` are (B, N) per-member standardization
     constants (default: 0/1 — members already standardized).
@@ -216,6 +220,9 @@ def posterior_states_from_fleet(
         else np.asarray(fleet.t_steps)
     )
     n_series = np.asarray(fleet.n_series)
+    n_factors = (
+        None if fleet.n_factors is None else np.asarray(fleet.n_factors)
+    )
     means, covs = np.asarray(means), np.asarray(covs)
     p_np = np.asarray(params)
     lds = np.asarray(fleet.loadings)
@@ -229,9 +236,20 @@ def posterior_states_from_fleet(
     states = []
     for i in range(b):
         ti, ni = int(t_steps[i]), int(n_series[i])
+        if ti <= 0:
+            raise ValueError(
+                f"fleet member {i} has t_steps == 0: no timestep was "
+                "ever assimilated, so it has no filtered posterior to "
+                "extract"
+            )
         ld = lds[i, :ni]
-        keep_f = np.flatnonzero(np.any(ld != 0, axis=0))
-        ki = int(keep_f.max()) + 1 if keep_f.size else 0
+        if n_factors is not None:
+            ki = int(n_factors[i])
+        else:
+            # hand-built fleet without explicit factor counts: trailing
+            # all-zero loading columns are assumed to be padding
+            keep_f = np.flatnonzero(np.any(ld != 0, axis=0))
+            ki = int(keep_f.max()) + 1 if keep_f.size else 0
         sl = state_slot_index(ni, ki, n_pad)
         states.append(PosteriorState(
             model_id=(

@@ -10,6 +10,7 @@ and ``time``.
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from dataclasses import dataclass, field
 from logging import getLogger
@@ -98,18 +99,25 @@ class LatencyRecorder:
     latency SLO is written against.  Bounded memory: beyond ``maxlen``
     samples the oldest half is dropped (quantiles then describe recent
     traffic, which is what an operator wants from a live service).
+    Thread-safe: the serving layer records from several dispatch
+    threads at once (background flusher + size-triggered submitters),
+    and an unlocked truncation racing an append would drop samples.
     """
 
     unit: str = "s"
     maxlen: int = 100_000
     samples: List[float] = field(default_factory=list)
     total: int = 0
+    _lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False, compare=False
+    )
 
     def record(self, seconds: float) -> None:
-        self.samples.append(float(seconds))
-        self.total += 1
-        if len(self.samples) > self.maxlen:
-            del self.samples[: len(self.samples) // 2]
+        with self._lock:
+            self.samples.append(float(seconds))
+            self.total += 1
+            if len(self.samples) > self.maxlen:
+                del self.samples[: len(self.samples) // 2]
 
     @contextlib.contextmanager
     def measure(self) -> Iterator[None]:
@@ -121,9 +129,11 @@ class LatencyRecorder:
 
     def percentile(self, q: float) -> float:
         """q in [0, 100]; 0.0 when nothing has been recorded."""
-        if not self.samples:
+        with self._lock:  # snapshot only — sort outside, off the
+            samples = list(self.samples)  # dispatch threads' lock
+        if not samples:
             return 0.0
-        ordered = sorted(self.samples)
+        ordered = sorted(samples)
         idx = min(
             len(ordered) - 1, max(0, round(q / 100.0 * (len(ordered) - 1)))
         )
@@ -139,7 +149,9 @@ class LatencyRecorder:
 
     @property
     def mean(self) -> float:
-        return sum(self.samples) / len(self.samples) if self.samples else 0.0
+        with self._lock:
+            samples = list(self.samples)
+        return sum(samples) / len(samples) if samples else 0.0
 
     def summary(self) -> str:
         return (
@@ -157,20 +169,25 @@ class OccupancyCounter:
     near 1 means the batcher coalesces nothing and each request pays a
     full dispatch.  Totals are running counters (exact over the whole
     lifetime); ``batches`` keeps only the most recent ``maxlen`` sizes,
-    bounded like :class:`LatencyRecorder` for long-lived services.
+    bounded like :class:`LatencyRecorder` for long-lived services, and
+    thread-safe for the same reason (concurrent dispatch threads).
     """
 
     maxlen: int = 100_000
     batches: List[int] = field(default_factory=list)
     dispatches: int = 0
     requests: int = 0
+    _lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False, compare=False
+    )
 
     def record(self, size: int) -> None:
-        self.batches.append(int(size))
-        self.dispatches += 1
-        self.requests += int(size)
-        if len(self.batches) > self.maxlen:
-            del self.batches[: len(self.batches) // 2]
+        with self._lock:
+            self.batches.append(int(size))
+            self.dispatches += 1
+            self.requests += int(size)
+            if len(self.batches) > self.maxlen:
+                del self.batches[: len(self.batches) // 2]
 
     @property
     def mean_occupancy(self) -> float:

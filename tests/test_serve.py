@@ -201,6 +201,152 @@ def test_different_k_same_model_updates_apply_in_order(rng, tmp_path):
     )
 
 
+def test_three_mixed_k_updates_apply_in_order(rng, tmp_path):
+    """Regression: with u1 (k=1) in flight and u2 (k=2) deferred behind
+    it, a third k=2 update must chain behind u2 — not slip straight
+    into the batcher and assimilate before it (same batch key as u2,
+    but u2 itself has not been enqueued yet)."""
+    state, ss, y, mask = _make_state(rng)
+    reg = ModelRegistry(root=tmp_path)
+    reg.put(state)
+    obs = [
+        rng.normal(size=(1, state.n_series)),
+        rng.normal(size=(2, state.n_series)),
+        rng.normal(size=(2, state.n_series)),
+    ]
+    with MetranService(reg, flush_deadline=None) as svc:
+        futs = [
+            svc.update_async("m0", o * state.scaler_std + state.scaler_mean)
+            for o in obs
+        ]
+        assert svc.flush() == 3  # drains the whole deferred chain
+        s1, s2, s3 = (f.result(timeout=5) for f in futs)
+    assert (s1.version, s2.version, s3.version) == (1, 2, 3)
+    assert s3.t_seen == state.t_seen + 5
+    y_full = np.concatenate([y, *obs])
+    mask_full = np.concatenate([mask, np.ones((5, state.n_series), bool)])
+    res = kalman_filter(ss, y_full, mask_full, engine="joint")
+    np.testing.assert_allclose(
+        s3.mean, res.mean_f[-1], rtol=1e-10, atol=1e-12
+    )
+    np.testing.assert_allclose(
+        s3.cov, res.cov_f[-1], rtol=1e-10, atol=1e-12
+    )
+
+
+def test_sync_update_behind_deferred_predecessor_does_not_hang(
+    rng, tmp_path
+):
+    """Regression: in manual-flush mode a sync ``update`` whose request
+    was deferred behind a different-k predecessor must drain the whole
+    chain inline (a single batcher flush only dispatches the
+    predecessor and would leave the caller blocked forever)."""
+    state, ss, y, mask = _make_state(rng)
+    reg = ModelRegistry(root=tmp_path)
+    reg.put(state)
+    first = rng.normal(size=(1, state.n_series))
+    second = rng.normal(size=(2, state.n_series))
+    with MetranService(reg, flush_deadline=None) as svc:
+        f1 = svc.update_async(
+            "m0", first * state.scaler_std + state.scaler_mean
+        )
+        s2 = svc.update(
+            "m0", second * state.scaler_std + state.scaler_mean
+        )
+        s1 = f1.result(timeout=5)
+    assert (s1.version, s2.version) == (1, 2)
+    assert s2.t_seen == state.t_seen + 3
+    y_full = np.concatenate([y, first, second])
+    mask_full = np.concatenate([mask, np.ones((3, state.n_series), bool)])
+    res = kalman_filter(ss, y_full, mask_full, engine="joint")
+    np.testing.assert_allclose(
+        s2.mean, res.mean_f[-1], rtol=1e-10, atol=1e-12
+    )
+
+
+def test_close_drains_deferred_update_chain(rng, tmp_path):
+    """Regression: ``close()`` without a prior explicit flush must still
+    resolve a deferred update — it only enters the batcher from its
+    predecessor's done-callback mid-drain, and a close that refuses
+    submissions before draining would fail it with 'batcher is
+    closed'."""
+    state, *_ = _make_state(rng)
+    reg = ModelRegistry(root=tmp_path)
+    reg.put(state)
+    svc = MetranService(reg, flush_deadline=None)
+    f1 = svc.update_async(
+        "m0", rng.normal(size=(1, state.n_series))
+    )
+    f2 = svc.update_async(  # different k: deferred behind f1
+        "m0", rng.normal(size=(2, state.n_series))
+    )
+    svc.close()
+    assert f1.result(timeout=5).version == 1
+    assert f2.result(timeout=5).version == 2
+
+
+def test_cancelled_update_has_no_side_effect(rng, tmp_path):
+    """A successfully cancelled update must never run: dispatch would
+    mutate and persist the registry state behind the caller's back, and
+    a resubmit would then assimilate the same observations twice."""
+    state, *_ = _make_state(rng)
+    reg = ModelRegistry(root=tmp_path)
+    reg.put(state)
+    with MetranService(reg, flush_deadline=None) as svc:
+        fut = svc.update_async("m0", rng.normal(size=(1, state.n_series)))
+        assert fut.cancel()
+        svc.flush()
+        assert fut.cancelled()
+        assert reg.get("m0").version == 0  # nothing applied
+        # the service still works afterwards
+        assert svc.update(
+            "m0", rng.normal(size=(1, state.n_series))
+        ).version == 1
+
+
+def test_partial_round_failure_keeps_applied_updates(rng, tmp_path, monkeypatch):
+    """When a later chained round of a coalesced batch fails, the
+    earlier rounds' updates were already applied and persisted — their
+    futures must resolve with the applied states, and only the
+    unapplied requests fail."""
+    state, *_ = _make_state(rng)
+    reg = ModelRegistry(root=tmp_path)
+    reg.put(state)
+    with MetranService(reg, flush_deadline=None) as svc:
+        real = svc._run_update
+        calls = []
+
+        def flaky(bucket, k, requests):
+            calls.append(len(requests))
+            if len(calls) == 2:  # the second chained round
+                raise RuntimeError("device boom")
+            return real(bucket, k, requests)
+
+        monkeypatch.setattr(svc, "_run_update", flaky)
+        f1 = svc.update_async("m0", rng.normal(size=(1, state.n_series)))
+        f2 = svc.update_async("m0", rng.normal(size=(1, state.n_series)))
+        svc.flush()
+        assert f1.result(timeout=5).version == 1  # applied, not poisoned
+        with pytest.raises(RuntimeError, match="device boom"):
+            f2.result(timeout=5)
+    assert calls == [1, 1]  # one coalesced batch, two chained rounds
+    assert reg.get("m0").version == 1  # registry matches what callers saw
+
+
+def test_deferred_update_latency_measured_from_submission():
+    """A request backdated with the caller's submission stamp keeps it
+    through the batcher, so deferred updates' telemetry covers the time
+    spent waiting behind a predecessor too."""
+    batcher = MicroBatcher(
+        lambda key, reqs: [r.enqueued_at for r in reqs],
+        flush_deadline=None,
+    )
+    fut = batcher.submit(("g",), "a", None, enqueued_at=123.5)
+    batcher.flush()
+    assert fut.result(timeout=5) == 123.5
+    batcher.close()
+
+
 def test_registry_rejects_unstorable_model_ids(rng, tmp_path):
     state, *_ = _make_state(rng, model_id="site/A")
     reg = ModelRegistry(root=tmp_path)
@@ -437,3 +583,59 @@ def test_posterior_states_from_fleet(rng):
         np.testing.assert_allclose(
             st.cov, res.cov_f[-1], rtol=1e-10, atol=1e-12
         )
+
+
+def test_posterior_states_from_fleet_keeps_zero_loading_factor(rng):
+    """A real factor whose fitted loadings are exactly zero must stay in
+    the extracted state: pack_fleet records per-member factor counts, so
+    extraction no longer infers them from nonzero loading columns."""
+    from metran_tpu.data import Panel
+    from metran_tpu.parallel import pack_fleet
+    from metran_tpu.serve import posterior_states_from_fleet
+    import pandas as pd
+
+    panels, loadings = [], []
+    for n, ld in [(3, rng.uniform(0.3, 0.7, (3, 2))), (4, rng.uniform(0.3, 0.7, (4, 1)))]:
+        t = 50
+        values = rng.normal(size=(t, n))
+        panels.append(Panel(
+            values=values, mask=np.ones((t, n), bool),
+            index=pd.date_range("2020-01-01", periods=t, freq="D"),
+            names=[f"s{j}" for j in range(n)],
+            std=np.ones(n), mean=np.zeros(n), dt=1.0,
+        ))
+        loadings.append(ld)
+    loadings[0][:, 1] = 0.0  # real factor, exactly-zero loadings
+    fleet = pack_fleet(panels, loadings)
+    assert np.asarray(fleet.n_factors).tolist() == [2, 1]
+    params = np.concatenate([
+        rng.uniform(5, 40, (2, fleet.loadings.shape[1])),
+        rng.uniform(10, 60, (2, fleet.loadings.shape[2])),
+    ], axis=1)
+    states = posterior_states_from_fleet(params, fleet)
+    assert states[0].n_factors == 2  # zero-loading factor retained
+    assert states[0].loadings.shape == (3, 2)
+    assert states[1].n_factors == 1  # padded factor slot still dropped
+    assert states[1].loadings.shape == (4, 1)
+
+
+def test_posterior_states_from_fleet_rejects_zero_timesteps(rng):
+    """A member with no assimilated timesteps has no filtered posterior;
+    extraction must raise instead of silently reading a padded row."""
+    import jax.numpy as jnp
+
+    from metran_tpu.parallel.fleet import Fleet
+    from metran_tpu.serve import posterior_states_from_fleet
+
+    fleet = Fleet(
+        y=jnp.zeros((1, 5, 2)),
+        mask=jnp.zeros((1, 5, 2), bool),
+        loadings=jnp.asarray(rng.uniform(0.3, 0.7, (1, 2, 1))),
+        dt=jnp.ones(1),
+        n_series=jnp.asarray([2]),
+        t_steps=jnp.asarray([0]),
+        n_factors=jnp.asarray([1]),
+    )
+    params = rng.uniform(5, 40, (1, 3))
+    with pytest.raises(ValueError, match="t_steps == 0"):
+        posterior_states_from_fleet(params, fleet)
