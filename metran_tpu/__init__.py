@@ -7,7 +7,7 @@ exact autodiff gradients of the marginal likelihood, ``vmap`` over fleets of
 models, and device-mesh sharding for multi-chip scale.
 """
 
-from . import config, data, io, ops, utils
+from . import config, data, io, ops, reliability, utils
 from .io import load_model, save_model
 from .utils import show_versions
 from .version import __version__
@@ -19,6 +19,7 @@ __all__ = [
     "load_model",
     "save_model",
     "ops",
+    "reliability",
     "serve",
     "utils",
     "show_versions",
@@ -33,7 +34,7 @@ def __getattr__(name):
 
         return getattr(models, name)
     if name in ("BaseSolver", "ScipySolve", "JaxSolve", "LanesSolve",
-                "LmfitSolve"):
+                "LmfitSolve", "SolverDivergenceError"):
         from .models import solver
 
         return getattr(solver, name)

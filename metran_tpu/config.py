@@ -117,6 +117,13 @@ SERVE_FLUSH_DEADLINE_S = 0.005  # micro-batch coalescing window
 SERVE_MAX_BATCH = 256  # a batch this full dispatches immediately
 SERVE_BUCKET_MULTIPLE = 8  # shape-bucket rounding for (n_series, n_state)
 SERVE_MAX_COMPILED = 32  # LRU capacity for compiled serve kernels
+# reliability defaults (metran_tpu.reliability wired into MetranService)
+SERVE_REQUEST_DEADLINE_S = 30.0  # hard cap on any sync service call
+SERVE_RETRY_ATTEMPTS = 2  # total attempts for transient failures
+SERVE_RETRY_BACKOFF_S = 0.02  # first-retry backoff (doubles per retry)
+SERVE_BREAKER_FAILURES = 5  # consecutive failures that open a breaker
+SERVE_BREAKER_COOLDOWN_S = 30.0  # open -> half-open probe window
+SERVE_VALIDATE_UPDATES = 1  # per-slot posterior finiteness/PSD checks
 
 
 def serve_defaults() -> dict:
@@ -152,6 +159,25 @@ def serve_defaults() -> dict:
         ),
         "max_compiled": _env(
             "METRAN_TPU_SERVE_MAX_COMPILED", int, SERVE_MAX_COMPILED
+        ),
+        "request_deadline_s": _env(
+            "METRAN_TPU_SERVE_DEADLINE_S", float, SERVE_REQUEST_DEADLINE_S
+        ),
+        "retry_attempts": _env(
+            "METRAN_TPU_SERVE_RETRY_ATTEMPTS", int, SERVE_RETRY_ATTEMPTS
+        ),
+        "retry_backoff_s": _env(
+            "METRAN_TPU_SERVE_RETRY_BACKOFF_S", float, SERVE_RETRY_BACKOFF_S
+        ),
+        "breaker_failures": _env(
+            "METRAN_TPU_SERVE_BREAKER_FAILURES", int, SERVE_BREAKER_FAILURES
+        ),
+        "breaker_cooldown_s": _env(
+            "METRAN_TPU_SERVE_BREAKER_COOLDOWN_S", float,
+            SERVE_BREAKER_COOLDOWN_S,
+        ),
+        "validate_updates": _env(
+            "METRAN_TPU_SERVE_VALIDATE_UPDATES", int, SERVE_VALIDATE_UPDATES
         ),
     }
 

@@ -52,11 +52,25 @@ def atomic_savez(path, **arrays) -> Path:
     its own.  Shared by the fleet-state checkpoints below, the sweep
     runner's per-batch results (``parallel/sweep.py``) and the serving
     layer's posterior states (``serve/state.py``).
+
+    A writer killed between open() and rename leaves its temp file
+    behind (so does an injected :class:`~metran_tpu.reliability.
+    faultinject.SimulatedCrash`, which this function deliberately does
+    NOT clean up after — it models the process dying); dot-prefixed
+    temp names keep such leftovers invisible to readers, and
+    :func:`sweep_stale_tmps` reclaims them at the next startup.
+
+    Fault points: ``io.atomic_savez`` (entry — injectable IO errors) and
+    ``io.atomic_savez.rename`` (between fsync and rename — crash
+    window).
     """
     import os
     import uuid
 
+    from .reliability.faultinject import SimulatedCrash, fire
+
     path = Path(path)
+    fire("io.atomic_savez", str(path))
     tmp = path.with_name(
         f".{path.name}.{os.getpid()}-{uuid.uuid4().hex[:8]}.tmp.npz"
     )
@@ -65,11 +79,65 @@ def atomic_savez(path, **arrays) -> Path:
             np.savez(fh, **arrays)
             fh.flush()
             os.fsync(fh.fileno())
+        fire("io.atomic_savez.rename", str(path))
         tmp.replace(path)
-    finally:
-        if tmp.exists():  # only on a failed write/rename
+    except SimulatedCrash:
+        raise  # a killed writer leaves its temp behind; the sweep reclaims it
+    except BaseException:
+        if tmp.exists():  # failed write/rename: don't litter
             tmp.unlink()
+        raise
     return path
+
+
+_TMP_NAME_RE = None  # compiled lazily; module import stays regex-free
+
+
+def sweep_stale_tmps(directory) -> list:
+    """Delete ``atomic_savez`` temp files left by writers killed mid-write.
+
+    Matches the exact temp-name shape ``.{name}.{pid}-{hex8}.tmp.npz``
+    and only removes a temp whose writer pid is provably gone — a LIVE
+    pid (including this process: another thread may be mid-write right
+    now) is skipped, so the sweep can run concurrently with writers.
+    Returns the paths removed.  Called by ``ModelRegistry`` at startup
+    so a crash-looping service cannot accumulate unbounded garbage, and
+    safe to call from any process that owns a checkpoint directory.
+    """
+    import os
+    import re
+
+    global _TMP_NAME_RE
+    if _TMP_NAME_RE is None:
+        _TMP_NAME_RE = re.compile(
+            r"^\.(?P<name>.+)\.(?P<pid>\d+)-[0-9a-f]{8}\.tmp\.npz$"
+        )
+
+    def pid_alive(pid: int) -> bool:
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            return False
+        except PermissionError:  # exists, owned by someone else
+            return True
+        return True
+
+    removed = []
+    directory = Path(directory)
+    if not directory.is_dir():
+        return removed
+    for p in directory.glob(".*.tmp.npz"):
+        m = _TMP_NAME_RE.match(p.name)
+        if m is None:
+            continue
+        if pid_alive(int(m.group("pid"))):
+            continue  # writer still running (possibly this process)
+        try:
+            p.unlink()
+        except FileNotFoundError:  # pragma: no cover - concurrent sweep
+            continue
+        removed.append(p)
+    return removed
 
 
 def _frame_to_dict(frame: pd.DataFrame) -> dict:
@@ -286,4 +354,5 @@ __all__ = [
     "load_model",
     "save_fleet_state",
     "save_model",
+    "sweep_stale_tmps",
 ]

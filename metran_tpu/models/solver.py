@@ -27,6 +27,27 @@ from pandas import DataFrame
 logger = getLogger(__name__)
 
 
+class SolverDivergenceError(RuntimeError):
+    """The fit objective became non-finite during optimization.
+
+    The actionable replacement for an opaque optimizer failure: carries
+    the offending parameter point (``params``; unconstrained ``theta``
+    when raised from :func:`run_lbfgs` before the solver maps it back),
+    the non-finite ``value``, and the iteration count.  Typical causes:
+    an ``alpha`` driven into a degenerate region where the innovation
+    covariance is ill-conditioned, or a float32 run whose deviance
+    overflowed — tighten the parameter bounds (``pmin``/``pmax``), cap
+    ``alpha`` (the fleet solver's soft cap), or run under
+    ``METRAN_TPU_X64=1``.
+    """
+
+    def __init__(self, message: str, params=None, value=None, n_iters=None):
+        super().__init__(message)
+        self.params = params
+        self.value = value
+        self.n_iters = n_iters
+
+
 def near_psd(a: np.ndarray, epsilon: float = 0.0) -> np.ndarray:
     """Nearest positive semi-definite matrix by eigenvalue clipping.
 
@@ -227,9 +248,28 @@ class JaxSolve(BaseSolver):
             return dev_full(full)
 
         theta0 = transform.inverse(jnp.asarray(self.initial[self.vary]))
-        theta, value, _iters, nfev, converged = run_lbfgs(
-            objective, theta0, maxiter=maxiter, tol=tol
-        )
+        try:
+            theta, value, _iters, nfev, converged = run_lbfgs(
+                objective, theta0, maxiter=maxiter, tol=tol,
+                raise_on_divergence=True,
+            )
+        except SolverDivergenceError as exc:
+            # name the offending parameters (data units, table order)
+            # instead of surfacing an opaque optimizer failure
+            x_bad = np.asarray(transform.forward(jnp.asarray(exc.params)),
+                               float)
+            at = ", ".join(
+                f"{name}={val:.6g}" for name, val in zip(self.names, x_bad)
+            )
+            raise SolverDivergenceError(
+                f"fit objective for model {self.mt.name!r} became "
+                f"non-finite (value={exc.value!r}) after {exc.n_iters} "
+                f"iterations at parameters [{at}] — likely an "
+                "ill-conditioned innovation covariance in a degenerate "
+                "alpha region; tighten pmin/pmax for those parameters, "
+                "cap alpha, or rerun with METRAN_TPU_X64=1",
+                params=x_bad, value=exc.value, n_iters=exc.n_iters,
+            ) from exc
         x = np.asarray(transform.forward(theta), float)
 
         return self._finalize(x, float(value), int(nfev), bool(converged))
@@ -397,7 +437,8 @@ def default_ftol(dtype) -> float:
 
 
 def run_lbfgs(objective, theta0, maxiter: int = 200,
-              tol: Optional[float] = None, ftol: Optional[float] = None):
+              tol: Optional[float] = None, ftol: Optional[float] = None,
+              raise_on_divergence: bool = False):
     """Chunked optax L-BFGS loop with dtype-aware stopping.
 
     Returns ``(theta, value, n_iters, nfev, converged)`` where ``nfev``
@@ -412,6 +453,13 @@ def run_lbfgs(objective, theta0, maxiter: int = 200,
     up to 20 iterations; the host checks the stopping tests between
     chunks, so the improvement test compares values a whole chunk apart
     (strictly more conservative than scipy's per-iteration check).
+
+    A non-finite objective value never reports success; with
+    ``raise_on_divergence=True`` it raises
+    :class:`SolverDivergenceError` carrying the offending ``theta`` (the
+    solver layer maps it back to named parameters) instead of returning
+    ``converged=False`` — callers that cannot act on a NaN optimum get a
+    diagnosis instead of a downstream mystery.
     """
     import jax
     import jax.numpy as jnp
@@ -442,6 +490,13 @@ def run_lbfgs(objective, theta0, maxiter: int = 200,
         count = int(otu.tree_get(state, "count"))
         gnorm = float(tree_norm(otu.tree_get(state, "grad")))
         if not _np.isfinite(value):
+            if raise_on_divergence:
+                raise SolverDivergenceError(
+                    f"fit objective became non-finite (value={value!r}) "
+                    f"after {count} L-BFGS iterations",
+                    params=_np.asarray(theta, float),
+                    value=value, n_iters=count,
+                )
             break  # diverged — never report success
         if gnorm < tol:
             converged = True

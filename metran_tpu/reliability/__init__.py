@@ -1,0 +1,53 @@
+"""Reliability layer for serving at scale: policies, health, fault injection.
+
+The ROADMAP's "heavy traffic from millions of users" north star makes
+partial failure the steady state, not the exception: one poisoned model
+must not fail a micro-batch, one corrupt file must not crash membership
+checks, one wedged worker must not block callers forever.  This package
+holds the pieces the serving stack (``metran_tpu.serve``) wires in:
+
+- :mod:`~metran_tpu.reliability.policy` — retry/backoff schedules, hard
+  request deadlines, per-model circuit breakers, and the error taxonomy
+  (:class:`StateIntegrityError`, :class:`ChainedRequestError`,
+  :class:`CircuitOpenError`, :class:`DeadlineExceededError`);
+- :mod:`~metran_tpu.reliability.health` — error-rate-aware readiness
+  (:class:`HealthMonitor`), surfaced through ``MetranService.health()``;
+- :mod:`~metran_tpu.reliability.faultinject` — the fault-injection
+  harness that keeps every one of those failure paths exercised
+  (tests ``-m faults``; ``bench.py --phase serve-faults``).
+
+Numerical motivation: ill-conditioned covariances and non-finite
+likelihood paths are a known failure mode of Kalman filtering at scale
+(arxiv 2405.08971; arxiv 2311.10580) — filter updates are treated as
+fallible steps with explicit validation and recovery, not infallible
+linear algebra.
+"""
+
+from .faultinject import FaultInjector, SimulatedCrash
+from .health import HealthMonitor
+from .policy import (
+    BreakerBoard,
+    ChainedRequestError,
+    CircuitBreaker,
+    CircuitOpenError,
+    DeadlineExceededError,
+    ReliabilityPolicy,
+    RetryPolicy,
+    StateIntegrityError,
+    is_retryable,
+)
+
+__all__ = [
+    "BreakerBoard",
+    "ChainedRequestError",
+    "CircuitBreaker",
+    "CircuitOpenError",
+    "DeadlineExceededError",
+    "FaultInjector",
+    "HealthMonitor",
+    "ReliabilityPolicy",
+    "RetryPolicy",
+    "SimulatedCrash",
+    "StateIntegrityError",
+    "is_retryable",
+]

@@ -1,0 +1,171 @@
+"""Deterministic fault injection for the serving/IO stack.
+
+Reliability code is only trustworthy if its failure paths actually run:
+a quarantine branch nobody has ever executed is a liability, not a
+feature.  This module gives the library named *fault points* — cheap
+no-op hooks compiled into the real code paths — and tests/benchmarks a
+way to arm them with failures:
+
+    from metran_tpu.reliability import faultinject
+
+    with faultinject.active() as inj:
+        inj.add("serve.dispatch", error=RuntimeError("injected"), times=3)
+        inj.add("io.atomic_savez.rename", error=faultinject.SimulatedCrash)
+        ...  # exercise the service; the first 3 dispatches fail
+
+Armed faults can raise an exception (IO errors, device failures), sleep
+(``delay_s`` — a wedged worker or slow device), or both, optionally
+limited to the first ``times`` matches and filtered by a ``match``
+substring against the fault point's detail string (e.g. one model's
+file path).  The hot-path cost when nothing is armed is one module
+attribute read and a ``None`` check.
+
+:class:`SimulatedCrash` stands in for a process death (``kill -9``
+mid-write): it deliberately derives from ``BaseException`` so ordinary
+``except Exception`` recovery code cannot swallow it, and instrumented
+writers treat it as "the process is gone" — e.g. ``io.atomic_savez``
+leaves its temp file behind exactly like a killed writer would, which
+is what the crash-recovery sweep (``io.sweep_stale_tmps``) exists to
+clean up.
+
+The active injector is process-global (not thread-local) on purpose:
+the serving stack hops threads (caller -> batcher worker -> dispatch),
+and a fault armed by a test must fire on whichever thread executes the
+instrumented point.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from dataclasses import dataclass, field
+from logging import getLogger
+from typing import Dict, Iterator, List, Optional, Union
+
+logger = getLogger(__name__)
+
+
+class SimulatedCrash(BaseException):
+    """A simulated process death at a fault point (see module docstring)."""
+
+
+@dataclass
+class Fault:
+    """One armed fault rule.
+
+    Attributes
+    ----------
+    point : fault-point name this rule matches (exact).
+    error : exception class or instance to raise (``None``: no raise).
+    delay_s : seconds to sleep before (optionally) raising.
+    times : fire at most this many times (``None``: every match).
+    match : only fire when this substring occurs in the point's detail
+        string (e.g. a model id or file path); ``None`` matches all.
+    """
+
+    point: str
+    error: Union[BaseException, type, None] = None
+    delay_s: float = 0.0
+    times: Optional[int] = None
+    match: Optional[str] = None
+    fired: int = field(default=0, compare=False)
+
+
+class FaultInjector:
+    """A set of armed :class:`Fault` rules consulted by ``fire()``."""
+
+    def __init__(self):
+        self._faults: List[Fault] = []
+        self._lock = threading.Lock()
+        self.fired: Dict[str, int] = {}
+
+    def add(
+        self,
+        point: str,
+        error: Union[BaseException, type, None] = None,
+        delay_s: float = 0.0,
+        times: Optional[int] = None,
+        match: Optional[str] = None,
+    ) -> Fault:
+        """Arm one fault rule; returns it (``.fired`` counts matches)."""
+        fault = Fault(
+            point=point, error=error, delay_s=float(delay_s),
+            times=times, match=match,
+        )
+        with self._lock:
+            self._faults.append(fault)
+        return fault
+
+    def remove(self, fault: Fault) -> None:
+        with self._lock:
+            if fault in self._faults:
+                self._faults.remove(fault)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._faults.clear()
+
+    def fire(self, point: str, detail: str = "") -> None:
+        """Run every armed rule matching ``point`` (sleep, then raise)."""
+        to_apply: List[Fault] = []
+        with self._lock:
+            for fault in self._faults:
+                if fault.point != point:
+                    continue
+                if fault.match is not None and fault.match not in detail:
+                    continue
+                if fault.times is not None and fault.fired >= fault.times:
+                    continue
+                fault.fired += 1
+                self.fired[point] = self.fired.get(point, 0) + 1
+                to_apply.append(fault)
+        for fault in to_apply:
+            if fault.delay_s > 0:
+                time.sleep(fault.delay_s)
+            if fault.error is not None:
+                logger.info(
+                    "fault injection: raising at %s (%s)", point, detail
+                )
+                if isinstance(fault.error, type):
+                    raise fault.error(
+                        f"injected fault at {point}"
+                        + (f" ({detail})" if detail else "")
+                    )
+                raise fault.error
+
+
+# The process-global injector; ``None`` keeps every fault point a no-op.
+_active: Optional[FaultInjector] = None
+
+
+def fire(point: str, detail: str = "") -> None:
+    """Library-side hook: no-op unless an injector is active.
+
+    Instrumented code calls this at its named fault points; the cost
+    with nothing armed is a module attribute read and a ``None`` check.
+    """
+    injector = _active
+    if injector is not None:
+        injector.fire(point, detail)
+
+
+@contextlib.contextmanager
+def active(injector: Optional[FaultInjector] = None) -> Iterator[FaultInjector]:
+    """Activate ``injector`` (or a fresh one) for the enclosed block.
+
+    Not reentrant by design: nesting would silently shadow the outer
+    injector's rules, so it raises instead.
+    """
+    global _active
+    if _active is not None:
+        raise RuntimeError("a fault injector is already active")
+    injector = injector if injector is not None else FaultInjector()
+    _active = injector
+    try:
+        yield injector
+    finally:
+        _active = None
+
+
+__all__ = ["Fault", "FaultInjector", "SimulatedCrash", "active", "fire"]

@@ -1,0 +1,76 @@
+"""Error-rate-aware health tracking for readiness probes.
+
+A latency histogram says how fast the service is; it says nothing about
+whether it is *succeeding*.  :class:`HealthMonitor` keeps a bounded
+window of recent request outcomes so a readiness probe can answer "is
+this replica currently serving its traffic" — the number an
+orchestrator flips a replica out of rotation on — without unbounded
+memory and without scanning historical totals that would let one bad
+hour poison an otherwise-recovered replica forever.
+
+:meth:`MetranService.health` assembles the full snapshot: this window's
+error rate, the lifetime error counters by kind
+(``utils.profiling.EventCounters``), open circuit breakers, quarantine
+events, and batcher liveness.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Deque, Dict, Optional
+
+__all__ = ["HealthMonitor"]
+
+
+class HealthMonitor:
+    """Sliding-window request-outcome tracker (thread-safe).
+
+    ``window`` bounds memory AND forgives: once a fault clears, the bad
+    outcomes age out after ``window`` successful requests and the
+    replica reads ready again — recovery needs no restart.
+    """
+
+    def __init__(self, window: int = 512, max_error_rate: float = 0.5):
+        self.window = int(window)
+        self.max_error_rate = float(max_error_rate)
+        self._outcomes: Deque[bool] = deque(maxlen=self.window)
+        self._lock = threading.Lock()
+        self._seen = 0
+
+    def record(self, ok: bool) -> None:
+        with self._lock:
+            self._outcomes.append(bool(ok))
+            self._seen += 1
+
+    @property
+    def seen(self) -> int:
+        with self._lock:
+            return self._seen
+
+    def error_rate(self) -> float:
+        """Failure fraction over the recent window (0.0 when empty)."""
+        with self._lock:
+            if not self._outcomes:
+                return 0.0
+            return 1.0 - sum(self._outcomes) / len(self._outcomes)
+
+    def healthy(self) -> bool:
+        """Error-rate verdict alone; the service ANDs in liveness."""
+        return self.error_rate() <= self.max_error_rate
+
+    def snapshot(self, extra: Optional[Dict] = None) -> Dict:
+        with self._lock:
+            n = len(self._outcomes)
+            errors = n - sum(self._outcomes)
+            seen = self._seen
+        snap = {
+            "window": n,
+            "window_errors": int(errors),
+            "error_rate": (errors / n) if n else 0.0,
+            "requests_seen": seen,
+            "max_error_rate": self.max_error_rate,
+        }
+        if extra:
+            snap.update(extra)
+        return snap

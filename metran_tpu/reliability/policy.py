@@ -1,0 +1,317 @@
+"""Retry, deadline and circuit-breaker policies for the serving stack.
+
+Failure domains (see docs/concepts.md "Reliability & degradation"):
+
+- a **request** fails alone when its own payload or its own model's
+  posterior is bad (per-slot isolation in ``serve/service.py``);
+- a **model** that fails repeatedly gets its own :class:`CircuitBreaker`
+  opened, so traffic for it is rejected cheaply at submission instead of
+  burning batch slots on a poisoned model;
+- the **caller** is protected by a hard deadline: every synchronous
+  ``MetranService`` call bounds its wait on the request future, so a
+  dead or wedged batcher worker can never block a caller forever;
+- **transient** failures (a flaky dispatch) are retried with
+  exponential backoff inside the remaining deadline budget — but only
+  when the failed attempt provably produced no side effect (the
+  dispatch contract: an exception outcome means the update was NOT
+  applied), so a retry can never assimilate observations twice.
+
+Everything here is numpy/jax-free and allocation-light: policies sit on
+the request hot path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from logging import getLogger
+from typing import Callable, Dict, List, Optional
+
+logger = getLogger(__name__)
+
+
+# ----------------------------------------------------------------------
+# errors
+# ----------------------------------------------------------------------
+class StateIntegrityError(RuntimeError):
+    """A posterior state is corrupt or numerically invalid.
+
+    Raised when an on-disk state fails its checksum / cannot be parsed
+    (the file is then quarantined, ``ModelRegistry``), and when an
+    assimilation step produces a non-finite or non-PSD posterior (the
+    update is then rejected and the stored state left unchanged,
+    ``MetranService._run_update``).  Deterministic — never retried.
+    """
+
+
+class ChainedRequestError(RuntimeError):
+    """A request was not applied because its predecessor failed.
+
+    Same-model updates form an ordered chain (the Kalman recursion is
+    order-dependent); once one link fails, applying its successors
+    would silently skip observations.  The successors fail with this
+    error instead — the caller resolves the gap and resubmits.
+    """
+
+
+class CircuitOpenError(RuntimeError):
+    """Request rejected because the model's circuit breaker is open."""
+
+    def __init__(self, model_id: str, retry_after_s: float):
+        self.model_id = model_id
+        self.retry_after_s = retry_after_s
+        super().__init__(
+            f"circuit breaker for model {model_id!r} is open "
+            f"(retry after ~{retry_after_s:.1f}s)"
+        )
+
+
+class DeadlineExceededError(TimeoutError):
+    """A synchronous service call hit its hard deadline.
+
+    ``in_flight`` is True when the request could no longer be cancelled
+    (dispatch already claimed it): the operation MAY still complete in
+    the background, so an update must not be blindly retried — check
+    the state's version first.
+    """
+
+    def __init__(self, kind: str, model_id: str, deadline_s: float,
+                 in_flight: bool):
+        self.kind = kind
+        self.model_id = model_id
+        self.deadline_s = deadline_s
+        self.in_flight = in_flight
+        super().__init__(
+            f"{kind} for model {model_id!r} exceeded its {deadline_s:.3f}s "
+            f"deadline ({'request still in flight' if in_flight else 'request cancelled, no side effect'})"
+        )
+
+
+def is_retryable(exc: BaseException) -> bool:
+    """Whether a failed attempt may be retried at all.
+
+    Deterministic failures (bad payload, poisoned state, broken chain,
+    unknown model, open breaker) and exhausted deadlines are final;
+    everything else (flaky dispatch, transient IO) is fair game.  The
+    retry loop additionally requires the failure to be side-effect-free
+    — which the dispatch contract guarantees for exception outcomes.
+
+    Non-``Exception`` ``BaseException``\\ s (KeyboardInterrupt,
+    SystemExit, a faultinject ``SimulatedCrash``) are NEVER retryable:
+    they mean "stop", and a retry loop that swallows a Ctrl-C into a
+    backoff sleep has stolen the terminal from its operator.
+    """
+    from concurrent.futures import CancelledError
+
+    if not isinstance(exc, Exception):
+        return False
+    return not isinstance(
+        exc,
+        (
+            StateIntegrityError,
+            ChainedRequestError,
+            CircuitOpenError,
+            DeadlineExceededError,
+            CancelledError,  # someone chose to cancel; honor it
+            ValueError,
+            KeyError,
+        ),
+    )
+
+
+# ----------------------------------------------------------------------
+# retry
+# ----------------------------------------------------------------------
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff schedule for transient failures.
+
+    ``max_attempts`` counts the first try too (1 = no retries).  The
+    delay before retry ``i`` (1-based) is
+    ``min(backoff_s * multiplier**(i-1), max_backoff_s)``.
+    """
+
+    max_attempts: int = 2
+    backoff_s: float = 0.02
+    multiplier: float = 2.0
+    max_backoff_s: float = 1.0
+
+    def delay(self, attempt: int) -> float:
+        """Backoff before the retry following failed attempt ``attempt``."""
+        return min(
+            self.backoff_s * self.multiplier ** max(attempt - 1, 0),
+            self.max_backoff_s,
+        )
+
+
+# ----------------------------------------------------------------------
+# circuit breaker
+# ----------------------------------------------------------------------
+class CircuitBreaker:
+    """Per-model breaker: CLOSED -> OPEN -> HALF_OPEN -> CLOSED.
+
+    Opens after ``failure_threshold`` CONSECUTIVE failures; while open,
+    :meth:`allow` rejects instantly (no batch slot is wasted on a model
+    that keeps poisoning its own updates).  After ``cooldown_s`` the
+    breaker half-opens and admits exactly one probe request: a success
+    closes it, a failure re-opens it for another cooldown.  A cancelled
+    probe releases the slot without a verdict.
+
+    ``clock`` is injectable (monotonic seconds) so tests can drive the
+    cooldown deterministically.
+    """
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+    def __init__(self, model_id: str, failure_threshold: int = 5,
+                 cooldown_s: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.model_id = model_id
+        self.failure_threshold = int(failure_threshold)
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probe_in_flight = False
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def allow(self) -> None:
+        """Admit a request or raise :class:`CircuitOpenError`."""
+        with self._lock:
+            if self._state == self.CLOSED:
+                return
+            now = self._clock()
+            if self._state == self.OPEN:
+                remaining = self._opened_at + self.cooldown_s - now
+                if remaining > 0:
+                    raise CircuitOpenError(self.model_id, remaining)
+                self._state = self.HALF_OPEN
+                self._probe_in_flight = False
+            # HALF_OPEN: exactly one probe at a time
+            if self._probe_in_flight:
+                raise CircuitOpenError(self.model_id, self.cooldown_s)
+            self._probe_in_flight = True
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._state = self.CLOSED
+            self._failures = 0
+            self._probe_in_flight = False
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            if (
+                self._state == self.HALF_OPEN
+                or self._failures >= self.failure_threshold
+            ):
+                if self._state != self.OPEN:
+                    logger.warning(
+                        "circuit breaker OPEN for model %r after %d "
+                        "consecutive failures", self.model_id, self._failures,
+                    )
+                self._state = self.OPEN
+                self._opened_at = self._clock()
+                self._probe_in_flight = False
+
+    def record_abandoned(self) -> None:
+        """A half-open probe was cancelled: free the slot, no verdict."""
+        with self._lock:
+            self._probe_in_flight = False
+
+
+class BreakerBoard:
+    """Lazily-created per-model breakers sharing one configuration."""
+
+    def __init__(self, failure_threshold: int = 5, cooldown_s: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.failure_threshold = int(failure_threshold)
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._breakers: Dict[str, CircuitBreaker] = {}
+
+    def get(self, model_id: str) -> CircuitBreaker:
+        with self._lock:
+            breaker = self._breakers.get(model_id)
+            if breaker is None:
+                breaker = self._breakers[model_id] = CircuitBreaker(
+                    model_id, self.failure_threshold, self.cooldown_s,
+                    self._clock,
+                )
+            return breaker
+
+    def open_models(self) -> List[str]:
+        """Model ids whose breaker is not CLOSED (open or probing)."""
+        with self._lock:
+            breakers = list(self._breakers.values())
+        return sorted(
+            b.model_id for b in breakers if b.state != CircuitBreaker.CLOSED
+        )
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._breakers)
+
+
+# ----------------------------------------------------------------------
+# the bundle the service consumes
+# ----------------------------------------------------------------------
+@dataclass
+class ReliabilityPolicy:
+    """All serving-reliability knobs in one injectable object.
+
+    ``None`` fields fall back to :func:`metran_tpu.config.serve_defaults`
+    at :class:`~metran_tpu.serve.MetranService` construction.  ``clock``
+    and ``sleep`` are injectable for deterministic tests.
+    """
+
+    deadline_s: Optional[float] = 30.0
+    retry: RetryPolicy = field(default_factory=RetryPolicy)
+    breaker_failures: int = 5
+    breaker_cooldown_s: float = 30.0
+    validate_updates: bool = True
+    health_window: int = 512
+    max_error_rate: float = 0.5
+    clock: Callable[[], float] = time.monotonic
+    sleep: Callable[[float], None] = time.sleep
+
+    @classmethod
+    def from_defaults(cls) -> "ReliabilityPolicy":
+        """Build from :func:`metran_tpu.config.serve_defaults` (env-
+        overridable ``METRAN_TPU_SERVE_*`` knobs)."""
+        from ..config import serve_defaults
+
+        d = serve_defaults()
+        return cls(
+            deadline_s=d["request_deadline_s"],
+            retry=RetryPolicy(
+                max_attempts=d["retry_attempts"],
+                backoff_s=d["retry_backoff_s"],
+            ),
+            breaker_failures=d["breaker_failures"],
+            breaker_cooldown_s=d["breaker_cooldown_s"],
+            validate_updates=bool(d["validate_updates"]),
+        )
+
+
+__all__ = [
+    "BreakerBoard",
+    "ChainedRequestError",
+    "CircuitBreaker",
+    "CircuitOpenError",
+    "DeadlineExceededError",
+    "ReliabilityPolicy",
+    "RetryPolicy",
+    "StateIntegrityError",
+    "is_retryable",
+]

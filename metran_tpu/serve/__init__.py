@@ -18,13 +18,21 @@ refilters history:
   dispatches;
 - :mod:`~metran_tpu.serve.service` — :class:`MetranService`, the
   in-process ``update``/``forecast`` API with latency and occupancy
-  telemetry.
+  telemetry, hard request deadlines, per-model circuit breakers, and
+  per-slot failure isolation (``metran_tpu.reliability``).
 
-See the "Online assimilation & serving" section of docs/concepts.md.
+See the "Online assimilation & serving" and "Reliability &
+degradation" sections of docs/concepts.md.
 """
 
+from ..reliability.policy import (
+    ChainedRequestError,
+    CircuitOpenError,
+    DeadlineExceededError,
+    StateIntegrityError,
+)
 from .batching import MicroBatcher
-from .engine import forecast_bucket, stack_bucket, update_bucket
+from .engine import forecast_bucket, posterior_fault, stack_bucket, update_bucket
 from .registry import CompiledFnCache, ModelRegistry
 from .service import Forecast, MetranService, ServeMetrics
 from .state import (
@@ -34,14 +42,19 @@ from .state import (
 )
 
 __all__ = [
+    "ChainedRequestError",
+    "CircuitOpenError",
     "CompiledFnCache",
+    "DeadlineExceededError",
     "Forecast",
     "MetranService",
     "MicroBatcher",
     "ModelRegistry",
     "PosteriorState",
     "ServeMetrics",
+    "StateIntegrityError",
     "forecast_bucket",
+    "posterior_fault",
     "posterior_state_from_metran",
     "posterior_states_from_fleet",
     "stack_bucket",

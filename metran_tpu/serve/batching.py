@@ -169,6 +169,26 @@ class MicroBatcher:
         with self._lock:
             return sum(len(g.requests) for g in self._groups.values())
 
+    def worker_alive(self) -> bool:
+        """Whether the background flusher can still dispatch deadlines.
+
+        True in manual-flush mode (no worker to die — callers drive
+        dispatch); in background mode, the liveness half of the service
+        health probe: a dead worker means queued requests only ever
+        resolve through explicit ``flush()``/caller deadlines.
+        """
+        if self.flush_deadline is None:
+            return True
+        with self._lock:
+            if self._closed or self._stopping:
+                return False
+        return self._worker is not None and self._worker.is_alive()
+
+    @property
+    def closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
     def close(self) -> None:
         """Flush everything and stop the background worker.
 

@@ -12,20 +12,36 @@ States live one-``.npz``-per-model under ``root`` (written atomically
 via :func:`metran_tpu.io.atomic_savez`) with a write-through in-memory
 cache, so a service process warm-starts from disk and survives
 restarts.
+
+Integrity (``metran_tpu.reliability``): every disk load verifies the
+state file's embedded checksum and the posterior's numerical validity;
+a file that fails is **quarantined** — renamed into a ``.quarantine/``
+sibling directory, never deleted, so an operator can inspect it — and
+the registry degrades per-model instead of crashing: ``get`` falls back
+to the last-good in-memory state when one exists, ``__contains__``
+answers False, ``model_ids`` never trips over it.  Startup also sweeps
+``atomic_savez`` temp files abandoned by writers killed mid-write
+(:func:`metran_tpu.io.sweep_stale_tmps`).
 """
 
 from __future__ import annotations
 
+import os
 import threading
 from collections import OrderedDict
 from logging import getLogger
 from pathlib import Path
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
+from ..io import sweep_stale_tmps
 from ..parallel.mesh import pad_to_multiple
+from ..reliability.policy import StateIntegrityError
+from ..utils.profiling import EventCounters
 from .state import PosteriorState
 
 logger = getLogger(__name__)
+
+QUARANTINE_DIR = ".quarantine"
 
 ShapeBucket = Tuple[int, int]  # padded (n_series, n_state)
 
@@ -84,6 +100,11 @@ class ModelRegistry:
         cost of more padding FLOPs per request.
     max_compiled : LRU capacity for compiled kernels.
     engine : Kalman update engine for assimilation dispatches.
+    validate : run the numerical posterior gate on disk loads (default
+        ``serve_defaults()["validate_updates"]`` — the SAME knob the
+        service's write-path gate uses, so states an operator chose to
+        tolerate at write time are not quarantined at the next restart).
+        File-integrity checks (parse, checksum) always run.
     """
 
     def __init__(
@@ -92,6 +113,7 @@ class ModelRegistry:
         bucket_multiple: Optional[int] = None,
         max_compiled: Optional[int] = None,
         engine: str = "joint",
+        validate: Optional[bool] = None,
     ):
         from ..config import serve_defaults
 
@@ -100,9 +122,22 @@ class ModelRegistry:
             bucket_multiple = defaults["bucket_multiple"]
         if max_compiled is None:
             max_compiled = defaults["max_compiled"]
+        if validate is None:
+            validate = bool(defaults["validate_updates"])
+        self.validate = bool(validate)
         self.root = Path(root) if root is not None else None
+        self.integrity = EventCounters()
         if self.root is not None:
             self.root.mkdir(parents=True, exist_ok=True)
+            # crash recovery: reclaim atomic_savez temps abandoned by
+            # writers killed mid-write (live writers are skipped)
+            swept = sweep_stale_tmps(self.root)
+            if swept:
+                self.integrity.increment("stale_tmps_swept", len(swept))
+                logger.warning(
+                    "swept %d stale write temp(s) from %s",
+                    len(swept), self.root,
+                )
         self.bucket_multiple = int(bucket_multiple)
         self.engine = engine
         self._states: Dict[str, PosteriorState] = {}
@@ -150,24 +185,120 @@ class ModelRegistry:
             state.save(self.path_for(state.model_id))
         return state
 
-    def get(self, model_id: str) -> PosteriorState:
-        """The model's current state (memory first, then disk)."""
-        state = self._states.get(model_id)
-        if state is None:
-            if self.root is None:
-                raise KeyError(f"unknown model {model_id!r}")
-            path = self.path_for(model_id)
-            if not path.exists():
-                raise KeyError(f"unknown model {model_id!r} (no {path})")
+    def quarantine_dir(self) -> Path:
+        if self.root is None:
+            raise ValueError("in-memory registry has no storage root")
+        return self.root / QUARANTINE_DIR
+
+    def _quarantine(self, path: Path, reason: str) -> Optional[Path]:
+        """Move a corrupt state file aside (never delete — operators
+        inspect quarantined files) and count the event."""
+        qdir = self.quarantine_dir()
+        qdir.mkdir(exist_ok=True)
+        dest = qdir / path.name
+        if dest.exists():  # repeated corruption of one model id
+            dest = qdir / f"{path.name}.{os.getpid()}-{id(path) & 0xFFFF:x}"
+        try:
+            path.replace(dest)
+        except FileNotFoundError:  # pragma: no cover - concurrent move
+            return None
+        self.integrity.increment("quarantined")
+        logger.error(
+            "quarantined corrupt state file %s -> %s (%s)",
+            path, dest, reason,
+        )
+        return dest
+
+    def _load(self, model_id: str, path: Path) -> PosteriorState:
+        """Load + validate one on-disk state; quarantine on corruption.
+
+        Numerical validation runs on top of the file checksum: a state
+        persisted before the write-path finiteness gate existed can
+        carry a NaN posterior that checksums perfectly — it is just as
+        unserviceable as a torn file.
+        """
+        from .engine import posterior_fault
+
+        try:
             state = PosteriorState.load(path)
-            self._states[model_id] = state
+        except StateIntegrityError as exc:
+            self.integrity.increment("load_failures")
+            self._quarantine(path, str(exc))
+            raise
+        except ValueError:
+            # well-formed but unsupported (newer format): NOT corrupt,
+            # so never quarantine — this build just cannot read it
+            self.integrity.increment("load_failures")
+            raise
+        if self.validate:
+            fault = posterior_fault(state.mean, state.cov)
+            if fault is not None:
+                self.integrity.increment("load_failures")
+                self._quarantine(path, fault)
+                raise StateIntegrityError(
+                    f"stored state for model {model_id!r} is invalid: "
+                    f"{fault}"
+                )
         return state
 
+    def get(self, model_id: str, refresh: bool = False) -> PosteriorState:
+        """The model's current state (memory first, then disk).
+
+        ``refresh=True`` forces a disk re-read (replica catch-up after
+        another writer's update).  A corrupt disk file is quarantined
+        and the last-good in-memory state served instead when one
+        exists — degradation, not an outage; with no fallback the
+        :class:`~metran_tpu.reliability.StateIntegrityError` propagates.
+        """
+        state = self._states.get(model_id)
+        if state is not None and not refresh:
+            return state
+        if self.root is None:
+            if state is not None:
+                return state
+            raise KeyError(f"unknown model {model_id!r}")
+        path = self.path_for(model_id)
+        if not path.exists():
+            if state is not None:
+                return state
+            raise KeyError(f"unknown model {model_id!r} (no {path})")
+        try:
+            fresh = self._load(model_id, path)
+        except (StateIntegrityError, ValueError):
+            if state is not None:
+                self.integrity.increment("served_last_good")
+                logger.warning(
+                    "serving last-good in-memory state for model %r "
+                    "(version %d) after a failed disk load",
+                    model_id, state.version,
+                )
+                return state
+            raise
+        if state is not None and fresh.version < state.version:
+            # stale disk (e.g. an update that committed in memory but
+            # failed its write-through): refreshing must never roll an
+            # acknowledged version back and un-apply observations
+            self.integrity.increment("stale_disk_reads")
+            logger.warning(
+                "disk state for model %r (version %d) is older than "
+                "memory (version %d); keeping the in-memory state",
+                model_id, fresh.version, state.version,
+            )
+            return state
+        self._states[model_id] = fresh
+        return fresh
+
     def __contains__(self, model_id: str) -> bool:
+        """Membership that treats an unloadable file as absent.
+
+        A truncated/corrupt npz must make ``mid in registry`` answer
+        False (after quarantining it), never raise — membership checks
+        run in routing paths that cannot crash per-model.
+        """
         try:
             self.get(model_id)
             return True
-        except KeyError:
+        except (KeyError, StateIntegrityError, ValueError):
             return False
 
     def model_ids(self) -> List[str]:
@@ -229,6 +360,12 @@ class ModelRegistry:
             "misses": self._compiled.misses,
             "resident": len(self._compiled),
         }
+
+    @property
+    def integrity_stats(self) -> Dict[str, int]:
+        """Lifetime integrity-event counters (quarantines, load
+        failures, last-good fallbacks, startup temp sweeps)."""
+        return self.integrity.snapshot()
 
 
 __all__ = ["CompiledFnCache", "ModelRegistry", "ShapeBucket"]

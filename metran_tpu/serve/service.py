@@ -21,6 +21,18 @@ Request flow::
 The service is thread-safe for concurrent ``update``/``forecast``
 callers; dispatches for the same shape bucket coalesce into single
 device executions (``serve/batching.py``).
+
+Failure isolation (``metran_tpu.reliability``): a request fails ALONE.
+Payloads are validated at submission; each batch slot's computed
+posterior is checked for finiteness/symmetry/PSD before it is committed
+to the registry, so one poisoned model fails its own request (and its
+not-yet-applied same-model chain) while the other slots in the same
+device dispatch commit with correct versions.  Every synchronous call
+carries a hard deadline (a dead batcher worker can never block a caller
+forever), transient failures retry with backoff inside that deadline,
+and models that fail repeatedly get a per-model circuit breaker that
+rejects their traffic cheaply until a cooldown probe succeeds.
+:meth:`MetranService.health` is the readiness snapshot.
 """
 
 from __future__ import annotations
@@ -28,13 +40,25 @@ from __future__ import annotations
 import threading
 import time
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as _FutureTimeout
 from dataclasses import dataclass, field
 from logging import getLogger
 from typing import NamedTuple, Optional, Tuple
 
 import numpy as np
 
-from ..utils.profiling import LatencyRecorder, OccupancyCounter
+from ..reliability.faultinject import fire
+from ..reliability.health import HealthMonitor
+from ..reliability.policy import (
+    BreakerBoard,
+    ChainedRequestError,
+    CircuitOpenError,
+    DeadlineExceededError,
+    ReliabilityPolicy,
+    StateIntegrityError,
+    is_retryable,
+)
+from ..utils.profiling import EventCounters, LatencyRecorder, OccupancyCounter
 from .batching import MicroBatcher
 from .registry import ModelRegistry
 from .state import PosteriorState
@@ -69,7 +93,14 @@ class Forecast(NamedTuple):
 
 @dataclass
 class ServeMetrics:
-    """Request/dispatch telemetry (see ``utils/profiling.py``)."""
+    """Request/dispatch telemetry (see ``utils/profiling.py``).
+
+    ``errors`` counts reliability events by kind — ``poisoned_updates``,
+    ``poisoned_forecasts``, ``validation_errors``, ``chain_failures``,
+    ``deadline_exceeded``, ``breaker_rejections``, ``retries``,
+    ``persist_failures``, ``update_errors``/``forecast_errors`` — the
+    degradation half of the telemetry, exported into ``BENCH_*.json``.
+    """
 
     update_latency: LatencyRecorder = field(
         default_factory=lambda: LatencyRecorder()
@@ -78,12 +109,14 @@ class ServeMetrics:
         default_factory=lambda: LatencyRecorder()
     )
     occupancy: OccupancyCounter = field(default_factory=OccupancyCounter)
+    errors: EventCounters = field(default_factory=EventCounters)
 
     def summary(self) -> str:
         return (
             f"updates {self.update_latency.summary()} | "
             f"forecasts {self.forecast_latency.summary()} | "
-            f"{self.occupancy.summary()}"
+            f"{self.occupancy.summary()} | "
+            f"{self.errors.summary()}"
         )
 
 
@@ -100,6 +133,9 @@ class MetranService:
     max_batch : dispatch immediately once a group is this full.
     persist_updates : write updated posterior states through to the
         registry's disk root (ignored for in-memory registries).
+    reliability : deadline/retry/breaker/validation policy
+        (:class:`~metran_tpu.reliability.ReliabilityPolicy`); default
+        from :func:`metran_tpu.config.serve_defaults`.
     """
 
     def __init__(
@@ -108,6 +144,7 @@ class MetranService:
         flush_deadline: Optional[float] = "default",
         max_batch: Optional[int] = None,
         persist_updates: bool = True,
+        reliability: Optional[ReliabilityPolicy] = None,
     ):
         from ..config import serve_defaults
 
@@ -119,6 +156,19 @@ class MetranService:
         self.registry = registry
         self.persist_updates = persist_updates
         self.metrics = ServeMetrics()
+        self.reliability = (
+            reliability if reliability is not None
+            else ReliabilityPolicy.from_defaults()
+        )
+        self.breakers = BreakerBoard(
+            failure_threshold=self.reliability.breaker_failures,
+            cooldown_s=self.reliability.breaker_cooldown_s,
+            clock=self.reliability.clock,
+        )
+        self.monitor = HealthMonitor(
+            window=self.reliability.health_window,
+            max_error_rate=self.reliability.max_error_rate,
+        )
         # updates are registry read-modify-writes; dispatches can run on
         # SEVERAL threads at once (background flusher + size-triggered
         # submitter threads, with same-model requests possibly split
@@ -145,42 +195,202 @@ class MetranService:
     # ------------------------------------------------------------------
     # public API
     # ------------------------------------------------------------------
-    def forecast(self, model_id: str, steps: int) -> Forecast:
-        """Predictive means/variances ``steps`` grid periods ahead."""
-        return self._resolve(self.forecast_async(model_id, steps))
+    def forecast(
+        self, model_id: str, steps: int, deadline: Optional[float] = "default"
+    ) -> Forecast:
+        """Predictive means/variances ``steps`` grid periods ahead.
 
-    def forecast_async(self, model_id: str, steps: int) -> "Future[Forecast]":
-        state = self.registry.get(model_id)
-        bucket = self.registry.bucket_of(state)
-        return self.batcher.submit(
-            ("forecast", bucket, int(steps)), model_id, None
+        Bounded by ``deadline`` seconds (default from the reliability
+        policy; ``None`` disables) — raises
+        :class:`~metran_tpu.reliability.DeadlineExceededError` rather
+        than ever blocking past it.  Transient failures retry with
+        backoff inside the deadline budget.
+        """
+        return self._call(
+            "forecast", model_id,
+            lambda: self.forecast_async(model_id, steps), deadline,
         )
 
-    def update(self, model_id: str, new_obs) -> PosteriorState:
-        """Assimilate ``new_obs`` ((k, n_series), data units, NaN =
-        missing) and return the bumped :class:`PosteriorState`."""
-        return self._resolve(self.update_async(model_id, new_obs))
+    def forecast_async(self, model_id: str, steps: int) -> "Future[Forecast]":
+        steps = int(steps)
+        if steps < 1:
+            self.metrics.errors.increment("validation_errors")
+            raise ValueError(f"forecast steps must be >= 1, got {steps}")
+        # registry lookup BEFORE any breaker exists: a breaker per
+        # caller-supplied id would let typo'd/enumerated ids grow
+        # BreakerBoard without bound on a long-lived service — only
+        # ids the registry actually knows earn breaker state
+        try:
+            state = self.registry.get(model_id)
+        except StateIntegrityError:
+            # the model's own stored state is bad: a real per-model
+            # failure, and the breaker should learn it (a KNOWN id)
+            self._record_failure_without_request("forecast", model_id)
+            raise
+        breaker = self.breakers.get(model_id)
+        try:
+            breaker.allow()
+        except CircuitOpenError:
+            self.metrics.errors.increment("breaker_rejections")
+            raise
+        try:
+            bucket = self.registry.bucket_of(state)
+            fut = self.batcher.submit(
+                ("forecast", bucket, steps), model_id, None
+            )
+        except BaseException:
+            # infrastructure refusal before any request existed:
+            # release a half-open probe slot without a verdict
+            breaker.record_abandoned()
+            raise
+        self._observe(fut, "forecast", breaker)
+        return fut
 
-    def _resolve(self, fut: Future):
+    def _record_failure_without_request(self, kind: str, model_id: str):
+        """A known model failed before a request could exist (corrupt
+        stored state): book the outcome the same way a failed request
+        would have been."""
+        self.breakers.get(model_id).record_failure()
+        self.monitor.record(False)
+        self.metrics.errors.increment(f"{kind}_errors")
+
+    def update(
+        self, model_id: str, new_obs, deadline: Optional[float] = "default"
+    ) -> PosteriorState:
+        """Assimilate ``new_obs`` ((k, n_series), data units, NaN =
+        missing) and return the bumped :class:`PosteriorState`.
+
+        Deadline/retry semantics as :meth:`forecast`.  A retry only
+        happens when the failed attempt provably applied nothing (the
+        dispatch contract: exception outcome == not applied); a
+        deadline hit with the request already claimed by a dispatch
+        raises with ``in_flight=True`` and is never retried here — the
+        caller must check the state version before resubmitting.
+        """
+        return self._call(
+            "update", model_id,
+            lambda: self.update_async(model_id, new_obs), deadline,
+        )
+
+    def _call(self, kind: str, model_id: str, submit, deadline):
+        """Sync-call engine: hard deadline + bounded retries."""
+        pol = self.reliability
+        deadline_s = pol.deadline_s if deadline == "default" else deadline
+        t_end = None if deadline_s is None else pol.clock() + deadline_s
+        attempt = 0
+        while True:
+            attempt += 1
+            failure = None
+            try:
+                fut = submit()
+            except BaseException as exc:
+                failure = exc
+            if failure is None:
+                try:
+                    return self._resolve(fut, t_end)
+                except _FutureTimeout as exc:
+                    if (
+                        fut.done()
+                        and not fut.cancelled()
+                        and fut.exception() is exc
+                    ):
+                        # the DISPATCH raised a TimeoutError (on 3.11+
+                        # the same class as the future-wait timeout):
+                        # that is a request failure — provably not
+                        # applied, eligible for retry — not our
+                        # deadline expiring
+                        failure = exc
+                    else:
+                        in_flight = not fut.cancel()
+                        self.metrics.errors.increment("deadline_exceeded")
+                        self.monitor.record(False)
+                        raise DeadlineExceededError(
+                            kind, model_id, deadline_s, in_flight=in_flight
+                        ) from None
+                except BaseException as exc:
+                    failure = exc
+            # per-request outcome telemetry already ran in the future's
+            # done-callback; only the retry decision is made here
+            if is_retryable(failure) and attempt < pol.retry.max_attempts:
+                delay = pol.retry.delay(attempt)
+                if t_end is None or pol.clock() + delay < t_end:
+                    self.metrics.errors.increment("retries")
+                    logger.warning(
+                        "retrying %s for model %r (attempt %d) after: %s",
+                        kind, model_id, attempt, failure,
+                    )
+                    pol.sleep(delay)
+                    continue
+            raise failure
+
+    def _resolve(self, fut: Future, t_end: Optional[float] = None):
         """Wait for a sync call's future; in manual-flush mode
         (``flush_deadline=None``) nobody else will dispatch it, so
         flush inline first instead of blocking forever.  The DRAINING
         :meth:`flush`, not a single batcher flush: the future may be a
         deferred update that only enters the batcher once its
         predecessor resolves, which one batcher pass would leave
-        pending (and this call blocked) forever."""
+        pending (and this call blocked) forever.  ``t_end`` (a policy-
+        clock instant) bounds the wait — the hard-deadline half of the
+        reliability contract."""
         if self.batcher.flush_deadline is None and not fut.done():
             self.flush()
-        return fut.result()
+        if t_end is None:
+            return fut.result()
+        return fut.result(
+            timeout=max(t_end - self.reliability.clock(), 0.0)
+        )
+
+    def _observe(self, fut: Future, kind: str, breaker) -> None:
+        """Record a request's final outcome in breaker + health + errors."""
+
+        def _done(f: Future) -> None:
+            try:
+                if f.cancelled():
+                    breaker.record_abandoned()
+                    return
+                if f.exception() is None:
+                    breaker.record_success()
+                    self.monitor.record(True)
+                else:
+                    breaker.record_failure()
+                    self.monitor.record(False)
+                    self.metrics.errors.increment(f"{kind}_errors")
+            except Exception:  # pragma: no cover - telemetry must not
+                logger.exception("outcome telemetry failed")  # kill resolvers
+
+        fut.add_done_callback(_done)
 
     def update_async(self, model_id: str, new_obs) -> "Future[PosteriorState]":
-        state = self.registry.get(model_id)
+        # registry lookup first — see forecast_async: unknown ids must
+        # not allocate breaker state
+        try:
+            state = self.registry.get(model_id)
+        except StateIntegrityError:
+            self._record_failure_without_request("update", model_id)
+            raise
         new_obs = np.atleast_2d(np.asarray(new_obs, float))
         if new_obs.shape[1] != state.n_series:
+            self.metrics.errors.increment("validation_errors")
             raise ValueError(
                 f"new_obs has {new_obs.shape[1]} series, model "
                 f"{model_id!r} has {state.n_series}"
             )
+        if np.isinf(new_obs).any():
+            # NaN marks a missing observation; an infinity is never
+            # data — admitted, it would be masked out silently and
+            # the caller's poisoned payload acknowledged as applied
+            self.metrics.errors.increment("validation_errors")
+            raise ValueError(
+                f"new_obs for model {model_id!r} contains infinite "
+                "values; use NaN to mark missing observations"
+            )
+        breaker = self.breakers.get(model_id)
+        try:
+            breaker.allow()
+        except CircuitOpenError:
+            self.metrics.errors.increment("breaker_rejections")
+            raise
         mask = np.isfinite(new_obs)
         # standardize at the boundary; masked slots go to 0 like the
         # panel packer does (ignored under mask either way)
@@ -194,6 +404,32 @@ class MetranService:
         # spend time deferred behind a predecessor before they ever
         # enter the batcher — that wait is part of what the caller sees
         t_submit = time.monotonic()
+        try:
+            out = self._enqueue_update(model_id, key, payload, t_submit)
+        except BaseException:
+            # batcher refused (e.g. closed): no request exists, so a
+            # half-open probe slot must be released without a verdict
+            breaker.record_abandoned()
+            raise
+        self._observe(out, "update", breaker)
+
+        # the entry is only ever consulted while its future is
+        # unresolved; drop it once done so a long-lived service does
+        # not pin one stale PosteriorState result per model forever.
+        # Registered OUTSIDE _order_lock: an already-done future runs
+        # the callback inline, and the lock is not reentrant.
+        def _gc(_f):
+            with self._order_lock:
+                cur = self._last_update.get(model_id)
+                if cur is not None and cur[1] is out:
+                    del self._last_update[model_id]
+
+        out.add_done_callback(_gc)
+        return out
+
+    def _enqueue_update(self, model_id, key, payload, t_submit) -> Future:
+        """Enqueue one validated update, preserving per-model order
+        (chain on an unresolved predecessor unless provably co-batched)."""
         with self._order_lock:
             prior = self._last_update.get(model_id)
             entry = None
@@ -218,11 +454,31 @@ class MetranService:
                     # assimilate in submission order
                     fut: Future = Future()
 
-                    def _enqueue(_prior_done):
+                    def _enqueue(prior_done):
                         # cancelled while deferred: it never reached
                         # the batcher, so don't enqueue a side effect
                         # the caller was told did not happen
                         if fut.done():
+                            return
+                        if (
+                            not prior_done.cancelled()
+                            and prior_done.exception() is not None
+                        ):
+                            # chain break: the predecessor's update was
+                            # not applied, so applying this one would
+                            # silently skip observations mid-stream —
+                            # fail it instead (a successfully CANCELLED
+                            # predecessor had no side effect, so the
+                            # chain continues from the same state)
+                            self.metrics.errors.increment("chain_failures")
+                            try:
+                                fut.set_exception(ChainedRequestError(
+                                    f"update for model {model_id!r} not "
+                                    "applied: its predecessor failed "
+                                    f"({prior_done.exception()!r})"
+                                ))
+                            except Exception:  # raced with a cancel
+                                pass
                             return
                         try:
                             inner = self.batcher.submit(
@@ -243,21 +499,7 @@ class MetranService:
                 )
                 entry = (key, inner, group)
             self._last_update[model_id] = entry
-        out = entry[1]
-
-        # the entry is only ever consulted while its future is
-        # unresolved; drop it once done so a long-lived service does
-        # not pin one stale PosteriorState result per model forever.
-        # Registered OUTSIDE _order_lock: an already-done future runs
-        # the callback inline, and the lock is not reentrant.
-        def _gc(_f):
-            with self._order_lock:
-                cur = self._last_update.get(model_id)
-                if cur is not None and cur[1] is out:
-                    del self._last_update[model_id]
-
-        out.add_done_callback(_gc)
-        return out
+        return entry[1]
 
     def flush(self) -> int:
         """Dispatch everything pending now (manual/deterministic mode).
@@ -271,6 +513,36 @@ class MetranService:
             total += n
             if n == 0:
                 return total
+
+    def health(self) -> dict:
+        """Readiness/health snapshot for probes.
+
+        ``ready`` is the single bit an orchestrator needs: the batcher
+        can still dispatch (worker alive or manual mode, not closed)
+        AND the recent-window error rate is under the policy threshold.
+        The rest is the evidence: windowed error rate
+        (:class:`~metran_tpu.reliability.HealthMonitor`), lifetime
+        error counters by kind, open circuit breakers, batcher queue
+        depth, and the registry's integrity events (quarantines, stale
+        temp sweeps, last-good fallbacks).
+        """
+        open_breakers = self.breakers.open_models()
+        alive = self.batcher.worker_alive() and not self.batcher.closed
+        snap = self.monitor.snapshot({
+            "ready": bool(alive and self.monitor.healthy()),
+            "batcher": {
+                "worker_alive": alive,
+                "pending": self.batcher.pending(),
+                "flush_deadline_s": self.batcher.flush_deadline,
+            },
+            "breakers": {
+                "open": open_breakers,
+                "tracked": len(self.breakers),
+            },
+            "errors": self.metrics.errors.snapshot(),
+            "integrity": self.registry.integrity_stats,
+        })
+        return snap
 
     def close(self) -> None:
         # batcher.close() drains to empty — including deferred chained
@@ -289,6 +561,10 @@ class MetranService:
     # ------------------------------------------------------------------
     def _dispatch(self, batch_key, requests):
         kind, bucket, horizon = batch_key
+        # fault point: injectable dispatch failures (whole batch) and
+        # slow dispatches (wedged worker / slow device) for the
+        # reliability test suite and `bench.py --phase serve-faults`
+        fire("serve.dispatch", repr(batch_key))
         if kind == "forecast":
             results = self._run_forecast(bucket, int(horizon), requests)
             latency = self.metrics.forecast_latency
@@ -312,15 +588,8 @@ class MetranService:
             results = [None] * len(requests)
             with self._update_lock:
                 failed = None
+                broken: set = set()  # models whose per-slot chain broke
                 for positions in rounds:
-                    if failed is None:
-                        try:
-                            round_results = self._run_update(
-                                bucket, int(horizon),
-                                [requests[p] for p in positions],
-                            )
-                        except BaseException as exc:  # noqa: BLE001
-                            failed = exc
                     if failed is not None:
                         # a failed round breaks every later round's
                         # chain (round r+1's models all had a request
@@ -331,9 +600,39 @@ class MetranService:
                         # sees an exception for an update that happened
                         for p in positions:
                             results[p] = failed
-                    else:
-                        for p, res in zip(positions, round_results):
-                            results[p] = res
+                        continue
+                    # per-slot chain break: a model whose earlier-round
+                    # update was rejected (poisoned posterior) must not
+                    # have its later requests applied — that would skip
+                    # observations mid-stream.  Other models' rounds
+                    # proceed untouched.
+                    live = []
+                    for p in positions:
+                        if requests[p].model_id in broken:
+                            self.metrics.errors.increment("chain_failures")
+                            results[p] = ChainedRequestError(
+                                f"update for model "
+                                f"{requests[p].model_id!r} not applied: "
+                                "an earlier update in this batch failed"
+                            )
+                        else:
+                            live.append(p)
+                    if not live:
+                        continue
+                    try:
+                        round_results = self._run_update(
+                            bucket, int(horizon),
+                            [requests[p] for p in live],
+                        )
+                    except BaseException as exc:  # noqa: BLE001
+                        failed = exc
+                        for p in live:
+                            results[p] = failed
+                        continue
+                    for p, res in zip(live, round_results):
+                        results[p] = res
+                        if isinstance(res, BaseException):
+                            broken.add(requests[p].model_id)
             latency = self.metrics.update_latency
         else:  # pragma: no cover - batch keys are service-constructed
             raise ValueError(f"unknown dispatch kind {kind!r}")
@@ -344,39 +643,81 @@ class MetranService:
             latency.record(now - req.enqueued_at)
         return results
 
+    def _lookup_states(self, requests, results):
+        """Per-request registry reads: a model whose state cannot be
+        read (deleted file, quarantined corruption) fails ITS request
+        slot and leaves the rest of the batch serviceable."""
+        states, live = [], []
+        for j, req in enumerate(requests):
+            try:
+                states.append(self.registry.get(req.model_id))
+                live.append(j)
+            except BaseException as exc:  # noqa: BLE001 - per-slot channel
+                self.metrics.errors.increment("lookup_failures")
+                results[j] = exc
+        return states, live
+
     def _run_forecast(self, bucket, steps: int, requests):
+        """One batched forecast; per-slot failure isolation (a slot
+        whose posterior propagates to non-finite moments fails alone)."""
         from .engine import stack_bucket
 
-        states = [self.registry.get(r.model_id) for r in requests]
+        results: list = [None] * len(requests)
+        states, live = self._lookup_states(requests, results)
+        if not live:
+            return results
         batch = stack_bucket(states, bucket)
         fn = self.registry.forecast_fn(bucket, steps)
         means, variances = fn(batch.ss, batch.mean, batch.cov)
         means, variances = np.asarray(means), np.asarray(variances)
-        results = []
-        for i, st in enumerate(states):
+        validate = self.reliability.validate_updates
+        for i, (st, j) in enumerate(zip(states, live)):
             n = st.n_series
-            results.append(Forecast(
-                means=means[i, :, :n] * st.scaler_std + st.scaler_mean,
-                variances=variances[i, :, :n] * st.scaler_std**2,
+            m = means[i, :, :n]
+            v = variances[i, :, :n]
+            if validate and not (
+                np.all(np.isfinite(m)) and np.all(np.isfinite(v))
+            ):
+                self.metrics.errors.increment("poisoned_forecasts")
+                results[j] = StateIntegrityError(
+                    f"forecast for model {st.model_id!r} produced "
+                    "non-finite moments (poisoned posterior state)"
+                )
+                continue
+            results[j] = Forecast(
+                means=m * st.scaler_std + st.scaler_mean,
+                variances=v * st.scaler_std**2,
                 names=st.names,
                 version=st.version,
-            ))
+            )
         return results
 
     def _run_update(self, bucket, k: int, requests):
         """One batched assimilation over distinct-model requests; reads
         each model's CURRENT registry state, writes the bumped one.
         Callers must hold ``_update_lock`` across the read→compute→put
-        so concurrent dispatches cannot interleave on a model."""
-        from .engine import stack_bucket, state_slot_index
+        so concurrent dispatches cannot interleave on a model.
 
-        states = [self.registry.get(r.model_id) for r in requests]
+        Returns one result per request, where a result may BE an
+        exception (the per-request failure channel): a slot whose
+        computed posterior fails the finiteness/symmetry/PSD gate is
+        rejected BEFORE ``registry.put`` — its stored state stays
+        exactly as it was, its caller gets
+        :class:`~metran_tpu.reliability.StateIntegrityError` — while
+        every healthy slot in the same device execution commits.
+        """
+        from .engine import posterior_fault, stack_bucket, state_slot_index
+
+        results: list = [None] * len(requests)
+        states, live = self._lookup_states(requests, results)
+        if not live:
+            return results
         batch = stack_bucket(states, bucket)
         n_pad = bucket[0]
         y = np.zeros((len(states), k, n_pad))
         m = np.zeros((len(states), k, n_pad), bool)
-        for i, (st, req) in enumerate(zip(states, requests)):
-            y_std, mask = req.payload
+        for i, st in enumerate(states):
+            y_std, mask = requests[live[i]].payload
             y[i, :, : st.n_series] = y_std
             m[i, :, : st.n_series] = mask
         fn = self.registry.update_fn(bucket, k)
@@ -384,17 +725,47 @@ class MetranService:
             batch.ss, batch.mean, batch.cov, y, m
         )
         mean_t, cov_t = np.asarray(mean_t), np.asarray(cov_t)
-        results = []
-        for i, st in enumerate(states):
+        validate = self.reliability.validate_updates
+        for i, (st, j) in enumerate(zip(states, live)):
             idx = state_slot_index(st.n_series, st.n_factors, n_pad)
+            mean_i = mean_t[i][idx].astype(st.dtype)
+            cov_i = cov_t[i][np.ix_(idx, idx)].astype(st.dtype)
+            if validate:
+                fault = posterior_fault(mean_i, cov_i)
+                if fault is not None:
+                    self.metrics.errors.increment("poisoned_updates")
+                    logger.error(
+                        "rejecting update for model %r: %s",
+                        st.model_id, fault,
+                    )
+                    results[j] = StateIntegrityError(
+                        f"update for model {st.model_id!r} produced an "
+                        f"invalid posterior ({fault}); the request was "
+                        "not applied and the stored state is unchanged"
+                    )
+                    continue
             new_state = st._replace(
                 version=st.version + 1,
                 t_seen=st.t_seen + k,
-                mean=mean_t[i][idx].astype(st.dtype),
-                cov=cov_t[i][np.ix_(idx, idx)].astype(st.dtype),
+                mean=mean_i,
+                cov=cov_i,
             )
-            self.registry.put(new_state, persist=self.persist_updates)
-            results.append(new_state)
+            try:
+                self.registry.put(new_state, persist=self.persist_updates)
+            except Exception:
+                # the in-memory write in put() happens before the disk
+                # write-through, so the update IS applied — report the
+                # new state and degrade durability (health shows it)
+                # rather than fail a caller whose observations were
+                # assimilated.  Exception only: a SimulatedCrash /
+                # KeyboardInterrupt means the process is dying and must
+                # propagate, not be booked as a persist failure
+                self.metrics.errors.increment("persist_failures")
+                logger.exception(
+                    "write-through persist failed for model %r "
+                    "(serving from memory)", st.model_id,
+                )
+            results[j] = new_state
         return results
 
 

@@ -22,14 +22,35 @@ each other), round-tripping bit-identically.
 
 from __future__ import annotations
 
+import zlib
 from pathlib import Path
-from typing import NamedTuple, Optional, Sequence, Tuple
+from typing import Dict, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..io import atomic_savez
+from ..reliability.faultinject import fire
+from ..reliability.policy import StateIntegrityError
 
-STATE_FORMAT_VERSION = 1
+# Format history: v1 (PR 1) had no integrity protection; v2 embeds a
+# CRC-32 content checksum so a torn/bit-flipped file is detected at
+# load instead of silently serving garbage posteriors.  v1 files still
+# load (no checksum to verify) — a fleet written before the upgrade
+# must not need a migration pass.
+STATE_FORMAT_VERSION = 2
+
+
+def _content_checksum(payload: Dict[str, np.ndarray]) -> int:
+    """CRC-32 over every array's dtype, shape and raw bytes, in sorted
+    key order (deterministic across writers)."""
+    crc = 0
+    for key in sorted(payload):
+        a = np.ascontiguousarray(payload[key])
+        crc = zlib.crc32(key.encode(), crc)
+        crc = zlib.crc32(str(a.dtype).encode(), crc)
+        crc = zlib.crc32(repr(a.shape).encode(), crc)
+        crc = zlib.crc32(a.tobytes(), crc)
+    return crc & 0xFFFFFFFF
 
 
 class PosteriorState(NamedTuple):
@@ -92,10 +113,9 @@ class PosteriorState(NamedTuple):
         )
 
     def save(self, path) -> Path:
-        """Persist to one ``.npz``, atomically (see module docstring)."""
-        return atomic_savez(
-            Path(path),
-            format_version=np.int64(STATE_FORMAT_VERSION),
+        """Persist to one ``.npz``, atomically, with an embedded content
+        checksum (see module docstring and :data:`STATE_FORMAT_VERSION`)."""
+        payload = dict(
             model_id=np.str_(self.model_id),
             version=np.int64(self.version),
             t_seen=np.int64(self.t_seen),
@@ -108,30 +128,81 @@ class PosteriorState(NamedTuple):
             scaler_std=np.asarray(self.scaler_std),
             names=np.asarray(list(self.names), dtype=np.str_),
         )
+        return atomic_savez(
+            Path(path),
+            format_version=np.int64(STATE_FORMAT_VERSION),
+            checksum=np.uint32(_content_checksum(payload)),
+            **payload,
+        )
 
     @classmethod
     def load(cls, path) -> "PosteriorState":
-        """Restore a state saved with :meth:`save`, bit-identically."""
-        with np.load(Path(path), allow_pickle=False) as data:
-            fmt = int(data["format_version"])
-            if fmt != STATE_FORMAT_VERSION:
-                raise ValueError(
-                    f"unsupported posterior-state format {fmt} "
-                    f"(expected {STATE_FORMAT_VERSION}) in {path}"
+        """Restore a state saved with :meth:`save`, bit-identically.
+
+        Raises :class:`~metran_tpu.reliability.StateIntegrityError` for
+        a corrupt file — truncated/unparseable npz, missing fields, or
+        a checksum mismatch — and ``ValueError`` for a well-formed file
+        in a format this build does not speak (newer writer; not
+        corruption, so callers must not quarantine it).  Fault point:
+        ``serve.state.load``.
+        """
+        path = Path(path)
+        fire("serve.state.load", str(path))
+        try:
+            data_ctx = np.load(path, allow_pickle=False)
+        except Exception as exc:
+            # np.load's own failures — zipfile.BadZipFile on truncation,
+            # ValueError on unrecognizable bytes, OSError on unreadable
+            # files — all mean the same thing: the file cannot be parsed
+            raise StateIntegrityError(
+                f"posterior state {path} is unreadable or corrupt: "
+                f"{type(exc).__name__}: {exc}"
+            ) from exc
+        try:
+            with data_ctx as data:
+                fmt = int(data["format_version"])
+                if fmt not in (1, STATE_FORMAT_VERSION):
+                    raise ValueError(
+                        f"unsupported posterior-state format {fmt} "
+                        f"(expected <= {STATE_FORMAT_VERSION}) in {path}"
+                    )
+                payload = {
+                    k: data[k] for k in data.files
+                    if k not in ("format_version", "checksum")
+                }
+                if fmt >= 2:
+                    want = int(data["checksum"])
+                    got = _content_checksum(payload)
+                    if got != want:
+                        raise StateIntegrityError(
+                            f"posterior state {path} failed its content "
+                            f"checksum (stored {want:#010x}, recomputed "
+                            f"{got:#010x}): the file is corrupt"
+                        )
+                return cls(
+                    model_id=str(payload["model_id"]),
+                    version=int(payload["version"]),
+                    t_seen=int(payload["t_seen"]),
+                    mean=payload["mean"],
+                    cov=payload["cov"],
+                    params=payload["params"],
+                    loadings=payload["loadings"],
+                    dt=float(payload["dt"]),
+                    scaler_mean=payload["scaler_mean"],
+                    scaler_std=payload["scaler_std"],
+                    names=tuple(str(n) for n in payload["names"]),
                 )
-            return cls(
-                model_id=str(data["model_id"]),
-                version=int(data["version"]),
-                t_seen=int(data["t_seen"]),
-                mean=data["mean"],
-                cov=data["cov"],
-                params=data["params"],
-                loadings=data["loadings"],
-                dt=float(data["dt"]),
-                scaler_mean=data["scaler_mean"],
-                scaler_std=data["scaler_std"],
-                names=tuple(str(n) for n in data["names"]),
-            )
+        except (StateIntegrityError, ValueError):
+            # ValueError here is OURS (unsupported format) — a
+            # well-formed file from a newer writer, not corruption
+            raise
+        except Exception as exc:
+            # KeyError on missing fields, reshape errors on damaged
+            # members — one failure class to callers: untrustworthy file
+            raise StateIntegrityError(
+                f"posterior state {path} is unreadable or corrupt: "
+                f"{type(exc).__name__}: {exc}"
+            ) from exc
 
 
 def posterior_state_from_metran(

@@ -110,6 +110,7 @@ def show_versions() -> None:
 
 
 from .profiling import (  # noqa: E402,F401
+    EventCounters,
     LatencyRecorder,
     OccupancyCounter,
     ThroughputCounter,
@@ -118,6 +119,7 @@ from .profiling import (  # noqa: E402,F401
 )
 
 __all__ = [
+    "EventCounters",
     "ILLEGAL_NAME_CHARS",
     "LatencyRecorder",
     "OccupancyCounter",

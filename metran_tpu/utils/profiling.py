@@ -161,6 +161,49 @@ class LatencyRecorder:
 
 
 @dataclass
+class EventCounters:
+    """Named lifetime event counters (thread-safe).
+
+    The error/degradation half of the serving telemetry: every
+    reliability event (a poisoned update rejected, a file quarantined, a
+    deadline missed, a breaker rejection, a retry) increments a named
+    counter here, so operators and ``bench.py`` track robustness next to
+    latency and occupancy.  Counters are exact lifetime totals — rates
+    over recent traffic live in
+    :class:`metran_tpu.reliability.health.HealthMonitor`.
+    """
+
+    counts: Dict[str, int] = field(default_factory=dict)
+    _lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False, compare=False
+    )
+
+    def increment(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self.counts[name] = self.counts.get(name, 0) + int(n)
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self.counts.get(name, 0)
+
+    @property
+    def total(self) -> int:
+        with self._lock:
+            return sum(self.counts.values())
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self.counts)
+
+    def summary(self) -> str:
+        snap = self.snapshot()
+        if not snap:
+            return "no error events"
+        inner = ", ".join(f"{k}={v}" for k, v in sorted(snap.items()))
+        return f"events: {inner}"
+
+
+@dataclass
 class OccupancyCounter:
     """Batch-occupancy accounting for the micro-batching queue.
 
@@ -201,6 +244,7 @@ class OccupancyCounter:
 
 
 __all__ = [
+    "EventCounters",
     "LatencyRecorder",
     "OccupancyCounter",
     "ThroughputCounter",

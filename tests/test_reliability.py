@@ -1,0 +1,687 @@
+"""Fault-tolerant serving (`metran_tpu.reliability` + serve surgery).
+
+Pins the reliability layer's contracts:
+
+1. **per-model failure isolation** — one poisoned model in a 16-model
+   micro-batch fails only its own request(s) (and its not-yet-applied
+   same-model chain) while the other 15 commit with correct versions;
+2. **state integrity & quarantine** — a corrupted on-disk state is
+   detected (checksum / parse / numerical validation), moved into
+   ``.quarantine/``, counted, and never crashes ``get`` /
+   ``__contains__`` / ``model_ids``; a last-good in-memory state keeps
+   serving;
+3. **deadlines, retries, breakers** — no sync service call blocks past
+   its deadline even with the batcher worker killed; transient failures
+   retry with backoff; a model failing repeatedly gets its breaker
+   opened, half-opened after cooldown, closed on a successful probe;
+4. **crash recovery** — an ``atomic_savez`` writer killed at the rename
+   window leaves a temp file that never shadows a model id and is swept
+   at registry startup;
+5. **solver divergence** — a non-finite fit objective raises an
+   actionable error naming the offending parameters.
+
+Everything here is fast and CPU-only (the ``faults`` marker keeps the
+suite selectable; it runs inside tier-1).
+"""
+
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metran_tpu.reliability import (
+    ChainedRequestError,
+    CircuitBreaker,
+    CircuitOpenError,
+    DeadlineExceededError,
+    ReliabilityPolicy,
+    RetryPolicy,
+    SimulatedCrash,
+    StateIntegrityError,
+    faultinject,
+)
+from metran_tpu.serve import MetranService, ModelRegistry, PosteriorState
+
+from tests.test_serve import _make_state
+
+pytestmark = pytest.mark.faults
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += float(s)
+
+
+def _poison(state: PosteriorState) -> PosteriorState:
+    """A state whose next filter step can only produce NaN."""
+    return state._replace(mean=np.full_like(np.asarray(state.mean), np.nan))
+
+
+def _fast_policy(**kw) -> ReliabilityPolicy:
+    base = dict(
+        deadline_s=None,
+        retry=RetryPolicy(max_attempts=1),
+        breaker_failures=1000,  # breaker out of the way unless asked
+        breaker_cooldown_s=30.0,
+    )
+    base.update(kw)
+    return ReliabilityPolicy(**base)
+
+
+# ----------------------------------------------------------------------
+# 1. per-model failure isolation
+# ----------------------------------------------------------------------
+def test_poisoned_model_fails_alone_in_16_model_batch(rng):
+    """Acceptance: 1 poisoned model in a 16-model micro-batch fails only
+    its own request while the other 15 commit with correct versions —
+    all in ONE device dispatch."""
+    n_models = 16
+    reg = ModelRegistry()  # in-memory
+    states = {}
+    for i in range(n_models):
+        st, *_ = _make_state(rng, model_id=f"m{i}", n=3, k=1, t=40)
+        states[st.model_id] = st
+        reg.put(st._replace(mean=np.asarray(st.mean)), persist=False)
+    reg.put(_poison(reg.get("m7")), persist=False)
+
+    with MetranService(
+        reg, flush_deadline=None, persist_updates=False,
+        reliability=_fast_policy(),
+    ) as svc:
+        futs = {
+            mid: svc.update_async(
+                mid, rng.normal(size=(1, 3)) * st.scaler_std + st.scaler_mean
+            )
+            for mid, st in states.items()
+        }
+        svc.flush()
+        for mid, fut in futs.items():
+            if mid == "m7":
+                with pytest.raises(StateIntegrityError, match="m7"):
+                    fut.result(timeout=5)
+            else:
+                assert fut.result(timeout=5).version == 1
+
+    # one coalesced dispatch carried all 16 requests
+    assert svc.metrics.occupancy.batches == [n_models]
+    # the poisoned model's stored state is exactly what it was
+    assert reg.get("m7").version == 0
+    assert reg.get("m7").t_seen == states["m7"].t_seen
+    # the other 15 committed
+    assert sorted(
+        reg.get(f"m{i}").version for i in range(n_models) if i != 7
+    ) == [1] * 15
+    assert svc.metrics.errors.get("poisoned_updates") == 1
+
+
+def test_poisoned_forecast_fails_alone(rng):
+    reg = ModelRegistry()
+    good, *_ = _make_state(rng, model_id="good", n=3, k=1, t=40)
+    bad, *_ = _make_state(rng, model_id="bad", n=3, k=1, t=40)
+    reg.put(good, persist=False)
+    reg.put(_poison(bad), persist=False)
+    with MetranService(
+        reg, flush_deadline=None, reliability=_fast_policy()
+    ) as svc:
+        f_good = svc.forecast_async("good", 5)
+        f_bad = svc.forecast_async("bad", 5)
+        svc.flush()
+        assert np.all(np.isfinite(f_good.result(timeout=5).means))
+        with pytest.raises(StateIntegrityError, match="bad"):
+            f_bad.result(timeout=5)
+    assert svc.metrics.errors.get("poisoned_forecasts") == 1
+
+
+def test_poisoned_update_breaks_same_batch_chain(rng):
+    """Two coalesced same-model updates: the first is rejected (poisoned
+    posterior), so the second must fail with ChainedRequestError, not
+    assimilate onto the un-updated state — while a healthy model in the
+    same batch commits both its rounds."""
+    reg = ModelRegistry()
+    bad, *_ = _make_state(rng, model_id="bad", n=3, k=1, t=40)
+    good, *_ = _make_state(rng, model_id="good", n=3, k=1, t=40)
+    reg.put(_poison(bad), persist=False)
+    reg.put(good, persist=False)
+    with MetranService(
+        reg, flush_deadline=None, persist_updates=False,
+        reliability=_fast_policy(),
+    ) as svc:
+        obs = rng.normal(size=(1, 3))
+        b1 = svc.update_async("bad", obs)
+        b2 = svc.update_async("bad", obs)
+        g1 = svc.update_async("good", obs)
+        g2 = svc.update_async("good", obs)
+        svc.flush()
+        with pytest.raises(StateIntegrityError):
+            b1.result(timeout=5)
+        with pytest.raises(ChainedRequestError):
+            b2.result(timeout=5)
+        assert g1.result(timeout=5).version == 1
+        assert g2.result(timeout=5).version == 2
+    assert reg.get("bad").version == 0
+    assert svc.metrics.errors.get("chain_failures") == 1
+
+
+def test_deferred_chain_fails_when_predecessor_fails(rng):
+    """A deferred follow-up (different k, so it waits on its
+    predecessor's future) must fail with ChainedRequestError when the
+    predecessor's update was rejected."""
+    reg = ModelRegistry()
+    bad, *_ = _make_state(rng, model_id="bad", n=3, k=1, t=40)
+    reg.put(_poison(bad), persist=False)
+    with MetranService(
+        reg, flush_deadline=None, persist_updates=False,
+        reliability=_fast_policy(),
+    ) as svc:
+        f1 = svc.update_async("bad", rng.normal(size=(1, 3)))
+        f2 = svc.update_async("bad", rng.normal(size=(2, 3)))  # deferred
+        svc.flush()
+        with pytest.raises(StateIntegrityError):
+            f1.result(timeout=5)
+        with pytest.raises(ChainedRequestError):
+            f2.result(timeout=5)
+    assert reg.get("bad").version == 0
+
+
+def test_lookup_failure_is_per_slot(rng, tmp_path):
+    """A model whose state file vanished mid-flight fails its own slot;
+    the co-batched healthy model still commits."""
+    reg = ModelRegistry(root=tmp_path)
+    a, *_ = _make_state(rng, model_id="a", n=3, k=1, t=40)
+    b, *_ = _make_state(rng, model_id="b", n=3, k=1, t=40)
+    reg.put(a)
+    reg.put(b)
+    with MetranService(
+        reg, flush_deadline=None, reliability=_fast_policy()
+    ) as svc:
+        fa = svc.update_async("a", rng.normal(size=(1, 3)))
+        fb = svc.update_async("b", rng.normal(size=(1, 3)))
+        # simulate another replica deleting b between submit and dispatch
+        reg._states.pop("b")
+        reg.path_for("b").unlink()
+        svc.flush()
+        assert fa.result(timeout=5).version == 1
+        with pytest.raises(KeyError):
+            fb.result(timeout=5)
+    assert svc.metrics.errors.get("lookup_failures") == 1
+
+
+def test_infinite_payload_rejected(rng):
+    reg = ModelRegistry()
+    st, *_ = _make_state(rng, model_id="m0", n=3, k=1, t=40)
+    reg.put(st, persist=False)
+    with MetranService(
+        reg, flush_deadline=None, reliability=_fast_policy()
+    ) as svc:
+        obs = rng.normal(size=(1, 3))
+        obs[0, 1] = np.inf
+        with pytest.raises(ValueError, match="infinite"):
+            svc.update("m0", obs)
+        # NaN stays legal: it means "missing"
+        obs[0, 1] = np.nan
+        assert svc.update("m0", obs).version == 1
+    assert svc.metrics.errors.get("validation_errors") == 1
+
+
+# ----------------------------------------------------------------------
+# 2. state integrity & quarantine
+# ----------------------------------------------------------------------
+def test_corrupt_npz_quarantined_not_crashing(rng, tmp_path):
+    """Acceptance + satellite: a truncated/corrupt npz is quarantined
+    (file moved, event counted) and `get`/`__contains__`/`model_ids`
+    degrade instead of crashing."""
+    st, *_ = _make_state(rng, model_id="m0", n=3, k=1, t=40)
+    ModelRegistry(root=tmp_path).put(st)
+    path = tmp_path / "m0.npz"
+    path.write_bytes(path.read_bytes()[: path.stat().st_size // 2])
+
+    fresh = ModelRegistry(root=tmp_path)
+    assert "m0" in fresh.model_ids()  # listing does not open files
+    assert ("m0" in fresh) is False  # membership catches + quarantines
+    assert fresh.integrity_stats["quarantined"] == 1
+    assert not path.exists()
+    assert (tmp_path / ".quarantine" / "m0.npz").exists()
+    # after quarantine the model is simply absent, not poisonous
+    assert fresh.model_ids() == []
+    with pytest.raises(KeyError):
+        fresh.get("m0")
+
+
+def test_checksum_mismatch_quarantined(rng, tmp_path):
+    """A bit-flip that survives zip framing is caught by the embedded
+    content checksum."""
+    st, *_ = _make_state(rng, model_id="m0", n=3, k=1, t=40)
+    path = st.save(tmp_path / "m0.npz")
+    with np.load(path, allow_pickle=False) as data:
+        payload = {k: data[k] for k in data.files}
+    payload["mean"] = payload["mean"] + 1e-3  # silent corruption
+    np.savez(path, **payload)  # keeps the OLD checksum field
+    with pytest.raises(StateIntegrityError, match="checksum"):
+        PosteriorState.load(path)
+    reg = ModelRegistry(root=tmp_path)
+    assert ("m0" in reg) is False
+    assert reg.integrity_stats["quarantined"] == 1
+
+
+def test_nonfinite_stored_state_quarantined(rng, tmp_path):
+    """A checksum-valid file holding a NaN posterior is just as
+    unserviceable: registry load validates numerically too."""
+    st, *_ = _make_state(rng, model_id="m0", n=3, k=1, t=40)
+    _poison(st).save(tmp_path / "m0.npz")
+    reg = ModelRegistry(root=tmp_path)
+    with pytest.raises(StateIntegrityError, match="non-finite"):
+        reg.get("m0")
+    assert reg.integrity_stats["quarantined"] == 1
+    assert ("m0" in reg) is False
+
+
+def test_corrupt_disk_falls_back_to_last_good_in_memory(rng, tmp_path):
+    st, *_ = _make_state(rng, model_id="m0", n=3, k=1, t=40)
+    reg = ModelRegistry(root=tmp_path)
+    reg.put(st)  # memory + disk
+    path = tmp_path / "m0.npz"
+    path.write_bytes(b"not an npz at all")
+    got = reg.get("m0", refresh=True)  # forced disk read hits corruption
+    np.testing.assert_array_equal(got.mean, st.mean)  # last-good served
+    assert reg.integrity_stats["quarantined"] == 1
+    assert reg.integrity_stats["served_last_good"] == 1
+    assert ("m0" in reg)  # still a member via memory
+
+
+def test_v1_state_without_checksum_still_loads(rng, tmp_path):
+    """Format v1 (pre-checksum) files keep loading — no migration pass
+    required for fleets written before the upgrade."""
+    st, *_ = _make_state(rng, model_id="m0", n=3, k=1, t=40)
+    path = st.save(tmp_path / "m0.npz")
+    with np.load(path, allow_pickle=False) as data:
+        payload = {
+            k: data[k] for k in data.files
+            if k not in ("format_version", "checksum")
+        }
+    np.savez(path, format_version=np.int64(1), **payload)
+    loaded = PosteriorState.load(path)
+    np.testing.assert_array_equal(loaded.mean, st.mean)
+
+
+def test_unsupported_newer_format_not_quarantined(rng, tmp_path):
+    """A well-formed file from a NEWER writer is unreadable here but not
+    corrupt: membership answers False, the file stays where it is."""
+    st, *_ = _make_state(rng, model_id="m0", n=3, k=1, t=40)
+    path = st.save(tmp_path / "m0.npz")
+    with np.load(path, allow_pickle=False) as data:
+        payload = {k: data[k] for k in data.files}
+    payload["format_version"] = np.int64(99)
+    np.savez(path, **payload)
+    reg = ModelRegistry(root=tmp_path)
+    assert ("m0" in reg) is False
+    assert path.exists()  # NOT moved to quarantine
+    assert reg.integrity_stats.get("quarantined", 0) == 0
+
+
+# ----------------------------------------------------------------------
+# 3. crash recovery: atomic_savez temps
+# ----------------------------------------------------------------------
+def test_crash_at_rename_leaves_tmp_like_a_killed_writer(rng, tmp_path):
+    from metran_tpu.io import atomic_savez
+
+    atomic_savez(tmp_path / "a.npz", x=np.arange(3))
+    with faultinject.active() as inj:
+        inj.add("io.atomic_savez.rename", error=SimulatedCrash, times=1)
+        with pytest.raises(SimulatedCrash):
+            atomic_savez(tmp_path / "a.npz", x=np.arange(9))
+    leftovers = [p for p in tmp_path.iterdir() if ".tmp" in p.name]
+    assert len(leftovers) == 1  # the "killed" writer's temp survives
+    with np.load(tmp_path / "a.npz") as data:
+        assert data["x"].shape == (3,)  # published file untouched
+    # the same writer retries successfully afterwards
+    atomic_savez(tmp_path / "a.npz", x=np.arange(9))
+    with np.load(tmp_path / "a.npz") as data:
+        assert data["x"].shape == (9,)
+
+
+def test_io_error_injection_leaves_no_temp(tmp_path):
+    from metran_tpu.io import atomic_savez
+
+    with faultinject.active() as inj:
+        inj.add("io.atomic_savez", error=OSError("disk on fire"), times=1)
+        with pytest.raises(OSError, match="disk on fire"):
+            atomic_savez(tmp_path / "a.npz", x=np.arange(3))
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_registry_startup_sweeps_dead_writer_temps(rng, tmp_path):
+    """Satellite: a leftover temp from a killed writer is deleted at
+    registry startup, never shadows or corrupts a model id, and a LIVE
+    writer's temp is left alone."""
+    from metran_tpu.io import sweep_stale_tmps
+
+    st, *_ = _make_state(rng, model_id="m0", n=3, k=1, t=40)
+    ModelRegistry(root=tmp_path).put(st)
+    # a provably-dead pid: a subprocess that already exited
+    dead = subprocess.Popen(
+        [sys.executable, "-c", "import os; print(os.getpid())"],
+        stdout=subprocess.PIPE,
+    )
+    dead_pid = int(dead.stdout.read())
+    dead.wait()
+    stale = tmp_path / f".ghost.npz.{dead_pid}-0123abcd.tmp.npz"
+    stale.write_bytes(b"half-written garbage")
+    import os
+
+    live = tmp_path / f".m0.npz.{os.getpid()}-89abcdef.tmp.npz"
+    live.write_bytes(b"another thread mid-write")
+
+    reg = ModelRegistry(root=tmp_path)
+    assert not stale.exists()  # dead writer's temp reclaimed
+    assert live.exists()  # live writer's temp untouched
+    assert reg.integrity_stats["stale_tmps_swept"] == 1
+    assert reg.model_ids() == ["m0"]  # no bogus/ghost ids either way
+    np.testing.assert_array_equal(reg.get("m0").mean, st.mean)
+    live.unlink()
+    assert sweep_stale_tmps(tmp_path) == []  # nothing left to sweep
+
+
+# ----------------------------------------------------------------------
+# 4. deadlines, retries, circuit breakers
+# ----------------------------------------------------------------------
+def test_deadline_fires_with_worker_killed(rng):
+    """Acceptance: no sync call blocks past its deadline even with the
+    batcher worker dead (nothing will ever dispatch the request)."""
+    reg = ModelRegistry()
+    st, *_ = _make_state(rng, model_id="m0", n=3, k=1, t=40)
+    reg.put(st, persist=False)
+    svc = MetranService(
+        reg, flush_deadline=30.0, persist_updates=False,
+        reliability=_fast_policy(deadline_s=0.25),
+    )
+    try:
+        # kill the background worker the hard way
+        with svc.batcher._lock:
+            svc.batcher._stopping = True
+            svc.batcher._wake.notify_all()
+        svc.batcher._worker.join(timeout=5)
+        assert not svc.batcher.worker_alive()
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceededError) as err:
+            svc.update("m0", rng.normal(size=(1, 3)))
+        elapsed = time.monotonic() - t0
+        assert elapsed < 5.0  # nowhere near the 30 s flush deadline
+        assert err.value.in_flight is False  # cancelled: no side effect
+        assert reg.get("m0").version == 0
+        health = svc.health()
+        assert health["ready"] is False
+        assert health["batcher"]["worker_alive"] is False
+        assert svc.metrics.errors.get("deadline_exceeded") == 1
+    finally:
+        svc.close()
+
+
+def test_slow_dispatch_does_not_block_past_deadline(rng):
+    """A wedged dispatch (slow device / stuck IO) cannot hold the
+    caller: the deadline fires while the dispatch is still sleeping."""
+    reg = ModelRegistry()
+    st, *_ = _make_state(rng, model_id="m0", n=3, k=1, t=40)
+    reg.put(st, persist=False)
+    svc = MetranService(
+        reg, flush_deadline=0.005, persist_updates=False,
+        reliability=_fast_policy(deadline_s=0.2),
+    )
+    try:
+        with faultinject.active() as inj:
+            inj.add("serve.dispatch", delay_s=1.0, times=1)
+            t0 = time.monotonic()
+            with pytest.raises(DeadlineExceededError) as err:
+                svc.forecast("m0", 4)
+            assert time.monotonic() - t0 < 1.0
+            assert err.value.in_flight is True  # dispatch had claimed it
+    finally:
+        svc.close()
+
+
+def test_retry_recovers_transient_dispatch_failure(rng):
+    """A one-off dispatch failure is retried with backoff and succeeds;
+    exactly one update is applied."""
+    reg = ModelRegistry()
+    st, *_ = _make_state(rng, model_id="m0", n=3, k=1, t=40)
+    reg.put(st, persist=False)
+    pol = _fast_policy(
+        retry=RetryPolicy(max_attempts=2, backoff_s=0.001),
+        deadline_s=10.0,
+    )
+    with MetranService(
+        reg, flush_deadline=None, persist_updates=False, reliability=pol
+    ) as svc:
+        with faultinject.active() as inj:
+            inj.add(
+                "serve.dispatch", error=RuntimeError("transient"), times=1
+            )
+            out = svc.update("m0", rng.normal(size=(1, 3)))
+        assert out.version == 1
+    assert reg.get("m0").version == 1  # applied exactly once
+    assert svc.metrics.errors.get("retries") == 1
+    assert svc.metrics.errors.get("update_errors") == 1  # the first try
+
+
+def test_nonretryable_failures_are_not_retried(rng):
+    """Poisoned updates are deterministic: retrying would just burn a
+    batch slot twice."""
+    reg = ModelRegistry()
+    st, *_ = _make_state(rng, model_id="m0", n=3, k=1, t=40)
+    reg.put(_poison(st), persist=False)
+    pol = _fast_policy(retry=RetryPolicy(max_attempts=3, backoff_s=0.001))
+    with MetranService(
+        reg, flush_deadline=None, persist_updates=False, reliability=pol
+    ) as svc:
+        with pytest.raises(StateIntegrityError):
+            svc.update("m0", rng.normal(size=(1, 3)))
+    assert svc.metrics.errors.get("retries") == 0
+    assert svc.metrics.occupancy.dispatches == 1  # one attempt only
+
+
+def test_breaker_opens_after_consecutive_failures_and_recovers(rng):
+    """Acceptance: breaker opens after N consecutive per-model failures,
+    rejects instantly while open, half-opens after cooldown, and closes
+    on a successful probe."""
+    clock = FakeClock()
+    reg = ModelRegistry()
+    st, *_ = _make_state(rng, model_id="m0", n=3, k=1, t=40)
+    ok, *_ = _make_state(rng, model_id="ok", n=3, k=1, t=40)
+    reg.put(st, persist=False)
+    reg.put(ok, persist=False)
+    pol = _fast_policy(
+        breaker_failures=3, breaker_cooldown_s=10.0, clock=clock,
+        sleep=lambda s: None,
+    )
+    with MetranService(
+        reg, flush_deadline=None, persist_updates=False, reliability=pol
+    ) as svc:
+        with faultinject.active() as inj:
+            inj.add("serve.dispatch", error=RuntimeError("down"),
+                    match="update")
+            for _ in range(3):
+                with pytest.raises(RuntimeError, match="down"):
+                    svc.update("m0", rng.normal(size=(1, 3)))
+        # breaker now open: rejected without ever reaching the batcher
+        dispatches_before = svc.metrics.occupancy.dispatches
+        with pytest.raises(CircuitOpenError, match="m0"):
+            svc.update("m0", rng.normal(size=(1, 3)))
+        assert svc.metrics.occupancy.dispatches == dispatches_before
+        assert svc.metrics.errors.get("breaker_rejections") == 1
+        # other models are unaffected (per-model isolation)
+        assert svc.update("ok", rng.normal(size=(1, 3))).version == 1
+        assert svc.health()["breakers"]["open"] == ["m0"]
+        # cooldown passes -> half-open admits one probe, success closes
+        clock.advance(10.5)
+        assert svc.update("m0", rng.normal(size=(1, 3))).version == 1
+        assert svc.breakers.get("m0").state == CircuitBreaker.CLOSED
+        assert svc.health()["breakers"]["open"] == []
+
+
+def test_breaker_half_open_reopens_on_failed_probe():
+    clock = FakeClock()
+    b = CircuitBreaker("m", failure_threshold=2, cooldown_s=5.0, clock=clock)
+    b.allow()
+    b.record_failure()
+    b.allow()
+    b.record_failure()
+    assert b.state == CircuitBreaker.OPEN
+    with pytest.raises(CircuitOpenError):
+        b.allow()
+    clock.advance(5.1)
+    b.allow()  # the probe
+    # a second caller during the probe is still rejected
+    with pytest.raises(CircuitOpenError):
+        b.allow()
+    b.record_failure()  # probe failed -> re-open for another cooldown
+    assert b.state == CircuitBreaker.OPEN
+    with pytest.raises(CircuitOpenError):
+        b.allow()
+    clock.advance(5.1)
+    b.allow()
+    b.record_success()
+    assert b.state == CircuitBreaker.CLOSED
+
+
+def test_health_snapshot_reflects_recovery(rng):
+    """The readiness window forgives: after the fault clears, enough
+    successes flip the replica back to ready without a restart."""
+    reg = ModelRegistry()
+    st, *_ = _make_state(rng, model_id="m0", n=3, k=1, t=40)
+    reg.put(st, persist=False)
+    pol = _fast_policy(health_window=8, max_error_rate=0.4)
+    with MetranService(
+        reg, flush_deadline=None, persist_updates=False, reliability=pol
+    ) as svc:
+        with faultinject.active() as inj:
+            inj.add("serve.dispatch", error=RuntimeError("down"), times=4)
+            for _ in range(4):
+                with pytest.raises(RuntimeError):
+                    svc.update("m0", rng.normal(size=(1, 3)))
+        assert svc.health()["ready"] is False  # 4/4 recent failures
+        for _ in range(8):
+            svc.update("m0", rng.normal(size=(1, 3)))
+        health = svc.health()
+        assert health["ready"] is True  # failures aged out of the window
+        assert health["error_rate"] == 0.0
+        assert health["errors"]["update_errors"] == 4  # lifetime counters
+
+
+def test_unknown_model_ids_do_not_allocate_breakers(rng):
+    """Caller-supplied garbage ids must not grow BreakerBoard without
+    bound on a long-lived service — only registry-known ids earn
+    breaker state."""
+    reg = ModelRegistry()
+    st, *_ = _make_state(rng, model_id="m0", n=3, k=1, t=40)
+    reg.put(st, persist=False)
+    with MetranService(
+        reg, flush_deadline=None, reliability=_fast_policy()
+    ) as svc:
+        for i in range(20):
+            with pytest.raises(KeyError):
+                svc.forecast_async(f"nope{i}", 3)
+        assert len(svc.breakers) == 0
+        fut = svc.forecast_async("m0", 3)
+        svc.flush()
+        fut.result(timeout=5)
+        assert len(svc.breakers) == 1
+
+
+def test_refresh_never_rolls_back_acknowledged_version(rng, tmp_path):
+    """A memory state ahead of disk (an update whose write-through
+    failed) must survive get(refresh=True): refreshing cannot un-apply
+    acknowledged observations."""
+    reg = ModelRegistry(root=tmp_path)
+    st, *_ = _make_state(rng, model_id="m0", n=3, k=1, t=40)
+    reg.put(st)  # disk holds version 0
+    newer = st._replace(version=st.version + 1, t_seen=st.t_seen + 1)
+    reg._states["m0"] = newer  # memory ahead: failed write-through
+    got = reg.get("m0", refresh=True)
+    assert got.version == newer.version
+    assert reg.integrity_stats["stale_disk_reads"] == 1
+
+
+def test_registry_validate_off_loads_nonfinite_state(rng, tmp_path):
+    """With validation disabled (the operator's explicit choice), a
+    numerically-bad-but-parseable state loads instead of vanishing into
+    quarantine at restart; file-integrity checks still run."""
+    st, *_ = _make_state(rng, model_id="m0", n=3, k=1, t=40)
+    _poison(st).save(tmp_path / "m0.npz")
+    reg = ModelRegistry(root=tmp_path, validate=False)
+    got = reg.get("m0")
+    assert not np.all(np.isfinite(np.asarray(got.mean)))
+    assert reg.integrity_stats.get("quarantined", 0) == 0
+    # a torn file is still corrupt regardless of the knob
+    path = tmp_path / "m0.npz"
+    reg._states.pop("m0")
+    path.write_bytes(path.read_bytes()[:40])
+    assert ("m0" in reg) is False
+    assert reg.integrity_stats["quarantined"] == 1
+
+
+def test_dispatch_timeouterror_is_not_misread_as_deadline(rng):
+    """A TimeoutError raised INSIDE dispatch is a request failure
+    (provably not applied, retryable) — not the caller's deadline: the
+    sync path must retry it, never mislabel it in_flight."""
+    reg = ModelRegistry()
+    st, *_ = _make_state(rng, model_id="m0", n=3, k=1, t=40)
+    reg.put(st, persist=False)
+    pol = _fast_policy(
+        deadline_s=10.0, retry=RetryPolicy(max_attempts=2, backoff_s=0.001)
+    )
+    with MetranService(
+        reg, flush_deadline=None, persist_updates=False, reliability=pol
+    ) as svc:
+        with faultinject.active() as inj:
+            inj.add("serve.dispatch", error=TimeoutError, times=1)
+            out = svc.update("m0", rng.normal(size=(1, 3)))
+        assert out.version == 1
+    assert reg.get("m0").version == 1
+    assert svc.metrics.errors.get("retries") == 1
+    assert svc.metrics.errors.get("deadline_exceeded") == 0
+
+
+# ----------------------------------------------------------------------
+# 5. solver divergence guard
+# ----------------------------------------------------------------------
+def test_run_lbfgs_raise_on_divergence():
+    from metran_tpu.models.solver import SolverDivergenceError, run_lbfgs
+
+    def objective(x):
+        # a NaN objective everywhere: the degenerate-region blow-up in
+        # miniature, guaranteed non-finite at the first host check
+        return jnp.sum(x) * jnp.nan
+
+    with pytest.raises(SolverDivergenceError, match="non-finite") as err:
+        run_lbfgs(objective, jnp.ones(2), maxiter=40,
+                  raise_on_divergence=True)
+    assert err.value.params is not None
+    assert not np.isfinite(err.value.value)
+
+
+def test_jaxsolve_divergence_names_offending_parameters(series_list):
+    import metran_tpu
+    from metran_tpu.models.solver import JaxSolve, SolverDivergenceError
+
+    mt = metran_tpu.Metran(series_list, name="divmodel")
+    mt.get_factors(mt.oseries)
+    mt.set_init_parameters()
+    mt._deviance_jax = lambda p: jnp.float64(jnp.nan)  # bad alpha region
+    solver = JaxSolve(mt)
+    with pytest.raises(SolverDivergenceError) as err:
+        solver.solve(maxiter=10)
+    msg = str(err.value)
+    # the error names the model and every varying parameter with values
+    assert "divmodel" in msg
+    for name in mt.parameters.index[mt.parameters.vary.astype(bool)]:
+        assert str(name) in msg
+    assert "pmin" in msg  # actionable guidance, not just a stack trace

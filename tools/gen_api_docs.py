@@ -50,6 +50,9 @@ MODULES = [
     "metran_tpu.serve.registry",
     "metran_tpu.serve.batching",
     "metran_tpu.serve.service",
+    "metran_tpu.reliability.policy",
+    "metran_tpu.reliability.health",
+    "metran_tpu.reliability.faultinject",
     "metran_tpu.data",
     "metran_tpu.diagnostics",
     "metran_tpu.io",
