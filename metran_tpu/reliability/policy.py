@@ -148,6 +148,25 @@ class RetryPolicy:
 # ----------------------------------------------------------------------
 # circuit breaker
 # ----------------------------------------------------------------------
+class _Unattributed:
+    """Sentinel type for ``_UNATTRIBUTED`` (stable repr: the object's
+    default ``<object object at 0x..>`` leaks the process's heap
+    address into generated API docs, making them non-reproducible)."""
+
+    __slots__ = ()
+
+    def __repr__(self) -> str:
+        return "<unattributed>"
+
+
+#: default for the record_* ``token`` argument: the caller did not
+#: thread :meth:`CircuitBreaker.allow`'s admission token back, so the
+#: verdict is taken at face value (direct/unit usage).  Token-threading
+#: callers (the service) get strict attribution instead: a verdict only
+#: acts on the breaker's probe state when it belongs to the LIVE probe.
+_UNATTRIBUTED = _Unattributed()
+
+
 class CircuitBreaker:
     """Per-model breaker: CLOSED -> OPEN -> HALF_OPEN -> CLOSED.
 
@@ -157,6 +176,19 @@ class CircuitBreaker:
     breaker half-opens and admits exactly one probe request: a success
     closes it, a failure re-opens it for another cooldown.  A cancelled
     probe releases the slot without a verdict.
+
+    **Verdict attribution.**  :meth:`allow` returns an admission token
+    (``None`` when admitted CLOSED, a probe token when admitted as the
+    half-open probe); callers pass it back to :meth:`record_success` /
+    :meth:`record_failure` / :meth:`record_abandoned`.  A verdict whose
+    token is not the LIVE probe is *stale* — a slow request admitted
+    before the breaker opened that finished late — and never moves an
+    OPEN or HALF_OPEN breaker: a stale success cannot skip the
+    cooldown + probe, and a stale failure cannot re-open a half-open
+    breaker and steal the real probe's verdict.  Calls that omit the
+    token are taken at face value in CLOSED and HALF_OPEN (direct/unit
+    usage); a success while OPEN is ignored regardless of attribution
+    — recovery always goes through the cooldown + probe.
 
     ``clock`` is injectable (monotonic seconds) so tests can drive the
     cooldown deterministically.
@@ -177,56 +209,95 @@ class CircuitBreaker:
         self._state = self.CLOSED
         self._failures = 0
         self._opened_at = 0.0
-        self._probe_in_flight = False
+        self._probe: Optional[object] = None  # the live probe's token
 
     @property
     def state(self) -> str:
         with self._lock:
             return self._state
 
-    def allow(self) -> None:
-        """Admit a request or raise :class:`CircuitOpenError`."""
+    def allow(self):
+        """Admit a request or raise :class:`CircuitOpenError`; returns
+        the admission token to thread back into the ``record_*``
+        verdict calls."""
         with self._lock:
             if self._state == self.CLOSED:
-                return
+                return None
             now = self._clock()
             if self._state == self.OPEN:
                 remaining = self._opened_at + self.cooldown_s - now
                 if remaining > 0:
                     raise CircuitOpenError(self.model_id, remaining)
                 self._state = self.HALF_OPEN
-                self._probe_in_flight = False
+                self._probe = None
             # HALF_OPEN: exactly one probe at a time
-            if self._probe_in_flight:
+            if self._probe is not None:
                 raise CircuitOpenError(self.model_id, self.cooldown_s)
-            self._probe_in_flight = True
+            self._probe = object()
+            return self._probe
 
-    def record_success(self) -> None:
+    def _is_stale(self, token) -> bool:
+        """Attributed verdict that does NOT belong to the live probe.
+
+        ``None`` (admitted while CLOSED) is ALWAYS stale here: comparing
+        it against an empty probe slot (``self._probe is None`` after an
+        abandoned probe) must not make a pre-open request pass for the
+        probe."""
+        if token is _UNATTRIBUTED:
+            return False
+        return token is None or token is not self._probe
+
+    def record_success(self, token=_UNATTRIBUTED) -> None:
         with self._lock:
+            if self._state == self.OPEN:
+                # even the probe's own success cannot arrive while OPEN
+                # (re-opening cleared it): closing here would skip the
+                # cooldown + half-open probe the state machine promises
+                return
+            if self._state == self.HALF_OPEN:
+                if self._is_stale(token):
+                    return  # not the probe's verdict
+                logger.info(
+                    "circuit breaker CLOSED for model %r after a "
+                    "successful probe", self.model_id,
+                )
             self._state = self.CLOSED
             self._failures = 0
-            self._probe_in_flight = False
+            self._probe = None
 
-    def record_failure(self) -> None:
+    def record_failure(self, token=_UNATTRIBUTED) -> None:
         with self._lock:
-            self._failures += 1
-            if (
-                self._state == self.HALF_OPEN
-                or self._failures >= self.failure_threshold
-            ):
-                if self._state != self.OPEN:
-                    logger.warning(
-                        "circuit breaker OPEN for model %r after %d "
-                        "consecutive failures", self.model_id, self._failures,
-                    )
+            if self._state == self.OPEN:
+                # already open; a stale failure must not extend the
+                # cooldown another full period
+                return
+            if self._state == self.HALF_OPEN:
+                if self._is_stale(token):
+                    return  # must not steal the live probe's verdict
+                logger.warning(
+                    "circuit breaker re-OPENED for model %r: probe "
+                    "failed", self.model_id,
+                )
                 self._state = self.OPEN
                 self._opened_at = self._clock()
-                self._probe_in_flight = False
+                self._probe = None
+                return
+            self._failures += 1
+            if self._failures >= self.failure_threshold:
+                logger.warning(
+                    "circuit breaker OPEN for model %r after %d "
+                    "consecutive failures", self.model_id, self._failures,
+                )
+                self._state = self.OPEN
+                self._opened_at = self._clock()
+                self._probe = None
 
-    def record_abandoned(self) -> None:
-        """A half-open probe was cancelled: free the slot, no verdict."""
+    def record_abandoned(self, token=_UNATTRIBUTED) -> None:
+        """A request was cancelled / never materialized: free the probe
+        slot it held (if it held one), no verdict either way."""
         with self._lock:
-            self._probe_in_flight = False
+            if not self._is_stale(token):
+                self._probe = None
 
 
 class BreakerBoard:

@@ -197,7 +197,12 @@ class ModelRegistry:
         qdir.mkdir(exist_ok=True)
         dest = qdir / path.name
         if dest.exists():  # repeated corruption of one model id
-            dest = qdir / f"{path.name}.{os.getpid()}-{id(path) & 0xFFFF:x}"
+            # a genuinely unique suffix: an id()/counter-derived one can
+            # repeat and path.replace() would silently clobber earlier
+            # quarantined evidence
+            dest = qdir / (
+                f"{path.name}.{os.getpid()}-{os.urandom(4).hex()}"
+            )
         try:
             path.replace(dest)
         except FileNotFoundError:  # pragma: no cover - concurrent move
@@ -264,6 +269,14 @@ class ModelRegistry:
             raise KeyError(f"unknown model {model_id!r} (no {path})")
         try:
             fresh = self._load(model_id, path)
+        except FileNotFoundError:
+            # deleted between the exists() check and the read: absent,
+            # exactly as if exists() had said so
+            if state is not None:
+                return state
+            raise KeyError(
+                f"unknown model {model_id!r} (no {path})"
+            ) from None
         except (StateIntegrityError, ValueError):
             if state is not None:
                 self.integrity.increment("served_last_good")
@@ -298,7 +311,11 @@ class ModelRegistry:
         try:
             self.get(model_id)
             return True
-        except (KeyError, StateIntegrityError, ValueError):
+        except (KeyError, StateIntegrityError, ValueError,
+                OSError, MemoryError):
+            # OSError/MemoryError: a transient resource failure means
+            # "cannot load right now" — absent for routing purposes,
+            # but get() keeps raising it (and nothing was quarantined)
             return False
 
     def model_ids(self) -> List[str]:

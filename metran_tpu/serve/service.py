@@ -78,6 +78,94 @@ def _transfer(src: Future, dst: Future) -> None:
         dst.set_result(src.result())
 
 
+class _ChainedFuture(Future):
+    """Caller-visible future for an update whose batcher submission can
+    happen later than the call that created it (a deferred request
+    enqueues only once its same-model predecessor resolves).
+
+    Its job is making ``cancel()`` atomic with that hand-off: either the
+    cancel wins while nothing has been enqueued yet, or it propagates to
+    the inner batcher request and succeeds only if THAT request could
+    still be cancelled (not yet claimed by a dispatch).  Either way a
+    successful ``cancel()`` — and a ``DeadlineExceededError`` with
+    ``in_flight=False`` — proves the observations were never
+    assimilated, so the caller may safely resubmit.  A plain outer
+    ``Future`` cannot give that guarantee: once the inner request is in
+    the batcher, cancelling the still-pending outer "succeeds" while the
+    inner dispatch applies the update anyway.
+    """
+
+    def __init__(self):
+        super().__init__()
+        # RLock: propagating a cancel to the inner future runs the
+        # inner's done-callbacks, whose _transfer mirrors the
+        # cancellation back onto this future on the same thread
+        self._chain_lock = threading.RLock()
+        self._inner: Optional[Future] = None
+        self._detached = False  # a cancel won before any submission
+
+    def attach_inner(self, submit):
+        """Run ``submit()`` (returning ``(inner_future, token)``) unless
+        this future is already resolved or a cancel won the race, and
+        record the inner future so later cancels reach it.  Returns
+        ``submit()``'s result, or ``None`` when nothing was enqueued.
+        ``submit()`` runs under the chain lock — the atomicity that
+        closes the cancel-vs-enqueue window."""
+        with self._chain_lock:
+            if self._detached or self.done():
+                return None
+            out = submit()
+            if out[0] is not None:
+                self._inner = out[0]
+            return out
+
+    def cancel(self) -> bool:
+        with self._chain_lock:
+            inner = self._inner
+            if inner is None:
+                # forbid any later attach BEFORE deciding, so a
+                # deferred hand-off racing us can never enqueue a side
+                # effect a successful cancel just denied
+                self._detached = True
+        if inner is None:
+            return super().cancel() or self.cancelled()
+        if inner.cancel() or inner.cancelled():
+            # the batcher dropped the request before any dispatch
+            # claimed it: no side effect.  Mirror onto self — the
+            # inner's _transfer done-callback races us here harmlessly
+            # (both paths are idempotent).
+            super().cancel()
+            return True
+        return False
+
+
+class _PendingUpdate:
+    """One model's most recent update in flight (``_last_update``).
+
+    ``group`` is the batcher group token the request joined when it was
+    submitted directly; ``None`` while deferred behind a predecessor
+    (everything behind it must chain too) and until a direct submission
+    completes.  Written without ``_order_lock`` after the entry is
+    published — a racing reader seeing a stale ``None`` merely defers
+    conservatively.
+
+    ``prior`` links to the unresolved predecessor this entry chained on
+    (``None`` when it started a fresh chain).  The link is what keeps
+    ordering intact when an entry resolves while its predecessor is
+    STILL pending — a deferred request cancelled before its hand-off,
+    or one failed at submission: the chain walk skips the resolved
+    entry to the nearest unresolved ancestor instead of letting the
+    next update overtake observations already in the batcher."""
+
+    __slots__ = ("key", "future", "group", "prior")
+
+    def __init__(self, key, future: _ChainedFuture, prior=None):
+        self.key = key
+        self.future = future
+        self.group = None
+        self.prior = prior
+
+
 class Forecast(NamedTuple):
     """Forecast of one model, data units.
 
@@ -98,8 +186,9 @@ class ServeMetrics:
     ``errors`` counts reliability events by kind — ``poisoned_updates``,
     ``poisoned_forecasts``, ``validation_errors``, ``chain_failures``,
     ``deadline_exceeded``, ``breaker_rejections``, ``retries``,
-    ``persist_failures``, ``update_errors``/``forecast_errors`` — the
-    degradation half of the telemetry, exported into ``BENCH_*.json``.
+    ``persist_failures``, ``finalize_failures``,
+    ``update_errors``/``forecast_errors`` — the degradation half of the
+    telemetry, exported into ``BENCH_*.json``.
     """
 
     update_latency: LatencyRecorder = field(
@@ -182,11 +271,14 @@ class MetranService:
         # a model's update chains on its unresolved predecessor unless
         # the two provably share one pending batcher group (where the
         # rounds logic inside a dispatch orders them).  _order_lock
-        # guards the bookkeeping; the entry's third element is the
-        # pending-group token the request joined (None once it was
-        # deferred — everything behind it must chain too).
+        # guards ONLY the bookkeeping (_last_update and the chaining
+        # decision); batcher submissions happen after it is released —
+        # a size-triggered flush dispatches inline on the submitting
+        # thread, and the resolved futures' done-callbacks (_gc)
+        # re-take _order_lock, so submitting under it would deadlock
+        # the thread on its own lock.
         self._order_lock = threading.Lock()
-        self._last_update: dict = {}  # model_id -> (key, Future, group)
+        self._last_update: dict = {}  # model_id -> _PendingUpdate
         self.batcher = MicroBatcher(
             self._dispatch, flush_deadline=flush_deadline,
             max_batch=max_batch,
@@ -229,7 +321,7 @@ class MetranService:
             raise
         breaker = self.breakers.get(model_id)
         try:
-            breaker.allow()
+            token = breaker.allow()
         except CircuitOpenError:
             self.metrics.errors.increment("breaker_rejections")
             raise
@@ -241,9 +333,9 @@ class MetranService:
         except BaseException:
             # infrastructure refusal before any request existed:
             # release a half-open probe slot without a verdict
-            breaker.record_abandoned()
+            breaker.record_abandoned(token)
             raise
-        self._observe(fut, "forecast", breaker)
+        self._observe(fut, "forecast", breaker, token)
         return fut
 
     def _record_failure_without_request(self, kind: str, model_id: str):
@@ -326,34 +418,55 @@ class MetranService:
     def _resolve(self, fut: Future, t_end: Optional[float] = None):
         """Wait for a sync call's future; in manual-flush mode
         (``flush_deadline=None``) nobody else will dispatch it, so
-        flush inline first instead of blocking forever.  The DRAINING
-        :meth:`flush`, not a single batcher flush: the future may be a
-        deferred update that only enters the batcher once its
-        predecessor resolves, which one batcher pass would leave
-        pending (and this call blocked) forever.  ``t_end`` (a policy-
-        clock instant) bounds the wait — the hard-deadline half of the
-        reliability contract."""
-        if self.batcher.flush_deadline is None and not fut.done():
-            self.flush()
+        flush inline first instead of blocking forever.  Draining, a
+        batcher pass at a time: the future may be a deferred update
+        that only enters the batcher once its predecessor resolves,
+        which one pass would leave pending (and this call blocked)
+        forever.  ``t_end`` (a policy-clock instant) bounds the wait —
+        the hard-deadline half of the reliability contract — and is
+        re-checked between drain passes so an expired deadline stops
+        driving further dispatches.  Caveat: in manual mode each
+        dispatch runs synchronously on THIS thread, so the bound is
+        pass-granular — a single wedged dispatch still holds the caller
+        for its own duration (background-flush mode bounds the full
+        wait, since dispatch happens off-thread)."""
+        if self.batcher.flush_deadline is None:
+            while not fut.done():
+                if t_end is not None and self.reliability.clock() >= t_end:
+                    break  # the timed wait below raises the deadline
+                if self.batcher.flush() == 0:
+                    break
         if t_end is None:
             return fut.result()
         return fut.result(
             timeout=max(t_end - self.reliability.clock(), 0.0)
         )
 
-    def _observe(self, fut: Future, kind: str, breaker) -> None:
-        """Record a request's final outcome in breaker + health + errors."""
+    def _observe(self, fut: Future, kind: str, breaker, token) -> None:
+        """Record a request's final outcome in breaker + health + errors.
+
+        ``token`` is the breaker admission token — threading it back
+        attributes the verdict, so a slow request admitted before the
+        breaker opened cannot later close it (or steal/re-open a
+        half-open probe) with a stale outcome."""
 
         def _done(f: Future) -> None:
             try:
                 if f.cancelled():
-                    breaker.record_abandoned()
+                    breaker.record_abandoned(token)
                     return
-                if f.exception() is None:
-                    breaker.record_success()
+                exc = f.exception()
+                if exc is None:
+                    breaker.record_success(token)
                     self.monitor.record(True)
+                elif getattr(exc, "_metran_infra_refusal", False):
+                    # the batcher refused the hand-off (e.g. closed):
+                    # infrastructure's refusal, not the model's failure
+                    # — no verdict, matching the direct submission
+                    # path's record_abandoned
+                    breaker.record_abandoned(token)
                 else:
-                    breaker.record_failure()
+                    breaker.record_failure(token)
                     self.monitor.record(False)
                     self.metrics.errors.increment(f"{kind}_errors")
             except Exception:  # pragma: no cover - telemetry must not
@@ -387,7 +500,7 @@ class MetranService:
             )
         breaker = self.breakers.get(model_id)
         try:
-            breaker.allow()
+            token = breaker.allow()
         except CircuitOpenError:
             self.metrics.errors.increment("breaker_rejections")
             raise
@@ -409,97 +522,186 @@ class MetranService:
         except BaseException:
             # batcher refused (e.g. closed): no request exists, so a
             # half-open probe slot must be released without a verdict
-            breaker.record_abandoned()
+            breaker.record_abandoned(token)
             raise
-        self._observe(out, "update", breaker)
+        self._observe(out, "update", breaker, token)
 
         # the entry is only ever consulted while its future is
         # unresolved; drop it once done so a long-lived service does
         # not pin one stale PosteriorState result per model forever.
         # Registered OUTSIDE _order_lock: an already-done future runs
         # the callback inline, and the lock is not reentrant.
-        def _gc(_f):
-            with self._order_lock:
-                cur = self._last_update.get(model_id)
-                if cur is not None and cur[1] is out:
-                    del self._last_update[model_id]
-
-        out.add_done_callback(_gc)
+        out.add_done_callback(
+            lambda _f: self._forget_entry(model_id, out)
+        )
         return out
+
+    def _forget_entry(self, model_id, future) -> None:
+        """Drop a RESOLVED entry from ``_last_update``.
+
+        When the entry resolved with a predecessor still pending
+        (cancelled while deferred / failed at submission), that
+        predecessor still orders the model's stream: the nearest
+        unresolved ancestor is reinstated rather than letting the next
+        update overtake it.  Idempotent — safe to call from both the
+        future's done-callback and a submission failure path."""
+        with self._order_lock:
+            cur = self._last_update.get(model_id)
+            if cur is None or cur.future is not future:
+                return
+            anc = cur.prior
+            while anc is not None and anc.future.done():
+                anc = anc.prior
+            if anc is not None:
+                self._last_update[model_id] = anc
+            else:
+                del self._last_update[model_id]
 
     def _enqueue_update(self, model_id, key, payload, t_submit) -> Future:
         """Enqueue one validated update, preserving per-model order
-        (chain on an unresolved predecessor unless provably co-batched)."""
+        (chain on an unresolved predecessor unless provably co-batched).
+
+        The chaining DECISION is made and the entry published under
+        ``_order_lock``; the batcher submission itself happens after
+        the lock is released (see the ``_order_lock`` comment in
+        ``__init__``).  A successor that reads the freshly published
+        entry before its submission completed just sees ``group=None``
+        and defers — conservative, never wrong."""
+        fut = _ChainedFuture()
         with self._order_lock:
             prior = self._last_update.get(model_id)
-            entry = None
-            if prior is not None and not prior[1].done():
-                if prior[0] == key and prior[2] is not None:
-                    # the predecessor went straight into a batcher
-                    # group; join that very group if it is still
-                    # pending (atomic inside the batcher) — the rounds
-                    # logic in _dispatch then chains the duplicates
-                    inner, group = self.batcher.submit_tracked(
-                        key, model_id, payload, join=prior[2],
-                        enqueued_at=t_submit,
-                    )
-                    if inner is not None:
-                        entry = (key, inner, group)
-                if entry is None:
-                    # the predecessor is unresolved and not provably
-                    # co-batchable (different k, itself deferred, or
-                    # its group already dispatched): batch groups flush
-                    # in no particular order, so enqueue this one only
-                    # once the predecessor resolved — observations then
-                    # assimilate in submission order
-                    fut: Future = Future()
-
-                    def _enqueue(prior_done):
-                        # cancelled while deferred: it never reached
-                        # the batcher, so don't enqueue a side effect
-                        # the caller was told did not happen
-                        if fut.done():
-                            return
-                        if (
-                            not prior_done.cancelled()
-                            and prior_done.exception() is not None
-                        ):
-                            # chain break: the predecessor's update was
-                            # not applied, so applying this one would
-                            # silently skip observations mid-stream —
-                            # fail it instead (a successfully CANCELLED
-                            # predecessor had no side effect, so the
-                            # chain continues from the same state)
-                            self.metrics.errors.increment("chain_failures")
-                            try:
-                                fut.set_exception(ChainedRequestError(
-                                    f"update for model {model_id!r} not "
-                                    "applied: its predecessor failed "
-                                    f"({prior_done.exception()!r})"
-                                ))
-                            except Exception:  # raced with a cancel
-                                pass
-                            return
-                        try:
-                            inner = self.batcher.submit(
-                                key, model_id, payload,
-                                enqueued_at=t_submit,
-                            )
-                        except BaseException as exc:  # e.g. batcher closed
-                            if not fut.done():
-                                fut.set_exception(exc)
-                            return
-                        inner.add_done_callback(lambda f: _transfer(f, fut))
-
-                    prior[1].add_done_callback(_enqueue)
-                    entry = (key, fut, None)
-            else:
-                inner, group = self.batcher.submit_tracked(
-                    key, model_id, payload, enqueued_at=t_submit
-                )
-                entry = (key, inner, group)
+            # walk past resolved entries to the nearest UNRESOLVED
+            # predecessor: a cancelled/failed tail whose own
+            # predecessor is still pending must not sever the chain
+            while prior is not None and prior.future.done():
+                prior = prior.prior
+            join = (
+                prior.group
+                if prior is not None and prior.key == key else None
+            )
+            entry = _PendingUpdate(key, fut, prior=prior)
             self._last_update[model_id] = entry
-        return entry[1]
+        if prior is None:
+            self._attach_and_wire(entry, model_id, payload, t_submit)
+            return fut
+        if join is not None:
+            # the predecessor went straight into a batcher group; join
+            # that very group if it is still pending (atomic inside
+            # the batcher) — the rounds logic in _dispatch then chains
+            # the duplicates
+            outcome = self._attach_and_wire(
+                entry, model_id, payload, t_submit, join=join
+            )
+            if outcome != "join_missed":
+                return fut  # enqueued, or cancelled before enqueueing
+
+        # the predecessor is unresolved and not provably co-batchable
+        # (different k, itself deferred, or its group already
+        # dispatched): batch groups flush in no particular order, so
+        # enqueue this one only once the predecessor resolved —
+        # observations then assimilate in submission order
+        def _enqueue(prior_done):
+            # cancelled while deferred: it never reached the batcher,
+            # so don't enqueue a side effect the caller was told did
+            # not happen (attach_inner re-checks atomically below)
+            if fut.done():
+                return
+            if prior_done.cancelled():
+                # the cancelled predecessor had no side effect, but an
+                # EARLIER link of the chain may still be in flight:
+                # walk past cancelled links and re-defer on the nearest
+                # live ancestor, so this update cannot overtake the
+                # chain's pending root in the batcher
+                anc = entry.prior
+                while anc is not None:
+                    if anc.future.cancelled():
+                        # re-checked each pass: an ancestor cancelled
+                        # concurrently after an earlier check must be
+                        # skipped too, never have exception() called on
+                        # it (that raises CancelledError and would kill
+                        # this callback, stranding fut unresolved)
+                        anc = anc.prior
+                        continue
+                    if not anc.future.done():
+                        anc.future.add_done_callback(_enqueue)
+                        return
+                    # done and not cancelled is terminal: exception()
+                    # is safe here
+                    if anc.future.exception() is not None:
+                        prior_done = anc.future  # chain DID break
+                    break
+            if (
+                not prior_done.cancelled()
+                and prior_done.exception() is not None
+            ):
+                # chain break: the predecessor's update was not
+                # applied, so applying this one would silently skip
+                # observations mid-stream — fail it instead (a
+                # successfully CANCELLED predecessor had no side
+                # effect, so the chain continues from the same state)
+                self.metrics.errors.increment("chain_failures")
+                try:
+                    fut.set_exception(ChainedRequestError(
+                        f"update for model {model_id!r} not "
+                        "applied: its predecessor failed "
+                        f"({prior_done.exception()!r})"
+                    ))
+                except Exception:  # raced with a cancel
+                    pass
+                return
+            try:
+                self._attach_and_wire(entry, model_id, payload, t_submit)
+            except BaseException:  # e.g. batcher closed
+                return  # fut already resolved with the failure
+
+        prior.future.add_done_callback(_enqueue)
+        return fut
+
+    def _attach_and_wire(
+        self, entry, model_id, payload, t_submit, join=None
+    ) -> str:
+        """Submit the entry's update to the batcher through its outer
+        future's cancel-atomic ``attach_inner``, wiring the inner future
+        to the outer one.  Returns ``"enqueued"``, ``"cancelled"`` (the
+        outer future was resolved before anything reached the batcher)
+        or ``"join_missed"`` (``join`` given but that group already
+        dispatched — nothing enqueued).  A batcher refusal (e.g. closed)
+        resolves the already-published entry with the failure before
+        re-raising, so successors chain-break instead of deferring
+        forever on a future nobody will resolve; the resolved entry is
+        then dropped from ``_last_update`` (on the direct/join path the
+        caller has not reached the self-GC registration yet)."""
+        fut = entry.future
+        try:
+            out = fut.attach_inner(
+                lambda: self.batcher.submit_tracked(
+                    entry.key, model_id, payload, join=join,
+                    enqueued_at=t_submit,
+                )
+            )
+        except BaseException as exc:
+            try:
+                # mark it as an infrastructure refusal, not the model's
+                # failure: _observe must record no breaker verdict for
+                # it — exactly like the direct path's record_abandoned
+                exc._metran_infra_refusal = True
+            except Exception:  # exotic exception w/o attribute support
+                pass
+            try:
+                if not fut.done():
+                    fut.set_exception(exc)
+            except Exception:  # raced with a cancel
+                pass
+            self._forget_entry(model_id, fut)
+            raise
+        if out is None:
+            return "cancelled"
+        inner, group = out
+        if inner is None:
+            return "join_missed"
+        entry.group = group
+        inner.add_done_callback(lambda f: _transfer(f, fut))
+        return "enqueued"
 
     def flush(self) -> int:
         """Dispatch everything pending now (manual/deterministic mode).
@@ -597,9 +799,20 @@ class MetranService:
                         # ALREADY applied and persisted — fail only the
                         # unapplied requests, per-request (see the
                         # MicroBatcher dispatch contract), so no caller
-                        # sees an exception for an update that happened
+                        # sees an exception for an update that happened.
+                        # ChainedRequestError, NOT the raw (possibly
+                        # retryable) exception: these are same-model
+                        # successors of the failed round, and two
+                        # callers retrying concurrently could reorder
+                        # the model's observation stream
                         for p in positions:
-                            results[p] = failed
+                            self.metrics.errors.increment("chain_failures")
+                            results[p] = ChainedRequestError(
+                                f"update for model "
+                                f"{requests[p].model_id!r} not applied: "
+                                "an earlier update in this batch failed "
+                                f"({failed!r})"
+                            )
                         continue
                     # per-slot chain break: a model whose earlier-round
                     # update was rejected (poisoned posterior) must not
@@ -652,7 +865,11 @@ class MetranService:
             try:
                 states.append(self.registry.get(req.model_id))
                 live.append(j)
-            except BaseException as exc:  # noqa: BLE001 - per-slot channel
+            except Exception as exc:  # noqa: BLE001 - per-slot channel
+                # Exception only: a SimulatedCrash / KeyboardInterrupt
+                # is a process-death signal, not one slot's lookup
+                # failure — it must escape (same contract as the
+                # per-slot finalize in _run_update)
                 self.metrics.errors.increment("lookup_failures")
                 results[j] = exc
         return states, live
@@ -727,44 +944,65 @@ class MetranService:
         mean_t, cov_t = np.asarray(mean_t), np.asarray(cov_t)
         validate = self.reliability.validate_updates
         for i, (st, j) in enumerate(zip(states, live)):
-            idx = state_slot_index(st.n_series, st.n_factors, n_pad)
-            mean_i = mean_t[i][idx].astype(st.dtype)
-            cov_i = cov_t[i][np.ix_(idx, idx)].astype(st.dtype)
-            if validate:
-                fault = posterior_fault(mean_i, cov_i)
-                if fault is not None:
-                    self.metrics.errors.increment("poisoned_updates")
-                    logger.error(
-                        "rejecting update for model %r: %s",
-                        st.model_id, fault,
-                    )
-                    results[j] = StateIntegrityError(
-                        f"update for model {st.model_id!r} produced an "
-                        f"invalid posterior ({fault}); the request was "
-                        "not applied and the stored state is unchanged"
-                    )
-                    continue
-            new_state = st._replace(
-                version=st.version + 1,
-                t_seen=st.t_seen + k,
-                mean=mean_i,
-                cov=cov_i,
-            )
+            # per-slot finalize: everything between here and a
+            # successful registry.put can raise on one slot's own data
+            # (eigvalsh in posterior_fault on an ill-conditioned
+            # covariance, MemoryError in astype) AFTER earlier slots
+            # already committed.  Such a failure must stay that slot's
+            # alone — letting it escape would make _dispatch fail the
+            # whole round, mislabelling committed updates as failed and
+            # retryable (exception outcome == not applied is the retry
+            # loop's licence to resubmit).  Exception only: a
+            # SimulatedCrash / KeyboardInterrupt means the process is
+            # dying and must propagate.
             try:
-                self.registry.put(new_state, persist=self.persist_updates)
-            except Exception:
-                # the in-memory write in put() happens before the disk
-                # write-through, so the update IS applied — report the
-                # new state and degrade durability (health shows it)
-                # rather than fail a caller whose observations were
-                # assimilated.  Exception only: a SimulatedCrash /
-                # KeyboardInterrupt means the process is dying and must
-                # propagate, not be booked as a persist failure
-                self.metrics.errors.increment("persist_failures")
-                logger.exception(
-                    "write-through persist failed for model %r "
-                    "(serving from memory)", st.model_id,
+                idx = state_slot_index(st.n_series, st.n_factors, n_pad)
+                mean_i = mean_t[i][idx].astype(st.dtype)
+                cov_i = cov_t[i][np.ix_(idx, idx)].astype(st.dtype)
+                if validate:
+                    fault = posterior_fault(mean_i, cov_i)
+                    if fault is not None:
+                        self.metrics.errors.increment("poisoned_updates")
+                        logger.error(
+                            "rejecting update for model %r: %s",
+                            st.model_id, fault,
+                        )
+                        results[j] = StateIntegrityError(
+                            f"update for model {st.model_id!r} produced "
+                            f"an invalid posterior ({fault}); the "
+                            "request was not applied and the stored "
+                            "state is unchanged"
+                        )
+                        continue
+                new_state = st._replace(
+                    version=st.version + 1,
+                    t_seen=st.t_seen + k,
+                    mean=mean_i,
+                    cov=cov_i,
                 )
+                try:
+                    self.registry.put(
+                        new_state, persist=self.persist_updates
+                    )
+                except Exception:
+                    # the in-memory write in put() happens before the
+                    # disk write-through, so the update IS applied —
+                    # report the new state and degrade durability
+                    # (health shows it) rather than fail a caller whose
+                    # observations were assimilated
+                    self.metrics.errors.increment("persist_failures")
+                    logger.exception(
+                        "write-through persist failed for model %r "
+                        "(serving from memory)", st.model_id,
+                    )
+            except Exception as exc:
+                self.metrics.errors.increment("finalize_failures")
+                logger.exception(
+                    "finalize failed for model %r; its update was not "
+                    "applied", st.model_id,
+                )
+                results[j] = exc
+                continue
             results[j] = new_state
         return results
 

@@ -143,17 +143,26 @@ class PosteriorState(NamedTuple):
         a corrupt file — truncated/unparseable npz, missing fields, or
         a checksum mismatch — and ``ValueError`` for a well-formed file
         in a format this build does not speak (newer writer; not
-        corruption, so callers must not quarantine it).  Fault point:
+        corruption, so callers must not quarantine it).
+        ``MemoryError``/``OSError`` (resource pressure, filesystem
+        trouble) propagate unchanged: they say nothing about the file's
+        bytes, and callers must not quarantine over them.  Fault point:
         ``serve.state.load``.
         """
         path = Path(path)
         fire("serve.state.load", str(path))
         try:
             data_ctx = np.load(path, allow_pickle=False)
+        except (MemoryError, OSError):
+            # resource pressure / filesystem trouble (EMFILE, EACCES,
+            # an ENOENT race, EIO) says nothing about the BYTES being
+            # bad: propagate as-is so callers never quarantine a
+            # possibly-healthy file over a transient condition
+            raise
         except Exception as exc:
-            # np.load's own failures — zipfile.BadZipFile on truncation,
-            # ValueError on unrecognizable bytes, OSError on unreadable
-            # files — all mean the same thing: the file cannot be parsed
+            # np.load's parse failures — zipfile.BadZipFile on
+            # truncation, ValueError on unrecognizable bytes — mean the
+            # file itself cannot be parsed
             raise StateIntegrityError(
                 f"posterior state {path} is unreadable or corrupt: "
                 f"{type(exc).__name__}: {exc}"
@@ -196,6 +205,8 @@ class PosteriorState(NamedTuple):
             # ValueError here is OURS (unsupported format) — a
             # well-formed file from a newer writer, not corruption
             raise
+        except (MemoryError, OSError):
+            raise  # transient resource trouble, not corruption (above)
         except Exception as exc:
             # KeyError on missing fields, reshape errors on damaged
             # members — one failure class to callers: untrustworthy file

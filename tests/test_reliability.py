@@ -26,6 +26,7 @@ suite selectable; it runs inside tier-1).
 
 import subprocess
 import sys
+import threading
 import time
 
 import numpy as np
@@ -214,6 +215,91 @@ def test_lookup_failure_is_per_slot(rng, tmp_path):
         with pytest.raises(KeyError):
             fb.result(timeout=5)
     assert svc.metrics.errors.get("lookup_failures") == 1
+
+
+def test_simulated_crash_is_not_swallowed_per_slot(rng, monkeypatch):
+    """A SimulatedCrash (BaseException) during a per-slot registry read
+    must not be booked as that slot's ordinary lookup failure while the
+    rest of the batch commits — a process-death simulation fails the
+    whole dispatch with nothing applied."""
+    reg = ModelRegistry()
+    for mid in ("a", "b"):
+        st, *_ = _make_state(rng, model_id=mid, n=3, k=1, t=40)
+        reg.put(st, persist=False)
+    with MetranService(
+        reg, flush_deadline=None, persist_updates=False,
+        reliability=_fast_policy(),
+    ) as svc:
+        fa = svc.update_async("a", rng.normal(size=(1, 3)))
+        fb = svc.update_async("b", rng.normal(size=(1, 3)))
+        real_get = reg.get
+
+        def crashing(mid, refresh=False):
+            if mid == "b":
+                raise SimulatedCrash("kill -9 mid-read")
+            return real_get(mid, refresh=refresh)
+
+        monkeypatch.setattr(reg, "get", crashing)
+        svc.flush()
+        with pytest.raises(SimulatedCrash):
+            fa.result(timeout=5)
+        with pytest.raises(SimulatedCrash):
+            fb.result(timeout=5)
+    assert svc.metrics.errors.get("lookup_failures") == 0
+    assert reg._states["a"].version == 0  # nothing committed
+
+
+def test_transient_read_error_is_not_quarantined(rng, tmp_path, monkeypatch):
+    """MemoryError / fd-pressure OSError while reading a HEALTHY state
+    file must propagate, not masquerade as corruption: quarantining it
+    would turn a transient resource blip into a permanent per-model
+    outage."""
+    st, *_ = _make_state(rng, model_id="m0", n=3, k=1, t=40)
+    path = st.save(tmp_path / "m0.npz")
+    reg = ModelRegistry(root=tmp_path)
+    real_load = np.load
+
+    def pressured(*a, **kw):
+        raise OSError(24, "Too many open files")
+
+    monkeypatch.setattr(np, "load", pressured)
+    with pytest.raises(OSError, match="open files"):
+        reg.get("m0")
+    assert ("m0" in reg) is False  # membership degrades, never raises
+    monkeypatch.setattr(np, "load", real_load)
+    assert path.exists()  # the healthy file was NOT moved
+    assert reg.integrity_stats.get("quarantined", 0) == 0
+    assert reg.get("m0").version == 0  # heals once the pressure clears
+
+
+def test_batcher_refusal_is_not_a_model_failure(rng):
+    """An infrastructure refusal (batcher closed) surfacing through a
+    deferred update must not count against the model's breaker or error
+    counters — the direct path records no verdict for the identical
+    condition either."""
+    reg = ModelRegistry()
+    st, *_ = _make_state(rng, model_id="m0", n=3, k=1, t=40)
+    reg.put(st, persist=False)
+    svc = MetranService(
+        reg, flush_deadline=None, persist_updates=False,
+        reliability=_fast_policy(breaker_failures=1),
+    )
+    try:
+        f1 = svc.update_async("m0", rng.normal(size=(1, 3)))
+        f2 = svc.update_async("m0", rng.normal(size=(2, 3)))  # deferred
+        with svc.batcher._lock:
+            svc.batcher._closed = True
+        svc.batcher.flush()  # resolves f1; f2's hand-off is refused
+        assert f1.result(timeout=5).version == 1
+        with pytest.raises(RuntimeError, match="closed"):
+            f2.result(timeout=5)
+        # threshold 1: a recorded failure would have opened the breaker
+        assert svc.breakers.get("m0").state == CircuitBreaker.CLOSED
+        assert svc.metrics.errors.get("update_errors") == 0
+    finally:
+        with svc.batcher._lock:
+            svc.batcher._closed = False
+        svc.close()
 
 
 def test_infinite_payload_rejected(rng):
@@ -626,6 +712,379 @@ def test_registry_validate_off_loads_nonfinite_state(rng, tmp_path):
     path.write_bytes(path.read_bytes()[:40])
     assert ("m0" in reg) is False
     assert reg.integrity_stats["quarantined"] == 1
+
+
+def test_cancel_after_deferred_enqueue_propagates_to_batcher(rng):
+    """Once a deferred update's predecessor resolved and its inner
+    request reached the batcher, a successful cancel() must drop that
+    inner request too — not just the outer future, which would report
+    'no side effect' while the batcher assimilates the observations
+    anyway (and a contract-following resubmit applies them twice)."""
+    reg = ModelRegistry()
+    st, *_ = _make_state(rng, model_id="m0", n=3, k=1, t=40)
+    reg.put(st, persist=False)
+    with MetranService(
+        reg, flush_deadline=None, persist_updates=False,
+        reliability=_fast_policy(),
+    ) as svc:
+        f1 = svc.update_async("m0", rng.normal(size=(1, 3)))
+        f2 = svc.update_async("m0", rng.normal(size=(2, 3)))  # deferred
+        # ONE batcher pass: resolves f1, whose done-callback enqueues
+        # f2's inner request into a fresh (still pending) group
+        svc.batcher.flush()
+        assert f1.result(timeout=5).version == 1
+        assert not f2.done()
+        assert svc.batcher.pending() == 1  # f2 reached the batcher
+        assert f2.cancel()  # must propagate to the inner request
+        assert f2.cancelled()
+        svc.flush()  # draining: the cancelled inner must never dispatch
+        assert reg.get("m0").version == 1  # f2 was NOT applied
+    assert svc.metrics.occupancy.dispatches == 1
+
+
+def test_chained_future_cancel_semantics():
+    """White-box pin of the cancellation primitive: a successful
+    cancel() proves no side effect in every hand-off phase."""
+    from concurrent.futures import Future
+
+    from metran_tpu.serve.service import _ChainedFuture
+
+    # cancel before any hand-off: a later attach refuses to enqueue
+    cf = _ChainedFuture()
+    assert cf.cancel()
+    assert cf.cancelled()
+    assert cf.attach_inner(lambda: (Future(), None)) is None
+
+    # inner still pending in the batcher: cancel propagates to it
+    cf2 = _ChainedFuture()
+    inner2 = cf2.attach_inner(lambda: (Future(), None))[0]
+    assert cf2.cancel()
+    assert inner2.cancelled()
+    assert cf2.cancelled()
+
+    # inner claimed by a dispatch: cancel must fail (in flight)
+    cf3 = _ChainedFuture()
+    inner3 = cf3.attach_inner(lambda: (Future(), None))[0]
+    assert inner3.set_running_or_notify_cancel()
+    assert not cf3.cancel()
+    assert not cf3.cancelled()
+    inner3.set_result("late")  # the dispatch completes in background
+
+
+def test_size_flush_on_submitting_thread_does_not_deadlock(rng):
+    """A submission that fills a group to max_batch dispatches inline on
+    the submitting thread; the resolved futures' done-callbacks re-take
+    the service's ordering lock, so submitting while holding it would
+    deadlock the thread on its own lock."""
+    reg = ModelRegistry()
+    for i in range(2):
+        st, *_ = _make_state(rng, model_id=f"m{i}", n=3, k=1, t=40)
+        reg.put(st, persist=False)
+    obs = [rng.normal(size=(1, 3)) for _ in range(2)]
+    with MetranService(
+        reg, flush_deadline=None, max_batch=2, persist_updates=False,
+        reliability=_fast_policy(),
+    ) as svc:
+        futs = []
+
+        def work():
+            futs.append(svc.update_async("m0", obs[0]))
+            # fills the group: size-triggered inline dispatch
+            futs.append(svc.update_async("m1", obs[1]))
+
+        t = threading.Thread(target=work, daemon=True)
+        t.start()
+        t.join(timeout=10)
+        assert not t.is_alive(), "submitter deadlocked on its own lock"
+        assert futs[0].result(timeout=5).version == 1
+        assert futs[1].result(timeout=5).version == 1
+    assert svc.metrics.occupancy.batches == [2]
+
+
+def test_finalize_failure_is_per_slot_not_whole_round(rng, monkeypatch):
+    """A slot whose finalize raises (eigvalsh blow-up inside
+    posterior_fault) AFTER an earlier slot already committed must fail
+    alone: the committed slot's future resolves with its applied state
+    — never an exception that licenses the retry loop to resubmit an
+    update that was in fact applied and persisted."""
+    from metran_tpu.serve import engine
+
+    reg = ModelRegistry()
+    for mid in ("ok", "bad"):
+        st, *_ = _make_state(rng, model_id=mid, n=3, k=1, t=40)
+        reg.put(st, persist=False)
+    real_fault = engine.posterior_fault
+    calls = []
+
+    def exploding(mean, cov):
+        calls.append(1)
+        if len(calls) == 2:  # the 2nd slot — "ok" already committed
+            raise np.linalg.LinAlgError("eigvalsh did not converge")
+        return real_fault(mean, cov)
+
+    monkeypatch.setattr(engine, "posterior_fault", exploding)
+    with MetranService(
+        reg, flush_deadline=None, persist_updates=False,
+        reliability=_fast_policy(),
+    ) as svc:
+        f_ok = svc.update_async("ok", rng.normal(size=(1, 3)))
+        f_bad = svc.update_async("bad", rng.normal(size=(1, 3)))
+        svc.flush()
+        assert f_ok.result(timeout=5).version == 1  # not mislabelled
+        with pytest.raises(np.linalg.LinAlgError):
+            f_bad.result(timeout=5)
+    assert reg.get("ok").version == 1
+    assert reg.get("bad").version == 0  # provably not applied
+    assert svc.metrics.errors.get("finalize_failures") == 1
+
+
+def test_manual_mode_deadline_checked_between_drain_passes(rng, monkeypatch):
+    """The inline drain re-checks the deadline between passes: when the
+    first pass eats the whole budget, the deferred follow-up is
+    cancelled — never dispatched later as a silent late assimilation —
+    and the caller's in_flight=False verdict is truthful."""
+    clock = FakeClock()
+    reg = ModelRegistry()
+    st, *_ = _make_state(rng, model_id="m0", n=3, k=1, t=40)
+    reg.put(st, persist=False)
+    pol = _fast_policy(deadline_s=1.0, clock=clock, sleep=lambda s: None)
+    with MetranService(
+        reg, flush_deadline=None, persist_updates=False, reliability=pol
+    ) as svc:
+        real = svc._run_update
+
+        def wedged(bucket, k, requests):
+            clock.advance(5.0)  # one dispatch eats the whole budget
+            return real(bucket, k, requests)
+
+        monkeypatch.setattr(svc, "_run_update", wedged)
+        f1 = svc.update_async("m0", rng.normal(size=(1, 3)))
+        with pytest.raises(DeadlineExceededError) as err:
+            svc.update("m0", rng.normal(size=(2, 3)))  # deferred behind f1
+        assert err.value.in_flight is False  # cancelled: no side effect
+        assert f1.result(timeout=5).version == 1  # first pass applied f1
+        svc.flush()  # the cancelled follow-up must never dispatch
+        assert reg.get("m0").version == 1
+    assert svc.metrics.occupancy.dispatches == 1
+    assert svc.metrics.errors.get("deadline_exceeded") == 1
+
+
+def test_breaker_ignores_stale_success_while_open():
+    """A slow request admitted before the breaker opened that finishes
+    late must not close an OPEN breaker: recovery always goes through
+    the cooldown + half-open probe."""
+    clock = FakeClock()
+    b = CircuitBreaker("m", failure_threshold=2, cooldown_s=5.0, clock=clock)
+    b.allow()  # the slow request goes out while still CLOSED
+    b.record_failure()
+    b.record_failure()
+    assert b.state == CircuitBreaker.OPEN
+    b.record_success()  # the slow request's late, stale verdict
+    assert b.state == CircuitBreaker.OPEN  # cooldown still stands
+    with pytest.raises(CircuitOpenError):
+        b.allow()
+    clock.advance(5.1)
+    b.allow()  # the probe
+    b.record_success()
+    assert b.state == CircuitBreaker.CLOSED
+
+
+def test_stale_verdicts_cannot_touch_half_open_probe():
+    """Verdict attribution: outcomes of requests admitted before the
+    breaker opened must not re-open a half-open breaker (stealing the
+    live probe's verdict), close it in the probe's stead, or free the
+    probe slot — only the probe's own verdict rules."""
+    clock = FakeClock()
+    b = CircuitBreaker("m", failure_threshold=2, cooldown_s=5.0, clock=clock)
+    slow = b.allow()  # admitted while CLOSED, finishes much later
+    b.record_failure(b.allow())
+    b.record_failure(b.allow())
+    assert b.state == CircuitBreaker.OPEN
+    clock.advance(5.1)
+    probe = b.allow()  # the half-open probe
+    b.record_failure(slow)  # stale failure: must not re-open
+    assert b.state == CircuitBreaker.HALF_OPEN
+    b.record_success(slow)  # stale success: must not close
+    assert b.state == CircuitBreaker.HALF_OPEN
+    b.record_abandoned(slow)  # stale cancel: must not free the slot
+    with pytest.raises(CircuitOpenError):
+        b.allow()
+    b.record_success(probe)  # the probe's own verdict rules
+    assert b.state == CircuitBreaker.CLOSED
+
+
+def test_cancelled_deferred_update_does_not_sever_order_chain(rng):
+    """Cancelling a deferred update must not disconnect the NEXT update
+    from the still-pending predecessor: the ordering chain walks
+    through resolved entries to the nearest unresolved one, so a
+    contract-following resubmit cannot overtake observations already
+    sitting in the batcher."""
+    reg = ModelRegistry()
+    st, *_ = _make_state(rng, model_id="m0", n=3, k=1, t=40)
+    reg.put(st, persist=False)
+    with MetranService(
+        reg, flush_deadline=None, persist_updates=False,
+        reliability=_fast_policy(),
+    ) as svc:
+        f1 = svc.update_async("m0", rng.normal(size=(1, 3)))
+        f2 = svc.update_async("m0", rng.normal(size=(2, 3)))  # deferred
+        assert f2.cancel()
+        # resubmission per the documented contract
+        f3 = svc.update_async("m0", rng.normal(size=(2, 3)))
+        # f3 must NOT have gone straight into the batcher while f1 is
+        # still pending there — it chains behind f1
+        assert svc.batcher.pending() == 1
+        svc.flush()
+        assert f1.result(timeout=5).version == 1
+        assert f3.result(timeout=5).version == 2  # applied AFTER f1
+    assert reg.get("m0").version == 2
+
+
+def test_mid_chain_cancel_redefers_on_pending_root(rng):
+    """Cancelling the MIDDLE of a 3-deep deferred chain must re-defer
+    the tail on the chain's still-pending root — not submit it into the
+    batcher where it can dispatch before the root's observations."""
+    reg = ModelRegistry()
+    st, *_ = _make_state(rng, model_id="m0", n=3, k=1, t=40)
+    reg.put(st, persist=False)
+    with MetranService(
+        reg, flush_deadline=None, persist_updates=False,
+        reliability=_fast_policy(),
+    ) as svc:
+        f1 = svc.update_async("m0", rng.normal(size=(1, 3)))
+        f2 = svc.update_async("m0", rng.normal(size=(2, 3)))  # defers on f1
+        f3 = svc.update_async("m0", rng.normal(size=(3, 3)))  # defers on f2
+        assert f2.cancel()
+        # f3 must now wait on f1, not sit in the batcher next to it
+        assert svc.batcher.pending() == 1
+        assert not f3.done()
+        svc.flush()
+        assert f1.result(timeout=5).version == 1
+        assert f3.result(timeout=5).version == 2  # applied AFTER f1
+    assert reg.get("m0").version == 2
+
+
+def test_whole_round_failure_chain_breaks_later_rounds(rng, monkeypatch):
+    """When an earlier round of a coalesced batch fails wholesale with
+    a TRANSIENT error, the same model's later-round requests must fail
+    with non-retryable ChainedRequestError — handing them the raw
+    retryable exception would let two callers' retry loops resubmit
+    concurrently and reorder the model's observation stream."""
+    reg = ModelRegistry()
+    st, *_ = _make_state(rng, model_id="m0", n=3, k=1, t=40)
+    reg.put(st, persist=False)
+    with MetranService(
+        reg, flush_deadline=None, persist_updates=False,
+        reliability=_fast_policy(),
+    ) as svc:
+        def boom(bucket, k, requests):
+            raise RuntimeError("transient device failure")
+
+        monkeypatch.setattr(svc, "_run_update", boom)
+        f1 = svc.update_async("m0", rng.normal(size=(1, 3)))
+        f2 = svc.update_async("m0", rng.normal(size=(1, 3)))  # round 1
+        svc.flush()
+        with pytest.raises(RuntimeError, match="transient"):
+            f1.result(timeout=5)  # its own attempt: retryable is right
+        with pytest.raises(ChainedRequestError):
+            f2.result(timeout=5)  # successor: must NOT look retryable
+    assert reg.get("m0").version == 0
+    assert svc.metrics.errors.get("chain_failures") == 1
+
+
+def test_repeated_quarantine_preserves_all_evidence(rng, tmp_path):
+    """Quarantining the same model id repeatedly must never overwrite
+    an earlier quarantined file — every corrupt artifact is evidence."""
+    st, *_ = _make_state(rng, model_id="m0", n=3, k=1, t=40)
+    reg = ModelRegistry(root=tmp_path)
+    for i in range(4):
+        reg.put(st)
+        (tmp_path / "m0.npz").write_bytes(b"garbage %d" % i)
+        reg._states.pop("m0", None)
+        assert ("m0" in reg) is False  # load fails -> quarantined
+    qfiles = sorted((tmp_path / ".quarantine").iterdir())
+    assert len(qfiles) == 4, qfiles
+    assert reg.integrity_stats["quarantined"] == 4
+    # the artifacts are distinct corruptions, all preserved
+    assert len({p.read_bytes() for p in qfiles}) == 4
+
+
+def test_fully_cancelled_chain_lets_tail_proceed(rng):
+    """With every predecessor cancelled (all provably no-ops), the tail
+    walks past the cancelled links to the chain root and submits —
+    including an ancestor it had already re-deferred on that was then
+    cancelled as well (the walk must skip it, not trip on its
+    CancelledError)."""
+    reg = ModelRegistry()
+    st, *_ = _make_state(rng, model_id="m0", n=3, k=1, t=40)
+    reg.put(st, persist=False)
+    with MetranService(
+        reg, flush_deadline=None, persist_updates=False,
+        reliability=_fast_policy(),
+    ) as svc:
+        f1 = svc.update_async("m0", rng.normal(size=(1, 3)))
+        f2 = svc.update_async("m0", rng.normal(size=(2, 3)))  # defers on f1
+        f3 = svc.update_async("m0", rng.normal(size=(3, 3)))  # defers on f2
+        assert f2.cancel()  # f3 re-defers on f1
+        assert f1.cancel()  # ...which is then cancelled too
+        svc.flush()
+        assert f3.result(timeout=5).version == 1  # applied from v0
+    assert reg.get("m0").version == 1
+
+
+def test_stale_verdict_with_empty_probe_slot_stays_half_open():
+    """A CLOSED-admitted request's late verdict must stay stale even
+    when the half-open probe slot is empty (an abandoned probe leaves
+    ``_probe=None``, which a ``None`` admission token must not match)."""
+    clock = FakeClock()
+    b = CircuitBreaker("m", failure_threshold=1, cooldown_s=5.0, clock=clock)
+    slow = b.allow()  # None: admitted while CLOSED
+    b.record_failure(b.allow())  # opens
+    assert b.state == CircuitBreaker.OPEN
+    clock.advance(5.1)
+    probe = b.allow()
+    b.record_abandoned(probe)  # probe cancelled: slot free, HALF_OPEN
+    assert b.state == CircuitBreaker.HALF_OPEN
+    b.record_success(slow)  # stale: must NOT pass for the probe
+    assert b.state == CircuitBreaker.HALF_OPEN
+    b.record_failure(slow)  # stale: must not re-open either
+    assert b.state == CircuitBreaker.HALF_OPEN
+    b.record_success(b.allow())  # a real probe's verdict closes
+    assert b.state == CircuitBreaker.CLOSED
+
+
+def test_batcher_refusal_resolves_published_ordering_entry(rng):
+    """A batcher refusal AFTER the per-model ordering entry was
+    published must resolve that entry's future with the failure: a
+    later update for the model then fails fast instead of deferring
+    forever on a future nobody will ever resolve (join-path case)."""
+    reg = ModelRegistry()
+    st, *_ = _make_state(rng, model_id="m0", n=3, k=1, t=40)
+    reg.put(st, persist=False)
+    svc = MetranService(
+        reg, flush_deadline=None, persist_updates=False,
+        reliability=_fast_policy(),
+    )
+    try:
+        f1 = svc.update_async("m0", rng.normal(size=(1, 3)))
+        # the batcher starts refusing while f1's group is still pending
+        with svc.batcher._lock:
+            svc.batcher._closed = True
+        with pytest.raises(RuntimeError, match="closed"):
+            svc.update_async("m0", rng.normal(size=(1, 3)))  # join path
+        # the refused entry resolved -> the next update must not defer
+        # on it forever; it fails fast at submission too
+        with pytest.raises(RuntimeError, match="closed"):
+            svc.update_async("m0", rng.normal(size=(1, 3)))
+        # refused entries are dropped (no per-model pinning); f1's
+        # still-pending entry keeps ordering the model
+        assert svc._last_update["m0"].future is f1
+        with svc.batcher._lock:
+            svc.batcher._closed = False
+        svc.flush()
+        assert f1.result(timeout=5).version == 1  # f1 itself unharmed
+    finally:
+        svc.close()
 
 
 def test_dispatch_timeouterror_is_not_misread_as_deadline(rng):
