@@ -1213,6 +1213,121 @@ def run_serve_faults_bench(out_path: str, budget_s: float) -> dict:
 
 
 # ----------------------------------------------------------------------
+# phase: square-root engine (robustness cost + f32 drift per regime)
+# ----------------------------------------------------------------------
+def run_sqrt_bench(out_path: str, budget_s: float) -> dict:
+    """Square-root vs covariance engine: runtime overhead and f32 drift.
+
+    Two questions an operator picking ``engine="sqrt"`` asks:
+
+    1. what does the QR-based robustness cost per deviance /
+       value-and-grad evaluation versus the ``joint`` engine, and
+    2. how much closer does f32 land to f64 per alpha regime — in
+       particular the near-unit-root cap regime where the covariance
+       engine's drift is 10x-bar material (tests/test_precision.py).
+
+    Runs on whatever backend the environment provides; shapes follow
+    the flagship benchmark config at a bounded T so the whole phase
+    fits a small budget.
+    """
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", JAX_CACHE + "-cpu")
+    import jax
+
+    # the drift half of this phase needs true float64 references; on an
+    # accelerator the f64 evaluations run emulated (slow but correct —
+    # the budget guard bounds them), the f32 timings are native either
+    # way
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    from metran_tpu.ops import deviance, dfm_statespace
+
+    n, k_fct, t_steps, reps = N_SERIES, N_FACTORS, 2000, 3
+    if os.environ.get("METRAN_TPU_BENCH_SMALL"):
+        t_steps, reps = 400, 2
+    deadline = time.monotonic() + budget_s
+    out = {
+        "platform": jax.default_backend(),
+        "n_series": n, "n_factors": k_fct, "t_steps": t_steps,
+        "engines": ["joint", "sqrt"],
+        "regimes": {}, "overhead": {},
+    }
+
+    rng = np.random.default_rng(0)
+    loadings = rng.uniform(0.4, 0.8, (n, k_fct))
+    mask = rng.uniform(size=(t_steps, n)) > MISSING
+    mask[0] = False
+    y = np.where(mask, rng.normal(size=(t_steps, n)), 0.0)
+    regimes = {
+        "init": np.full(n + k_fct, 10.0),
+        "fast": np.full(n + k_fct, 0.1),
+        "near_unit_root": np.full(n + k_fct, 3e4),
+        "mixed": np.concatenate([np.linspace(0.1, 100.0, n), [1e4] * k_fct]),
+    }
+
+    def dev(alpha, dtype, engine):
+        ss = dfm_statespace(
+            jnp.asarray(alpha[:n], dtype), jnp.asarray(alpha[n:], dtype),
+            jnp.asarray(loadings, dtype), 1.0,
+        )
+        return deviance(
+            ss, jnp.asarray(y, dtype), jnp.asarray(mask), warmup=1,
+            engine=engine,
+        )
+
+    # f32-vs-f64 deviance drift per regime, both engines
+    for name, alpha in regimes.items():
+        row = {}
+        for engine in ("joint", "sqrt"):
+            v64 = float(dev(alpha, jnp.float64, engine))
+            v32 = float(dev(alpha, jnp.float32, engine))
+            row[f"dev_rel_f32_{engine}"] = abs(v32 - v64) / abs(v64)
+        row["abs_dev"] = abs(v64)
+        out["regimes"][name] = row
+        progress("sqrt_drift", regime=name, **{
+            k: f"{v:.3e}" for k, v in row.items()
+        })
+        if time.monotonic() > deadline:
+            out["truncated"] = "budget"
+            write_partial(out_path, out)
+            return out
+
+    # runtime overhead: jitted deviance and value-and-grad, f32, the
+    # interior init regime (representative optimizer workload)
+    alpha32 = jnp.asarray(regimes["init"], jnp.float32)
+
+    def timed(fn, *args):
+        warm = fn(*args)  # warm (compile)
+        (warm[0] if isinstance(warm, tuple) else warm).block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            r = fn(*args)
+            (r[0] if isinstance(r, tuple) else r).block_until_ready()
+        return (time.perf_counter() - t0) / reps
+
+    for engine in ("joint", "sqrt"):
+        f = jax.jit(lambda a, e=engine: dev(a, jnp.float32, e))
+        vg = jax.jit(jax.value_and_grad(
+            lambda a, e=engine: dev(a, jnp.float32, e)
+        ))
+        out["overhead"][f"deviance_s_{engine}"] = timed(f, alpha32)
+        out["overhead"][f"value_and_grad_s_{engine}"] = timed(vg, alpha32)
+    oh = out["overhead"]
+    oh["sqrt_vs_joint_deviance"] = (
+        oh["deviance_s_sqrt"] / max(oh["deviance_s_joint"], 1e-12)
+    )
+    oh["sqrt_vs_joint_value_and_grad"] = (
+        oh["value_and_grad_s_sqrt"]
+        / max(oh["value_and_grad_s_joint"], 1e-12)
+    )
+    progress("sqrt_overhead", **{
+        k: round(v, 4) for k, v in oh.items()
+    })
+    write_partial(out_path, out)
+    return out
+
+
+# ----------------------------------------------------------------------
 # orchestrator
 # ----------------------------------------------------------------------
 def _read_json(path: str):
@@ -1510,7 +1625,7 @@ if __name__ == "__main__":
     parser.add_argument("--phase", default="main",
                         choices=["main", "cpu", "device", "device-cpu",
                                  "mesh", "mesh-solo", "serve",
-                                 "serve-faults"])
+                                 "serve-faults", "sqrt"])
     parser.add_argument("--out", default=None)
     parser.add_argument("--budget", type=float, default=900.0)
     args = parser.parse_args()
@@ -1551,6 +1666,22 @@ if __name__ == "__main__":
                 "metric": "serve update qps with 1/16 poisoned slots",
                 "value": qps, "unit": "updates/s", "vs_baseline": 0.0,
                 "detail": sf_out,
+            }), flush=True)
+    elif args.phase == "sqrt":
+        out_path = args.out or os.path.join(CACHE_DIR, "bench_sqrt.json")
+        os.makedirs(CACHE_DIR, exist_ok=True)
+        sq_out = run_sqrt_bench(out_path, args.budget)
+        if args.out is None:
+            # standalone run: emit the BENCH_r* result-line schema with
+            # the robustness-cost headline (sqrt runtime per deviance
+            # as a multiple of the joint engine's)
+            ratio = (sq_out.get("overhead") or {}).get(
+                "sqrt_vs_joint_deviance", 0.0
+            )
+            print(json.dumps({
+                "metric": "sqrt engine deviance cost vs joint",
+                "value": ratio, "unit": "x", "vs_baseline": 0.0,
+                "detail": sq_out,
             }), flush=True)
     elif args.phase == "device":
         run_device_bench(args.out, args.budget)

@@ -11,23 +11,24 @@ Policy:
   float32; the fleet/bench paths use float32 state with the same
   algorithms, validated against the f64 CPU path.
 
-**Cap-regime exemption (measured, tests/test_precision.py).**  f32
-meets the 1e-6 deviance parity bar in every *interior* alpha regime
-(worst measured rel. error 1.7e-7, i.e. >=5.8x margin).  The one
-exemption is the degenerate near-unit-root boundary ``alpha ~ 3e4``
-(``phi = 0.99997``): there the deviance magnitude is ~1.3e8, and ANY
-float32 result is limited to ``|dev| * eps_f32 * O(sqrt(T))`` ~ 4e-6
-relative by representation alone — the measured 1.4e-6 is that floor,
-not an engine defect, and the gradient direction (what the optimizer
-consumes) stays exact to 1-cos ~ 5e-11.  This regime is flat/degenerate
-by construction (it is why the fleet solver soft-caps alpha,
-``parallel/fleet.py::_soft_cap``); the SURVEY section 7 mixed-precision
-fallback (f32 state + f64 accumulators) was therefore not built: it
-could only polish the final summation, while the irreducible error is
-in the f32 representation of per-step innovation terms at ~1e8
-magnitude, and TPU f64 emulation would cost far more than the
-exemption is worth.  The cap regime carries its own 10x-headroom bar in
-tests/test_precision.py.
+**Cap-regime exemption (measured, tests/test_precision.py) — and its
+square-root repeal.**  f32 meets the 1e-6 deviance parity bar in every
+*interior* alpha regime (worst measured rel. error 1.7e-7, i.e. >=5.8x
+margin).  The one exemption is the degenerate near-unit-root boundary
+``alpha ~ 3e4`` (``phi = 0.99997``): there the covariance-form engines
+carry a measured 1.4e-6 residual, and the cap regime gets its own
+10x-headroom bar.  The earlier reading of that residual as a
+representation floor (``|dev| * eps_f32 * O(sqrt(T))``) turned out
+pessimistic: the QR square-root engine (``engine="sqrt"``,
+ops/kalman.py) measures 4.7e-8 in the SAME regime at the same dtype —
+30x better, meeting the uncapped interior bars everywhere — so the
+error was algorithmic (covariance differencing + Cholesky of a
+near-singular innovation), not representational.  The covariance
+engines keep their capped bar; the sqrt engine carries uncapped bars
+(tests/test_precision.py::check_f32_sqrt) and is the accelerator
+default for ``Metran``.  The fleet solver's soft alpha cap
+(``parallel/fleet.py::_soft_cap``) remains: the regime is still
+flat/degenerate for *optimization* whatever the engine.
 
 Set ``METRAN_TPU_X64=1`` to force x64 regardless of backend, or call
 ``enable_x64(False)`` after import to opt out.
@@ -124,6 +125,9 @@ SERVE_RETRY_BACKOFF_S = 0.02  # first-retry backoff (doubles per retry)
 SERVE_BREAKER_FAILURES = 5  # consecutive failures that open a breaker
 SERVE_BREAKER_COOLDOWN_S = 30.0  # open -> half-open probe window
 SERVE_VALIDATE_UPDATES = 1  # per-slot posterior finiteness/PSD checks
+SERVE_ENGINE = "joint"  # assimilation kernel; "sqrt" = square-root
+#                         serving (factored posteriors, PSD by
+#                         construction — the robust f32 choice)
 
 
 def serve_defaults() -> dict:
@@ -178,6 +182,9 @@ def serve_defaults() -> dict:
         ),
         "validate_updates": _env(
             "METRAN_TPU_SERVE_VALIDATE_UPDATES", int, SERVE_VALIDATE_UPDATES
+        ),
+        "engine": _env(
+            "METRAN_TPU_SERVE_ENGINE", str, SERVE_ENGINE
         ),
     }
 

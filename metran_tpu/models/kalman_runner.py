@@ -44,6 +44,9 @@ class KalmanRunner:
     def init_states(self) -> None:
         self.filtered: Optional[FilterResult] = None
         self.smoothed: Optional[SmootherResult] = None
+        # square-root engines: the factored filter pass is cached so
+        # the smoother consumes factors, not reconstituted covariances
+        self._sqrt_filtered = None
 
     def set_observations(self, panel: Panel) -> None:
         self.panel = panel
@@ -59,15 +62,41 @@ class KalmanRunner:
         if self.filtered is None:
             if self.mask_active:
                 logger.info("Running Kalman filter with masked observations.")
-            self.filtered = kalman_filter(
-                self.ss, self.y, self.mask, engine=self.engine
-            )
+            if self.engine in ("sqrt", "sqrt_parallel"):
+                # run ONE factored pass, cache the factors for the
+                # smoother (PSD by construction end to end) and expose
+                # the reconstituted moments through the usual accessors
+                from ..ops import (
+                    chol_outer,
+                    sqrt_kalman_filter,
+                    sqrt_parallel_filter,
+                )
+
+                sq = (
+                    sqrt_parallel_filter(self.ss, self.y, self.mask)
+                    if self.engine == "sqrt_parallel"
+                    else sqrt_kalman_filter(self.ss, self.y, self.mask)
+                )
+                self._sqrt_filtered = sq
+                self.filtered = FilterResult(
+                    sq.mean_p, chol_outer(sq.chol_p), sq.mean_f,
+                    chol_outer(sq.chol_f), sq.sigma, sq.detf,
+                )
+            else:
+                self.filtered = kalman_filter(
+                    self.ss, self.y, self.mask, engine=self.engine
+                )
         return self.filtered
 
     def run_smoother(self) -> SmootherResult:
         if self.smoothed is None:
+            filtered = self.run_filter()
+            if self._sqrt_filtered is not None:
+                # smooth the factored pass: rts_smoother dispatches on
+                # the SqrtFilterResult type and stays in factors
+                filtered = self._sqrt_filtered
             self.smoothed = rts_smoother(
-                self.ss, self.run_filter(), engine=self.engine
+                self.ss, filtered, engine=self.engine
             )
         return self.smoothed
 
@@ -129,12 +158,15 @@ class KalmanRunner:
     def sample_states(self, key, n_draws: int, draw_chunk: int = 8):
         """Joint posterior state-path draws
         (:func:`metran_tpu.ops.sample_states`), reusing the cached
-        smoother pass for the data side; the parallel engine falls back
-        to "joint" for the per-draw passes (identical results, without
-        the associative scan's compile cost per draw)."""
+        smoother pass for the data side; the parallel engines fall back
+        to their sequential counterparts for the per-draw passes
+        (identical results, without the associative scan's compile cost
+        per draw)."""
         from ..ops import sample_states as _sample_states
 
-        engine = self.engine if self.engine != "parallel" else "joint"
+        engine = {"parallel": "joint", "sqrt_parallel": "sqrt"}.get(
+            self.engine, self.engine
+        )
         return np.asarray(_sample_states(
             self.ss, self.y, self.mask, key, n_draws=n_draws,
             engine=engine, sm_data=self.run_smoother().mean_s,

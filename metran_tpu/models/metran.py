@@ -34,6 +34,8 @@ _ENGINE_ALIASES = {
     "sequential": "sequential",
     "joint": "joint",
     "parallel": "parallel",  # associative-scan parallel-in-time engine
+    "sqrt": "sqrt",  # QR square-root engine (robust f32 default)
+    "sqrt_parallel": "sqrt_parallel",  # square-root associative scan
 }
 
 
@@ -64,12 +66,17 @@ class Metran:
         Start/end of the analysis period.
     engine : str, optional
         Kalman engine: "sequential" (parity with the reference's
-        sequential processing), "joint" (batched Cholesky update) or
+        sequential processing), "joint" (batched Cholesky update),
+        "sqrt" (QR square-root filtering/smoothing — covariances PSD
+        by construction, the numerically robust float32 engine),
         "parallel" (associative-scan parallel-in-time filter/smoother,
-        O(log T) depth).  The reference's "numba"/"numpy" names are
+        O(log T) depth) or "sqrt_parallel" (associative scan over
+        triangular factors).  The reference's "numba"/"numpy" names are
         accepted aliases of "sequential".  Default: backend-aware —
-        "sequential" on CPU (reference parity), "joint" on accelerators
-        (MXU-friendly batched updates).
+        "sequential" on CPU (float64 reference parity), "sqrt" on
+        accelerators (float32, where the covariance-form engines can
+        lose PSD near ``phi -> 1``; see docs/concepts.md "Numerical
+        robustness").
     """
 
     def __init__(
@@ -85,7 +92,12 @@ class Metran:
 
         ensure_precision()
         if engine is None:
-            engine = "joint" if is_accelerator() else "sequential"
+            # float32 accelerators default to the square-root engine:
+            # same likelihood, PSD-by-construction covariances (the
+            # covariance-form "joint" engine can NaN-poison a filter
+            # pass when f32 roundoff makes an innovation covariance
+            # indefinite near phi -> 1)
+            engine = "sqrt" if is_accelerator() else "sequential"
         self.settings = {
             "tmin": None,
             "tmax": None,
@@ -760,8 +772,9 @@ class Metran:
         report : bool, optional
             Print fit and metran reports when done.
         engine : str, optional
-            Kalman engine override ("sequential"/"joint"/"parallel"; the
-            reference's "numba"/"numpy" map to "sequential").
+            Kalman engine override ("sequential"/"joint"/"sqrt"/
+            "parallel"/"sqrt_parallel"; the reference's "numba"/"numpy"
+            map to "sequential").
         init : str or None, optional
             Initial-parameter strategy: "reference" (constant alpha=10,
             reference parity), "autocorr" (data-driven lag-1
@@ -972,7 +985,15 @@ class Metran:
             correlations = (
                 "\n\nParameter correlations |rho| > 0.5\n" + "=" * width + "\n" + body
             )
-        return header + basic + block + correlations
+        note = ""
+        if getattr(self.fit, "nonpsd_pcov", False):
+            note = (
+                "\n\nWarning: parameter covariance was not positive "
+                "semi-definite;\nnegative variances were clipped to "
+                "zero — treat the affected\nstderr values as "
+                "unreliable (flat or degenerate optimum)."
+            )
+        return header + basic + block + correlations + note
 
     def metran_report(self, output: str = "full") -> str:
         """Factor analysis, communality, state/observation parameters

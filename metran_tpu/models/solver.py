@@ -78,6 +78,9 @@ class BaseSolver:
         self.result = None
         self.obj_func: Optional[float] = None
         self.aic: Optional[float] = None
+        # True when the parameter covariance had negative variances
+        # (clipped to zero in _finalize; surfaced in the fit report)
+        self.nonpsd_pcov: bool = False
 
     # -- objective ------------------------------------------------------
     def objfunction(self, p, callback: Optional[Callable] = None) -> float:
@@ -128,16 +131,36 @@ class BaseSolver:
 
     @staticmethod
     def _get_correlations(pcov: DataFrame) -> DataFrame:
-        d = np.sqrt(np.diag(pcov.values))
+        # clip: a non-PSD pcov's negative variances would otherwise
+        # emit sqrt RuntimeWarnings.  The clipped (zero) rows divide to
+        # +/-inf, not NaN — map every non-finite entry to NaN so a
+        # clipped parameter's undefined correlations stay excluded from
+        # fit_report's |rho| > 0.5 listing exactly as the pre-clip NaN
+        # rows were
+        d = np.sqrt(np.clip(np.diag(pcov.values), 0.0, None))
         with np.errstate(divide="ignore", invalid="ignore"):
             corr = pcov.values / np.outer(d, d)
+        corr[~np.isfinite(corr)] = np.nan
         return DataFrame(corr, index=pcov.index, columns=pcov.columns)
 
     def _finalize(self, x, fun, nfev, success, pcov=None):
         """Common post-optimization bookkeeping shared by solvers."""
         if pcov is None:
             pcov = self._get_covariance(x)
-        _stderr = np.sqrt(np.diag(pcov))
+        diag = np.diag(pcov)
+        neg = diag < 0
+        self.nonpsd_pcov = bool(np.any(neg))
+        if self.nonpsd_pcov:
+            # a numerical Hessian at a flat/degenerate optimum can come
+            # out indefinite: clip the negative variances to zero
+            # (stderr 0) instead of spraying RuntimeWarnings and NaN
+            # stderrs; Metran.fit_report carries an explicit note
+            logger.warning(
+                "parameter covariance is not PSD (%d negative "
+                "variance(s) clipped to zero); treat the affected "
+                "standard errors as unreliable", int(neg.sum()),
+            )
+        _stderr = np.sqrt(np.clip(diag, 0.0, None))
         optimal = self._full_params(np.asarray(x, float))
         stderr = np.full(len(optimal), np.nan)
         stderr[self.vary] = _stderr
@@ -195,7 +218,10 @@ class ScipySolve(BaseSolver):
                 pcov = np.asarray(self.result.hess_inv.todense())
             except AttributeError:
                 pcov = np.asarray(self.result.hess_inv)
-            if np.isnan(np.sqrt(np.diag(pcov))).any():
+            # sign test instead of isnan(sqrt(...)): same verdict, no
+            # RuntimeWarning noise from sqrt of a negative variance
+            d = np.diag(pcov)
+            if np.isnan(d).any() or (d < 0).any():
                 pcov = None
         if pcov is None:
             pcov = self._get_covariance(self.result.x)
@@ -353,6 +379,33 @@ def zoom_linesearch(max_linesearch_steps: int):
         )
 
 
+def lbfgs_trace_ctx(dtype):
+    """Trace context for optax L-BFGS runs of the given parameter dtype.
+
+    optax 0.2.x seeds its zoom-line-search state with *default-dtype*
+    scalars (``jnp.asarray(0.0)``, ``jnp.asarray(jnp.inf)``), so a
+    float32 objective under an x64-enabled backend mixes f64 state
+    leaves into f32 iterates and hits ``lax.cond`` branch-type
+    mismatches (``TypeError: true_fun and false_fun output must have
+    identical types``) on the very first iteration — the root cause of
+    the former tier-1 "f32/optax" failures.  Tracing the whole
+    optimizer (state init included) under ``jax.experimental.
+    disable_x64`` makes every default 32-bit, which is also exactly the
+    regime the f32 path models: a real f32 accelerator has x64 off.
+    float64 runs trace under the ambient config unchanged.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if jnp.dtype(dtype).itemsize < 8 and jax.config.jax_enable_x64:
+        from jax.experimental import disable_x64
+
+        return disable_x64()
+    from contextlib import nullcontext
+
+    return nullcontext()
+
+
 def lbfgs_advance(objective, opt, theta, state, tol, maxiter, max_new_iters,
                   nfev=0):
     """Advance an optax L-BFGS run by up to ``max_new_iters`` iterations.
@@ -459,7 +512,11 @@ def run_lbfgs(objective, theta0, maxiter: int = 200,
     :class:`SolverDivergenceError` carrying the offending ``theta`` (the
     solver layer maps it back to named parameters) instead of returning
     ``converged=False`` — callers that cannot act on a NaN optimum get a
-    diagnosis instead of a downstream mystery.
+    diagnosis instead of a downstream mystery.  A run that stops at a
+    point *worse than its starting value* (a line-search failure
+    creeping to a stationary point — e.g. a saddle of a divergent
+    objective) is likewise never reported converged, whatever the
+    gradient norm says.
     """
     import jax
     import jax.numpy as jnp
@@ -481,40 +538,64 @@ def run_lbfgs(objective, theta0, maxiter: int = 200,
             objective, opt, theta, state, tol, maxiter, chunk, nfev
         )
 
-    theta, state, nfev = theta0, opt.init(theta0), 0
-    prev_value = None
-    converged = False
-    while True:
-        theta, state, nfev = advance(theta, state, nfev)
-        value = float(otu.tree_get(state, "value"))
-        count = int(otu.tree_get(state, "count"))
-        gnorm = float(tree_norm(otu.tree_get(state, "grad")))
-        if not _np.isfinite(value):
+    with lbfgs_trace_ctx(theta0.dtype):
+        # one extra objective evaluation, for two guards: a start that
+        # is already non-finite diagnoses immediately, and no stopping
+        # test may report success at a value worse than this
+        value0 = float(objective(theta0))
+        if not _np.isfinite(value0):
             if raise_on_divergence:
                 raise SolverDivergenceError(
-                    f"fit objective became non-finite (value={value!r}) "
-                    f"after {count} L-BFGS iterations",
-                    params=_np.asarray(theta, float),
-                    value=value, n_iters=count,
+                    "fit objective is non-finite at the initial "
+                    f"parameters (value={value0!r})",
+                    params=_np.asarray(theta0, float),
+                    value=value0, n_iters=0,
                 )
-            break  # diverged — never report success
-        if gnorm < tol:
-            converged = True
-            break
-        # floor stop: the value CHANGED by less than the resolution
-        # tolerance across a whole chunk.  Two-sided on purpose — a
-        # chunk that made the value meaningfully worse (line-search
-        # failure excursion) must keep running or exhaust maxiter
-        # unconverged, not masquerade as a factr-style success.
-        if prev_value is not None and (
-            abs(prev_value - value)
-            <= ftol * max(abs(prev_value), abs(value), 1.0)
+            return theta0, jnp.asarray(value0), 0, 1, False
+        # nfev starts at 1: the value0 guard above is a true objective
+        # evaluation (matching the early-divergence return's count)
+        theta, state, nfev = theta0, opt.init(theta0), 1
+        prev_value = None
+        converged = False
+        while True:
+            theta, state, nfev = advance(theta, state, nfev)
+            value = float(otu.tree_get(state, "value"))
+            count = int(otu.tree_get(state, "count"))
+            gnorm = float(tree_norm(otu.tree_get(state, "grad")))
+            if not _np.isfinite(value):
+                if raise_on_divergence:
+                    raise SolverDivergenceError(
+                        f"fit objective became non-finite "
+                        f"(value={value!r}) after {count} L-BFGS "
+                        "iterations",
+                        params=_np.asarray(theta, float),
+                        value=value, n_iters=count,
+                    )
+                break  # diverged — never report success
+            if gnorm < tol:
+                converged = True
+                break
+            # floor stop: the value CHANGED by less than the resolution
+            # tolerance across a whole chunk.  Two-sided on purpose — a
+            # chunk that made the value meaningfully worse (line-search
+            # failure excursion) must keep running or exhaust maxiter
+            # unconverged, not masquerade as a factr-style success.
+            if prev_value is not None and (
+                abs(prev_value - value)
+                <= ftol * max(abs(prev_value), abs(value), 1.0)
+            ):
+                converged = True  # resolution-floor stop, factr-style
+                break
+            if count >= maxiter:
+                break
+            prev_value = value
+        if converged and not (
+            value <= value0 + ftol * max(abs(value0), abs(value), 1.0)
         ):
-            converged = True  # resolution-floor stop, scipy factr-style
-            break
-        if count >= maxiter:
-            break
-        prev_value = value
+            # stationary (or stalled) at a point worse than the start:
+            # the iterates went uphill through line-search failure
+            # fallbacks — that is a failed run, not an optimum
+            converged = False
     return (
         theta,
         otu.tree_get(state, "value"),

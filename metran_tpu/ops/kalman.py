@@ -9,7 +9,8 @@ handled by a boolean mask per timestep and masked no-op updates (XLA-friendly
 ``where``-selects instead of ragged indices).  Everything is pure, jittable,
 differentiable and vmappable over leading batch axes.
 
-Two update engines are provided:
+Three update engines are provided here (plus the associative-scan
+engines in :mod:`metran_tpu.ops.pkalman`):
 
 - ``sequential``: processes observed series one scalar at a time (rank-1
   covariance downdates), numerically step-for-step equivalent to the
@@ -17,6 +18,17 @@ Two update engines are provided:
 - ``joint``: conditions on all observed series at once via a Cholesky solve
   of the masked innovation covariance; mathematically identical likelihood,
   maps the inner work onto batched matmuls/Cholesky (MXU-friendly).
+- ``sqrt``: propagates lower-triangular Cholesky factors instead of
+  covariances, with predict/update as QR factorizations of stacked
+  factor blocks (orthogonal transformations, arXiv:2502.11686) —
+  covariances are PSD by construction and there is no ``cholesky`` of
+  a computed matrix anywhere, so no NaN path exists even where float32
+  roundoff makes the explicit innovation covariance indefinite.  The
+  numerically robust float32 engine.
+
+Every engine's deviance maps a non-finite filter path to ``+inf`` — a
+rejectable line-search value — instead of a NaN that would poison the
+optimizer state (see :func:`_finite_or_inf`).
 
 Log-likelihood semantics match ``SPKalmanFilter.get_mle``
 (``metran/kalmanfilter.py:550-567``): the returned objective is the deviance
@@ -105,6 +117,13 @@ def _joint_update(mean, cov, y, mask, z, r, dtype):
     Unobserved slots get a unit innovation variance and zero innovation, so
     they contribute nothing to the gain, ``sigma`` or ``detf`` (log 1 = 0);
     the result equals conditioning on the observed subset only.
+
+    An innovation covariance that is indefinite in the working precision
+    (the float32 failure mode near ``phi -> 1``) makes the raw Cholesky
+    emit NaN columns; instead of letting them poison the remainder of
+    the scan, the step degrades to a no-op with ``detf = +inf`` — the
+    deviance becomes ``+inf`` (a rejectable line-search value) while the
+    state carry stays finite.
     """
     maskf = mask.astype(dtype)
     z_m = z * maskf[:, None]
@@ -112,13 +131,21 @@ def _joint_update(mean, cov, y, mask, z, r, dtype):
     pz = cov @ z_m.T  # (n, m)
     f = z_m @ pz + jnp.diag(jnp.where(mask, r, 0.0) + (1.0 - maskf))
     chol = jnp.linalg.cholesky(f)
+    ok = jnp.all(jnp.isfinite(chol))
+    chol_safe = jnp.where(ok, chol, jnp.eye(f.shape[0], dtype=dtype))
     # K = P Z' F^-1  ->  solve F K' = Z P
-    kt = jax.scipy.linalg.cho_solve((chol, True), pz.T)  # (m, n)
-    mean = mean + kt.T @ v
-    cov = cov - kt.T @ f @ kt
-    w = jax.scipy.linalg.solve_triangular(chol, v, lower=True)
-    sigma = jnp.sum(w * w)
-    detf = 2.0 * jnp.sum(jnp.log(jnp.diagonal(chol)))
+    kt = jax.scipy.linalg.cho_solve((chol_safe, True), pz.T)  # (m, n)
+    mean_u = mean + kt.T @ v
+    cov_u = cov - kt.T @ f @ kt
+    w = jax.scipy.linalg.solve_triangular(chol_safe, v, lower=True)
+    mean = jnp.where(ok, mean_u, mean)
+    cov = jnp.where(ok, cov_u, cov)
+    sigma = jnp.where(ok, jnp.sum(w * w), jnp.zeros((), dtype))
+    detf = jnp.where(
+        ok,
+        2.0 * jnp.sum(jnp.log(jnp.diagonal(chol_safe))),
+        jnp.asarray(jnp.inf, dtype),
+    )
     return mean, cov, sigma, detf
 
 
@@ -172,13 +199,19 @@ def kalman_filter(
     ss : StateSpace (diagonal transition).
     y : (T, n_obs) observations; entries at masked positions are ignored.
     mask : (T, n_obs) bool, True where a real observation is present.
-    engine : "sequential" (parity) or "joint" (Cholesky batch update).
+    engine : "sequential" (parity), "joint" (Cholesky batch update),
+        "sqrt" (QR square-root updates, PSD by construction —
+        covariances here are reconstituted ``S S'``; use
+        :func:`sqrt_kalman_filter` to keep the factors), "parallel"
+        (associative scan) or "sqrt_parallel" (associative scan over
+        triangular factors).
     store : if False, per-step means/covariances are not stacked (loglik-only
         path — keeps memory O(n^2) instead of O(T n^2)).  Note this memory
-        saving applies to the ``sequential``/``joint`` scan engines only:
-        the ``parallel`` associative-scan engine materializes all per-step
-        moments regardless of ``store`` (only the return shapes follow the
-        contract), so its memory is always O(T n^2).
+        saving applies to the ``sequential``/``joint``/``sqrt`` scan
+        engines only: the ``parallel``/``sqrt_parallel`` associative-scan
+        engines materialize all per-step moments regardless of ``store``
+        (only the return shapes follow the contract), so their memory is
+        always O(T n^2).
 
     Returns
     -------
@@ -196,6 +229,31 @@ def kalman_filter(
                 res.cov_f[-1], res.sigma, res.detf,
             )
         return res
+    if engine == "sqrt_parallel":
+        from .pkalman import sqrt_parallel_filter
+
+        res = sqrt_parallel_filter(ss, y, mask)
+        if not store:  # store=False contract; O(T n^2) already spent
+            cov_t = chol_outer(res.chol_f[-1])
+            return FilterResult(
+                res.mean_f[-1], cov_t, res.mean_f[-1], cov_t,
+                res.sigma, res.detf,
+            )
+        return FilterResult(
+            res.mean_p, chol_outer(res.chol_p), res.mean_f,
+            chol_outer(res.chol_f), res.sigma, res.detf,
+        )
+    if engine == "sqrt":
+        res = _sqrt_kalman_filter(ss, y, mask, store)
+        if not store:
+            cov_t = chol_outer(res.chol_f)
+            return FilterResult(
+                res.mean_f, cov_t, res.mean_f, cov_t, res.sigma, res.detf
+            )
+        return FilterResult(
+            res.mean_p, chol_outer(res.chol_p), res.mean_f,
+            chol_outer(res.chol_f), res.sigma, res.detf,
+        )
     dtype = ss.q.dtype
     y = jnp.asarray(y, dtype)
     mask = jnp.asarray(mask, bool)
@@ -253,6 +311,11 @@ def filter_update(
     step's ``v^2/f`` and ``log f`` sums (zero when ``mask_t`` is all
     False, matching the scan's no-op semantics for missing rows).
     """
+    if engine in ("sqrt", "sqrt_parallel"):
+        raise ValueError(
+            "filter_update carries a covariance; the square-root engine "
+            "carries a Cholesky factor — use sqrt_filter_update"
+        )
     dtype = ss.q.dtype
     core = _make_core_step(ss, engine, dtype)
     _, _, mean_f, cov_f, sigma, detf = core(
@@ -289,6 +352,11 @@ def filter_append(
     ``(mean_T, cov_T, sigma, detf)``: the filtered posterior after the
     last appended step and the per-step (k,) likelihood-term arrays.
     """
+    if engine in ("sqrt", "sqrt_parallel"):
+        raise ValueError(
+            "filter_append carries a covariance; the square-root engine "
+            "carries a Cholesky factor — use sqrt_filter_append"
+        )
     dtype = ss.q.dtype
     y_new = jnp.atleast_2d(jnp.asarray(y_new, dtype))
     mask_new = jnp.atleast_2d(jnp.asarray(mask_new, bool))
@@ -305,6 +373,305 @@ def filter_append(
         (y_new, mask_new),
     )
     return mean_T, cov_T, sigma, detf
+
+
+# ----------------------------------------------------------------------
+# square-root (Cholesky-factor) engine
+# ----------------------------------------------------------------------
+#
+# The covariance-form engines above propagate P itself and factor the
+# innovation covariance with ``jnp.linalg.cholesky`` — the one operation
+# that can fail (NaN columns) when float32 roundoff makes its argument
+# indefinite, silently poisoning the remainder of the scan.  The
+# square-root engine instead propagates the lower-triangular Cholesky
+# factor S of every covariance (P = S S') and performs predict/update
+# as QR factorizations of stacked factor blocks (orthogonal
+# transformations, cf. arXiv:2502.11686): covariances are PSD **by
+# construction** and no Cholesky of a computed — possibly indefinite —
+# matrix ever happens.  This is the numerically robust float32 path.
+
+
+class SqrtFilterResult(NamedTuple):
+    """Filter moments in square-root (Cholesky-factor) form.
+
+    ``chol_p``/``chol_f`` are lower-triangular factors of the
+    predicted/filtered covariances (``P = S S'``); keeping the factored
+    form through downstream consumers (smoother, serving updates) is
+    what preserves the PSD-by-construction guarantee end to end
+    (cf. arXiv:2405.08971).
+    """
+
+    mean_p: jnp.ndarray  # (T, n)
+    chol_p: jnp.ndarray  # (T, n, n) lower factor of the predicted cov
+    mean_f: jnp.ndarray  # (T, n)
+    chol_f: jnp.ndarray  # (T, n, n) lower factor of the filtered cov
+    sigma: jnp.ndarray  # (T,)
+    detf: jnp.ndarray  # (T,)
+
+
+class SqrtSmootherResult(NamedTuple):
+    mean_s: jnp.ndarray  # (T, n)
+    chol_s: jnp.ndarray  # (T, n, n) lower factor of the smoothed cov
+
+
+def _tria(blocks: jnp.ndarray) -> jnp.ndarray:
+    """Lower-triangular ``L`` with ``L L' = B B'`` via QR of ``B'``.
+
+    The orthogonal-transformation primitive of the square-root engine:
+    ``B B'`` is never formed, so the result is a valid Cholesky factor
+    (PSD by construction) even where the explicit product would come
+    out indefinite in float32.  The diagonal is sign-normalized to be
+    nonnegative (the factor is then the unique Cholesky factor when
+    ``B`` has full row rank).  ``B`` is (n, k) with k >= n (QR of a
+    wide transpose has no JAX derivative; callers with k < n pad zero
+    columns instead — a rank-deficient but exact factor).
+    """
+    return _sign_normalize_rows(jnp.linalg.qr(blocks.T, mode="r")).T
+
+
+def _sign_normalize_rows(r: jnp.ndarray) -> jnp.ndarray:
+    """Flip rows of an upper-triangular QR factor so its diagonal is
+    nonnegative (``R' R`` is invariant; the factor becomes the unique
+    Cholesky factor where full-rank).  The single source of the sign
+    convention for every square-root triangularization."""
+    sign = jnp.sign(jnp.diagonal(r))
+    sign = jnp.where(sign == 0, jnp.ones_like(sign), sign)
+    return sign[:, None] * r
+
+
+def _q_sqrt_diag(q: jnp.ndarray) -> jnp.ndarray:
+    """(n,) elementwise sqrt of the (diagonal) process covariance.
+
+    The square-root engines read ``Q^{1/2}`` off the diagonal — the
+    only form the DFM builder emits.  A non-diagonal ``Q`` reaching a
+    traced path (where :func:`_check_diagonal_q` cannot concretize)
+    must never be *silently* truncated to its diagonal: the returned
+    factor is NaN-poisoned instead, so moments come back NaN and the
+    deviance books a loud ``+inf`` (the rejectable-step guard) rather
+    than a plausible-but-wrong likelihood.  For the concrete/constant
+    diagonal ``Q`` of the DFM, XLA folds the check away.
+    """
+    diag = jnp.diagonal(q)
+    is_diag = jnp.all(q == jnp.diag(diag))
+    return jnp.where(
+        is_diag,
+        jnp.sqrt(jnp.clip(diag, 0.0)),
+        jnp.asarray(jnp.nan, q.dtype),
+    )
+
+
+def chol_outer(chol: jnp.ndarray) -> jnp.ndarray:
+    """Reconstitute ``S S'`` from stacked factors (leading batch axes).
+
+    The product is exactly symmetric and PSD up to the roundoff of one
+    matmul — use only at true consumer boundaries; inside the engine the
+    factored form is carried instead.
+    """
+    return jnp.einsum("...ij,...kj->...ik", chol, chol)
+
+
+def _check_diagonal_q(q) -> None:
+    """Reject concrete non-diagonal transition covariances.
+
+    The square-root engine reads ``Q^{1/2}`` off the diagonal (the DFM
+    builder only emits diagonal Q); a non-diagonal Q would silently
+    drop process-noise correlations.  Tracers cannot be concretized —
+    skipping the check under a trace is fine, same contract as
+    :func:`sample_states`.
+    """
+    try:
+        q_np = np.asarray(q)
+    except (jax.errors.TracerArrayConversionError,
+            jax.errors.ConcretizationTypeError):
+        return
+    if np.abs(q_np - np.diag(np.diagonal(q_np))).max() > 0.0:
+        raise ValueError(
+            "the square-root engine requires a diagonal transition "
+            "covariance Q (the DFM builder's form); got off-diagonal "
+            "entries"
+        )
+
+
+def _make_sqrt_core_step(ss: StateSpace, dtype):
+    """Predict+update body of one square-root filter timestep.
+
+    Carries ``(mean, chol)`` with ``chol`` the lower Cholesky factor of
+    the state covariance.  Predict stacks ``[Phi S | Q^{1/2}]`` and
+    re-triangularizes; the update is the classical array algorithm: one
+    QR of the pre-array
+
+        [[ R^{1/2}     0   ]
+         [ (Z S_p)'   S_p' ]]
+
+    whose triangular result holds the innovation factor ``F^{1/2}``,
+    the scaled gain ``Kbar = P Z' F^{-T/2}`` and the filtered factor —
+    all PSD by construction, no Cholesky of a computed matrix anywhere.
+    Masked slots carry unit pseudo-noise and zero Z rows, contributing
+    exactly nothing to gain, ``sigma`` or ``detf`` (their innovation-
+    factor diagonal is exactly 1).
+
+    A step whose innovation factor degenerates (zero/non-finite
+    diagonal — possible only when the model itself is degenerate, e.g.
+    exactly-zero process noise on an observed slot) passes the state
+    through and books ``detf = +inf``: the deviance becomes a
+    rejectable ``+inf`` instead of NaN-poisoning the scan.
+    """
+    n = ss.phi.shape[-1]
+    m = ss.z.shape[-2]
+    eye_m = jnp.eye(m, dtype=dtype)
+    q_sqrt = _q_sqrt_diag(ss.q).astype(dtype)
+    zero = jnp.zeros((), dtype)
+    inf = jnp.asarray(jnp.inf, dtype)
+
+    def core(mean, chol, y_t, mask_t):
+        mean_p = ss.phi * mean
+        chol_p = _tria(jnp.concatenate(
+            [ss.phi[:, None] * chol, jnp.diag(q_sqrt)], axis=1
+        ))
+        maskf = mask_t.astype(dtype)
+        z_m = ss.z * maskf[:, None]
+        r_t = jnp.where(mask_t, ss.r, 0.0) + (1.0 - maskf)
+        v = jnp.where(mask_t, y_t - ss.z @ mean_p, 0.0)
+        pre = jnp.concatenate([
+            jnp.concatenate(
+                [jnp.diag(jnp.sqrt(r_t)), jnp.zeros((m, n), dtype)], axis=1
+            ),
+            jnp.concatenate([(z_m @ chol_p).T, chol_p.T], axis=1),
+        ], axis=0)
+        rfull = _sign_normalize_rows(jnp.linalg.qr(pre, mode="r"))
+        fu = rfull[:m, :m]  # F^{1/2}' (upper)
+        kbar = rfull[:m, m:].T  # P Z' F^{-T/2}
+        chol_u = rfull[m:, m:].T  # filtered factor, PSD by construction
+        d = jnp.diagonal(fu)
+        ok = jnp.all(d > 0) & jnp.all(jnp.isfinite(rfull))
+        fu_safe = jnp.where(ok, fu, eye_m)
+        w = jax.scipy.linalg.solve_triangular(fu_safe.T, v, lower=True)
+        mean_f = jnp.where(ok, mean_p + kbar @ w, mean_p)
+        chol_f = jnp.where(ok, chol_u, chol_p)
+        sigma = jnp.where(ok, jnp.sum(w * w), zero)
+        detf = jnp.where(
+            ok, 2.0 * jnp.sum(jnp.log(jnp.where(ok, d, 1.0))), inf
+        )
+        return mean_p, chol_p, mean_f, chol_f, sigma, detf
+
+    return core
+
+
+@functools.partial(jax.jit, static_argnames=("store",))
+def _sqrt_kalman_filter(ss, y, mask, store):
+    dtype = ss.q.dtype
+    y = jnp.asarray(y, dtype)
+    mask = jnp.asarray(mask, bool)
+    core = _make_sqrt_core_step(ss, dtype)
+    mean0, chol0 = _init_state(ss, dtype)  # identity factor == identity cov
+
+    def step(carry, xs):
+        mean, chol = carry
+        y_t, mask_t = xs
+        mean_p, chol_p, mean_f, chol_f, sigma, detf = core(
+            mean, chol, y_t, mask_t
+        )
+        if store:
+            out = (mean_p, chol_p, mean_f, chol_f, sigma, detf)
+        else:
+            out = (sigma, detf)
+        return (mean_f, chol_f), out
+
+    (mean_t, chol_t), outs = lax.scan(step, (mean0, chol0), (y, mask))
+    if store:
+        return SqrtFilterResult(*outs)
+    sigma, detf = outs
+    return SqrtFilterResult(mean_t, chol_t, mean_t, chol_t, sigma, detf)
+
+
+def sqrt_kalman_filter(
+    ss: StateSpace, y: jnp.ndarray, mask: jnp.ndarray, store: bool = True
+) -> SqrtFilterResult:
+    """Masked Kalman filter propagating Cholesky factors (QR updates).
+
+    The ``engine="sqrt"`` workhorse: same recursion, masking and
+    likelihood semantics as :func:`kalman_filter`, but every covariance
+    is carried as its lower-triangular factor and updated by orthogonal
+    transformations — PSD by construction, no ``cholesky`` of a
+    computed matrix, hence no NaN path even when float32 roundoff would
+    make the explicit innovation covariance indefinite (the
+    near-unit-root ``phi -> 0.99997`` regime of
+    ``tests/test_precision.py``).  Requires the DFM's diagonal ``Q``.
+
+    ``store=False`` keeps only the final carry (loglik-only path,
+    memory O(n^2) instead of O(T n^2)), mirroring
+    :func:`kalman_filter`.
+    """
+    _check_diagonal_q(ss.q)
+    return _sqrt_kalman_filter(ss, y, mask, bool(store))
+
+
+def sqrt_filter_update(
+    ss: StateSpace,
+    mean: jnp.ndarray,
+    chol: jnp.ndarray,
+    y_t: jnp.ndarray,
+    mask_t: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One online-assimilation step carrying a Cholesky factor.
+
+    The square-root counterpart of :func:`filter_update` (the same
+    ``_make_sqrt_core_step`` body the scan uses): given the filtered
+    posterior ``N(mean, chol chol')`` at ``t-1`` and one observation
+    row, returns ``(mean_f, chol_f, sigma, detf)`` with ``chol_f`` PSD
+    by construction — the serving path's integrity gate collapses to a
+    finiteness check (``serve.engine.posterior_fault``).
+    """
+    _check_diagonal_q(ss.q)
+    return _sqrt_filter_update(ss, mean, chol, y_t, mask_t)
+
+
+@jax.jit
+def _sqrt_filter_update(ss, mean, chol, y_t, mask_t):
+    dtype = ss.q.dtype
+    core = _make_sqrt_core_step(ss, dtype)
+    _, _, mean_f, chol_f, sigma, detf = core(
+        jnp.asarray(mean, dtype), jnp.asarray(chol, dtype),
+        jnp.asarray(y_t, dtype), jnp.asarray(mask_t, bool),
+    )
+    return mean_f, chol_f, sigma, detf
+
+
+def sqrt_filter_append(
+    ss: StateSpace,
+    mean: jnp.ndarray,
+    chol: jnp.ndarray,
+    y_new: jnp.ndarray,
+    mask_new: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Assimilate ``k`` appended rows carrying a Cholesky factor.
+
+    Square-root counterpart of :func:`filter_append` — the incremental
+    serving path in factored form.  Returns ``(mean_T, chol_T, sigma,
+    detf)`` with per-step (k,) likelihood terms.
+    """
+    _check_diagonal_q(ss.q)
+    return _sqrt_filter_append(ss, mean, chol, y_new, mask_new)
+
+
+@jax.jit
+def _sqrt_filter_append(ss, mean, chol, y_new, mask_new):
+    dtype = ss.q.dtype
+    y_new = jnp.atleast_2d(jnp.asarray(y_new, dtype))
+    mask_new = jnp.atleast_2d(jnp.asarray(mask_new, bool))
+    core = _make_sqrt_core_step(ss, dtype)
+
+    def step(carry, xs):
+        m, s = carry
+        y_t, mask_t = xs
+        _, _, mean_f, chol_f, sigma, detf = core(m, s, y_t, mask_t)
+        return (mean_f, chol_f), (sigma, detf)
+
+    (mean_t, chol_t), (sigma, detf) = lax.scan(
+        step, (jnp.asarray(mean, dtype), jnp.asarray(chol, dtype)),
+        (y_new, mask_new),
+    )
+    return mean_t, chol_t, sigma, detf
 
 
 def deviance_terms(
@@ -334,6 +701,46 @@ def deviance_terms(
     )
 
 
+def _scan_likelihood_engine(ss, engine, dtype):
+    """Engine-agnostic ``(carry0, step)`` pair for likelihood-only scans.
+
+    The covariance engines carry ``(mean, cov)``, the square-root
+    engine ``(mean, chol)`` — both initialize at ``(0, I)`` and share
+    the step signature, so the segmented remat scan and the plain
+    loglik scan stay engine-generic.
+    """
+    core = (
+        _make_sqrt_core_step(ss, dtype)
+        if engine == "sqrt"
+        else _make_core_step(ss, engine, dtype)
+    )
+    carry0 = _init_state(ss, dtype)
+
+    def step(carry, xs):
+        y_t, mask_t = xs
+        _, _, mean_f, cov_f, sigma, detf = core(
+            carry[0], carry[1], y_t, mask_t
+        )
+        return (mean_f, cov_f), (sigma, detf)
+
+    return carry0, step
+
+
+def _finite_or_inf(total):
+    """Map a non-finite deviance to ``+inf``.
+
+    ``+inf`` is a *rejectable* line-search value — Armijo comparisons
+    against it fail and the optimizer backs off — whereas a NaN
+    objective poisons the L-BFGS memory and every later iteration
+    (``run_lbfgs(raise_on_divergence=True)`` only catches that after
+    the fact).  Gradients at such points are meaningless (possibly
+    NaN); the value alone is what rejects the step.
+    """
+    return jnp.where(
+        jnp.isfinite(total), total, jnp.asarray(jnp.inf, total.dtype)
+    )
+
+
 def _deviance_terms_remat(ss, y, mask, engine, remat_seg):
     """Per-timestep (sigma, detf) via a segmented, checkpointed scan.
 
@@ -350,14 +757,7 @@ def _deviance_terms_remat(ss, y, mask, engine, remat_seg):
     y = jnp.asarray(y, dtype)
     mask = jnp.asarray(mask, bool)
     t_steps = y.shape[0]
-    core = _make_core_step(ss, engine, dtype)
-    mean0, cov0 = _init_state(ss, dtype)
-
-    def step(carry, xs):
-        mean, cov = carry
-        y_t, mask_t = xs
-        _, _, mean_f, cov_f, sigma, detf = core(mean, cov, y_t, mask_t)
-        return (mean_f, cov_f), (sigma, detf)
+    (mean0, cov0), step = _scan_likelihood_engine(ss, engine, dtype)
 
     pad = (-t_steps) % remat_seg
     if pad:
@@ -393,27 +793,43 @@ def deviance(
     checkpointed scan, cutting autodiff residual memory from O(T n^2) to
     O(seg n^2) at the cost of one extra forward recompute in the
     backward pass; results are identical to the plain scan.
+
+    A non-finite result is mapped to ``+inf`` in every engine (see
+    :func:`_finite_or_inf`): optimizers see a rejectable step, never a
+    NaN-poisoned state.
     """
-    if engine == "parallel":
+    if engine in ("parallel", "sqrt_parallel"):
         if remat_seg:
             raise ValueError(
-                "remat_seg is not supported by the 'parallel' "
+                f"remat_seg is not supported by the {engine!r} "
                 "(associative-scan) engine: it materializes O(T n^2) "
                 "moments regardless, so the O(seg) memory promise "
-                "cannot hold — use engine='sequential'/'joint'"
+                "cannot hold — use engine='sequential'/'joint'/'sqrt'"
             )
+        if engine == "sqrt_parallel":
+            from .pkalman import sqrt_parallel_deviance
+
+            return sqrt_parallel_deviance(ss, y, mask, warmup=warmup)
         from .pkalman import parallel_deviance
 
         return parallel_deviance(ss, y, mask, warmup=warmup)
     if remat_seg:
         sigma, detf = _deviance_terms_remat(ss, y, mask, engine, remat_seg)
-        return deviance_terms(sigma, detf, mask, warmup=warmup)
-    res = kalman_filter(ss, y, mask, engine=engine, store=False)
-    return deviance_terms(res.sigma, res.detf, mask, warmup=warmup)
+        return _finite_or_inf(
+            deviance_terms(sigma, detf, mask, warmup=warmup)
+        )
+    if engine == "sqrt":
+        res = _sqrt_kalman_filter(ss, y, mask, False)
+    else:
+        res = kalman_filter(ss, y, mask, engine=engine, store=False)
+    return _finite_or_inf(
+        deviance_terms(res.sigma, res.detf, mask, warmup=warmup)
+    )
 
 
 def log_likelihood(ss, y, mask, warmup: int = 1, engine: str = "sequential"):
-    """Actual log-likelihood ``-deviance / 2``."""
+    """Actual log-likelihood ``-deviance / 2`` (``-inf`` when the filter
+    path is non-finite — the rejectable-step guard of :func:`deviance`)."""
     return -0.5 * deviance(ss, y, mask, warmup=warmup, engine=engine)
 
 
@@ -433,8 +849,20 @@ def rts_smoother(
     ``pinv`` (both agree when the predicted covariance is PD, which holds for
     the DFM with identity initial covariance).  ``engine="parallel"``
     dispatches to the O(log T) associative-scan smoother; other engine
-    names use the sequential reverse scan.
+    names use the sequential reverse scan.  A :class:`SqrtFilterResult`
+    input is smoothed in factored form (:func:`sqrt_rts_smoother` — or
+    its associative-scan variant for the parallel engines) and
+    reconstituted only at return, so the PSD-by-construction guarantee
+    carries through the smoothing boundary.
     """
+    if isinstance(filtered, SqrtFilterResult):
+        if engine in ("parallel", "sqrt_parallel"):
+            from .pkalman import sqrt_parallel_smoother
+
+            sm = sqrt_parallel_smoother(ss, filtered)
+        else:
+            sm = sqrt_rts_smoother(ss, filtered)
+        return SmootherResult(sm.mean_s, chol_outer(sm.chol_s))
     if engine == "parallel":
         from .pkalman import parallel_smoother
 
@@ -449,9 +877,15 @@ def rts_smoother(
         # G = P^f Phi' (P^p_{t+1})^-1 with diagonal Phi
         a = pf * phi[None, :]
         chol = jnp.linalg.cholesky(pp_next)
-        g = jax.scipy.linalg.cho_solve((chol, True), a.T).T
-        mean_s = mf + g @ (mean_next - mp_next)
-        cov_s = pf + g @ (cov_next - pp_next) @ g.T
+        # a predicted covariance gone indefinite in f32 would NaN the
+        # whole reverse scan; degrade that step to smoothed == filtered
+        ok = jnp.all(jnp.isfinite(chol))
+        chol_safe = jnp.where(
+            ok, chol, jnp.eye(pp_next.shape[-1], dtype=pp_next.dtype)
+        )
+        g = jax.scipy.linalg.cho_solve((chol_safe, True), a.T).T
+        mean_s = jnp.where(ok, mf + g @ (mean_next - mp_next), mf)
+        cov_s = jnp.where(ok, pf + g @ (cov_next - pp_next) @ g.T, pf)
         return (mean_s, cov_s), (mean_s, cov_s)
 
     xs = (mean_f[:-1], cov_f[:-1], mean_p[1:], cov_p[1:])
@@ -460,6 +894,56 @@ def rts_smoother(
     mean_s = jnp.concatenate([means, mean_f[-1:]], axis=0)
     cov_s = jnp.concatenate([covs, cov_f[-1:]], axis=0)
     return SmootherResult(mean_s, cov_s)
+
+
+@jax.jit
+def sqrt_rts_smoother(
+    ss: StateSpace, filtered: SqrtFilterResult
+) -> SqrtSmootherResult:
+    """RTS smoother propagating Cholesky factors (QR re-triangularization).
+
+    Uses the Joseph-like PSD decomposition of the smoothed covariance
+
+        C_s = (I - G Phi) P_f (I - G Phi)' + G Q G' + G C_next G'
+
+    — algebraically identical to the classical ``P_f + G (C_next -
+    P_pn) G'`` but a sum of three PSD terms, so the smoothed factor is
+    one :func:`_tria` of stacked blocks: PSD by construction, mirroring
+    the forward square-root filter.  The gain solves against the
+    *predicted factor* from the filter pass (triangular solves only —
+    no Cholesky of a computed matrix, unlike the covariance smoother's
+    ``cholesky(P_pn)``).
+    """
+    phi = ss.phi
+    dtype = filtered.chol_f.dtype
+    n = phi.shape[-1]
+    eye = jnp.eye(n, dtype=dtype)
+    q_sqrt = _q_sqrt_diag(ss.q).astype(dtype)
+
+    def step(carry, xs):
+        mean_next, chol_next = carry  # smoothed at t+1
+        mf, cf, mp_next, sp_next = xs  # filtered t; predicted t+1 factor
+        d = jnp.diagonal(sp_next)
+        ok = jnp.all(d > 0) & jnp.all(jnp.isfinite(sp_next))
+        sp_safe = jnp.where(ok, sp_next, eye)
+        a = phi[:, None] * (cf @ cf.T)  # Phi P_f
+        g = jax.scipy.linalg.cho_solve((sp_safe, True), a).T
+        mean_s = jnp.where(ok, mf + g @ (mean_next - mp_next), mf)
+        chol_s = _tria(jnp.concatenate([
+            (eye - g * phi[None, :]) @ cf,
+            g * q_sqrt[None, :],
+            g @ chol_next,
+        ], axis=1))
+        chol_s = jnp.where(ok, chol_s, cf)
+        return (mean_s, chol_s), (mean_s, chol_s)
+
+    xs = (filtered.mean_f[:-1], filtered.chol_f[:-1],
+          filtered.mean_p[1:], filtered.chol_p[1:])
+    init = (filtered.mean_f[-1], filtered.chol_f[-1])
+    _, (means, chols) = lax.scan(step, init, xs, reverse=True)
+    mean_s = jnp.concatenate([means, filtered.mean_f[-1:]], axis=0)
+    chol_s = jnp.concatenate([chols, filtered.chol_f[-1:]], axis=0)
+    return SqrtSmootherResult(mean_s, chol_s)
 
 
 def sample_states(
@@ -538,9 +1022,7 @@ def _sample_states(ss, y, mask, key, sm_data, *, n_draws, engine,
     mask = jnp.asarray(mask, bool)
     t_steps, n = y.shape[0], ss.phi.shape[0]
     if sm_data is None:
-        sm_data = rts_smoother(
-            ss, kalman_filter(ss, y, mask, engine=engine), engine=engine
-        ).mean_s
+        sm_data = _smoothed_means(ss, y, mask, engine)
     # clip guards exact-zero variances (communality 1) against -0.0
     q_sd = jnp.sqrt(jnp.clip(jnp.diagonal(ss.q), 0.0))
     r_sd = jnp.sqrt(jnp.clip(ss.r, 0.0))
@@ -556,15 +1038,29 @@ def _sample_states(ss, y, mask, key, sm_data, *, n_draws, engine,
 
         _, xs = lax.scan(step, x0, w)
         y_star = xs @ ss.z.T + jax.random.normal(ke, y.shape, dtype) * r_sd
-        sm_star = rts_smoother(
-            ss, kalman_filter(ss, y_star, mask, engine=engine),
-            engine=engine,
-        ).mean_s
+        sm_star = _smoothed_means(ss, y_star, mask, engine)
         return sm_data + xs - sm_star
 
     return lax.map(
         one, jax.random.split(key, n_draws), batch_size=draw_chunk
     )
+
+
+def _smoothed_means(ss, y, mask, engine):
+    """Smoothed state means under ``engine``; the square-root engines
+    stay in factored form through the smoother (no reconstituted
+    covariance is ever refactored).  ``sqrt_parallel`` runs the
+    sequential factored pass here: the draws in :func:`sample_states`
+    are already mapped sequentially, and routing it through the
+    covariance-form smoother would reintroduce the ``cholesky`` of a
+    reconstituted (possibly indefinite-in-f32) matrix."""
+    if engine in ("sqrt", "sqrt_parallel"):
+        return sqrt_rts_smoother(
+            ss, _sqrt_kalman_filter(ss, y, mask, True)
+        ).mean_s
+    return rts_smoother(
+        ss, kalman_filter(ss, y, mask, engine=engine), engine=engine
+    ).mean_s
 
 
 @functools.partial(jax.jit, static_argnames=("standardized", "engine"))

@@ -23,6 +23,13 @@ identical to conditioning on the observed subset only.
 Filtering elements (per timestep): ``(A, b, C, J, eta)`` such that the
 pair ``(b, C)`` of the combined prefix equals the filtered mean/cov.
 Smoothing elements: ``(E, g, L)`` combined in reverse.
+
+A square-root variant (``sqrt_parallel_filter``/``sqrt_parallel_
+smoother``) carries the covariance parts of the elements as
+lower-triangular Cholesky factors combined via orthogonal
+transformations — per-step moments PSD by construction in float32,
+the robustness layer of arXiv:2502.11686 on the same combine
+machinery (including :func:`blocked_associative_scan`).
 """
 
 from __future__ import annotations
@@ -182,18 +189,29 @@ def _filter_element(ss: StateSpace, y_t, mask_t, p_prior, first, dtype):
 
     s = z_t @ cov_pred @ z_t.T + jnp.diag(r_t)
     chol = jnp.linalg.cholesky(s)
+    # an innovation covariance indefinite in f32 would make the raw
+    # Cholesky emit NaN columns that the combine then spreads over the
+    # whole scan; degrade this step to the no-observation element (the
+    # post-scan loglik terms book its +inf) instead
+    ok = jnp.all(jnp.isfinite(chol))
+    chol_safe = jnp.where(ok, chol, jnp.eye(s.shape[0], dtype=dtype))
     # K = cov_pred Z' S^-1  (via Cholesky solves)
-    k = jax.scipy.linalg.cho_solve((chol, True), z_t @ cov_pred).T
+    k = jax.scipy.linalg.cho_solve((chol_safe, True), z_t @ cov_pred).T
     ikh = eye - k @ z_t
 
     a = ikh * phi_eff[None, :]  # (I - K Z) Phi, diagonal Phi
     b = k @ y_t
     c = ikh @ cov_pred
     # eta = Phi' Z' S^-1 y ; J = Phi' Z' S^-1 Z Phi
-    sinv_y = jax.scipy.linalg.cho_solve((chol, True), y_t)
-    sinv_z = jax.scipy.linalg.cho_solve((chol, True), z_t)
+    sinv_y = jax.scipy.linalg.cho_solve((chol_safe, True), y_t)
+    sinv_z = jax.scipy.linalg.cho_solve((chol_safe, True), z_t)
     eta = phi_eff * (z_t.T @ sinv_y)
     j = (z_t.T @ sinv_z) * jnp.outer(phi_eff, phi_eff)
+    a = jnp.where(ok, a, jnp.diag(phi_eff))
+    b = jnp.where(ok, b, jnp.zeros_like(b))
+    c = jnp.where(ok, c, cov_pred)
+    j = jnp.where(ok, j, jnp.zeros_like(j))
+    eta = jnp.where(ok, eta, jnp.zeros_like(eta))
     return a, b, c, j, eta
 
 
@@ -266,8 +284,17 @@ def _filter_from_scan(ss: StateSpace, y, mask, scan_fn) -> FilterResult:
         v = jnp.where(mask_t, y_t - z_t @ mp, 0.0)
         f = z_t @ pp @ z_t.T + jnp.diag(r_t)
         chol = jnp.linalg.cholesky(f)
-        w = jax.scipy.linalg.solve_triangular(chol, v, lower=True)
-        return jnp.sum(w * w), 2.0 * jnp.sum(jnp.log(jnp.diagonal(chol)))
+        # indefinite-in-f32 step: book +inf (rejectable deviance), no NaN
+        ok = jnp.all(jnp.isfinite(chol))
+        chol_safe = jnp.where(ok, chol, jnp.eye(f.shape[0], dtype=dtype))
+        w = jax.scipy.linalg.solve_triangular(chol_safe, v, lower=True)
+        sigma = jnp.where(ok, jnp.sum(w * w), jnp.zeros((), dtype))
+        detf = jnp.where(
+            ok,
+            2.0 * jnp.sum(jnp.log(jnp.diagonal(chol_safe))),
+            jnp.asarray(jnp.inf, dtype),
+        )
+        return sigma, detf
 
     sigma, detf = jax.vmap(loglik_terms)(y, mask, mean_p, cov_p)
     return FilterResult(mean_p, cov_p, mean_f, cov_f, sigma, detf)
@@ -312,12 +339,18 @@ def parallel_filter(ss: StateSpace, y: jnp.ndarray, mask: jnp.ndarray,
 def _smoother_element(phi, mf, pf, mp_next, pp_next, last):
     """Build one associative smoothing element (E, g, L)."""
     n = phi.shape[-1]
-    # E = P^f Phi' (P^p_next)^-1 via Cholesky
+    # E = P^f Phi' (P^p_next)^-1 via Cholesky; a factorization gone
+    # non-finite (indefinite P^p in f32) degrades this element to the
+    # boundary form (smoothed == filtered) instead of NaN-poisoning the
+    # reverse combine
     chol = jnp.linalg.cholesky(pp_next)
-    e = jax.scipy.linalg.cho_solve((chol, True), phi[:, None] * pf.T).T
-    e = jnp.where(last, jnp.zeros((n, n), pf.dtype), e)
-    g = jnp.where(last, mf, mf - e @ mp_next)
-    l = jnp.where(last, pf, pf - e @ pp_next @ e.T)  # noqa: E741
+    ok = jnp.all(jnp.isfinite(chol))
+    chol_safe = jnp.where(ok, chol, jnp.eye(n, dtype=pf.dtype))
+    e = jax.scipy.linalg.cho_solve((chol_safe, True), phi[:, None] * pf.T).T
+    cut = last | ~ok
+    e = jnp.where(cut, jnp.zeros((n, n), pf.dtype), e)
+    g = jnp.where(cut, mf, mf - e @ mp_next)
+    l = jnp.where(cut, pf, pf - e @ pp_next @ e.T)  # noqa: E741
     return e, g, l
 
 
@@ -382,11 +415,323 @@ def parallel_deviance(
 ) -> jnp.ndarray:
     """-2 log L evaluated with the parallel filter (reference semantics).
 
-    ``block`` as in :func:`parallel_filter`."""
-    from .kalman import deviance_terms
+    ``block`` as in :func:`parallel_filter`.  Non-finite results map to
+    ``+inf`` (the rejectable-step guard shared with the sequential
+    engines, :func:`metran_tpu.ops.kalman.deviance`)."""
+    from .kalman import _finite_or_inf, deviance_terms
 
     res = parallel_filter(ss, y, mask, block=block)
-    return deviance_terms(res.sigma, res.detf, mask, warmup=warmup)
+    return _finite_or_inf(
+        deviance_terms(res.sigma, res.detf, mask, warmup=warmup)
+    )
+
+
+# ----------------------------------------------------------------------
+# square-root (Cholesky-factor) associative scan
+# ----------------------------------------------------------------------
+#
+# The filtering elements above carry covariance-like matrices (C, J)
+# whose construction and combination factor computed matrices with
+# ``jnp.linalg.cholesky`` / ``jnp.linalg.solve`` — the f32 NaN path.
+# The square-root elements instead carry the *covariance* part in
+# lower-triangular factored form (C = U U') and update/combine it via
+# orthogonal transformations (QR of stacked factor blocks —
+# "Parallel-in-Time Kalman Smoothing Using Orthogonal
+# Transformations", arXiv:2502.11686): every per-step covariance
+# factor, and everything reconstituted from one, is PSD by
+# construction.  The information-like term J stays an explicit PSD
+# matrix: its only factorization in the combine is
+# ``cholesky(I + U' J U)``, whose argument is bounded below by the
+# identity — it cannot go indefinite the way an innovation covariance
+# can, so no NaN path is reintroduced.  (A fully factored J would need
+# QR of rank-deficient stacks, where JAX's QR derivative is undefined —
+# the hybrid keeps the engine differentiable, which the deviance
+# gradient path requires.)
+
+
+def _sqrt_filter_element(ss: StateSpace, y_t, mask_t, first, dtype):
+    """Build one square-root associative filtering element.
+
+    Same ``(A, b, C, J, eta)`` semantics as :func:`_filter_element`,
+    with ``C = U U'`` and ``J = Zf Zf'`` carried in factored form.  The
+    predicted covariance entering the step (``P1-`` when first, ``Q``
+    interior) is diagonal for the DFM, so its factor is an exact
+    elementwise sqrt; the update runs the same QR array algorithm as
+    the sequential square-root engine.
+    """
+    from .kalman import _q_sqrt_diag, _sign_normalize_rows
+
+    n = ss.phi.shape[-1]
+    m = ss.z.shape[-2]
+    z_t, r_t = _masked_obs(ss, mask_t, dtype)
+    q_sq = _q_sqrt_diag(ss.q).astype(dtype)
+    # reference init: x0 ~ N(0, I) then one predict => P1- = Phi^2 + Q
+    n_pred = jnp.sqrt(jnp.where(first, ss.phi**2 + q_sq**2, q_sq**2))
+    phi_eff = jnp.where(first, jnp.zeros_like(ss.phi), ss.phi)
+
+    # array update: QR of [[sqrt(r), 0], [(Z N)', N']] with N = diag
+    pre = jnp.concatenate([
+        jnp.concatenate(
+            [jnp.diag(jnp.sqrt(r_t)), jnp.zeros((m, n), dtype)], axis=1
+        ),
+        jnp.concatenate(
+            [(z_t * n_pred[None, :]).T, jnp.diag(n_pred)], axis=1
+        ),
+    ], axis=0)
+    rfull = _sign_normalize_rows(jnp.linalg.qr(pre, mode="r"))
+    sf = rfull[:m, :m].T  # innovation factor S^{1/2} (lower)
+    kbar = rfull[:m, m:].T  # cov_pred Z' S^{-T/2}
+    u = rfull[m:, m:].T  # factor of (I - K Z) cov_pred
+
+    d = jnp.diagonal(sf)
+    ok = jnp.all(d > 0) & jnp.all(jnp.isfinite(rfull))
+    sf_safe = jnp.where(ok, sf, jnp.eye(m, dtype=dtype))
+    # K = kbar S^{-1/2}: apply through triangular solves against sf
+    z_hat = jax.scipy.linalg.solve_triangular(sf_safe, z_t, lower=True)
+    w_y = jax.scipy.linalg.solve_triangular(sf_safe, y_t, lower=True)
+    a = (jnp.eye(n, dtype=dtype) - kbar @ z_hat) * phi_eff[None, :]
+    b = kbar @ w_y
+    # eta = Phi' Z' S^-1 y ; J = Phi' Z' S^-1 Z Phi = B'B (PSD, formed
+    # from the triangular-solve products — never from an inverse)
+    eta = phi_eff * (z_hat.T @ w_y)
+    bmat = z_hat * phi_eff[None, :]  # (m, n)
+    j = bmat.T @ bmat
+    # degenerate innovation factor: emit the no-observation element
+    # (the post-scan loglik terms book the +inf)
+    a = jnp.where(ok, a, jnp.diag(phi_eff))
+    b = jnp.where(ok, b, jnp.zeros_like(b))
+    u = jnp.where(ok, u, jnp.diag(n_pred))
+    j = jnp.where(ok, j, jnp.zeros_like(j))
+    eta = jnp.where(ok, eta, jnp.zeros_like(eta))
+    return a, b, u, j, eta
+
+
+def _sqrt_filter_combine(e1, e2):
+    """Associative combine of square-root filtering elements.
+
+    Implements exactly the covariance combine of
+    :func:`_filter_combine` with ``C = U U'`` carried in factored form,
+    using the push-through identity ``(I + C1 J2)^{-1} C1 = U1 (I +
+    U1' J2 U1)^{-1} U1'``: the only factorization is the Cholesky of
+    ``S = I + U1' J2 U1``, which is bounded below by the identity (it
+    cannot go indefinite the way an innovation covariance can), and the
+    combined covariance factor is one re-triangularization of
+    ``[G | U2]`` with ``G = A2 U1 S^{-T/2}`` — so ``C`` stays PSD by
+    construction through every level of the combine tree.
+    """
+    a1, b1, u1, j1, eta1 = e1
+    a2, b2, u2, j2, eta2 = e2
+
+    def comb(a1, b1, u1, j1, eta1, a2, b2, u2, j2, eta2):
+        from .kalman import _tria
+
+        n = a1.shape[-1]
+        eye = jnp.eye(n, dtype=a1.dtype)
+        solve = jax.scipy.linalg.solve_triangular
+        ju = j2 @ u1
+        # S = I + U1' J2 U1 >= I: Cholesky cannot meet an indefinite
+        # argument here (contrast the raw innovation covariances the
+        # covariance engines factor)
+        ls = jnp.linalg.cholesky(eye + u1.T @ ju)
+        g = solve(ls, (a2 @ u1).T, lower=True).T  # A2 U1 S^{-T/2}
+        sinv = lambda x: jax.scipy.linalg.cho_solve((ls, True), x)  # noqa: E731
+        a = a2 @ a1 - (a2 @ u1) @ sinv(ju.T @ a1)
+        u_mid = b1 + u1 @ (u1.T @ eta2)  # b1 + C1 eta2
+        b = a2 @ u_mid - (a2 @ u1) @ sinv(ju.T @ u_mid) + b2
+        u = _tria(jnp.concatenate([g, u2], axis=1))
+        v = eta2 - j2 @ b1
+        eta = a1.T @ (v - ju @ sinv(u1.T @ v)) + eta1
+        # J combine: A1' (J2 - J2 U1 S^-1 U1' J2) A1 + J1 (PSD;
+        # symmetrized against accumulation drift)
+        j = a1.T @ (j2 - ju @ sinv(ju.T)) @ a1 + j1
+        j = 0.5 * (j + j.T)
+        return a, b, u, j, eta
+
+    return jax.vmap(comb)(a1, b1, u1, j1, eta1, a2, b2, u2, j2, eta2)
+
+
+def _sqrt_filter_from_scan(ss: StateSpace, y, mask, scan_fn):
+    """Shared body of :func:`sqrt_parallel_filter` (element build ->
+    combine -> factored moments and likelihood terms), mirroring
+    :func:`_filter_from_scan` in square-root form."""
+    from .kalman import SqrtFilterResult, _q_sqrt_diag, _tria
+
+    dtype = ss.q.dtype
+    mask = jnp.asarray(mask, bool)
+    y = jnp.where(mask, jnp.asarray(y, dtype), 0.0)
+    t_steps = y.shape[0]
+    n = ss.phi.shape[-1]
+    m = ss.z.shape[-2]
+    first = jnp.arange(t_steps) == 0
+    q_sqrt = _q_sqrt_diag(ss.q).astype(dtype)
+
+    elements = jax.vmap(
+        lambda y_t, m_t, f: _sqrt_filter_element(ss, y_t, m_t, f, dtype)
+    )(y, mask, first)
+
+    _, b, u, _, _ = scan_fn(_sqrt_filter_combine, elements)
+    mean_f, chol_f = b, u
+
+    # predicted moments in factored form: one re-triangularization of
+    # [Phi S_f | Q^{1/2}] per step from the filtered factor one back
+    mean_p = jnp.concatenate(
+        [jnp.zeros((1, n), dtype), mean_f[:-1] * ss.phi[None, :]], axis=0
+    )
+    chol_p1 = jnp.diag(jnp.sqrt(ss.phi**2 + q_sqrt**2))
+    chol_p_rest = jax.vmap(
+        lambda cf: _tria(jnp.concatenate(
+            [ss.phi[:, None] * cf, jnp.diag(q_sqrt)], axis=1
+        ))
+    )(chol_f[:-1])
+    chol_p = jnp.concatenate([chol_p1[None], chol_p_rest], axis=0)
+
+    # likelihood terms from masked innovations at the predicted state,
+    # factored: S^{1/2} = tria([Z S_p | diag(sqrt(r))]) — no Cholesky
+    def loglik_terms(y_t, mask_t, mp, sp):
+        z_t, r_t = _masked_obs(ss, mask_t, dtype)
+        sf = _tria(jnp.concatenate(
+            [z_t @ sp, jnp.diag(jnp.sqrt(r_t))], axis=1
+        ))
+        d = jnp.diagonal(sf)
+        ok = jnp.all(d > 0) & jnp.all(jnp.isfinite(sf))
+        sf_safe = jnp.where(ok, sf, jnp.eye(m, dtype=dtype))
+        v = jnp.where(mask_t, y_t - z_t @ mp, 0.0)
+        w = jax.scipy.linalg.solve_triangular(sf_safe, v, lower=True)
+        sigma = jnp.where(ok, jnp.sum(w * w), jnp.zeros((), dtype))
+        detf = jnp.where(
+            ok,
+            2.0 * jnp.sum(jnp.log(jnp.where(ok, d, 1.0))),
+            jnp.asarray(jnp.inf, dtype),
+        )
+        return sigma, detf
+
+    sigma, detf = jax.vmap(loglik_terms)(y, mask, mean_p, chol_p)
+    return SqrtFilterResult(mean_p, chol_p, mean_f, chol_f, sigma, detf)
+
+
+@functools.partial(jax.jit, static_argnames=("block",))
+def sqrt_parallel_filter(ss: StateSpace, y: jnp.ndarray,
+                         mask: jnp.ndarray, block="auto"):
+    """Square-root Kalman filter with O(log T) depth.
+
+    The ``engine="sqrt_parallel"`` workhorse: associative elements
+    carry triangular factors and combine via orthogonal transformations
+    (arXiv:2502.11686), so every per-step covariance factor — and
+    anything reconstituted from it — is PSD by construction even in
+    float32, with the same masked-data and likelihood semantics as
+    :func:`parallel_filter`.  Returns a
+    :class:`~metran_tpu.ops.kalman.SqrtFilterResult`; ``block`` routes
+    the combine through :func:`blocked_associative_scan` exactly as in
+    :func:`parallel_filter`.  Requires the DFM's diagonal ``Q``.
+
+    Autodiff caveat: with the DFM's exact observations (``r = 0``) the
+    filtered covariance is structurally rank-deficient in the observed
+    directions, and re-triangularizing such factors inside the combine
+    tree is not a differentiable operation (the factor's null space
+    rotates with the parameters) — gradients through this engine carry
+    O(1e-5) relative noise while *values* match the other engines to
+    reassociation rounding.  For optimization use ``engine="sqrt"``:
+    the sequential square-root scan is gradient-exact (its singular
+    factors feed only full-rank predict re-triangularizations).  This
+    engine is the robust long-series *filtering/smoothing* path.
+    """
+    block = _resolve_block(block, y.shape[0])
+    return _sqrt_filter_from_scan(ss, y, mask, _block_scan_fn(block))
+
+
+@functools.partial(jax.jit, static_argnames=("warmup", "block"))
+def sqrt_parallel_deviance(
+    ss: StateSpace, y: jnp.ndarray, mask: jnp.ndarray, warmup: int = 1,
+    block="auto",
+) -> jnp.ndarray:
+    """-2 log L evaluated with the square-root parallel filter.
+
+    Non-finite results map to ``+inf`` (rejectable step), matching
+    every other engine's deviance guard."""
+    from .kalman import _finite_or_inf, deviance_terms
+
+    res = sqrt_parallel_filter(ss, y, mask, block=block)
+    return _finite_or_inf(
+        deviance_terms(res.sigma, res.detf, mask, warmup=warmup)
+    )
+
+
+def _sqrt_smoother_element(phi, q_sqrt, mf, cf, mp_next, sp_next, last):
+    """Build one square-root associative smoothing element (E, g, D).
+
+    ``D`` is the factor of the element's additive covariance term:
+    the boundary identity ``P_f - E P_pn E' = (I - E Phi) P_f (I - E
+    Phi)' + E Q E'`` (a sum of two PSD terms) makes it one
+    re-triangularization of stacked blocks.
+    """
+    from .kalman import _tria
+
+    n = phi.shape[-1]
+    eye = jnp.eye(n, dtype=cf.dtype)
+    d = jnp.diagonal(sp_next)
+    ok = jnp.all(d > 0) & jnp.all(jnp.isfinite(sp_next))
+    sp_safe = jnp.where(ok, sp_next, eye)
+    a = phi[:, None] * (cf @ cf.T)  # Phi P_f
+    e = jax.scipy.linalg.cho_solve((sp_safe, True), a).T
+    cut = last | ~ok
+    e = jnp.where(cut, jnp.zeros((n, n), cf.dtype), e)
+    g = jnp.where(cut, mf, mf - e @ mp_next)
+    dfac = _tria(jnp.concatenate(
+        [(eye - e * phi[None, :]) @ cf, e * q_sqrt[None, :]], axis=1
+    ))
+    dfac = jnp.where(cut, cf, dfac)
+    return e, g, dfac
+
+
+def _sqrt_smoother_combine(later, earlier):
+    """Square-root combine for the reverse scan: composes as
+    :func:`_smoother_combine` with ``L = D D'``; the combined factor is
+    one re-triangularization of ``[E_e D_l | D_e]``."""
+
+    def comb(e_l, g_l, d_l, e_e, g_e, d_e):
+        from .kalman import _tria
+
+        return (
+            e_e @ e_l,
+            e_e @ g_l + g_e,
+            _tria(jnp.concatenate([e_e @ d_l, d_e], axis=1)),
+        )
+
+    return jax.vmap(comb)(*later, *earlier)
+
+
+@functools.partial(jax.jit, static_argnames=("block",))
+def sqrt_parallel_smoother(ss: StateSpace, filtered, block="auto"):
+    """RTS smoother with O(log T) depth over triangular factors.
+
+    Takes the :class:`~metran_tpu.ops.kalman.SqrtFilterResult` of
+    :func:`sqrt_parallel_filter` (or the sequential
+    :func:`~metran_tpu.ops.kalman.sqrt_kalman_filter`) and returns a
+    :class:`~metran_tpu.ops.kalman.SqrtSmootherResult` — smoothed
+    covariance factors PSD by construction, combine tree identical in
+    shape to :func:`parallel_smoother`.
+    """
+    from .kalman import SqrtSmootherResult, _q_sqrt_diag
+
+    dtype = filtered.chol_f.dtype
+    t_steps = filtered.mean_f.shape[0]
+    block = _resolve_block(block, t_steps)
+    scan_fn = _block_scan_fn(block)
+    last = jnp.arange(t_steps) == t_steps - 1
+    q_sqrt = _q_sqrt_diag(ss.q).astype(dtype)
+    mp_next = jnp.concatenate(
+        [filtered.mean_p[1:], filtered.mean_p[-1:]], axis=0
+    )
+    sp_next = jnp.concatenate(
+        [filtered.chol_p[1:], filtered.chol_p[-1:]], axis=0
+    )
+    elements = jax.vmap(
+        lambda mf, cf, mpn, spn, lt: _sqrt_smoother_element(
+            ss.phi, q_sqrt, mf, cf, mpn, spn, lt
+        )
+    )(filtered.mean_f, filtered.chol_f, mp_next, sp_next, last)
+    _, g, dfac = scan_fn(_sqrt_smoother_combine, elements, reverse=True)
+    return SqrtSmootherResult(g, dfac)
 
 
 def _sharded_associative_scan(combine, elements, mesh, axis, block,
@@ -545,4 +890,7 @@ __all__ = [
     "parallel_filter",
     "parallel_smoother",
     "sequence_sharded_filter",
+    "sqrt_parallel_deviance",
+    "sqrt_parallel_filter",
+    "sqrt_parallel_smoother",
 ]

@@ -835,8 +835,12 @@ def fit_fleet(
     ----------
     fleet : packed fleet (see :func:`pack_fleet`).
     p0 : (B, N+K) initial parameters (default: reference init, alpha=10).
-    engine : "joint" (Cholesky update, MXU-friendly — default) or
-        "sequential" (reference-parity scalar updates).
+    engine : "joint" (Cholesky update, MXU-friendly — default),
+        "sequential" (reference-parity scalar updates) or "sqrt" (QR
+        square-root updates: PSD by construction, no NaN path through
+        an indefinite-in-f32 innovation covariance — the robust f32
+        choice; ``layout="batch"`` only, the lanes layout has its own
+        sequential-processing kernel).
     mesh : optional device mesh; the fleet axis is sharded over its
         ``"batch"`` axis.  ``fleet.batch`` must divide evenly (use
         ``pack_fleet(..., pad_batch_to=pad_to_multiple(B, mesh.size))``).
@@ -1011,7 +1015,15 @@ def fit_fleet(
             jax.device_put(a, shard(a)) for a in data_args
         )
         theta = jax.device_put(theta, shard(theta))
-    state = jax.jit(jax.vmap(opt.init))(theta)
+    # f32 fleets under an x64-enabled backend trace the optimizer (init
+    # included) with 32-bit defaults — optax 0.2.x otherwise seeds f64
+    # line-search state that lax.cond rejects against f32 iterates on
+    # the first dispatch (see models.solver.lbfgs_trace_ctx)
+    from ..models.solver import lbfgs_trace_ctx
+
+    trace_ctx = lambda: lbfgs_trace_ctx(theta.dtype)  # noqa: E731
+    with trace_ctx():
+        state = jax.jit(jax.vmap(opt.init))(theta)
 
     frozen = jnp.zeros(fleet.batch, bool)
     if mesh is not None:
@@ -1087,7 +1099,8 @@ def fit_fleet(
     if max_chunks is not None:
         n_chunks = min(n_chunks, max_chunks)
     for _ in range(n_chunks):
-        theta, state = advance(theta, state, frozen, *data_args)
+        with trace_ctx():
+            theta, state = advance(theta, state, frozen, *data_args)
         if chunk >= maxiter:
             _save_ckpt()
             break
@@ -1122,7 +1135,8 @@ def fit_fleet(
         _save_ckpt()
         if done.all():
             break
-    params, value, count, conv = outputs(theta, state)
+    with trace_ctx():
+        params, value, count, conv = outputs(theta, state)
     # in this layout ``frozen`` only ever gets set by the host-side
     # stall bookkeeping above, so the floor-frozen subset is exactly the
     # frozen lanes the gradient/maxiter tests don't explain.  A lane

@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops import filter_append, forecast_observation_moments
+from ..ops import filter_append, forecast_observation_moments, sqrt_filter_append
 from ..ops.statespace import StateSpace, dfm_statespace
 
 
@@ -35,15 +35,18 @@ class BucketBatch(NamedTuple):
 
     Every leaf leads with the batch axis B; ``ss`` is a
     :class:`StateSpace` whose leaves are (B, ...) stacked matrices.
+    ``chol`` is the stacked covariance factors when the bucket serves a
+    square-root engine (``stack_bucket(..., sqrt=True)``), else None.
     """
 
     ss: StateSpace
     mean: jnp.ndarray  # (B, S)
-    cov: jnp.ndarray  # (B, S, S)
+    cov: "jnp.ndarray | None"  # (B, S, S); None when stacked for sqrt
+    chol: "jnp.ndarray | None" = None  # (B, S, S) factors (sqrt engine)
 
 
 def posterior_fault(
-    mean, cov, sym_rtol: float = 1e-4, psd_tol: float = 1e-4
+    mean, cov, sym_rtol: float = 1e-4, psd_tol: float = 1e-4, chol=None
 ) -> "str | None":
     """Why a filtered posterior is numerically unserviceable, or ``None``.
 
@@ -57,6 +60,13 @@ def posterior_fault(
     small (S, S) matrices — cheap next to the batched device dispatch
     it guards.
 
+    When ``chol`` (a covariance factor with ``cov = chol chol'``, the
+    square-root engine's carry) is given, the symmetry/eigenvalue
+    checks collapse to a finiteness check of the factor: any finite
+    factor's product is symmetric PSD **by construction** (it passes
+    ``psd_tol=0`` exactly), so the ``eigvalsh`` gate has nothing left
+    to catch and the per-slot host cost drops from O(S^3) to O(S^2).
+
     The tolerances are deliberately loose relative to one step's
     roundoff: a long-lived model assimilates thousands of incremental
     updates and the non-Joseph covariance recursion drifts a few ULPs
@@ -64,12 +74,22 @@ def posterior_fault(
     updates; float32 serving drifts proportionally more).  The gate
     exists to catch *blowups* — NaN/inf paths and grossly indefinite
     covariances from degenerate alpha regions — not to reject a healthy
-    model for accumulated floating-point dust.
+    model for accumulated floating-point dust.  (The square-root engine
+    removes the drift at the source instead of tolerating it.)
     """
     mean = np.asarray(mean)
-    cov = np.asarray(cov)
     if not np.all(np.isfinite(mean)):
         return "non-finite posterior mean"
+    if chol is not None:
+        if not np.all(np.isfinite(np.asarray(chol))):
+            return "non-finite posterior covariance factor"
+        # the reconstituted cov is what forecast consumers read — a
+        # finite factor's product can still overflow to inf, and a
+        # stored cov inconsistent with its factor must not be served
+        if not np.all(np.isfinite(np.asarray(cov))):
+            return "non-finite posterior covariance"
+        return None  # cov = chol chol': symmetric PSD by construction
+    cov = np.asarray(cov)
     if not np.all(np.isfinite(cov)):
         return "non-finite posterior covariance"
     scale = max(1.0, float(np.abs(cov).max()))
@@ -95,15 +115,41 @@ def state_slot_index(n_series: int, n_factors: int, n_obs_pad: int) -> np.ndarra
     )
 
 
-def pad_state_arrays(state, bucket: Tuple[int, int], dtype=None):
+def psd_factor(cov: np.ndarray) -> np.ndarray:
+    """A (host-side) factor ``F`` with ``F F' = cov`` for a PSD matrix.
+
+    The migration shim for covariance-form states entering a
+    square-root serving path: ``np.linalg.cholesky`` would refuse the
+    *structurally singular* filtered covariances the DFM produces
+    (``r = 0`` makes observed directions exactly known), so the factor
+    comes from an eigendecomposition with negative roundoff eigenvalues
+    clipped at zero.  The square-root kernels re-triangularize on the
+    first update, so the factor need not be lower-triangular.
+    """
+    cov = np.asarray(cov)
+    w, v = np.linalg.eigh((cov + cov.T) * 0.5)
+    return (v * np.sqrt(np.clip(w, 0.0, None))).astype(cov.dtype)
+
+
+def pad_state_arrays(state, bucket: Tuple[int, int], dtype=None,
+                     sqrt: bool = False):
     """Pad one PosteriorState's arrays into bucket shape ``(N, S)``.
 
     Returns ``(alpha_sdf (N,), alpha_cdf (S-N,), loadings (N, S-N),
-    mean (S,), cov (S, S))`` host-side arrays.  Padded alphas are 1.0
-    (a harmless fast-decay AR(1) nobody observes), padded loadings are
-    zero, padded mean/cov slots carry the filter's ``N(0, I)`` init
-    with zero cross-covariance — all invisible to the real slots (see
-    module docstring).
+    mean (S,), cov (S, S) | None, chol (S, S) | None)`` host-side
+    arrays; exactly one of ``cov``/``chol`` is filled (the factored
+    kernels never read the covariance stack and vice versa).
+    Padded alphas are 1.0 (a harmless fast-decay AR(1) nobody
+    observes), padded loadings are zero, padded mean/cov slots carry
+    the filter's ``N(0, I)`` init with zero cross-covariance — all
+    invisible to the real slots (see module docstring).
+
+    ``sqrt=True`` additionally pads a covariance *factor*: the state's
+    own ``chol`` when present (the true slots decouple exactly from the
+    padding, so scattering the factor into an identity is again a valid
+    factor), else one computed host-side from ``cov`` via
+    :func:`psd_factor` (one-time migration cost for covariance-form
+    states — the factor persists with the next update).
     """
     n_pad, s_pad = bucket
     n, k = state.n_series, state.n_factors
@@ -123,26 +169,46 @@ def pad_state_arrays(state, bucket: Tuple[int, int], dtype=None):
     idx = state_slot_index(n, k, n_pad)
     mean = np.zeros(s_pad, dtype)
     mean[idx] = state.mean
-    cov = np.eye(s_pad, dtype=dtype)
-    cov[np.ix_(idx, idx)] = state.cov
-    return alpha[:n_pad], alpha[n_pad:], loadings, mean, cov
+    cov = chol = None
+    if sqrt:
+        # the factored kernels never read the covariance stack — skip
+        # the O(S^2) pad and its device transfer on the hot path
+        factor = (
+            state.chol if getattr(state, "chol", None) is not None
+            else psd_factor(state.cov)
+        )
+        chol = np.eye(s_pad, dtype=dtype)
+        chol[np.ix_(idx, idx)] = factor
+    else:
+        cov = np.eye(s_pad, dtype=dtype)
+        cov[np.ix_(idx, idx)] = state.cov
+    return alpha[:n_pad], alpha[n_pad:], loadings, mean, cov, chol
 
 
-def stack_bucket(states: List, bucket: Tuple[int, int], dtype=None) -> BucketBatch:
+def stack_bucket(states: List, bucket: Tuple[int, int], dtype=None,
+                 sqrt: bool = False) -> BucketBatch:
     """Stack heterogeneous same-bucket models into one :class:`BucketBatch`.
 
     The state-space build itself (``dfm_statespace``) runs vmapped on
     device, so the host only stacks small parameter arrays.
+    ``sqrt=True`` stacks covariance factors too (see
+    :func:`pad_state_arrays`) for the square-root update kernels.
     """
     if dtype is None:
         dtype = states[0].dtype
-    padded = [pad_state_arrays(st, bucket, dtype) for st in states]
-    a_sdf, a_cdf, lds, means, covs = (
-        jnp.asarray(np.stack(part)) for part in zip(*padded)
+    padded = [pad_state_arrays(st, bucket, dtype, sqrt=sqrt) for st in states]
+    a_sdf, a_cdf, lds, means = (
+        jnp.asarray(np.stack(part)) for part in zip(*[p[:4] for p in padded])
+    )
+    covs = (
+        None if sqrt else jnp.asarray(np.stack([p[4] for p in padded]))
+    )
+    chols = (
+        jnp.asarray(np.stack([p[5] for p in padded])) if sqrt else None
     )
     dts = jnp.asarray(np.array([st.dt for st in states], dtype))
     ss = _build_statespace(a_sdf, a_cdf, lds, dts)
-    return BucketBatch(ss=ss, mean=means, cov=covs)
+    return BucketBatch(ss=ss, mean=means, cov=covs, chol=chols)
 
 
 @jax.jit
@@ -156,10 +222,24 @@ def make_update_fn(engine: str = "joint"):
 
     ``fn(ss, mean, cov, y_new, mask_new) -> (mean_T, cov_T, sigma,
     detf)`` with every argument batch-leading; ``y_new``/``mask_new``
-    are (B, k, N).  A *fresh* ``jax.jit`` wrapper per call site so the
-    registry's LRU eviction actually frees the underlying executables
-    (a module-level jit would pin every bucket's compilation forever).
+    are (B, k, N).  For ``engine="sqrt"`` the third argument and second
+    result are the stacked covariance *factors* (``BucketBatch.chol``)
+    and the per-model step is :func:`metran_tpu.ops.
+    sqrt_filter_append` — posteriors PSD by construction, so the
+    service's integrity gate is a finiteness check.  A *fresh*
+    ``jax.jit`` wrapper per call site so the registry's LRU eviction
+    actually frees the underlying executables (a module-level jit would
+    pin every bucket's compilation forever).
     """
+    if engine in ("sqrt", "sqrt_parallel"):
+
+        @jax.jit
+        def fn(ss, mean, chol, y_new, mask_new):
+            return jax.vmap(
+                lambda s, m, c, y, k: sqrt_filter_append(s, m, c, y, k)
+            )(ss, mean, chol, y_new, mask_new)
+
+        return fn
 
     @jax.jit
     def fn(ss, mean, cov, y_new, mask_new):
@@ -197,7 +277,11 @@ _forecast_fn_cached = functools.lru_cache(maxsize=8)(make_forecast_fn)
 
 
 def update_bucket(ss, mean, cov, y_new, mask_new, engine: str = "joint"):
-    """Batched incremental update (see :func:`make_update_fn`)."""
+    """Batched incremental update (see :func:`make_update_fn`).
+
+    For ``engine="sqrt"`` pass the stacked covariance *factors* as
+    ``cov``; the second result is then the updated factors (PSD by
+    construction)."""
     return _update_fn_cached(engine)(ss, mean, cov, y_new, mask_new)
 
 
@@ -213,6 +297,7 @@ __all__ = [
     "make_update_fn",
     "pad_state_arrays",
     "posterior_fault",
+    "psd_factor",
     "stack_bucket",
     "state_slot_index",
     "update_bucket",

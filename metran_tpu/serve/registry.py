@@ -99,7 +99,13 @@ class ModelRegistry:
         values coalesce more heterogeneous models per executable at the
         cost of more padding FLOPs per request.
     max_compiled : LRU capacity for compiled kernels.
-    engine : Kalman update engine for assimilation dispatches.
+    engine : Kalman update engine for assimilation dispatches
+        (default from ``serve_defaults()["engine"]``, overridable via
+        ``METRAN_TPU_SERVE_ENGINE``).  ``"sqrt"`` serves in square-root
+        form: updates carry Cholesky factors (``ops.
+        sqrt_filter_append``), posteriors are PSD by construction, and
+        the per-slot integrity gate is a finiteness check instead of an
+        ``eigvalsh`` — the recommended engine for float32 serving.
     validate : run the numerical posterior gate on disk loads (default
         ``serve_defaults()["validate_updates"]`` — the SAME knob the
         service's write-path gate uses, so states an operator chose to
@@ -112,12 +118,14 @@ class ModelRegistry:
         root=None,
         bucket_multiple: Optional[int] = None,
         max_compiled: Optional[int] = None,
-        engine: str = "joint",
+        engine: Optional[str] = None,
         validate: Optional[bool] = None,
     ):
         from ..config import serve_defaults
 
         defaults = serve_defaults()
+        if engine is None:
+            engine = defaults["engine"]
         if bucket_multiple is None:
             bucket_multiple = defaults["bucket_multiple"]
         if max_compiled is None:
@@ -236,7 +244,10 @@ class ModelRegistry:
             self.integrity.increment("load_failures")
             raise
         if self.validate:
-            fault = posterior_fault(state.mean, state.cov)
+            # a factored state validates by finiteness alone (PSD by
+            # construction); covariance-form states keep the eigen gate
+            fault = posterior_fault(state.mean, state.cov,
+                                    chol=state.chol)
             if fault is not None:
                 self.integrity.increment("load_failures")
                 self._quarantine(path, fault)

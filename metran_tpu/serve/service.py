@@ -929,7 +929,13 @@ class MetranService:
         states, live = self._lookup_states(requests, results)
         if not live:
             return results
-        batch = stack_bucket(states, bucket)
+        # square-root registries assimilate in factored form: the
+        # kernel carries Cholesky factors, the posterior gate below
+        # collapses to a finiteness check (PSD by construction), and a
+        # covariance-form state entering this path is migrated to a
+        # factor once (stack_bucket) and stays factored thereafter
+        sqrt_engine = self.registry.engine in ("sqrt", "sqrt_parallel")
+        batch = stack_bucket(states, bucket, sqrt=sqrt_engine)
         n_pad = bucket[0]
         y = np.zeros((len(states), k, n_pad))
         m = np.zeros((len(states), k, n_pad), bool)
@@ -938,10 +944,19 @@ class MetranService:
             y[i, :, : st.n_series] = y_std
             m[i, :, : st.n_series] = mask
         fn = self.registry.update_fn(bucket, k)
-        mean_t, cov_t, _sigma, _detf = fn(
-            batch.ss, batch.mean, batch.cov, y, m
-        )
-        mean_t, cov_t = np.asarray(mean_t), np.asarray(cov_t)
+        chol_t = None
+        if sqrt_engine:
+            mean_t, chol_t, sigma_t, detf_t = fn(
+                batch.ss, batch.mean, batch.chol, y, m
+            )
+            chol_t = np.asarray(chol_t)
+        else:
+            mean_t, cov_t, sigma_t, detf_t = fn(
+                batch.ss, batch.mean, batch.cov, y, m
+            )
+            cov_t = np.asarray(cov_t)
+        mean_t = np.asarray(mean_t)
+        sigma_t, detf_t = np.asarray(sigma_t), np.asarray(detf_t)
         validate = self.reliability.validate_updates
         for i, (st, j) in enumerate(zip(states, live)):
             # per-slot finalize: everything between here and a
@@ -958,9 +973,33 @@ class MetranService:
             try:
                 idx = state_slot_index(st.n_series, st.n_factors, n_pad)
                 mean_i = mean_t[i][idx].astype(st.dtype)
-                cov_i = cov_t[i][np.ix_(idx, idx)].astype(st.dtype)
+                if sqrt_engine:
+                    # the slot submatrix of the factor IS the factor of
+                    # the slot submatrix (padding decouples exactly);
+                    # the covariance is reconstituted for consumers but
+                    # the factor is what persists and carries forward
+                    chol_i = chol_t[i][np.ix_(idx, idx)].astype(st.dtype)
+                    cov_i = chol_i @ chol_i.T
+                else:
+                    chol_i = None
+                    cov_i = cov_t[i][np.ix_(idx, idx)].astype(st.dtype)
                 if validate:
-                    fault = posterior_fault(mean_i, cov_i)
+                    # a degraded filter step (indefinite-in-precision
+                    # innovation covariance) passes through with a
+                    # finite state but books detf = +inf: the
+                    # observation was NOT assimilated, so committing
+                    # version+1/t_seen+k would claim data the state
+                    # never saw.  The likelihood terms are the only
+                    # place that signal survives to the host.
+                    if np.all(np.isfinite(detf_t[i])) and np.all(
+                        np.isfinite(sigma_t[i])
+                    ):
+                        fault = posterior_fault(mean_i, cov_i, chol=chol_i)
+                    else:
+                        fault = (
+                            "non-finite likelihood step (degraded "
+                            "filter update; observation not assimilated)"
+                        )
                     if fault is not None:
                         self.metrics.errors.increment("poisoned_updates")
                         logger.error(
@@ -974,11 +1013,15 @@ class MetranService:
                             "state is unchanged"
                         )
                         continue
+                # chol_i is None on covariance engines — which also
+                # DROPS any stale factor a sqrt-extracted state carried
+                # (the covariance kernel did not update it)
                 new_state = st._replace(
                     version=st.version + 1,
                     t_seen=st.t_seen + k,
                     mean=mean_i,
                     cov=cov_i,
+                    chol=chol_i,
                 )
                 try:
                     self.registry.put(

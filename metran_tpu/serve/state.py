@@ -72,6 +72,15 @@ class PosteriorState(NamedTuple):
         (original data units), so the service can accept/return data
         units while the engine runs standardized.
     names : series names, column order.
+    chol : optional (n_state, n_state) lower-triangular Cholesky factor
+        of ``cov`` (``cov = chol chol'``).  Present when the state was
+        produced by a square-root engine; the serving stack then
+        assimilates in factored form (``sqrt_filter_append``) and the
+        posterior-integrity gate collapses to a finiteness check —
+        PSD holds by construction (``serve.engine.posterior_fault``).
+        Absent (None) on states from covariance engines and on files
+        written before the field existed; everything downstream treats
+        that as "covariance form".
     """
 
     model_id: str
@@ -85,6 +94,7 @@ class PosteriorState(NamedTuple):
     scaler_mean: np.ndarray
     scaler_std: np.ndarray
     names: Tuple[str, ...]
+    chol: Optional[np.ndarray] = None
 
     @property
     def n_series(self) -> int:
@@ -114,7 +124,12 @@ class PosteriorState(NamedTuple):
 
     def save(self, path) -> Path:
         """Persist to one ``.npz``, atomically, with an embedded content
-        checksum (see module docstring and :data:`STATE_FORMAT_VERSION`)."""
+        checksum (see module docstring and :data:`STATE_FORMAT_VERSION`).
+
+        The optional ``chol`` factor rides as one more array key when
+        present — still format v2: older readers checksum every payload
+        key (including this one) and then simply don't construct from
+        it, so sqrt-engine files stay loadable everywhere."""
         payload = dict(
             model_id=np.str_(self.model_id),
             version=np.int64(self.version),
@@ -128,6 +143,8 @@ class PosteriorState(NamedTuple):
             scaler_std=np.asarray(self.scaler_std),
             names=np.asarray(list(self.names), dtype=np.str_),
         )
+        if self.chol is not None:
+            payload["chol"] = np.asarray(self.chol)
         return atomic_savez(
             Path(path),
             format_version=np.int64(STATE_FORMAT_VERSION),
@@ -200,6 +217,7 @@ class PosteriorState(NamedTuple):
                     scaler_mean=payload["scaler_mean"],
                     scaler_std=payload["scaler_std"],
                     names=tuple(str(n) for n in payload["names"]),
+                    chol=payload.get("chol"),
                 )
         except (StateIntegrityError, ValueError):
             # ValueError here is OURS (unsupported format) — a
@@ -238,6 +256,10 @@ def posterior_state_from_metran(
         mt.set_init_parameters()
     mt._run_kalman("filter", p=p)
     filt = mt.kf.run_filter()
+    # a square-root runner keeps the factored pass cached: freeze the
+    # factor alongside the (reconstituted) covariance so the serving
+    # stack can assimilate in factored form from the first request
+    sq = getattr(mt.kf, "_sqrt_filtered", None)
     params = mt._param_array(p if p is not None else mt.get_parameters())
     return PosteriorState(
         model_id=str(model_id if model_id is not None else mt.name),
@@ -251,6 +273,7 @@ def posterior_state_from_metran(
         scaler_mean=np.asarray(mt.oseries_mean, float),
         scaler_std=np.asarray(mt.oseries_std, float),
         names=tuple(mt.snames),
+        chol=None if sq is None else np.asarray(sq.chol_f[-1]),
     )
 
 
@@ -281,21 +304,37 @@ def posterior_states_from_fleet(
     import jax
     import jax.numpy as jnp
 
-    from ..ops import dfm_statespace, kalman_filter
+    from ..ops import (
+        chol_outer,
+        dfm_statespace,
+        kalman_filter,
+        sqrt_kalman_filter,
+        sqrt_parallel_filter,
+    )
 
     params = jnp.asarray(params)
     b = fleet.batch
     n_pad = fleet.loadings.shape[1]
+    sqrt_engine = engine in ("sqrt", "sqrt_parallel")
 
     def one(p, y, mask, loadings, dt):
         n = loadings.shape[0]
         ss = dfm_statespace(p[:n], p[n:], loadings, dt)
+        if sqrt_engine:
+            res = (
+                sqrt_parallel_filter(ss, y, mask)
+                if engine == "sqrt_parallel"
+                else sqrt_kalman_filter(ss, y, mask)
+            )
+            return res.mean_f, chol_outer(res.chol_f), res.chol_f
         res = kalman_filter(ss, y, mask, engine=engine)
-        return res.mean_f, res.cov_f
+        return res.mean_f, res.cov_f  # no factor leg: nothing wasted
 
-    means, covs = jax.jit(jax.vmap(one))(
+    outs = jax.jit(jax.vmap(one))(
         params, fleet.y, fleet.mask, fleet.loadings, fleet.dt
     )
+    means, covs = outs[0], outs[1]
+    chols = outs[2] if sqrt_engine else None
     t_steps = (
         np.full(b, fleet.y.shape[1], np.int64)
         if fleet.t_steps is None
@@ -306,6 +345,7 @@ def posterior_states_from_fleet(
         None if fleet.n_factors is None else np.asarray(fleet.n_factors)
     )
     means, covs = np.asarray(means), np.asarray(covs)
+    chols = None if chols is None else np.asarray(chols)
     p_np = np.asarray(params)
     lds = np.asarray(fleet.loadings)
     dts = np.asarray(fleet.dt)
@@ -347,6 +387,12 @@ def posterior_states_from_fleet(
             scaler_mean=np.asarray(scaler_mean[i][:ni], float),
             scaler_std=np.asarray(scaler_std[i][:ni], float),
             names=tuple(f"series{j}" for j in range(ni)),
+            # a padded member's true slots decouple exactly from the
+            # padding (zero cross-covariance by the fleet contract), so
+            # the factor's slot submatrix IS the factor of the slot
+            # submatrix of the covariance
+            chol=None if chols is None
+            else chols[i, ti - 1][np.ix_(sl, sl)],
         ))
     return states
 

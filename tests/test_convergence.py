@@ -80,11 +80,19 @@ def _small_fleet(rng, dtype, n_models=3, n=4, t=120):
     return pack_fleet(panels, loadings, dtype=dtype)
 
 
-@pytest.mark.parametrize("layout", ["lanes", "batch"])
+@pytest.mark.parametrize("layout", ["lanes", "batch", "batch-sqrt"])
 def test_fit_fleet_f32_reports_converged(rng, layout):
+    """The ``batch-sqrt`` case runs the same contract with the
+    square-root Kalman engine end to end (ISSUE 3: the robust f32
+    path through the whole optimizer)."""
     fleet = _small_fleet(rng, np.float32)
     assert fleet.y.dtype == jnp.float32
+    engine = None
+    if layout == "batch-sqrt":
+        layout, engine = "batch", "sqrt"
     kwargs = dict(maxiter=80, layout=layout)
+    if engine is not None:
+        kwargs["engine"] = engine
     if layout == "batch":
         kwargs["chunk"] = 10  # host-side stall stop needs chunking
     fit = fit_fleet(fleet, **kwargs)

@@ -838,13 +838,18 @@ def test_multistart_fit_fleet_mesh_matches_unsharded(rng):
     sharded, dev_m = multistart_fit_fleet(
         fleet, n_starts=2, seed=5, mesh=mesh, **kwargs
     )
+    # 1e-11, not 1e-12: the sharded run's collectives reassociate
+    # reductions, and a converged parameter can legitimately differ by
+    # a few ULPs of accumulated rounding (measured 1.16e-12 on one
+    # element in this environment — a tolerance hair, not a defect; the
+    # sharded-parity bar everywhere else in the suite is 1e-10)
     np.testing.assert_allclose(
-        np.asarray(dev_m), np.asarray(dev), rtol=1e-12
+        np.asarray(dev_m), np.asarray(dev), rtol=1e-11
     )
     np.testing.assert_allclose(
-        np.asarray(sharded.params), np.asarray(base.params), rtol=1e-12
+        np.asarray(sharded.params), np.asarray(base.params), rtol=1e-11
     )
     np.testing.assert_allclose(
         np.asarray(sharded.deviance), np.asarray(base.deviance),
-        rtol=1e-12,
+        rtol=1e-11,
     )

@@ -38,6 +38,30 @@ the 1e-6 parity bar they guard), so a legitimate environment-to-
 environment rounding drift cannot flake the suite while a real
 regression (e.g. reintroducing the ``1 - phi^2`` cancellation that the
 ``expm1`` form fixes) still trips it.
+
+**Square-root engine: no cap exemption.**  The QR square-root engine
+(``engine="sqrt"``) meets the *uncapped* interior bars in EVERY regime,
+including the near-unit-root cap regime (measured 2026-08, same
+environment):
+
+================  ==========  ==========  ==========  ==========
+alpha regime      |deviance|  dev rel     grad rel    1 - cosine
+================  ==========  ==========  ==========  ==========
+10 (init)         4.7e+04     4.6e-08     6.9e-07     2.3e-13
+0.1 (fast)        1.8e+05     7.3e-08     6.2e-06     1.5e-11
+3e4 (cap bound)   1.3e+08     4.7e-08     1.6e-06     1.3e-12
+mixed 0.1..1e4    2.1e+05     1.7e-07     1.1e-06     3.6e-13
+================  ==========  ==========  ==========  ==========
+
+The covariance engine's 1.4e-6 cap-regime residual was therefore NOT a
+float32 representation floor: propagating Cholesky factors through
+orthogonal updates removes it (30x better at the same dtype), which is
+why ``check_f32_sqrt`` asserts the uncapped ``DEV_RTOL``/``GRAD_RTOL``
+bars with no ``*_CAP`` fallback anywhere.
+
+All f32-bar tests carry the ``precision`` marker: select them alone
+with ``pytest -m precision`` (they stay inside tier-1's ``-m "not
+slow"`` selection).
 """
 
 import functools
@@ -45,8 +69,11 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from metran_tpu.ops import deviance, dfm_statespace
+
+pytestmark = pytest.mark.precision
 
 N, K, T = 20, 1, 5000
 DEV_RTOL = 2e-6  # interior regimes: 10x worst measured (1.7e-7)
@@ -117,6 +144,20 @@ def check_f32_joint(regime):
     assert cos > GRAD_COS, regime
 
 
+def check_f32_sqrt(regime):
+    """Assert the sqrt-engine f32 bars for one alpha regime — the
+    UNCAPPED interior bars everywhere, near-unit-root included (the
+    square-root engine has no cap exemption; module docstring)."""
+    y, mask, loadings = make_flagship()
+    alpha = ALPHAS[regime]
+    v64, g64 = _value_and_grad(alpha, y, mask, loadings, jnp.float64, "sqrt")
+    v32, g32 = _value_and_grad(alpha, y, mask, loadings, jnp.float32, "sqrt")
+    assert abs(v32 - v64) / abs(v64) < DEV_RTOL, regime
+    assert np.linalg.norm(g32 - g64) / np.linalg.norm(g64) < GRAD_RTOL, regime
+    cos = np.dot(g32, g64) / (np.linalg.norm(g32) * np.linalg.norm(g64))
+    assert cos > GRAD_COS, regime
+
+
 def check_f32_lanes(regime):
     """Assert the lanes-kernel f32 bars for one alpha regime."""
     from metran_tpu.ops import lanes_dfm_deviance
@@ -177,6 +218,13 @@ def test_f32_joint_matches_f64():
     _run_checks([f"check_f32_joint({r!r})" for r in ALPHAS])
 
 
+def test_f32_sqrt_matches_f64_uncapped():
+    """engine="sqrt" meets the uncapped bars in all four regimes —
+    including near_unit_root, where the covariance engines need the
+    10x relaxed ``*_CAP`` bars (ISSUE 3 acceptance)."""
+    _run_checks([f"check_f32_sqrt({r!r})" for r in ALPHAS])
+
+
 def test_f32_lanes_matches_f64():
     _run_checks([
         "check_f32_lanes('init')", "check_f32_lanes('near_unit_root')",
@@ -215,31 +263,47 @@ print("F32_PARALLEL_OK")
     assert "F32_PARALLEL_OK" in res.stdout
 
 
-def check_f32_fleet_fit():
-    """An f32 fleet fit lands within rtol 1e-3 of the f64 deviance
-    optimum (the fit-quality guarantee behind the TPU-default policy)."""
+def check_f32_fleet_fit(engines=("joint",)):
+    """Each engine's f32 fleet fit lands within rtol 1e-3 of the SAME
+    engine's f64 fit (the fit-quality guarantee behind the TPU-default
+    policy).  Same-engine references on purpose: at this bounded
+    ``maxiter`` the runs are mid-trajectory, and different engines make
+    legitimately different progress per iteration (the sqrt engine's
+    40-iteration deviance is ~4% LOWER than joint's on this problem) —
+    the contract under test is f32-tracks-f64, not engine-vs-engine.
+    The ``"sqrt"`` leg (ISSUE 3's re-enabled ambition on this former
+    failure) runs a shorter slice: the tracking property it pins is
+    per-step, so the extra subprocess stays inside the tier-1 budget.
+    """
     from metran_tpu.parallel import fit_fleet
     from metran_tpu.parallel.fleet import Fleet
 
-    y, mask, loadings = make_flagship()
-    y, mask = y[:1500], mask[:1500]
+    y_full, mask_full, loadings = make_flagship()
 
-    def fleet_of(dtype):
+    def fleet_of(dtype, t):
         return Fleet(
-            y=jnp.asarray(y, dtype)[None],
-            mask=jnp.asarray(mask)[None],
+            y=jnp.asarray(y_full[:t], dtype)[None],
+            mask=jnp.asarray(mask_full[:t])[None],
             loadings=jnp.asarray(loadings, dtype)[None],
             dt=jnp.ones(1, dtype),
             n_series=jnp.full(1, N, np.int32),
         )
 
-    kwargs = dict(maxiter=40, chunk=40, max_linesearch_steps=8)
-    fit64 = fit_fleet(fleet_of(jnp.float64), tol=1e-6, **kwargs)
-    fit32 = fit_fleet(fleet_of(jnp.float32), tol=0.05, **kwargs)
-    d64 = float(np.asarray(fit64.deviance)[0])
-    d32 = float(np.asarray(fit32.deviance)[0])
-    assert abs(d32 - d64) / abs(d64) < 1e-3
+    for engine in engines:
+        t, maxiter = (1500, 40) if engine == "joint" else (1000, 30)
+        kwargs = dict(
+            maxiter=maxiter, chunk=maxiter, max_linesearch_steps=8,
+        )
+        if engine != "joint":
+            kwargs["engine"] = engine
+        fit64 = fit_fleet(fleet_of(jnp.float64, t), tol=1e-6, **kwargs)
+        fit32 = fit_fleet(fleet_of(jnp.float32, t), tol=0.05, **kwargs)
+        d64 = float(np.asarray(fit64.deviance)[0])
+        d32 = float(np.asarray(fit32.deviance)[0])
+        assert abs(d32 - d64) / abs(d64) < 1e-3, engine
 
 
 def test_f32_fleet_fit_reaches_f64_optimum():
-    _run_checks(["check_f32_fleet_fit()"])
+    """Both the covariance ("joint") and square-root f32 paths track
+    their f64 references — one subprocess for both engines."""
+    _run_checks(["check_f32_fleet_fit(engines=('joint', 'sqrt'))"])

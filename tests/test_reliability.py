@@ -143,6 +143,59 @@ def test_poisoned_forecast_fails_alone(rng):
     assert svc.metrics.errors.get("poisoned_forecasts") == 1
 
 
+def test_degraded_filter_step_rejected_not_committed(rng, monkeypatch):
+    """A filter step that degrades to a pass-through (an indefinite-in-
+    precision innovation covariance books ``detf = +inf`` while the
+    state carry stays finite) must be rejected like any poisoned
+    update: the observation was never assimilated, so committing
+    ``version+1``/``t_seen+k`` would claim data the stored state never
+    saw — and the finite posterior sails through ``posterior_fault``,
+    making the likelihood terms the only surviving signal."""
+    reg = ModelRegistry()
+    st, *_ = _make_state(rng, model_id="m0", n=3, k=1, t=40)
+    reg.put(st, persist=False)
+
+    real_update_fn = reg.update_fn
+
+    def degraded_update_fn(bucket, k):
+        fn = real_update_fn(bucket, k)
+
+        def wrapped(ss, mean, cov, y, m):
+            mean_t, cov_t, sigma, detf = fn(ss, mean, cov, y, m)
+            detf = np.full_like(np.asarray(detf), np.inf)
+            return mean_t, cov_t, sigma, detf
+
+        return wrapped
+
+    monkeypatch.setattr(reg, "update_fn", degraded_update_fn)
+    with MetranService(
+        reg, flush_deadline=None, persist_updates=False,
+        reliability=_fast_policy(),
+    ) as svc:
+        with pytest.raises(StateIntegrityError, match="not assimilated"):
+            svc.update(
+                "m0", rng.normal(size=(1, 3)) * st.scaler_std
+                + st.scaler_mean
+            )
+    assert reg.get("m0").version == 0
+    assert reg.get("m0").t_seen == st.t_seen
+    assert svc.metrics.errors.get("poisoned_updates") == 1
+
+
+def test_posterior_fault_checks_cov_behind_finite_factor(rng):
+    """The factored gate must still validate the cov array consumers
+    read: a finite factor with a non-finite stored covariance (an
+    inconsistent writer, or a factor product overflowing the working
+    precision) is unserviceable."""
+    from metran_tpu.serve.engine import posterior_fault
+
+    mean = np.zeros(3)
+    chol = np.eye(3)
+    cov_bad = np.full((3, 3), np.nan)
+    assert posterior_fault(mean, cov_bad, chol=chol) is not None
+    assert posterior_fault(mean, chol @ chol.T, chol=chol) is None
+
+
 def test_poisoned_update_breaks_same_batch_chain(rng):
     """Two coalesced same-model updates: the first is rejected (poisoned
     posterior), so the second must fail with ChainedRequestError, not
@@ -816,11 +869,11 @@ def test_finalize_failure_is_per_slot_not_whole_round(rng, monkeypatch):
     real_fault = engine.posterior_fault
     calls = []
 
-    def exploding(mean, cov):
+    def exploding(mean, cov, chol=None):
         calls.append(1)
         if len(calls) == 2:  # the 2nd slot — "ok" already committed
             raise np.linalg.LinAlgError("eigvalsh did not converge")
-        return real_fault(mean, cov)
+        return real_fault(mean, cov, chol=chol)
 
     monkeypatch.setattr(engine, "posterior_fault", exploding)
     with MetranService(

@@ -125,6 +125,83 @@ def test_service_update_matches_full_refilter(rng, tmp_path):
     assert PosteriorState.load(reg.path_for("m0")).version == 1
 
 
+def test_sqrt_service_update_matches_covariance_engine(rng, tmp_path):
+    """``engine="sqrt"`` registry end to end (ISSUE 3): the factored
+    update matches the joint engine's refiltered posterior, the factor
+    persists through the npz (still format v2) and passes the
+    integrity gate at ``psd_tol=0`` — PSD by construction."""
+    from metran_tpu.ops import sqrt_kalman_filter
+    from metran_tpu.serve.engine import posterior_fault
+
+    state, ss, y, mask = _make_state(rng)
+    sq = sqrt_kalman_filter(ss, y, mask)
+    state = state._replace(chol=np.asarray(sq.chol_f[-1]))
+    reg = ModelRegistry(root=tmp_path, engine="sqrt")
+    reg.put(state)
+    k = 5
+    new_std = rng.normal(size=(k, state.n_series))
+    new_std[rng.uniform(size=new_std.shape) > 0.7] = np.nan
+    with MetranService(reg, flush_deadline=None) as svc:
+        new_state = svc.update(
+            "m0", new_std * state.scaler_std + state.scaler_mean
+        )
+    assert new_state.version == 1
+    assert new_state.chol is not None
+
+    mask_new = np.isfinite(new_std)
+    y_full = np.concatenate([y, np.where(mask_new, new_std, 0.0)])
+    mask_full = np.concatenate([mask, mask_new])
+    res = kalman_filter(ss, y_full, mask_full, engine="joint")
+    np.testing.assert_allclose(
+        new_state.mean, res.mean_f[-1], rtol=1e-10, atol=1e-12
+    )
+    np.testing.assert_allclose(
+        new_state.cov, res.cov_f[-1], rtol=1e-8, atol=1e-10
+    )
+    # zero-tolerance PSD gate: only the factored path can promise this
+    assert posterior_fault(
+        new_state.mean, new_state.cov, psd_tol=0.0, chol=new_state.chol
+    ) is None
+    # the factor round-trips through the persisted npz
+    loaded = PosteriorState.load(reg.path_for("m0"))
+    assert loaded.version == 1
+    np.testing.assert_array_equal(loaded.chol, new_state.chol)
+
+
+def test_sqrt_registry_migrates_covariance_state(rng):
+    """A chol-less (covariance-form) state served through a sqrt
+    registry is factored host-side once (``psd_factor`` — plain
+    ``np.linalg.cholesky`` would refuse the structurally singular
+    ``r=0`` covariance) and stays factored after the first update; a
+    covariance registry conversely DROPS a stale factor it did not
+    update."""
+    state, ss, y, mask = _make_state(rng)
+    assert state.chol is None
+    reg = ModelRegistry(engine="sqrt")
+    reg.put(state, persist=False)
+    k = 3
+    new_std = rng.normal(size=(k, state.n_series))
+    obs = new_std * state.scaler_std + state.scaler_mean
+    with MetranService(
+        reg, flush_deadline=None, persist_updates=False
+    ) as svc:
+        new_state = svc.update("m0", obs)
+    assert new_state.chol is not None
+    y_full = np.concatenate([y, new_std])
+    mask_full = np.concatenate([mask, np.ones((k, state.n_series), bool)])
+    res = kalman_filter(ss, y_full, mask_full, engine="joint")
+    np.testing.assert_allclose(
+        new_state.cov, res.cov_f[-1], rtol=1e-8, atol=1e-10
+    )
+    reg2 = ModelRegistry(engine="joint")
+    reg2.put(new_state._replace(model_id="m1"), persist=False)
+    with MetranService(
+        reg2, flush_deadline=None, persist_updates=False
+    ) as svc2:
+        after = svc2.update("m1", obs)
+    assert after.chol is None  # stale factor dropped, not served
+
+
 def test_cancelled_request_does_not_break_batch():
     """A caller cancelling a queued future must not blow up the
     dispatch (an unguarded set_result on a cancelled future would kill

@@ -1,0 +1,283 @@
+"""Square-root (Cholesky-factor) engine: equivalence, PSD-by-construction,
+and the +inf rejectable-step guard.
+
+Three contracts (ISSUE 3):
+
+1. **Equivalence** — sequential sqrt and parallel sqrt reproduce the f64
+   covariance engines (filter, smoother, deviance, gradients) to tight
+   tolerance on identical matrices.
+2. **Robustness** — in float32, sqrt-engine filtered/smoothed covariance
+   factors stay finite and their reconstituted covariances PSD *by
+   construction* across every alpha regime of ``tests/test_precision.py``
+   including the near-unit-root cap regime — and pass the serving
+   integrity gate at ``psd_tol=0``.
+3. **Rejectable steps** — a non-finite filter path yields a ``+inf``
+   deviance (never NaN) in both covariance and sqrt engines, and an
+   L-BFGS run whose line search probes such a region recovers instead of
+   NaN-poisoning the fit.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.conftest import random_ssm
+
+from metran_tpu.ops import (
+    chol_outer,
+    deviance,
+    dfm_statespace,
+    kalman_filter,
+    rts_smoother,
+    sqrt_filter_append,
+    sqrt_filter_update,
+    sqrt_kalman_filter,
+    sqrt_parallel_deviance,
+    sqrt_parallel_filter,
+    sqrt_parallel_smoother,
+    sqrt_rts_smoother,
+)
+
+
+@pytest.fixture()
+def ssm(rng):
+    return random_ssm(rng, n_series=5, n_factors=2, t=120, missing=0.3)
+
+
+def test_sqrt_filter_matches_covariance_engines(ssm):
+    """Sequential sqrt ≡ parallel sqrt ≡ f64 covariance filter (the
+    engine-equivalence contract, factored representation included)."""
+    ss, y, mask = ssm
+    ref = kalman_filter(ss, y, mask, engine="joint")
+    sq = sqrt_kalman_filter(ss, y, mask)
+    psq = sqrt_parallel_filter(ss, y, mask)
+    for got in (sq, psq):
+        np.testing.assert_allclose(
+            np.asarray(got.mean_f), np.asarray(ref.mean_f), atol=1e-9
+        )
+        np.testing.assert_allclose(
+            np.asarray(chol_outer(got.chol_f)), np.asarray(ref.cov_f),
+            atol=1e-9,
+        )
+        np.testing.assert_allclose(
+            np.asarray(chol_outer(got.chol_p)), np.asarray(ref.cov_p),
+            atol=1e-9,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got.sigma), np.asarray(ref.sigma), atol=1e-9
+        )
+        np.testing.assert_allclose(
+            np.asarray(got.detf), np.asarray(ref.detf), atol=1e-9
+        )
+    # engine-name dispatch reconstitutes the same moments
+    via_engine = kalman_filter(ss, y, mask, engine="sqrt")
+    np.testing.assert_allclose(
+        np.asarray(via_engine.cov_f), np.asarray(ref.cov_f), atol=1e-9
+    )
+
+
+def test_sqrt_deviance_matches_engines(ssm):
+    ss, y, mask = ssm
+    want = float(deviance(ss, y, mask, warmup=1, engine="sequential"))
+    for engine in ("sqrt", "sqrt_parallel"):
+        got = float(deviance(ss, y, mask, warmup=1, engine=engine))
+        assert got == pytest.approx(want, rel=1e-10), engine
+    # the remat path (what fleet batch fits run) agrees exactly
+    got = float(deviance(ss, y, mask, warmup=1, engine="sqrt",
+                         remat_seg=32))
+    assert got == pytest.approx(want, rel=1e-10)
+    assert float(sqrt_parallel_deviance(ss, y, mask, warmup=1)) == (
+        pytest.approx(want, rel=1e-10)
+    )
+
+
+def test_sqrt_smoothers_match_covariance_smoother(ssm):
+    ss, y, mask = ssm
+    want = rts_smoother(ss, kalman_filter(ss, y, mask))
+    sq = sqrt_kalman_filter(ss, y, mask)
+    got_seq = sqrt_rts_smoother(ss, sq)
+    got_par = sqrt_parallel_smoother(ss, sqrt_parallel_filter(ss, y, mask))
+    for got in (got_seq, got_par):
+        np.testing.assert_allclose(
+            np.asarray(got.mean_s), np.asarray(want.mean_s), atol=1e-8
+        )
+        np.testing.assert_allclose(
+            np.asarray(chol_outer(got.chol_s)), np.asarray(want.cov_s),
+            atol=1e-8,
+        )
+    # rts_smoother dispatches on the factored result type
+    via = rts_smoother(ss, sq, engine="sqrt")
+    np.testing.assert_allclose(
+        np.asarray(via.cov_s), np.asarray(want.cov_s), atol=1e-8
+    )
+
+
+def test_sqrt_gradient_matches_sequential(ssm):
+    """The sequential sqrt engine is gradient-exact against the
+    covariance engines (it is the optimization engine; the parallel
+    sqrt engine's factored combine is value-exact but carries
+    documented O(1e-5) gradient noise from rank-deficient
+    re-triangularizations, see ops/pkalman.py)."""
+    _, y, mask = ssm
+    rng = np.random.default_rng(7)
+    n, k = 5, 2
+    loadings = jnp.asarray(rng.uniform(0.3, 0.8, (n, k)) / np.sqrt(k))
+
+    def dev(alpha, engine):
+        ss = dfm_statespace(alpha[:n], alpha[n:], loadings, 1.0)
+        return deviance(ss, y, mask, warmup=1, engine=engine)
+
+    alpha = jnp.asarray(rng.uniform(5.0, 40.0, n + k))
+    g_seq = jax.grad(lambda a: dev(a, "sequential"))(alpha)
+    g_sq = jax.grad(lambda a: dev(a, "sqrt"))(alpha)
+    np.testing.assert_allclose(
+        np.asarray(g_sq), np.asarray(g_seq), rtol=1e-9
+    )
+    g_rem = jax.grad(
+        lambda a: deviance(
+            dfm_statespace(a[:n], a[n:], loadings, 1.0), y, mask,
+            warmup=1, engine="sqrt", remat_seg=32,
+        )
+    )(alpha)
+    np.testing.assert_allclose(
+        np.asarray(g_rem), np.asarray(g_seq), rtol=1e-9
+    )
+
+
+def test_sqrt_update_append_match_full_filter(ssm):
+    """The factored online-assimilation entry points reproduce the full
+    filter's carry — the serving path's O(k) contract in sqrt form."""
+    ss, y, mask = ssm
+    full = sqrt_kalman_filter(ss, y, mask)
+    m0, c0 = full.mean_f[99], full.chol_f[99]
+    m1, c1, sigma, detf = sqrt_filter_update(ss, m0, c0, y[100], mask[100])
+    np.testing.assert_allclose(
+        np.asarray(m1), np.asarray(full.mean_f[100]), rtol=1e-12,
+        atol=1e-12,
+    )
+    np.testing.assert_allclose(
+        np.asarray(c1), np.asarray(full.chol_f[100]), rtol=1e-10,
+        atol=1e-12,
+    )
+    mT, cT, sig, det = sqrt_filter_append(
+        ss, m0, c0, y[100:], mask[100:]
+    )
+    np.testing.assert_allclose(
+        np.asarray(mT), np.asarray(full.mean_f[-1]), atol=1e-12
+    )
+    np.testing.assert_allclose(
+        np.asarray(cT), np.asarray(full.chol_f[-1]), atol=1e-12
+    )
+    np.testing.assert_allclose(
+        np.asarray(sig), np.asarray(full.sigma[100:]), atol=1e-12
+    )
+    # covariance-form filter_update refuses the sqrt engine loudly
+    from metran_tpu.ops import filter_update
+
+    with pytest.raises(ValueError, match="sqrt_filter_update"):
+        filter_update(ss, m0, chol_outer(c0), y[100], mask[100],
+                      engine="sqrt")
+
+
+@pytest.mark.precision
+def test_sqrt_f32_factors_finite_and_psd_all_regimes():
+    """Property: in float32, sqrt-engine filtered and smoothed factors
+    stay finite across ALL alpha regimes of tests/test_precision.py —
+    including the near-unit-root cap regime — and the reconstituted
+    covariances are PSD by construction (they pass the serving
+    integrity gate at ``psd_tol=0`` exactly).  A short series length
+    suffices: the failure mode under test is per-step factorization
+    collapse, not accumulation."""
+    from tests.test_precision import ALPHAS, N, make_flagship
+
+    from metran_tpu.serve.engine import posterior_fault
+
+    y, mask, loadings = make_flagship()
+    y, mask = y[:400], mask[:400]
+    for regime, alpha in ALPHAS.items():
+        a = jnp.asarray(alpha, jnp.float32)
+        ss = dfm_statespace(
+            a[:N], a[N:], jnp.asarray(loadings, jnp.float32), 1.0
+        )
+        sq = sqrt_kalman_filter(ss, jnp.asarray(y, jnp.float32), mask)
+        sm = sqrt_rts_smoother(ss, sq)
+        for name, factor in [
+            ("chol_p", sq.chol_p), ("chol_f", sq.chol_f),
+            ("chol_s", sm.chol_s),
+        ]:
+            arr = np.asarray(factor)
+            assert arr.dtype == np.float32, (regime, name)
+            assert np.isfinite(arr).all(), (regime, name)
+        # PSD by construction: the final posterior passes the serving
+        # gate with zero tolerance (what engine="sqrt" serving relies
+        # on; a covariance-form filter pass cannot promise this)
+        fault = posterior_fault(
+            np.asarray(sq.mean_f[-1]),
+            np.asarray(chol_outer(sq.chol_f[-1])),
+            psd_tol=0.0,
+            chol=np.asarray(sq.chol_f[-1]),
+        )
+        assert fault is None, (regime, fault)
+        # and the f32 factors are true factors: their exact (f64)
+        # products are PSD to Gram-matrix roundoff — the property a
+        # covariance-form f32 filter pass does not have
+        for l in np.asarray(sm.chol_s[::50], np.float64):
+            c = l @ l.T
+            w = np.linalg.eigvalsh(c)
+            scale = max(1.0, float(np.abs(c).max()))
+            assert w.min() >= -1e-12 * scale, regime
+
+
+def test_nonfinite_step_yields_inf_deviance_all_engines(ssm):
+    """An innovation covariance that cannot factor (here: forced
+    indefinite via negative observation noise) books a ``+inf``
+    deviance — a rejectable line-search value — in every engine,
+    instead of the NaN the raw Cholesky used to emit."""
+    ss, y, mask = ssm
+    ss_bad = ss._replace(r=jnp.full(ss.r.shape, -2.0))
+    for engine in ("sequential", "joint", "sqrt", "parallel",
+                   "sqrt_parallel"):
+        d = float(deviance(ss_bad, y, mask, engine=engine))
+        assert d == np.inf, engine  # +inf exactly; NaN would fail here
+    # remat path too (the fleet-fit configuration)
+    assert float(
+        deviance(ss_bad, y, mask, engine="joint", remat_seg=32)
+    ) == np.inf
+
+
+def test_lbfgs_recovers_from_nonfinite_linesearch_probe():
+    """Regression for the rejectable-step contract: minimizing the
+    deviance over an UNCONSTRAINED alpha (no positivity transform), the
+    very first L-BFGS line search overshoots into alpha < 0 — where
+    phi = exp(-1/alpha) > 1 and the process variance is negative, a
+    region whose deviance used to come back NaN and poison the
+    optimizer state.  With the +inf guard the step is rejected, the
+    line search backs off, and the fit converges to a finite optimum.
+    """
+    from metran_tpu.models.solver import run_lbfgs
+
+    rng = np.random.default_rng(3)
+    n, k, t = 4, 1, 160
+    loadings = jnp.asarray(rng.uniform(0.4, 0.7, (n, k)))
+    mask = rng.uniform(size=(t, n)) > 0.2
+    mask[0] = False
+    y = jnp.asarray(np.where(mask, rng.normal(size=(t, n)), 0.0))
+    mask = jnp.asarray(mask)
+
+    def objective(alpha):
+        ss = dfm_statespace(alpha[:n], alpha[n:], loadings, 1.0)
+        return deviance(ss, y, mask, warmup=1, engine="sqrt")
+
+    # start close above zero so the unit-step probe lands negative
+    alpha0 = jnp.full(n + k, 1.5)
+    probe = alpha0 - 1.0 * jax.grad(objective)(alpha0)
+    assert float(jnp.min(probe)) < 0.0  # the overshoot really happens
+    assert float(objective(probe)) == np.inf  # and it is +inf, not NaN
+    theta, value, iters, nfev, converged = run_lbfgs(
+        objective, alpha0, maxiter=300
+    )
+    assert np.isfinite(float(value))
+    assert float(value) <= float(objective(alpha0))
+    assert bool(converged)
+    assert np.all(np.asarray(theta) > 0)
