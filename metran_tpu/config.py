@@ -128,6 +128,24 @@ SERVE_VALIDATE_UPDATES = 1  # per-slot posterior finiteness/PSD checks
 SERVE_ENGINE = "joint"  # assimilation kernel; "sqrt" = square-root
 #                         serving (factored posteriors, PSD by
 #                         construction — the robust f32 choice)
+# observability defaults (metran_tpu.obs wired into MetranService)
+OBS_TRACE = 0  # request-scoped span tracing (metrics/events stay on)
+OBS_TRACE_BUFFER = 4096  # finished spans kept in the tracer ring
+OBS_EVENT_BUFFER = 2048  # reliability events kept in the log ring
+OBS_EVENT_SINK = ""  # JSON-lines file sink path ("" = ring only)
+
+
+def _env(name, cast, default):
+    """One env-var override: ``cast(value)`` when set and parsable,
+    ``default`` otherwise (unparsable values warn and fall back)."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return cast(raw)
+    except ValueError:
+        logger.warning("ignoring unparsable %s=%r", name, raw)
+        return default
 
 
 def serve_defaults() -> dict:
@@ -139,16 +157,6 @@ def serve_defaults() -> dict:
     :class:`~metran_tpu.serve.ModelRegistry` /
     :class:`~metran_tpu.serve.MetranService` construction.
     """
-
-    def _env(name, cast, default):
-        raw = os.environ.get(name)
-        if raw is None or raw == "":
-            return default
-        try:
-            return cast(raw)
-        except ValueError:
-            logger.warning("ignoring unparsable %s=%r", name, raw)
-            return default
 
     return {
         "flush_deadline_s": _env(
@@ -185,6 +193,30 @@ def serve_defaults() -> dict:
         ),
         "engine": _env(
             "METRAN_TPU_SERVE_ENGINE", str, SERVE_ENGINE
+        ),
+    }
+
+
+def obs_defaults() -> dict:
+    """Observability knobs, each overridable via ``METRAN_TPU_OBS_*``.
+
+    ``trace`` arms request-scoped span tracing (metrics and the event
+    ring are always on — they are allocation-light; tracing adds a
+    handful of timestamped records per request, so it is the one knob
+    that defaults OFF).  Read at
+    :meth:`metran_tpu.obs.Observability.default`.
+    """
+
+    return {
+        "trace": _env("METRAN_TPU_OBS_TRACE", int, OBS_TRACE),
+        "trace_buffer": _env(
+            "METRAN_TPU_OBS_TRACE_BUFFER", int, OBS_TRACE_BUFFER
+        ),
+        "event_buffer": _env(
+            "METRAN_TPU_OBS_EVENT_BUFFER", int, OBS_EVENT_BUFFER
+        ),
+        "event_sink": os.environ.get(
+            "METRAN_TPU_OBS_EVENT_SINK", OBS_EVENT_SINK
         ),
     }
 

@@ -993,7 +993,17 @@ class Metran:
                 "zero — treat the affected\nstderr values as "
                 "unreliable (flat or degenerate optimum)."
             )
-        return header + basic + block + correlations + note
+        tele = ""
+        telemetry = getattr(self.fit, "telemetry", None)
+        if telemetry is not None and telemetry.stop_reason is not None:
+            # why the optimizer stopped (metran_tpu.obs.FitTelemetry):
+            # stop reason, checkpointed deviance drop, gradient norm,
+            # line-search stalls, divergence diagnosis when any
+            tele = (
+                "\n\nFit telemetry\n" + "=" * width + "\n"
+                + telemetry.summary()
+            )
+        return header + basic + block + correlations + note + tele
 
     def metran_report(self, output: str = "full") -> str:
         """Factor analysis, communality, state/observation parameters

@@ -81,6 +81,10 @@ class BaseSolver:
         # True when the parameter covariance had negative variances
         # (clipped to zero in _finalize; surfaced in the fit report)
         self.nonpsd_pcov: bool = False
+        # per-fit optimizer trajectory (metran_tpu.obs.FitTelemetry):
+        # filled by solvers that run through run_lbfgs (JaxSolve);
+        # surfaced by Metran.fit_report()
+        self.telemetry = None
 
     # -- objective ------------------------------------------------------
     def objfunction(self, p, callback: Optional[Callable] = None) -> float:
@@ -274,10 +278,13 @@ class JaxSolve(BaseSolver):
             return dev_full(full)
 
         theta0 = transform.inverse(jnp.asarray(self.initial[self.vary]))
+        from ..obs.telemetry import FitTelemetry
+
+        self.telemetry = FitTelemetry()
         try:
             theta, value, _iters, nfev, converged = run_lbfgs(
                 objective, theta0, maxiter=maxiter, tol=tol,
-                raise_on_divergence=True,
+                raise_on_divergence=True, telemetry=self.telemetry,
             )
         except SolverDivergenceError as exc:
             # name the offending parameters (data units, table order)
@@ -491,8 +498,14 @@ def default_ftol(dtype) -> float:
 
 def run_lbfgs(objective, theta0, maxiter: int = 200,
               tol: Optional[float] = None, ftol: Optional[float] = None,
-              raise_on_divergence: bool = False):
+              raise_on_divergence: bool = False, telemetry=None):
     """Chunked optax L-BFGS loop with dtype-aware stopping.
+
+    ``telemetry`` (a :class:`metran_tpu.obs.FitTelemetry`) records the
+    run's trajectory at zero device cost — one checkpoint per host-side
+    convergence check (deviance, gradient norm, nfev), the precise stop
+    reason, line-search stall counts and any divergence diagnosis —
+    surfaced by ``Metran.fit_report()``.
 
     Returns ``(theta, value, n_iters, nfev, converged)`` where ``nfev``
     counts true objective evaluations (scipy-comparable).  ``converged``
@@ -543,7 +556,17 @@ def run_lbfgs(objective, theta0, maxiter: int = 200,
         # is already non-finite diagnoses immediately, and no stopping
         # test may report success at a value worse than this
         value0 = float(objective(theta0))
+        if telemetry is not None:
+            telemetry.record_start(value0)
         if not _np.isfinite(value0):
+            if telemetry is not None:
+                telemetry.record_stop(
+                    "init_nonfinite", False,
+                    divergence=(
+                        "non-finite at the initial parameters "
+                        f"(value={value0!r})"
+                    ),
+                )
             if raise_on_divergence:
                 raise SolverDivergenceError(
                     "fit objective is non-finite at the initial "
@@ -557,12 +580,27 @@ def run_lbfgs(objective, theta0, maxiter: int = 200,
         theta, state, nfev = theta0, opt.init(theta0), 1
         prev_value = None
         converged = False
+        reason = "maxiter"
         while True:
             theta, state, nfev = advance(theta, state, nfev)
             value = float(otu.tree_get(state, "value"))
             count = int(otu.tree_get(state, "count"))
             gnorm = float(tree_norm(otu.tree_get(state, "grad")))
+            if telemetry is not None:
+                # one record per device chunk — the deviance curve and
+                # gradient-norm trail, at host-checkpoint granularity
+                telemetry.record_checkpoint(count, value, gnorm,
+                                            int(nfev))
             if not _np.isfinite(value):
+                reason = "diverged"
+                if telemetry is not None:
+                    telemetry.record_stop(
+                        "diverged", False,
+                        divergence=(
+                            f"value={value!r} after {count} L-BFGS "
+                            "iterations"
+                        ),
+                    )
                 if raise_on_divergence:
                     raise SolverDivergenceError(
                         f"fit objective became non-finite "
@@ -574,6 +612,7 @@ def run_lbfgs(objective, theta0, maxiter: int = 200,
                 break  # diverged — never report success
             if gnorm < tol:
                 converged = True
+                reason = "gradient"
                 break
             # floor stop: the value CHANGED by less than the resolution
             # tolerance across a whole chunk.  Two-sided on purpose — a
@@ -585,6 +624,7 @@ def run_lbfgs(objective, theta0, maxiter: int = 200,
                 <= ftol * max(abs(prev_value), abs(value), 1.0)
             ):
                 converged = True  # resolution-floor stop, factr-style
+                reason = "floor"
                 break
             if count >= maxiter:
                 break
@@ -596,6 +636,9 @@ def run_lbfgs(objective, theta0, maxiter: int = 200,
             # the iterates went uphill through line-search failure
             # fallbacks — that is a failed run, not an optimum
             converged = False
+            reason = "worse_than_start"
+        if telemetry is not None and reason != "diverged":
+            telemetry.record_stop(reason, converged)
     return (
         theta,
         otu.tree_get(state, "value"),

@@ -1,0 +1,122 @@
+"""End-to-end observability for the serving and fitting stacks.
+
+After PRs 1-3 the repo runs a multi-threaded serving pipeline
+(micro-batching, deferred ordering chains, circuit breakers,
+quarantine) whose behavior was invisible beyond ad-hoc counters.  This
+package is the unified layer that makes it observable — and the
+numbers it produces are what lets an operator trade accuracy, batching
+and engine choice against latency (the computation-aware filtering
+argument, arXiv:2405.08971):
+
+- :mod:`~metran_tpu.obs.metrics` — :class:`MetricsRegistry`: one
+  thread-safe home for counters/gauges/histograms with ``snapshot()``
+  and Prometheus text exposition; the serving instruments
+  (:class:`LatencyRecorder`, :class:`EventCounters`,
+  :class:`OccupancyCounter`) are registry-backed.
+- :mod:`~metran_tpu.obs.tracing` — :class:`Tracer`: request-scoped
+  spans under one correlation ID from submit through batcher wait,
+  dispatch, engine, integrity gate and commit — across the batcher
+  thread boundary and the deferred-chain/retry paths — exported as
+  Chrome trace-event JSON (Perfetto-compatible).
+- :mod:`~metran_tpu.obs.events` — :class:`EventLog`: a bounded
+  structured JSON-lines log of attributed reliability events (breaker
+  transitions, quarantines, retries, chain breaks, poisoned updates),
+  post-mortem-reconstructable per model.
+- :mod:`~metran_tpu.obs.telemetry` — :class:`FitTelemetry`: per-fit
+  optimizer trajectory (deviance curve, gradient norms, stop reason)
+  surfaced in ``fit_report()``.
+
+:class:`Observability` bundles the three serving-side pieces for
+injection into :class:`~metran_tpu.serve.MetranService`; defaults come
+from :func:`metran_tpu.config.obs_defaults` (``METRAN_TPU_OBS_*``
+environment knobs).  See docs/concepts.md "Observability" for the
+metric-name catalogue, the span map and the event schema.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from .events import EventLog
+from .metrics import (
+    DEFAULT_LATENCY_BUCKETS,
+    DEFAULT_SIZE_BUCKETS,
+    Counter,
+    EventCounters,
+    Gauge,
+    Histogram,
+    LatencyRecorder,
+    MetricsRegistry,
+    OccupancyCounter,
+)
+from .telemetry import FitTelemetry
+from .tracing import Span, SpanContext, Tracer, current_trace_id
+
+
+@dataclass
+class Observability:
+    """The serving stack's observability bundle (inject into
+    :class:`~metran_tpu.serve.MetranService`).
+
+    Any component may be ``None`` — the corresponding instrumentation
+    then compiles down to an ``is None`` check on the hot path.
+    :meth:`default` builds the configured default (metrics + event
+    ring always on — they are cheap; tracing opt-in via
+    ``METRAN_TPU_OBS_TRACE=1`` or an explicit :class:`Tracer`);
+    :meth:`disabled` turns everything off (the bench baseline).
+    """
+
+    metrics: Optional[MetricsRegistry] = None
+    tracer: Optional[Tracer] = None
+    events: Optional[EventLog] = None
+
+    @classmethod
+    def default(cls) -> "Observability":
+        """Config-driven default (see :func:`metran_tpu.config.
+        obs_defaults`)."""
+        from ..config import obs_defaults
+
+        d = obs_defaults()
+        return cls(
+            metrics=MetricsRegistry(),
+            tracer=(
+                Tracer(maxlen=d["trace_buffer"]) if d["trace"] else None
+            ),
+            events=EventLog(
+                maxlen=d["event_buffer"],
+                sink=d["event_sink"] or None,
+            ),
+        )
+
+    @classmethod
+    def disabled(cls) -> "Observability":
+        """No instrumentation at all (overhead-measurement baseline)."""
+        return cls(metrics=None, tracer=None, events=None)
+
+    def render_prometheus(self) -> str:
+        """Exposition text of the bundled registry ("" when none)."""
+        return (
+            self.metrics.render_prometheus()
+            if self.metrics is not None else ""
+        )
+
+
+__all__ = [
+    "Counter",
+    "DEFAULT_LATENCY_BUCKETS",
+    "DEFAULT_SIZE_BUCKETS",
+    "EventCounters",
+    "EventLog",
+    "FitTelemetry",
+    "Gauge",
+    "Histogram",
+    "LatencyRecorder",
+    "MetricsRegistry",
+    "Observability",
+    "OccupancyCounter",
+    "Span",
+    "SpanContext",
+    "Tracer",
+    "current_trace_id",
+]

@@ -1,0 +1,193 @@
+"""Structured, attributed reliability-event log (JSON lines).
+
+Counters say *how many* breaker trips or quarantines happened; a
+post-mortem needs *which model, which request, in what order, why*.
+The :class:`EventLog` is the serving stack's flight recorder: every
+reliability event — breaker ``open``/``half_open``/``closed``
+transitions, quarantines, retries, chain breaks, poisoned-update
+rejections, deadline hits — is emitted as one structured record::
+
+    {"ts": 1722772800.123, "kind": "breaker_open", "model_id": "m7",
+     "request_id": "3f2a-00000004", "fault_point": "serve.dispatch",
+     "detail": {"previous": "closed", "failures": 5}}
+
+``model_id`` + ``request_id`` (the tracing correlation ID when tracing
+is on) + ``fault_point`` (the named code location, matching
+``reliability.faultinject`` point names where one exists) make the log
+joinable against traces and metrics: a model's outage reconstructs
+from ``log.for_model("m7")`` alone — breaker opened after N rejected
+updates at the integrity gate, cooled down, probe succeeded, closed.
+
+Storage is a bounded ring buffer (memory-safe for long-lived services)
+plus an optional append-only JSON-lines **file sink** flushed per
+event, so a crash loses nothing that was emitted.  A sink write
+failure disables the sink (and logs once) rather than ever failing the
+serving path — telemetry must not take down what it observes.
+
+Stdlib-only, thread-safe; one ``emit()`` is a dict build, a deque
+append and (with a sink) one buffered write.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import threading
+import time
+from collections import deque
+from logging import getLogger
+from typing import Dict, List, Optional
+
+logger = getLogger(__name__)
+
+
+class EventLog:
+    """Bounded structured event ring with optional JSON-lines sink.
+
+    Parameters
+    ----------
+    maxlen : events kept in memory (oldest dropped).
+    sink : a path (opened append-mode) or an open text file-like; each
+        event is written as one JSON line and flushed.  ``None``
+        disables the sink (ring buffer only).
+    clock : epoch-seconds time source (injectable for tests).
+    """
+
+    def __init__(self, maxlen: int = 2048, sink=None,
+                 clock=time.time):
+        self._events: "deque[dict]" = deque(maxlen=int(maxlen))
+        self._lock = threading.Lock()
+        self._clock = clock
+        self._counts: Dict[str, int] = {}
+        self.dropped = 0  # events pushed out of the ring (lifetime)
+        self._sink = None
+        self._owns_sink = False
+        if sink is not None:
+            if isinstance(sink, (str, bytes)) or hasattr(sink, "__fspath__"):
+                try:
+                    self._sink = open(sink, "a", encoding="utf-8")
+                    self._owns_sink = True
+                except OSError:
+                    # degrade-don't-fail, same contract as a write
+                    # failure: an unwritable sink path must not stop
+                    # the service this log observes from constructing
+                    logger.exception(
+                        "event-log sink %r could not be opened; "
+                        "continuing with the in-memory ring only", sink,
+                    )
+            else:
+                self._sink = sink
+
+    def emit(self, kind: str, model_id: Optional[str] = None,
+             request_id: Optional[str] = None,
+             fault_point: Optional[str] = None, **detail) -> dict:
+        """Record one event; returns the record (a plain dict).
+
+        ``request_id`` defaults to the caller thread's active tracing
+        correlation ID, so events emitted on the request path join the
+        trace without explicit plumbing; cross-thread emitters (the
+        dispatch path) pass it explicitly.
+        """
+        if request_id is None:
+            from .tracing import current_trace_id
+
+            request_id = current_trace_id()
+        event = {
+            "ts": float(self._clock()),
+            "kind": str(kind),
+            "model_id": model_id,
+            "request_id": request_id,
+            "fault_point": fault_point,
+            "detail": detail,
+        }
+        line = None
+        with self._lock:
+            if len(self._events) == self._events.maxlen:
+                self.dropped += 1
+            self._events.append(event)
+            self._counts[event["kind"]] = (
+                self._counts.get(event["kind"], 0) + 1
+            )
+            sink = self._sink
+            if sink is not None:
+                try:
+                    line = json.dumps(event, default=repr)
+                except (TypeError, ValueError):  # exotic detail payload
+                    safe = dict(event, detail=repr(detail))
+                    line = json.dumps(safe)
+        if sink is not None and line is not None:
+            try:
+                sink.write(line + "\n")
+                sink.flush()
+            except (OSError, ValueError, io.UnsupportedOperation):
+                # a full disk / closed file must degrade the sink, not
+                # the serving path that emitted the event
+                with self._lock:
+                    self._sink = None
+                    owns, self._owns_sink = self._owns_sink, False
+                if owns:
+                    try:
+                        sink.close()  # release the fd we opened
+                    except (OSError, ValueError):
+                        pass
+                logger.exception(
+                    "event-log sink failed; disabling the file sink "
+                    "(in-memory ring continues)"
+                )
+        return event
+
+    # -- read -----------------------------------------------------------
+    def tail(self, n: int = 50) -> List[dict]:
+        """The most recent ``n`` events, oldest first."""
+        with self._lock:
+            events = list(self._events)
+        return events[-int(n):]
+
+    def snapshot(self) -> List[dict]:
+        """Every buffered event, oldest first."""
+        with self._lock:
+            return list(self._events)
+
+    def for_model(self, model_id: str) -> List[dict]:
+        """One model's buffered events, oldest first — the post-mortem
+        view (see module docstring)."""
+        with self._lock:
+            return [
+                e for e in self._events if e["model_id"] == model_id
+            ]
+
+    def for_request(self, request_id: str) -> List[dict]:
+        """Events attributed to one correlation ID, oldest first."""
+        with self._lock:
+            return [
+                e for e in self._events if e["request_id"] == request_id
+            ]
+
+    def counts(self) -> Dict[str, int]:
+        """Lifetime event totals by kind (survives ring eviction)."""
+        with self._lock:
+            return dict(self._counts)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def close(self) -> None:
+        """Close a sink this log opened itself (path-constructed)."""
+        with self._lock:
+            sink, self._sink = self._sink, None
+            owns, self._owns_sink = self._owns_sink, False
+        if sink is not None and owns:
+            try:
+                sink.close()
+            except OSError:  # pragma: no cover - close-on-full-disk
+                pass
+
+    def __enter__(self) -> "EventLog":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+__all__ = ["EventLog"]

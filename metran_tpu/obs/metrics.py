@@ -1,0 +1,697 @@
+"""Unified metrics: one thread-safe registry, Prometheus exposition.
+
+Before this module every subsystem kept its own ad-hoc counters
+(``ServeMetrics`` latency recorders, the registry's integrity
+``EventCounters``, ``CompiledFnCache.hits/misses``) with no single
+place an operator — or a scrape endpoint — could read them from.  The
+:class:`MetricsRegistry` is that place: **counters** (monotone totals),
+**gauges** (instantaneous values, optionally computed by a callback at
+collection time) and **fixed-bucket histograms** (latency/size
+distributions), each registered once by name, collected together via
+:meth:`MetricsRegistry.snapshot` (nested dict, JSON-ready) or
+:meth:`MetricsRegistry.render_prometheus` (the Prometheus text
+exposition format, ready to serve from any HTTP handler).
+
+The serving instruments — :class:`LatencyRecorder`,
+:class:`EventCounters`, :class:`OccupancyCounter`, historically in
+``metran_tpu.utils.profiling`` (aliases remain there) — live here and
+are *registry-backed*: constructed with ``registry=``/``name=`` they
+mirror every observation into the shared registry (a histogram for
+latencies and batch sizes, a ``kind``-labelled counter family for
+events) while keeping their original standalone behavior — exact
+percentiles from bounded sample windows, lifetime totals — so existing
+callers see no change.
+
+Metric names follow the Prometheus conventions this package enforces
+(``tools/check_metrics.py``): snake_case, ``_total`` suffix on counter
+families, ``_seconds`` on time histograms.  The full name catalogue is
+in docs/concepts.md ("Observability").
+
+Everything here is stdlib-only and allocation-light: instruments sit on
+the serving hot path.
+"""
+
+from __future__ import annotations
+
+import bisect
+import contextlib
+import math
+import re
+import threading
+import time
+from dataclasses import dataclass, field
+from logging import getLogger
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+logger = getLogger(__name__)
+
+# Prometheus allows [a-zA-Z_:][a-zA-Z0-9_:]*; this package additionally
+# requires plain snake_case (no colons — those are reserved for
+# recording rules — and no capitals), which check_metrics.py enforces
+# statically over the whole package.
+_NAME_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
+_LABEL_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
+
+#: default buckets for request-latency histograms (seconds): sub-ms
+#: through 10 s, roughly log-spaced — micro-batched serve latencies sit
+#: in the 0.5-50 ms range on CPU, lower on a real accelerator.
+DEFAULT_LATENCY_BUCKETS: Tuple[float, ...] = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+#: default buckets for batch-size histograms (powers of two up to the
+#: default ``max_batch``).
+DEFAULT_SIZE_BUCKETS: Tuple[float, ...] = (
+    1, 2, 4, 8, 16, 32, 64, 128, 256,
+)
+
+
+def _format_value(v: float) -> str:
+    """Prometheus sample-value formatting: integral floats render as
+    integers, everything else as repr (NaN/Inf as ``NaN``/``+Inf``)."""
+    f = float(v)
+    if math.isnan(f):
+        return "NaN"
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _escape_label(v: str) -> str:
+    return (
+        str(v).replace("\\", r"\\").replace("\n", r"\n").replace('"', r"\"")
+    )
+
+
+def _escape_help(v: str) -> str:
+    return str(v).replace("\\", r"\\").replace("\n", r"\n")
+
+
+class _Metric:
+    """Shared shape of every instrument: name, help, label names, lock."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "",
+                 label_names: Tuple[str, ...] = ()):
+        self.name = name
+        self.help = help
+        self.label_names = tuple(label_names)
+        for ln in self.label_names:
+            if not _LABEL_RE.match(ln):
+                raise ValueError(
+                    f"label name {ln!r} of metric {name!r} is not "
+                    "snake_case"
+                )
+        self._lock = threading.Lock()
+
+    def _key(self, labels: Dict[str, str]) -> Tuple[str, ...]:
+        if set(labels) != set(self.label_names):
+            raise ValueError(
+                f"metric {self.name!r} takes labels {self.label_names}, "
+                f"got {tuple(sorted(labels))}"
+            )
+        return tuple(str(labels[ln]) for ln in self.label_names)
+
+
+class Counter(_Metric):
+    """Monotone total, optionally split by a fixed label set.
+
+    >>> c = registry.counter("metran_serve_errors_total",
+    ...                      "errors by kind", label_names=("kind",))
+    >>> c.inc(kind="retries")
+    >>> c.value(kind="retries")
+    1.0
+    """
+
+    kind = "counter"
+
+    def __init__(self, name, help="", label_names=()):
+        super().__init__(name, help, label_names)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def inc(self, n: float = 1, **labels) -> None:
+        if n < 0:
+            raise ValueError(
+                f"counter {self.name!r} cannot decrease (n={n}); use a "
+                "gauge for values that go down"
+            )
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + n
+
+    def value(self, **labels) -> float:
+        key = self._key(labels)
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def collect(self) -> dict:
+        with self._lock:
+            values = dict(self._values)
+        if not self.label_names:
+            return {"value": values.get((), 0.0)}
+        return {
+            "values": {
+                ",".join(f"{ln}={lv}" for ln, lv in zip(self.label_names, k)):
+                v for k, v in sorted(values.items())
+            }
+        }
+
+    def _samples(self) -> List[Tuple[str, Dict[str, str], float]]:
+        with self._lock:
+            values = dict(self._values)
+        if not self.label_names:
+            return [(self.name, {}, values.get((), 0.0))]
+        return [
+            (self.name, dict(zip(self.label_names, k)), v)
+            for k, v in sorted(values.items())
+        ]
+
+
+class Gauge(_Metric):
+    """Instantaneous value; set directly or computed by a callback.
+
+    A ``callback`` (zero-argument callable returning a number) is
+    evaluated at collection time — the natural fit for values another
+    object already tracks (queue depth, cache residency, a sliding
+    window's error rate) so no code has to remember to push updates.  A
+    callback that raises yields ``NaN`` for that scrape rather than
+    killing the exposition.
+    """
+
+    kind = "gauge"
+
+    def __init__(self, name, help="", label_names=(),
+                 callback: Optional[Callable[[], float]] = None):
+        super().__init__(name, help, label_names)
+        if callback is not None and label_names:
+            raise ValueError(
+                f"gauge {name!r}: callbacks are only supported on "
+                "unlabelled gauges"
+            )
+        self._values: Dict[Tuple[str, ...], float] = {}
+        self._callback = callback
+
+    def set(self, v: float, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = float(v)
+
+    def inc(self, n: float = 1, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + n
+
+    def dec(self, n: float = 1, **labels) -> None:
+        self.inc(-n, **labels)
+
+    def value(self, **labels) -> float:
+        if self._callback is not None:
+            try:
+                return float(self._callback())
+            except Exception:
+                logger.exception("gauge callback %r failed", self.name)
+                return float("nan")
+        key = self._key(labels)
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def collect(self) -> dict:
+        if self._callback is not None or not self.label_names:
+            return {"value": self.value()}
+        with self._lock:
+            values = dict(self._values)
+        return {
+            "values": {
+                ",".join(f"{ln}={lv}" for ln, lv in zip(self.label_names, k)):
+                v for k, v in sorted(values.items())
+            }
+        }
+
+    def _samples(self) -> List[Tuple[str, Dict[str, str], float]]:
+        if self._callback is not None or not self.label_names:
+            return [(self.name, {}, self.value())]
+        with self._lock:
+            values = dict(self._values)
+        return [
+            (self.name, dict(zip(self.label_names, k)), v)
+            for k, v in sorted(values.items())
+        ]
+
+
+class Histogram(_Metric):
+    """Fixed-bucket distribution (unlabelled; buckets chosen at
+    registration).
+
+    Exposes the Prometheus histogram triplet: cumulative
+    ``{name}_bucket{le="..."}`` counts (closing with ``le="+Inf"``),
+    ``{name}_sum`` and ``{name}_count``.  Quantile *estimates* come
+    from the buckets at scrape time; exact recent percentiles remain
+    the job of :class:`LatencyRecorder`'s sample window.
+    """
+
+    kind = "histogram"
+
+    def __init__(self, name, help="",
+                 buckets: Tuple[float, ...] = DEFAULT_LATENCY_BUCKETS):
+        super().__init__(name, help, ())
+        bounds = tuple(sorted(float(b) for b in buckets))
+        if not bounds:
+            raise ValueError(f"histogram {name!r} needs at least 1 bucket")
+        if any(b != b or math.isinf(b) for b in bounds):
+            raise ValueError(
+                f"histogram {name!r}: finite bucket bounds only "
+                "(+Inf is implicit)"
+            )
+        self.buckets = bounds
+        self._counts = [0] * (len(bounds) + 1)  # last slot: > max bound
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        # first bound with v <= bound (== the `le` bucket); C bisect —
+        # this runs once per served request via LatencyRecorder
+        i = bisect.bisect_left(self.buckets, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def collect(self) -> dict:
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self._count, self._sum
+        cum = 0
+        out = []
+        for bound, c in zip(self.buckets, counts):
+            cum += c
+            out.append({"le": bound, "count": cum})
+        out.append({"le": float("inf"), "count": total})
+        return {"buckets": out, "sum": s, "count": total}
+
+
+class MetricsRegistry:
+    """Thread-safe, get-or-create registry of named instruments.
+
+    Registration is idempotent: asking for an existing name returns the
+    existing instrument when the type (and label set) matches, so every
+    subsystem can declare the metrics it publishes without coordination
+    — and raises when it does not, so two subsystems can never silently
+    share one name for different things.  Re-registering a callback
+    gauge rebinds the callback (a fresh service attached to a long-lived
+    registry must read the *new* object's state).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: "Dict[str, _Metric]" = {}
+
+    # -- registration ---------------------------------------------------
+    def _register(self, factory: Callable[[], _Metric], name: str,
+                  kind: str, label_names: Tuple[str, ...]) -> _Metric:
+        if not _NAME_RE.match(name):
+            raise ValueError(
+                f"metric name {name!r} is not snake_case "
+                "([a-z_][a-z0-9_]*)"
+            )
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if existing.kind != kind or (
+                    tuple(existing.label_names) != tuple(label_names)
+                ):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{existing.kind}{existing.label_names} "
+                        f"(requested {kind}{tuple(label_names)})"
+                    )
+                return existing
+            metric = factory()
+            self._metrics[name] = metric
+            return metric
+
+    def counter(self, name: str, help: str = "",
+                label_names: Tuple[str, ...] = ()) -> Counter:
+        return self._register(
+            lambda: Counter(name, help, label_names), name, "counter",
+            tuple(label_names),
+        )
+
+    def gauge(self, name: str, help: str = "",
+              label_names: Tuple[str, ...] = (),
+              callback: Optional[Callable[[], float]] = None) -> Gauge:
+        g = self._register(
+            lambda: Gauge(name, help, label_names, callback=callback),
+            name, "gauge", tuple(label_names),
+        )
+        if callback is not None and g._callback is not callback:
+            g._callback = callback  # rebind (see class docstring)
+        return g
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Tuple[float, ...] = DEFAULT_LATENCY_BUCKETS,
+                  ) -> Histogram:
+        h = self._register(
+            lambda: Histogram(name, help, buckets), name, "histogram", ()
+        )
+        if tuple(h.buckets) != tuple(sorted(float(b) for b in buckets)):
+            raise ValueError(
+                f"histogram {name!r} already registered with buckets "
+                f"{h.buckets}"
+            )
+        return h
+
+    # -- read -----------------------------------------------------------
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    def __contains__(self, name: str) -> bool:
+        with self._lock:
+            return name in self._metrics
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._metrics)
+
+    def snapshot(self) -> Dict[str, dict]:
+        """Every metric's current value(s) as one nested, JSON-ready
+        dict — the programmatic twin of :meth:`render_prometheus`."""
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+        out: Dict[str, dict] = {}
+        for name, m in metrics:
+            entry = {"type": m.kind, "help": m.help}
+            entry.update(m.collect())
+            out[name] = entry
+        return out
+
+    def render_prometheus(self) -> str:
+        """The Prometheus text exposition format (version 0.0.4).
+
+        Deterministic: metrics sorted by name, label sets sorted, one
+        ``# HELP``/``# TYPE`` pair per metric family preceding its
+        samples.  Serve it from any HTTP handler with content type
+        ``text/plain; version=0.0.4``.
+        """
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+        lines: List[str] = []
+        for name, m in metrics:
+            lines.append(f"# HELP {name} {_escape_help(m.help)}")
+            lines.append(f"# TYPE {name} {m.kind}")
+            if isinstance(m, Histogram):
+                data = m.collect()
+                for b in data["buckets"]:
+                    le = (
+                        "+Inf" if math.isinf(b["le"])
+                        else _format_value(b["le"])
+                    )
+                    lines.append(
+                        f'{name}_bucket{{le="{le}"}} {b["count"]}'
+                    )
+                lines.append(f"{name}_sum {_format_value(data['sum'])}")
+                lines.append(f"{name}_count {data['count']}")
+                continue
+            for sname, labels, value in m._samples():
+                if labels:
+                    inner = ",".join(
+                        f'{k}="{_escape_label(v)}"'
+                        for k, v in sorted(labels.items())
+                    )
+                    lines.append(f"{sname}{{{inner}}} {_format_value(value)}")
+                else:
+                    lines.append(f"{sname} {_format_value(value)}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+# ----------------------------------------------------------------------
+# registry-backed serving instruments (back-compat aliases live in
+# metran_tpu.utils.profiling, their historical home)
+# ----------------------------------------------------------------------
+@dataclass
+class LatencyRecorder:
+    """Per-request latency samples with percentile summaries.
+
+    The serving layer's request-path instrument (``metran_tpu.serve``):
+    record wall seconds per request, read p50/p99 — the numbers a
+    latency SLO is written against.  Bounded memory: beyond ``maxlen``
+    samples the oldest half is dropped (quantiles then describe recent
+    traffic, which is what an operator wants from a live service).
+    Thread-safe: the serving layer records from several dispatch
+    threads at once (background flusher + size-triggered submitters),
+    and an unlocked truncation racing an append would drop samples.
+
+    Registry-backed when constructed with ``registry=``/``name=``:
+    every sample is additionally observed into a fixed-bucket
+    :class:`Histogram` of that name (``DEFAULT_LATENCY_BUCKETS``), so
+    the exposition endpoint carries the full distribution while the
+    exact recent percentiles stay here.
+    """
+
+    unit: str = "s"
+    maxlen: int = 100_000
+    samples: List[float] = field(default_factory=list)
+    total: int = 0
+    registry: Optional[MetricsRegistry] = field(
+        default=None, repr=False, compare=False
+    )
+    name: Optional[str] = None
+    help: str = ""
+    _lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False, compare=False
+    )
+
+    def __post_init__(self):
+        self._hist = (
+            self.registry.histogram(
+                self.name, self.help or "request latency (seconds)"
+            )
+            if self.registry is not None and self.name else None
+        )
+
+    def record(self, seconds: float) -> None:
+        with self._lock:
+            self.samples.append(float(seconds))
+            self.total += 1
+            if len(self.samples) > self.maxlen:
+                del self.samples[: len(self.samples) // 2]
+        if self._hist is not None:
+            self._hist.observe(seconds)
+
+    def reset(self) -> None:
+        """Forget the recorded samples (``total`` and the backing
+        registry histogram keep their lifetime counts) — percentiles
+        then describe traffic recorded after the reset.  Used to drop
+        warm-up/compile laps from a measurement window."""
+        with self._lock:
+            self.samples.clear()
+
+    @contextlib.contextmanager
+    def measure(self) -> Iterator[None]:
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(time.perf_counter() - start)
+
+    def percentile(self, q: float) -> float:
+        """q in [0, 100]; 0.0 when nothing has been recorded."""
+        with self._lock:  # snapshot only — sort outside, off the
+            samples = list(self.samples)  # dispatch threads' lock
+        if not samples:
+            return 0.0
+        ordered = sorted(samples)
+        idx = min(
+            len(ordered) - 1, max(0, round(q / 100.0 * (len(ordered) - 1)))
+        )
+        return ordered[idx]
+
+    @property
+    def p50(self) -> float:
+        return self.percentile(50.0)
+
+    @property
+    def p99(self) -> float:
+        return self.percentile(99.0)
+
+    @property
+    def mean(self) -> float:
+        with self._lock:
+            samples = list(self.samples)
+        return sum(samples) / len(samples) if samples else 0.0
+
+    def summary(self) -> str:
+        return (
+            f"{self.total} samples: p50={self.p50 * 1e3:.2f}ms "
+            f"p99={self.p99 * 1e3:.2f}ms mean={self.mean * 1e3:.2f}ms"
+        )
+
+
+@dataclass
+class EventCounters:
+    """Named lifetime event counters (thread-safe).
+
+    The error/degradation half of the serving telemetry: every
+    reliability event (a poisoned update rejected, a file quarantined, a
+    deadline missed, a breaker rejection, a retry) increments a named
+    counter here, so operators and ``bench.py`` track robustness next to
+    latency and occupancy.  Counters are exact lifetime totals — rates
+    over recent traffic live in
+    :class:`metran_tpu.reliability.health.HealthMonitor`.
+
+    Registry-backed when constructed with ``registry=``/``name=`` (or
+    bound later via :meth:`bind`): increments mirror into a
+    ``kind``-labelled :class:`Counter` family of that name, so the
+    exposition endpoint sees ``{name}{kind="retries"} 3``.
+    """
+
+    counts: Dict[str, int] = field(default_factory=dict)
+    registry: Optional[MetricsRegistry] = field(
+        default=None, repr=False, compare=False
+    )
+    name: Optional[str] = None
+    help: str = ""
+    _lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False, compare=False
+    )
+
+    def __post_init__(self):
+        self._counter = None
+        if self.registry is not None and self.name:
+            self.bind(self.registry, self.name, self.help)
+
+    def bind(self, registry: MetricsRegistry, name: str,
+             help: str = "") -> None:
+        """Mirror this instrument into ``registry`` as a
+        ``kind``-labelled counter family named ``name``; counts
+        accumulated before binding are carried over."""
+        counter = registry.counter(
+            name, help or "events by kind", label_names=("kind",)
+        )
+        with self._lock:
+            if self._counter is counter:
+                return
+            self._counter = counter
+            backlog = dict(self.counts)
+        for k, v in backlog.items():
+            counter.inc(v, kind=k)
+
+    def increment(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self.counts[name] = self.counts.get(name, 0) + int(n)
+            counter = self._counter
+        if counter is not None:
+            counter.inc(int(n), kind=name)
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self.counts.get(name, 0)
+
+    @property
+    def total(self) -> int:
+        with self._lock:
+            return sum(self.counts.values())
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self.counts)
+
+    def summary(self) -> str:
+        snap = self.snapshot()
+        if not snap:
+            return "no error events"
+        inner = ", ".join(f"{k}={v}" for k, v in sorted(snap.items()))
+        return f"events: {inner}"
+
+
+@dataclass
+class OccupancyCounter:
+    """Batch-occupancy accounting for the micro-batching queue.
+
+    How full device dispatches actually run — the efficiency half of
+    the serving telemetry (latency being the other): ``mean_occupancy``
+    near 1 means the batcher coalesces nothing and each request pays a
+    full dispatch.  Totals are running counters (exact over the whole
+    lifetime); ``batches`` keeps only the most recent ``maxlen`` sizes,
+    bounded like :class:`LatencyRecorder` for long-lived services, and
+    thread-safe for the same reason (concurrent dispatch threads).
+
+    Registry-backed when constructed with ``registry=``/``name=``:
+    batch sizes feed a power-of-two :class:`Histogram`
+    (``DEFAULT_SIZE_BUCKETS``) whose ``_count``/``_sum`` are the
+    dispatch and request totals.
+    """
+
+    maxlen: int = 100_000
+    batches: List[int] = field(default_factory=list)
+    dispatches: int = 0
+    requests: int = 0
+    registry: Optional[MetricsRegistry] = field(
+        default=None, repr=False, compare=False
+    )
+    name: Optional[str] = None
+    help: str = ""
+    _lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False, compare=False
+    )
+
+    def __post_init__(self):
+        self._hist = (
+            self.registry.histogram(
+                self.name, self.help or "requests per device dispatch",
+                buckets=DEFAULT_SIZE_BUCKETS,
+            )
+            if self.registry is not None and self.name else None
+        )
+
+    def record(self, size: int) -> None:
+        with self._lock:
+            self.batches.append(int(size))
+            self.dispatches += 1
+            self.requests += int(size)
+            if len(self.batches) > self.maxlen:
+                del self.batches[: len(self.batches) // 2]
+        if self._hist is not None:
+            self._hist.observe(size)
+
+    @property
+    def mean_occupancy(self) -> float:
+        return self.requests / self.dispatches if self.dispatches else 0.0
+
+    def summary(self) -> str:
+        return (
+            f"{self.requests} requests over {self.dispatches} dispatches "
+            f"(mean occupancy {self.mean_occupancy:.1f})"
+        )
+
+
+__all__ = [
+    "Counter",
+    "DEFAULT_LATENCY_BUCKETS",
+    "DEFAULT_SIZE_BUCKETS",
+    "EventCounters",
+    "Gauge",
+    "Histogram",
+    "LatencyRecorder",
+    "MetricsRegistry",
+    "OccupancyCounter",
+]

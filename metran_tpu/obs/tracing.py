@@ -1,0 +1,529 @@
+"""Request-scoped tracing with correlation IDs and Chrome trace export.
+
+Latency percentiles say a request was slow; they cannot say *where* —
+batcher coalescing wait?  cold compile?  engine kernel?  integrity
+gate?  The :class:`Tracer` answers that with **spans**: named, timed
+intervals that share one **correlation (trace) ID** per request, so a
+single ``MetranService.update`` call yields a connected tree::
+
+    serve.update                      (sync call: deadline + retries)
+      serve.update.request            (one attempt: submit -> resolve)
+        serve.batcher_wait            (enqueue -> dispatch claim)
+        serve.dispatch                (whole batched device dispatch)
+        serve.engine                  (the jitted kernel execution)
+        serve.integrity_gate          (per-slot posterior validation)
+        serve.commit                  (registry write-through)
+
+Propagation is hybrid, matching the serving stack's threading model:
+on the *caller* thread spans nest via a ``contextvars`` context (so a
+retry attempt automatically joins its sync call's trace), while across
+the *batcher thread boundary* — where a request is dispatched on a
+different thread, possibly much later (deferred same-model chains) —
+the :class:`SpanContext` rides the request object explicitly and
+stages re-attach to it with :meth:`Tracer.record`.
+
+Finished spans land in a bounded ring buffer; :meth:`Tracer.
+export_chrome` renders them as Chrome trace-event JSON (the
+``chrome://tracing`` / Perfetto format), which composes with the XLA
+device traces from :func:`metran_tpu.utils.profiling.trace`: span
+names match the ``jax.profiler.TraceAnnotation`` names the serve
+kernels emit (``serve.engine``), so host spans and device timelines
+line up by name in one Perfetto view.
+
+Stdlib-only; when no tracer is installed the serving layer's guard is
+a single ``is None`` check per call site.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import itertools
+import json
+import os
+import threading
+import time
+from logging import getLogger
+from typing import Any, Dict, Iterator, List, NamedTuple, Optional
+
+logger = getLogger(__name__)
+
+#: the caller-thread trace context (see module docstring); one var for
+#: the whole process — contexts are per-thread/per-task by construction.
+_current: "contextvars.ContextVar[Optional[SpanContext]]" = (
+    contextvars.ContextVar("metran_tpu_trace", default=None)
+)
+
+
+class SpanContext(NamedTuple):
+    """The portable identity of a span: pass it across threads to
+    parent further spans onto the same trace.
+
+    ``trace_id`` is an opaque correlation token, unique within one
+    :class:`Tracer` — a plain int, because the hot path mints one per
+    request and string formatting there is measurable overhead (the
+    Chrome export carries the process id separately).
+
+    The two optional fields serve the *request-span* hot path
+    (:meth:`Tracer.begin`): a submission allocates exactly ONE object
+    carrying identity + its own parent and start time, rides the
+    request across the batcher thread boundary (stages parent on
+    ``trace_id``/``span_id``), and is closed later with
+    :meth:`Tracer.finish`.  Code that only re-parents (``record*``)
+    never reads them.
+    """
+
+    trace_id: int
+    span_id: int
+    parent_id: Optional[int] = None
+    t0: float = 0.0
+
+
+class Span:
+    """One named, timed interval; ``end()`` is idempotent and
+    thread-safe (futures' done-callbacks race cancellation paths)."""
+
+    __slots__ = (
+        "name", "context", "parent_id", "t0", "t1", "tid", "attrs",
+        "_tracer",
+    )
+
+    def __init__(self, tracer: "Tracer", name: str, context: SpanContext,
+                 parent_id: Optional[int], t0: float,
+                 attrs: Dict[str, Any]):
+        self._tracer = tracer
+        self.name = name
+        self.context = context
+        self.parent_id = parent_id
+        self.t0 = t0
+        self.t1: Optional[float] = None
+        self.tid = threading.get_ident()
+        self.attrs = attrs
+
+    @property
+    def trace_id(self) -> str:
+        return self.context.trace_id
+
+    def end(self, **attrs) -> None:
+        """Close the span (first call wins; later calls are no-ops)."""
+        self._tracer._finish(self, attrs)
+
+    def __repr__(self) -> str:  # debugging aid, not part of the export
+        state = "open" if self.t1 is None else f"{self.t1 - self.t0:.6f}s"
+        return f"<Span {self.name} {self.context.trace_id} {state}>"
+
+
+class Tracer:
+    """Bounded ring buffer of finished spans + context propagation.
+
+    Parameters
+    ----------
+    maxlen : finished spans kept (oldest dropped) — bounded memory for
+        long-lived services; export what you need, when you need it.
+    clock : monotonic-seconds time source.  The default matches the
+        serving layer's ``time.monotonic`` request timestamps, so
+        pre-timed spans (:meth:`record`, e.g. batcher wait measured
+        from ``Request.enqueued_at``) share the tracer's timeline.
+    annotate_device : also enter a ``jax.profiler.TraceAnnotation`` of
+        the span's name inside :meth:`span` blocks, so host spans show
+        up on XLA device traces captured around the same workload.
+        Off by default (requires jax; adds a TraceMe per span).
+    """
+
+    def __init__(self, maxlen: int = 4096,
+                 clock=time.monotonic, annotate_device: bool = False):
+        self.clock = clock
+        self.annotate_device = bool(annotate_device)
+        # The ring is COLUMNAR: eight preallocated lists, one per span
+        # field, written by slot assignment.  A record therefore
+        # allocates NO GC-tracked container — the naive
+        # tuple-in-a-deque ring was measured costing more in garbage
+        # collection than in its own bytecode (every appended tuple
+        # survives into the older generations and is re-scanned on
+        # every collection; the ring alone doubled the process's
+        # gen0 rate and put 8% of serve wall time into the collector).
+        # Rows are written under a short lock (8 slot stores); reads
+        # snapshot under the same lock on the cold path.
+        m = max(1, int(maxlen))
+        self._maxlen = m
+        self._head = 0  # rows ever written; row i lives at i % maxlen
+        self._c_name: List[Any] = [None] * m
+        self._c_trace: List[Any] = [0] * m
+        self._c_span: List[Any] = [0] * m
+        self._c_parent: List[Any] = [None] * m
+        self._c_ts: List[Any] = [0.0] * m
+        self._c_dur: List[Any] = [0.0] * m
+        self._c_tid: List[Any] = [0] * m
+        self._c_args: List[Any] = [None] * m
+        self._lock = threading.Lock()
+        self._span_ids = itertools.count(1)
+        self._trace_ids = itertools.count(1)
+        self._pid = os.getpid()
+        self._epoch = float(clock())
+
+    @property
+    def dropped(self) -> int:
+        """Spans pushed out of the ring since creation/:meth:`clear`."""
+        return max(0, self._head - self._maxlen)
+
+    def _append(self, name, trace_id, span_id, parent_id, ts, dur,
+                tid, args) -> None:
+        m = self._maxlen
+        with self._lock:
+            i = self._head
+            self._head = i + 1
+            j = i % m
+            self._c_name[j] = name
+            self._c_trace[j] = trace_id
+            self._c_span[j] = span_id
+            self._c_parent[j] = parent_id
+            self._c_ts[j] = ts
+            self._c_dur[j] = dur
+            self._c_tid[j] = tid
+            self._c_args[j] = args
+
+    # -- context --------------------------------------------------------
+    def current(self) -> Optional[SpanContext]:
+        """The caller thread's active span context, if any."""
+        return _current.get()
+
+    def new_trace_id(self) -> int:
+        return next(self._trace_ids)
+
+    def make_context(self,
+                     parent: Optional[SpanContext] = None) -> SpanContext:
+        """A fresh span identity WITHOUT an open-span object — for
+        spans whose interval is recorded later via :meth:`record_span`
+        (children recorded meanwhile already parent on it)."""
+        return SpanContext(
+            parent.trace_id if parent is not None else self.new_trace_id(),
+            next(self._span_ids),
+        )
+
+    def begin(self) -> SpanContext:
+        """Open a request span as ONE allocation: a :class:`SpanContext`
+        carrying its own parent (the caller thread's current context,
+        a fresh trace when none) and start time.  The submission
+        hot-path primitive: the context rides the request object across
+        the batcher thread boundary — stages parent on it immediately —
+        and the outcome callback the serving layer registers anyway
+        closes it with :meth:`finish` (an open ``Span`` object + its
+        own done-callback would be pure overhead)."""
+        parent = _current.get()
+        if parent is not None:
+            return SpanContext(
+                parent.trace_id, next(self._span_ids), parent.span_id,
+                self.clock(),
+            )
+        return SpanContext(
+            next(self._trace_ids), next(self._span_ids), None,
+            self.clock(),
+        )
+
+    def finish(self, name: str, ctx: SpanContext, attrs=None) -> None:
+        """Close a span opened with :meth:`begin` (interval =
+        ``ctx.t0`` .. now).
+
+        ``attrs`` is a dict, or — the zero-allocation hot-path form —
+        a bare string, exposed on the read path as ``{"label": <str>}``
+        (the serving layer labels successful request spans with their
+        model id this way; a dict per success was measurable as pure
+        allocator/GC load)."""
+        t1 = self.clock()
+        self._append(
+            name, ctx.trace_id, ctx.span_id, ctx.parent_id, ctx.t0,
+            t1 - ctx.t0 if t1 > ctx.t0 else 0.0,
+            threading.get_ident(), attrs,
+        )
+
+    def finish_many(self, name: str, entries, end: float) -> None:
+        """Close many :meth:`begin` contexts at one shared end time;
+        ``entries`` are ``(ctx, attrs)`` pairs (attrs as in
+        :meth:`finish`).
+
+        The batched-resolution primitive: a dispatch that resolves B
+        requests can close all their request spans in one lock-held
+        sweep instead of B :meth:`finish` calls from B done-callbacks
+        — measured worth several percent of serve throughput on the
+        forecast hot path.
+        """
+        tid = threading.get_ident()
+        m = self._maxlen
+        with self._lock:
+            i = self._head
+            for ctx, attrs in entries:
+                j = i % m
+                i += 1
+                self._c_name[j] = name
+                self._c_trace[j] = ctx[0]
+                self._c_span[j] = ctx[1]  # the span's OWN id (begin)
+                self._c_parent[j] = ctx[2]
+                self._c_ts[j] = ctx[3]
+                self._c_dur[j] = end - ctx[3] if end > ctx[3] else 0.0
+                self._c_tid[j] = tid
+                self._c_args[j] = attrs
+            self._head = i
+
+    def record_span(self, name: str, ctx: SpanContext,
+                    parent: Optional[SpanContext], start: float,
+                    end: float, attrs: Optional[dict] = None) -> None:
+        """Append a pre-timed span under an identity allocated earlier
+        with :meth:`make_context` (children recorded meanwhile already
+        point at ``ctx.span_id``)."""
+        self._append(
+            name, ctx.trace_id, ctx.span_id,
+            parent.span_id if parent is not None else None,
+            start, end - start if end > start else 0.0,
+            threading.get_ident(), attrs,
+        )
+
+    def record_many(self, name: str, entries, end: float,
+                    attrs: Optional[dict] = None) -> None:
+        """Append one pre-timed span per ``(parent_ctx, start)`` entry,
+        all sharing ``name``/``end``/``attrs`` (the attrs DICT is
+        shared by reference — treat it as frozen).
+
+        The batched-dispatch primitive: one device execution serves B
+        requests, and attributing its stage to every rider must not
+        cost B full :meth:`record` calls on the dispatch thread.
+        """
+        self._record_batch(name, entries, end, attrs, shared_start=None)
+
+    def record_shared(self, name: str, ctxs, start: float, end: float,
+                      attrs: Optional[dict] = None) -> None:
+        """Like :meth:`record_many` but for one shared interval
+        attributed to every context in ``ctxs`` — the common batched
+        case (one engine execution, B riders), where the caller can
+        pass a plain list of contexts and skip building per-entry
+        pairs."""
+        self._record_batch(name, ctxs, end, attrs, shared_start=start)
+
+    def _record_batch(self, name, entries, end, attrs,
+                      shared_start) -> None:
+        """One lock-held columnar write loop for both batched forms:
+        ``shared_start=None`` means ``entries`` are ``(ctx, start)``
+        pairs; otherwise they are bare contexts sharing the interval
+        ``shared_start``..``end``."""
+        tid = threading.get_ident()
+        ids = self._span_ids
+        m = self._maxlen
+        shared_dur = (
+            None if shared_start is None
+            else (end - shared_start if end > shared_start else 0.0)
+        )
+        with self._lock:
+            i = self._head
+            for entry in entries:
+                if shared_dur is None:
+                    ctx, start = entry
+                    dur = end - start if end > start else 0.0
+                else:
+                    ctx, start, dur = entry, shared_start, shared_dur
+                j = i % m
+                i += 1
+                self._c_name[j] = name
+                self._c_trace[j] = ctx[0]
+                self._c_span[j] = next(ids)
+                self._c_parent[j] = ctx[1]
+                self._c_ts[j] = start
+                self._c_dur[j] = dur
+                self._c_tid[j] = tid
+                self._c_args[j] = attrs
+            self._head = i
+
+    # -- span lifecycle -------------------------------------------------
+    def start(self, name: str, parent: Any = "current",
+              **attrs) -> Span:
+        """Open a span.
+
+        ``parent`` is a :class:`SpanContext` (explicit cross-thread
+        attach), ``"current"`` (default: the caller thread's active
+        context, a fresh root when none), or ``None`` (force a new
+        root/trace).  The returned span must be closed with
+        :meth:`Span.end` — from any thread.
+        """
+        if parent == "current":
+            parent = _current.get()
+        if parent is not None and not isinstance(parent, SpanContext):
+            parent = getattr(parent, "context", None)
+        trace_id = (
+            parent.trace_id if parent is not None else self.new_trace_id()
+        )
+        ctx = SpanContext(trace_id, next(self._span_ids))
+        return Span(
+            self, name, ctx,
+            parent.span_id if parent is not None else None,
+            float(self.clock()), attrs,
+        )
+
+    def _finish(self, span: Span, attrs: Dict[str, Any]) -> None:
+        # the t1 guard tolerates the benign double-end race — a
+        # duplicate row in the ring at worst, never a crash
+        if span.t1 is not None:
+            return  # idempotent: first end() wins
+        span.t1 = t1 = self.clock()
+        if attrs:
+            span.attrs.update(attrs)
+        ctx = span.context
+        self._append(
+            span.name, ctx.trace_id, ctx.span_id, span.parent_id,
+            span.t0, t1 - span.t0, span.tid, span.attrs,
+        )
+
+    def record(self, name: str, parent: Any, start: float, end: float,
+               **attrs) -> None:
+        """Append an already-timed span (clock-of-this-tracer seconds).
+
+        The cross-thread primitive: the dispatch path measures a stage
+        once and attributes it to each affected request's trace without
+        holding per-request open spans — e.g. ``serve.batcher_wait``
+        from ``Request.enqueued_at`` to the dispatch claim.
+        """
+        if parent is not None and not isinstance(parent, SpanContext):
+            parent = getattr(parent, "context", None)
+        if parent is not None:
+            trace_id, parent_id = parent[0], parent[1]
+        else:
+            trace_id, parent_id = self.new_trace_id(), None
+        self._append(
+            name, trace_id, next(self._span_ids), parent_id,
+            start, end - start if end > start else 0.0,
+            threading.get_ident(), attrs,
+        )
+
+    @contextlib.contextmanager
+    def span(self, name: str, parent: Any = "current",
+             **attrs) -> Iterator[Span]:
+        """Context-managed span that installs itself as the caller
+        thread's current context (children opened inside nest under
+        it, including across ``yield``-free helper calls)."""
+        sp = self.start(name, parent=parent, **attrs)
+        token = _current.set(sp.context)
+        device_ctx = contextlib.nullcontext()
+        if self.annotate_device:
+            try:
+                import jax
+
+                device_ctx = jax.profiler.TraceAnnotation(name)
+            except Exception:  # jax unavailable: host spans still work
+                pass
+        try:
+            with device_ctx:
+                yield sp
+        except BaseException as exc:
+            sp.attrs.setdefault("error", repr(exc))
+            raise
+        finally:
+            _current.reset(token)
+            sp.end()
+
+    # -- read / export --------------------------------------------------
+    def spans(self, trace_id: Optional[int] = None,
+              name: Optional[str] = None) -> List[dict]:
+        """Finished spans as dicts (oldest first), optionally filtered
+        — the cold read path; the ring itself stores columns."""
+        with self._lock:
+            h = self._head
+            m = self._maxlen
+            n = min(h, m)
+            raw = []
+            for k in range(h - n, h):
+                j = k % m
+                raw.append((
+                    self._c_name[j], self._c_trace[j], self._c_span[j],
+                    self._c_parent[j], self._c_ts[j], self._c_dur[j],
+                    self._c_tid[j], self._c_args[j],
+                ))
+        out = []
+        for (nm, tr, sid, pid, ts, dur, tid, args) in raw:
+            if trace_id is not None and tr != trace_id:
+                continue
+            if name is not None and nm != name:
+                continue
+            if args is None:
+                args = {}
+            elif isinstance(args, str):
+                args = {"label": args}  # finish()'s bare-string form
+            else:
+                args = dict(args)
+            out.append({
+                "name": nm, "trace_id": tr, "span_id": sid,
+                "parent_id": pid, "ts": ts, "dur": dur, "tid": tid,
+                "args": args,
+            })
+        return out
+
+    def trace_ids(self) -> List[int]:
+        with self._lock:
+            h, m = self._head, self._maxlen
+            seen = {
+                self._c_trace[k % m] for k in range(max(0, h - m), h)
+            }
+        return sorted(seen)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._head = 0
+            m = self._maxlen
+            # fresh columns, so cleared rows' strings/dicts are freed
+            self._c_name = [None] * m
+            self._c_trace = [0] * m
+            self._c_span = [0] * m
+            self._c_parent = [None] * m
+            self._c_ts = [0.0] * m
+            self._c_dur = [0.0] * m
+            self._c_tid = [0] * m
+            self._c_args = [None] * m
+
+    def export_chrome(self) -> dict:
+        """Chrome trace-event JSON (``chrome://tracing``, Perfetto).
+
+        Complete events (``"ph": "X"``) with microsecond ``ts`` relative
+        to the tracer's epoch; ``args`` carries the correlation
+        ``trace_id``/``span_id``/``parent_id`` so a Perfetto query can
+        reassemble one request's tree across thread tracks.
+        """
+        events = []
+        for s in self.spans():
+            args = dict(s["args"])
+            args["trace_id"] = s["trace_id"]
+            args["span_id"] = s["span_id"]
+            if s["parent_id"] is not None:
+                args["parent_id"] = s["parent_id"]
+            events.append({
+                "name": s["name"],
+                "cat": s["name"].split(".", 1)[0],
+                "ph": "X",
+                "ts": (s["ts"] - self._epoch) * 1e6,
+                "dur": s["dur"] * 1e6,
+                "pid": self._pid,
+                "tid": s["tid"],
+                "args": args,
+            })
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def save_chrome(self, path) -> str:
+        """Write :meth:`export_chrome` to ``path``; returns the path."""
+        payload = self.export_chrome()
+        with open(path, "w") as fh:
+            json.dump(payload, fh)
+        logger.info(
+            "wrote %d trace events to %s", len(payload["traceEvents"]),
+            path,
+        )
+        return str(path)
+
+
+def current_trace_id() -> Optional[int]:
+    """The caller thread's active correlation ID, if any (module-level
+    so event emitters need no tracer handle)."""
+    ctx = _current.get()
+    return ctx.trace_id if ctx is not None else None
+
+
+__all__ = [
+    "Span",
+    "SpanContext",
+    "Tracer",
+    "current_trace_id",
+]

@@ -59,6 +59,23 @@ class HealthMonitor:
         """Error-rate verdict alone; the service ANDs in liveness."""
         return self.error_rate() <= self.max_error_rate
 
+    def bind_metrics(self, registry, prefix: str = "metran_serve") -> None:
+        """Publish this monitor into a :class:`~metran_tpu.obs.
+        MetricsRegistry` as callback gauges (evaluated at scrape time,
+        so nothing here has to push updates): the windowed error rate
+        and the lifetime request count.  Re-binding a fresh monitor to
+        a long-lived registry re-points the callbacks at it."""
+        registry.gauge(
+            f"{prefix}_window_error_rate",
+            "failure fraction over the recent outcome window",
+            callback=self.error_rate,
+        )
+        registry.gauge(
+            f"{prefix}_requests_seen",
+            "lifetime request outcomes recorded",
+            callback=lambda: float(self.seen),
+        )
+
     def snapshot(self, extra: Optional[Dict] = None) -> Dict:
         with self._lock:
             n = len(self._outcomes)

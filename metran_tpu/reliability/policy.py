@@ -192,6 +192,15 @@ class CircuitBreaker:
 
     ``clock`` is injectable (monotonic seconds) so tests can drive the
     cooldown deterministically.
+
+    ``on_transition(model_id, old_state, new_state)`` is an optional
+    observer hook fired on every state change — the serving layer
+    routes it into the structured event log
+    (:class:`metran_tpu.obs.EventLog`) so a model's outage timeline is
+    reconstructable.  It is invoked OUTSIDE the breaker lock (an
+    observer that re-enters breaker state cannot deadlock) and its
+    exceptions are swallowed: telemetry must never alter breaker
+    semantics.
     """
 
     CLOSED = "closed"
@@ -200,16 +209,30 @@ class CircuitBreaker:
 
     def __init__(self, model_id: str, failure_threshold: int = 5,
                  cooldown_s: float = 30.0,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 on_transition: Optional[
+                     Callable[[str, str, str], None]
+                 ] = None):
         self.model_id = model_id
         self.failure_threshold = int(failure_threshold)
         self.cooldown_s = float(cooldown_s)
         self._clock = clock
+        self._on_transition = on_transition
         self._lock = threading.Lock()
         self._state = self.CLOSED
         self._failures = 0
         self._opened_at = 0.0
         self._probe: Optional[object] = None  # the live probe's token
+
+    def _notify(self, old: str, new: str) -> None:
+        """Fire the transition observer (outside the lock; see class
+        docstring)."""
+        if self._on_transition is None or old == new:
+            return
+        try:
+            self._on_transition(self.model_id, old, new)
+        except Exception:  # pragma: no cover - observer must not break
+            logger.exception("breaker transition observer failed")
 
     @property
     def state(self) -> str:
@@ -220,6 +243,7 @@ class CircuitBreaker:
         """Admit a request or raise :class:`CircuitOpenError`; returns
         the admission token to thread back into the ``record_*``
         verdict calls."""
+        transition = None
         with self._lock:
             if self._state == self.CLOSED:
                 return None
@@ -230,11 +254,15 @@ class CircuitBreaker:
                     raise CircuitOpenError(self.model_id, remaining)
                 self._state = self.HALF_OPEN
                 self._probe = None
+                transition = (self.OPEN, self.HALF_OPEN)
             # HALF_OPEN: exactly one probe at a time
             if self._probe is not None:
                 raise CircuitOpenError(self.model_id, self.cooldown_s)
             self._probe = object()
-            return self._probe
+            token = self._probe
+        if transition is not None:
+            self._notify(*transition)
+        return token
 
     def _is_stale(self, token) -> bool:
         """Attributed verdict that does NOT belong to the live probe.
@@ -248,6 +276,7 @@ class CircuitBreaker:
         return token is None or token is not self._probe
 
     def record_success(self, token=_UNATTRIBUTED) -> None:
+        transition = None
         with self._lock:
             if self._state == self.OPEN:
                 # even the probe's own success cannot arrive while OPEN
@@ -261,17 +290,21 @@ class CircuitBreaker:
                     "circuit breaker CLOSED for model %r after a "
                     "successful probe", self.model_id,
                 )
+                transition = (self.HALF_OPEN, self.CLOSED)
             self._state = self.CLOSED
             self._failures = 0
             self._probe = None
+        if transition is not None:
+            self._notify(*transition)
 
     def record_failure(self, token=_UNATTRIBUTED) -> None:
+        transition = None
         with self._lock:
             if self._state == self.OPEN:
                 # already open; a stale failure must not extend the
                 # cooldown another full period
                 return
-            if self._state == self.HALF_OPEN:
+            elif self._state == self.HALF_OPEN:
                 if self._is_stale(token):
                     return  # must not steal the live probe's verdict
                 logger.warning(
@@ -281,16 +314,21 @@ class CircuitBreaker:
                 self._state = self.OPEN
                 self._opened_at = self._clock()
                 self._probe = None
-                return
-            self._failures += 1
-            if self._failures >= self.failure_threshold:
-                logger.warning(
-                    "circuit breaker OPEN for model %r after %d "
-                    "consecutive failures", self.model_id, self._failures,
-                )
-                self._state = self.OPEN
-                self._opened_at = self._clock()
-                self._probe = None
+                transition = (self.HALF_OPEN, self.OPEN)
+            else:
+                self._failures += 1
+                if self._failures >= self.failure_threshold:
+                    logger.warning(
+                        "circuit breaker OPEN for model %r after %d "
+                        "consecutive failures", self.model_id,
+                        self._failures,
+                    )
+                    self._state = self.OPEN
+                    self._opened_at = self._clock()
+                    self._probe = None
+                    transition = (self.CLOSED, self.OPEN)
+        if transition is not None:
+            self._notify(*transition)
 
     def record_abandoned(self, token=_UNATTRIBUTED) -> None:
         """A request was cancelled / never materialized: free the probe
@@ -301,13 +339,19 @@ class CircuitBreaker:
 
 
 class BreakerBoard:
-    """Lazily-created per-model breakers sharing one configuration."""
+    """Lazily-created per-model breakers sharing one configuration
+    (and one optional transition observer — see
+    :class:`CircuitBreaker`)."""
 
     def __init__(self, failure_threshold: int = 5, cooldown_s: float = 30.0,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 on_transition: Optional[
+                     Callable[[str, str, str], None]
+                 ] = None):
         self.failure_threshold = int(failure_threshold)
         self.cooldown_s = float(cooldown_s)
         self._clock = clock
+        self.on_transition = on_transition
         self._lock = threading.Lock()
         self._breakers: Dict[str, CircuitBreaker] = {}
 
@@ -317,7 +361,7 @@ class BreakerBoard:
             if breaker is None:
                 breaker = self._breakers[model_id] = CircuitBreaker(
                     model_id, self.failure_threshold, self.cooldown_s,
-                    self._clock,
+                    self._clock, on_transition=self.on_transition,
                 )
             return breaker
 

@@ -31,12 +31,21 @@ logger = getLogger(__name__)
 
 @dataclass
 class Request:
-    """One queued request; ``payload`` is opaque to the batcher."""
+    """One queued request; ``payload`` is opaque to the batcher.
+
+    ``trace`` is an equally opaque tracing handle (a
+    :class:`~metran_tpu.obs.SpanContext` when the service traces): the
+    batcher carries it across the thread boundary so the dispatch
+    callback can attribute its stages to the originating request's
+    correlation ID — the explicit ID pass-through half of the tracing
+    design (contextvars cannot cross the worker thread).
+    """
 
     model_id: str
     payload: Any
     future: Future = field(default_factory=Future)
     enqueued_at: float = field(default_factory=time.monotonic)
+    trace: Any = None
 
 
 @dataclass
@@ -91,7 +100,7 @@ class MicroBatcher:
     # ------------------------------------------------------------------
     def submit(
         self, batch_key: Hashable, model_id: str, payload,
-        enqueued_at: Optional[float] = None,
+        enqueued_at: Optional[float] = None, trace=None,
     ) -> Future:
         """Enqueue one request; resolve via the returned future.
 
@@ -101,15 +110,17 @@ class MicroBatcher:
         latency telemetry covers the wait the caller actually saw.  A
         group started by a backdated request may flush immediately
         (its deadline is measured from the stamp), which only shortens
-        an already-long wait.
+        an already-long wait.  ``trace`` rides the request to the
+        dispatch callback (see :class:`Request`).
         """
         return self.submit_tracked(
-            batch_key, model_id, payload, enqueued_at=enqueued_at
+            batch_key, model_id, payload, enqueued_at=enqueued_at,
+            trace=trace,
         )[0]
 
     def submit_tracked(
         self, batch_key: Hashable, model_id: str, payload, join=None,
-        enqueued_at: Optional[float] = None,
+        enqueued_at: Optional[float] = None, trace=None,
     ):
         """Enqueue like :meth:`submit` and also return the pending group
         joined, as ``(future, group)`` with ``group`` an opaque identity
@@ -123,7 +134,7 @@ class MicroBatcher:
         same-model requests are provably co-batchable inside one
         dispatch or must chain on each other's futures.
         """
-        req = Request(model_id=model_id, payload=payload)
+        req = Request(model_id=model_id, payload=payload, trace=trace)
         if enqueued_at is not None:
             req.enqueued_at = float(enqueued_at)
         flush_now = None

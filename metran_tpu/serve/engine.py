@@ -217,6 +217,27 @@ def _build_statespace(alpha_sdf, alpha_cdf, loadings, dt) -> StateSpace:
     return jax.vmap(dfm_statespace)(alpha_sdf, alpha_cdf, loadings, dt)
 
 
+#: ``jax.profiler.TraceAnnotation`` names the serve kernels run under.
+#: They deliberately MATCH the host-side span names the service's
+#: tracer records (``metran_tpu.obs.tracing``), so a Perfetto view of
+#: an XLA device trace (``utils.profiling.trace``) and an exported
+#: request trace line up by name — per-stage compute attribution on
+#: both timelines.
+UPDATE_ANNOTATION = "serve.engine.update"
+FORECAST_ANNOTATION = "serve.engine.forecast"
+
+
+def _annotated(fn, name: str):
+    """Run ``fn`` under a named profiler annotation (a TraceMe: ~ns
+    when no profiler is active, a labelled host slice when one is)."""
+
+    def annotated(*args):
+        with jax.profiler.TraceAnnotation(name):
+            return fn(*args)
+
+    return annotated
+
+
 def make_update_fn(engine: str = "joint"):
     """A fresh jitted batched incremental-update kernel.
 
@@ -229,7 +250,8 @@ def make_update_fn(engine: str = "joint"):
     service's integrity gate is a finiteness check.  A *fresh*
     ``jax.jit`` wrapper per call site so the registry's LRU eviction
     actually frees the underlying executables (a module-level jit would
-    pin every bucket's compilation forever).
+    pin every bucket's compilation forever).  Calls run under
+    :data:`UPDATE_ANNOTATION` for device-trace attribution.
     """
     if engine in ("sqrt", "sqrt_parallel"):
 
@@ -239,7 +261,7 @@ def make_update_fn(engine: str = "joint"):
                 lambda s, m, c, y, k: sqrt_filter_append(s, m, c, y, k)
             )(ss, mean, chol, y_new, mask_new)
 
-        return fn
+        return _annotated(fn, UPDATE_ANNOTATION)
 
     @jax.jit
     def fn(ss, mean, cov, y_new, mask_new):
@@ -247,7 +269,7 @@ def make_update_fn(engine: str = "joint"):
             lambda s, m, c, y, k: filter_append(s, m, c, y, k, engine=engine)
         )(ss, mean, cov, y_new, mask_new)
 
-    return fn
+    return _annotated(fn, UPDATE_ANNOTATION)
 
 
 def make_forecast_fn(steps: int):
@@ -255,7 +277,8 @@ def make_forecast_fn(steps: int):
 
     ``fn(ss, mean, cov) -> (means, variances)`` of shape (B, steps, N),
     standardized units.  Closed form over horizons (no scan) — see
-    :mod:`metran_tpu.ops.forecast`.
+    :mod:`metran_tpu.ops.forecast`.  Calls run under
+    :data:`FORECAST_ANNOTATION` for device-trace attribution.
     """
     horizons = jnp.arange(1, int(steps) + 1)
 
@@ -265,7 +288,7 @@ def make_forecast_fn(steps: int):
             lambda s, m, c: forecast_observation_moments(s, m, c, horizons)
         )(ss, mean, cov)
 
-    return fn
+    return _annotated(fn, FORECAST_ANNOTATION)
 
 
 # Module-level conveniences for direct (registry-less) use.  They go
@@ -292,6 +315,8 @@ def forecast_bucket(ss, mean, cov, steps: int):
 
 __all__ = [
     "BucketBatch",
+    "FORECAST_ANNOTATION",
+    "UPDATE_ANNOTATION",
     "forecast_bucket",
     "make_forecast_fn",
     "make_update_fn",

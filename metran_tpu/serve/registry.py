@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from collections import OrderedDict
 from logging import getLogger
 from pathlib import Path
@@ -52,6 +53,13 @@ class CompiledFnCache:
     Eviction drops the jitted wrapper itself, which is what actually
     frees the underlying XLA executables (each entry is a fresh
     ``jax.jit`` closure from ``serve.engine``'s factories).
+
+    Bound to a :class:`~metran_tpu.obs.MetricsRegistry`
+    (:meth:`bind_metrics`), the cache also records each entry's
+    **first-call wall time** — trace + XLA compile + launch, the
+    dominant cold-start cost of a new shape bucket — into a per-kernel
+    ``metran_serve_compile_seconds{key=...}`` gauge, plus hit/miss/
+    resident callback gauges.
     """
 
     def __init__(self, maxsize: int = 32):
@@ -66,6 +74,66 @@ class CompiledFnCache:
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        self._compile_gauge = None
+
+    def bind_metrics(self, registry, prefix: str = "metran_serve") -> None:
+        """Publish cache counters and per-kernel compile wall time into
+        ``registry`` (idempotent; see class docstring)."""
+        self._compile_gauge = registry.gauge(
+            f"{prefix}_compile_seconds",
+            "first-call wall time (trace+compile+launch) per kernel",
+            label_names=("key",),
+        )
+        registry.gauge(
+            f"{prefix}_compile_cache_hits",
+            "compiled-kernel cache hits (lifetime)",
+            callback=lambda: float(self.hits),
+        )
+        registry.gauge(
+            f"{prefix}_compile_cache_misses",
+            "compiled-kernel cache misses == distinct kernels built",
+            callback=lambda: float(self.misses),
+        )
+        registry.gauge(
+            f"{prefix}_compiled_kernels_resident",
+            "compiled kernels currently held by the LRU",
+            callback=lambda: float(len(self)),
+        )
+
+    @staticmethod
+    def _key_label(key: tuple) -> str:
+        """A stable, readable label for a compile key: nested tuples
+        flatten to ``update_8x16_1_joint``-style names."""
+        parts: list = []
+
+        def walk(obj):
+            if isinstance(obj, (tuple, list)):
+                parts.append("x".join(str(o) for o in obj))
+            else:
+                parts.append(str(obj))
+
+        for item in key:
+            walk(item)
+        return "_".join(parts)
+
+    def _timed_first_call(self, key: tuple, fn: Callable) -> Callable:
+        """Wrap a fresh cache entry so its first invocation — where
+        ``jax.jit`` traces and XLA compiles — lands in the compile
+        gauge.  Subsequent calls pay one boolean check."""
+        gauge = self._compile_gauge
+        label = self._key_label(key)
+        done = [False]
+
+        def wrapper(*args, **kwargs):
+            if done[0]:
+                return fn(*args, **kwargs)
+            t0 = time.perf_counter()
+            out = fn(*args, **kwargs)
+            done[0] = True  # a concurrent double-record is harmless
+            gauge.set(time.perf_counter() - t0, key=label)
+            return out
+
+        return wrapper
 
     def get_or_create(self, key: tuple, factory: Callable[[], Callable]):
         with self._lock:
@@ -76,6 +144,8 @@ class CompiledFnCache:
                 return entry
             self.misses += 1
             entry = factory()
+            if self._compile_gauge is not None:
+                entry = self._timed_first_call(key, entry)
             self._entries[key] = entry
             while len(self._entries) > self.maxsize:
                 evicted, _ = self._entries.popitem(last=False)
@@ -150,6 +220,33 @@ class ModelRegistry:
         self.engine = engine
         self._states: Dict[str, PosteriorState] = {}
         self._compiled = CompiledFnCache(max_compiled)
+        # structured event log (metran_tpu.obs.EventLog); attached by
+        # bind_observability — usually the owning service's log, so
+        # quarantine/load events land next to breaker/retry events
+        self.events = None
+
+    def bind_observability(self, metrics=None, events=None) -> None:
+        """Attach this registry to an observability bundle.
+
+        ``metrics`` (a :class:`~metran_tpu.obs.MetricsRegistry`) gets
+        the integrity counters mirrored as a ``kind``-labelled family
+        (``metran_registry_integrity_events_total``, pre-bind counts
+        carried over) and the compiled-kernel cache's hit/miss/resident
+        gauges plus per-bucket compile wall-time gauges.  ``events``
+        (a :class:`~metran_tpu.obs.EventLog`) receives quarantine and
+        load-failure events.  Idempotent; called by
+        :class:`~metran_tpu.serve.MetranService` construction with the
+        service's own bundle.
+        """
+        if metrics is not None:
+            self.integrity.bind(
+                metrics, "metran_registry_integrity_events_total",
+                "state-integrity events by kind (quarantines, load "
+                "failures, last-good fallbacks, temp sweeps)",
+            )
+            self._compiled.bind_metrics(metrics)
+        if events is not None:
+            self.events = events
 
     # ------------------------------------------------------------------
     # state storage
@@ -216,6 +313,12 @@ class ModelRegistry:
         except FileNotFoundError:  # pragma: no cover - concurrent move
             return None
         self.integrity.increment("quarantined")
+        if self.events is not None:
+            self.events.emit(
+                "quarantine", model_id=path.stem,
+                fault_point="registry.load",
+                reason=str(reason), quarantined_to=str(dest),
+            )
         logger.error(
             "quarantined corrupt state file %s -> %s (%s)",
             path, dest, reason,
@@ -291,6 +394,12 @@ class ModelRegistry:
         except (StateIntegrityError, ValueError):
             if state is not None:
                 self.integrity.increment("served_last_good")
+                if self.events is not None:
+                    self.events.emit(
+                        "served_last_good", model_id=model_id,
+                        fault_point="registry.load",
+                        version=state.version,
+                    )
                 logger.warning(
                     "serving last-good in-memory state for model %r "
                     "(version %d) after a failed disk load",

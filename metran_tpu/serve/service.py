@@ -22,6 +22,19 @@ The service is thread-safe for concurrent ``update``/``forecast``
 callers; dispatches for the same shape bucket coalesce into single
 device executions (``serve/batching.py``).
 
+Observability (``metran_tpu.obs``): the service publishes into one
+:class:`~metran_tpu.obs.MetricsRegistry` (latency/occupancy histograms,
+``kind``-labelled error counters, readiness/queue/breaker gauges, the
+model registry's integrity and compile-cache metrics — scrape them all
+with ``service.obs.metrics.render_prometheus()``), emits attributed
+reliability events into a structured :class:`~metran_tpu.obs.EventLog`
+(breaker transitions, retries, chain breaks, poisoned updates,
+quarantines), and — when a :class:`~metran_tpu.obs.Tracer` is
+installed — records request-scoped spans under one correlation ID from
+submit through batcher wait, dispatch, engine, integrity gate and
+commit, across the batcher thread boundary and the deferred-chain and
+retry paths.
+
 Failure isolation (``metran_tpu.reliability``): a request fails ALONE.
 Payloads are validated at submission; each batch slot's computed
 posterior is checked for finiteness/symmetry/PSD before it is committed
@@ -47,6 +60,7 @@ from typing import NamedTuple, Optional, Tuple
 
 import numpy as np
 
+from ..obs import Observability
 from ..reliability.faultinject import fire
 from ..reliability.health import HealthMonitor
 from ..reliability.policy import (
@@ -181,7 +195,7 @@ class Forecast(NamedTuple):
 
 @dataclass
 class ServeMetrics:
-    """Request/dispatch telemetry (see ``utils/profiling.py``).
+    """Request/dispatch telemetry (see ``metran_tpu.obs.metrics``).
 
     ``errors`` counts reliability events by kind — ``poisoned_updates``,
     ``poisoned_forecasts``, ``validation_errors``, ``chain_failures``,
@@ -189,6 +203,12 @@ class ServeMetrics:
     ``persist_failures``, ``finalize_failures``,
     ``update_errors``/``forecast_errors`` — the degradation half of the
     telemetry, exported into ``BENCH_*.json``.
+
+    Constructed via :meth:`registered`, every instrument mirrors into
+    the service's unified :class:`~metran_tpu.obs.MetricsRegistry`
+    (latency and occupancy histograms, a ``kind``-labelled error
+    counter family) so one Prometheus scrape covers all of it; the
+    bare constructor keeps the standalone (unregistered) behavior.
     """
 
     update_latency: LatencyRecorder = field(
@@ -199,6 +219,36 @@ class ServeMetrics:
     )
     occupancy: OccupancyCounter = field(default_factory=OccupancyCounter)
     errors: EventCounters = field(default_factory=EventCounters)
+
+    @classmethod
+    def registered(cls, registry) -> "ServeMetrics":
+        """Instruments backed by ``registry`` (a
+        :class:`~metran_tpu.obs.MetricsRegistry`); the metric names are
+        part of the documented catalogue (docs/concepts.md)."""
+        return cls(
+            update_latency=LatencyRecorder(
+                registry=registry,
+                name="metran_serve_update_latency_seconds",
+                help="update request latency, submit to resolve "
+                     "(seconds)",
+            ),
+            forecast_latency=LatencyRecorder(
+                registry=registry,
+                name="metran_serve_forecast_latency_seconds",
+                help="forecast request latency, submit to resolve "
+                     "(seconds)",
+            ),
+            occupancy=OccupancyCounter(
+                registry=registry,
+                name="metran_serve_batch_occupancy",
+                help="requests per device dispatch",
+            ),
+            errors=EventCounters(
+                registry=registry,
+                name="metran_serve_errors_total",
+                help="reliability/degradation events by kind",
+            ),
+        )
 
     def summary(self) -> str:
         return (
@@ -225,6 +275,11 @@ class MetranService:
     reliability : deadline/retry/breaker/validation policy
         (:class:`~metran_tpu.reliability.ReliabilityPolicy`); default
         from :func:`metran_tpu.config.serve_defaults`.
+    observability : metrics/tracing/event bundle
+        (:class:`~metran_tpu.obs.Observability`); default from
+        :meth:`~metran_tpu.obs.Observability.default` (metrics + event
+        ring on, tracing per ``METRAN_TPU_OBS_TRACE``).  Pass
+        ``Observability.disabled()`` to turn every instrument off.
     """
 
     def __init__(
@@ -234,6 +289,7 @@ class MetranService:
         max_batch: Optional[int] = None,
         persist_updates: bool = True,
         reliability: Optional[ReliabilityPolicy] = None,
+        observability: Optional[Observability] = None,
     ):
         from ..config import serve_defaults
 
@@ -244,15 +300,42 @@ class MetranService:
             max_batch = defaults["max_batch"]
         self.registry = registry
         self.persist_updates = persist_updates
-        self.metrics = ServeMetrics()
+        # a default-constructed bundle is OURS to close (its event log
+        # may own a file sink); a caller-provided one is theirs
+        self._owns_obs = observability is None
+        self.obs = (
+            observability if observability is not None
+            else Observability.default()
+        )
+        self.tracer = self.obs.tracer
+        self.events = self.obs.events
+        self.metrics = (
+            ServeMetrics.registered(self.obs.metrics)
+            if self.obs.metrics is not None else ServeMetrics()
+        )
         self.reliability = (
             reliability if reliability is not None
             else ReliabilityPolicy.from_defaults()
         )
+        on_transition = None
+        if self.events is not None:
+            events = self.events
+
+            def on_transition(model_id, old, new):
+                # the breaker fires this OUTSIDE its lock; each
+                # transition becomes one attributed event, so a model's
+                # open -> half_open -> closed outage timeline
+                # reconstructs from the log alone
+                events.emit(
+                    f"breaker_{new}", model_id=model_id,
+                    fault_point="breaker", previous=old,
+                )
+
         self.breakers = BreakerBoard(
             failure_threshold=self.reliability.breaker_failures,
             cooldown_s=self.reliability.breaker_cooldown_s,
             clock=self.reliability.clock,
+            on_transition=on_transition,
         )
         self.monitor = HealthMonitor(
             window=self.reliability.health_window,
@@ -283,6 +366,37 @@ class MetranService:
             self._dispatch, flush_deadline=flush_deadline,
             max_batch=max_batch,
         )
+        # unify the whole stack's metrics in ONE registry: the model
+        # registry's integrity counters + compile-cache telemetry join
+        # the service's instruments, and the liveness/health state is
+        # published as callback gauges (evaluated at scrape time)
+        self.registry.bind_observability(
+            metrics=self.obs.metrics, events=self.events
+        )
+        if self.obs.metrics is not None:
+            m = self.obs.metrics
+            self.monitor.bind_metrics(m)
+            m.gauge(
+                "metran_serve_ready",
+                "readiness bit: batcher can dispatch AND windowed "
+                "error rate under the policy threshold",
+                callback=self._ready,
+            )
+            m.gauge(
+                "metran_serve_batcher_pending",
+                "requests currently queued in the micro-batcher",
+                callback=lambda: float(self.batcher.pending()),
+            )
+            m.gauge(
+                "metran_serve_open_breakers",
+                "models whose circuit breaker is not closed",
+                callback=lambda: float(len(self.breakers.open_models())),
+            )
+
+    def _ready(self) -> float:
+        """The orchestrator bit as a float (callback-gauge friendly)."""
+        alive = self.batcher.worker_alive() and not self.batcher.closed
+        return 1.0 if (alive and self.monitor.healthy()) else 0.0
 
     # ------------------------------------------------------------------
     # public API
@@ -304,7 +418,47 @@ class MetranService:
         )
 
     def forecast_async(self, model_id: str, steps: int) -> "Future[Forecast]":
-        steps = int(steps)
+        # attempt-level span, submit -> future resolution: nested under
+        # the sync call's root when one is active (contextvars), a
+        # fresh trace for bare async use.  The span identity is
+        # pre-allocated (Tracer.begin) so the dispatch stages can
+        # parent on it immediately; its interval is recorded from the
+        # outcome callback the service registers anyway (_observe) —
+        # no per-request open-span object, no extra done-callback.
+        span = self._begin_request_span()
+        try:
+            return self._forecast_submit(model_id, int(steps), span)
+        except BaseException as exc:
+            self._fail_request_span(span, "forecast", model_id, exc)
+            raise
+
+    #: request-span names by call kind (looked up at close so the hot
+    #: begin path allocates no string and no attrs dict)
+    _REQUEST_SPAN = {
+        "forecast": "serve.forecast.request",
+        "update": "serve.update.request",
+    }
+
+    def _begin_request_span(self):
+        """Open one request span's identity + start time, or None when
+        tracing is off.  ``Tracer.begin`` allocates a single context
+        object — this runs once per request on the submission hot
+        path; ``_observe``'s done callback closes it."""
+        tracer = self.tracer
+        return tracer.begin() if tracer is not None else None
+
+    def _fail_request_span(self, span, kind: str, model_id: str,
+                           exc) -> None:
+        """Record a request span that failed before submission."""
+        tracer = self.tracer
+        if span is None or tracer is None:
+            return
+        tracer.finish(
+            self._REQUEST_SPAN[kind], span,
+            {"model_id": model_id, "outcome": "error", "error": repr(exc)},
+        )
+
+    def _forecast_submit(self, model_id: str, steps: int, span):
         if steps < 1:
             self.metrics.errors.increment("validation_errors")
             raise ValueError(f"forecast steps must be >= 1, got {steps}")
@@ -328,13 +482,19 @@ class MetranService:
         try:
             bucket = self.registry.bucket_of(state)
             fut = self.batcher.submit(
-                ("forecast", bucket, steps), model_id, None
+                ("forecast", bucket, steps), model_id, None, trace=span,
             )
         except BaseException:
             # infrastructure refusal before any request existed:
             # release a half-open probe slot without a verdict
             breaker.record_abandoned(token)
             raise
+        # span=None: forecast request spans are closed BATCHED on the
+        # dispatch thread (_dispatch's finish_many — the outcome is
+        # known there), not per done-callback — one lock-held sweep per
+        # batch instead of B finish calls on the hot path.  The cost: a
+        # forecast cancelled or refused after enqueue leaves no request
+        # span (its stages were never recorded either).
         self._observe(fut, "forecast", breaker, token)
         return fut
 
@@ -365,7 +525,20 @@ class MetranService:
         )
 
     def _call(self, kind: str, model_id: str, submit, deadline):
-        """Sync-call engine: hard deadline + bounded retries."""
+        """Sync-call engine: hard deadline + bounded retries.
+
+        When tracing, the whole engine — every retry attempt included —
+        runs under one root span ``serve.update``/``serve.forecast``,
+        so a retried request keeps ONE correlation ID: the attempt
+        spans (``*.request``) nest under it via the caller-thread
+        context, and each attempt's dispatch-side stages re-attach to
+        those explicitly."""
+        if self.tracer is None:
+            return self._call_inner(kind, model_id, submit, deadline)
+        with self.tracer.span(f"serve.{kind}", model_id=model_id):
+            return self._call_inner(kind, model_id, submit, deadline)
+
+    def _call_inner(self, kind: str, model_id: str, submit, deadline):
         pol = self.reliability
         deadline_s = pol.deadline_s if deadline == "default" else deadline
         t_end = None if deadline_s is None else pol.clock() + deadline_s
@@ -396,6 +569,12 @@ class MetranService:
                         in_flight = not fut.cancel()
                         self.metrics.errors.increment("deadline_exceeded")
                         self.monitor.record(False)
+                        if self.events is not None:
+                            self.events.emit(
+                                "deadline_exceeded", model_id=model_id,
+                                fault_point="serve.call", call=kind,
+                                deadline_s=deadline_s, in_flight=in_flight,
+                            )
                         raise DeadlineExceededError(
                             kind, model_id, deadline_s, in_flight=in_flight
                         ) from None
@@ -407,6 +586,12 @@ class MetranService:
                 delay = pol.retry.delay(attempt)
                 if t_end is None or pol.clock() + delay < t_end:
                     self.metrics.errors.increment("retries")
+                    if self.events is not None:
+                        self.events.emit(
+                            "retry", model_id=model_id,
+                            fault_point="serve.call", call=kind,
+                            attempt=attempt, error=repr(failure),
+                        )
                     logger.warning(
                         "retrying %s for model %r (attempt %d) after: %s",
                         kind, model_id, attempt, failure,
@@ -442,39 +627,70 @@ class MetranService:
             timeout=max(t_end - self.reliability.clock(), 0.0)
         )
 
-    def _observe(self, fut: Future, kind: str, breaker, token) -> None:
+    def _observe(self, fut: Future, kind: str, breaker, token,
+                 span=None, model_id: Optional[str] = None) -> None:
         """Record a request's final outcome in breaker + health + errors.
 
         ``token`` is the breaker admission token — threading it back
         attributes the verdict, so a slow request admitted before the
         breaker opened cannot later close it (or steal/re-open a
-        half-open probe) with a stale outcome."""
+        half-open probe) with a stale outcome.  ``span`` (from
+        ``_begin_request_span``) piggybacks the request span's close on
+        this same callback — one callback per future, not two.
+        """
 
         def _done(f: Future) -> None:
             try:
                 if f.cancelled():
                     breaker.record_abandoned(token)
-                    return
-                exc = f.exception()
-                if exc is None:
-                    breaker.record_success(token)
-                    self.monitor.record(True)
-                elif getattr(exc, "_metran_infra_refusal", False):
-                    # the batcher refused the hand-off (e.g. closed):
-                    # infrastructure's refusal, not the model's failure
-                    # — no verdict, matching the direct submission
-                    # path's record_abandoned
-                    breaker.record_abandoned(token)
+                    outcome = "cancelled"
                 else:
-                    breaker.record_failure(token)
-                    self.monitor.record(False)
-                    self.metrics.errors.increment(f"{kind}_errors")
+                    exc = f.exception()
+                    if exc is None:
+                        breaker.record_success(token)
+                        self.monitor.record(True)
+                        outcome = "ok"
+                    elif getattr(exc, "_metran_infra_refusal", False):
+                        # the batcher refused the hand-off (e.g.
+                        # closed): infrastructure's refusal, not the
+                        # model's failure — no verdict, matching the
+                        # direct submission path's record_abandoned
+                        breaker.record_abandoned(token)
+                        outcome = "abandoned"
+                    else:
+                        breaker.record_failure(token)
+                        self.monitor.record(False)
+                        self.metrics.errors.increment(f"{kind}_errors")
+                        outcome = "error"
+                if span is not None:
+                    tracer = self.tracer
+                    if tracer is not None:
+                        # bare-string attrs on success (zero-allocation
+                        # form, read back as label=<model_id>); a dict
+                        # with the outcome only off the happy path
+                        tracer.finish(
+                            self._REQUEST_SPAN[kind], span,
+                            model_id if outcome == "ok" else
+                            {"model_id": model_id, "outcome": outcome},
+                        )
             except Exception:  # pragma: no cover - telemetry must not
                 logger.exception("outcome telemetry failed")  # kill resolvers
 
         fut.add_done_callback(_done)
 
     def update_async(self, model_id: str, new_obs) -> "Future[PosteriorState]":
+        # attempt-level span (see forecast_async); its context rides
+        # the batcher request explicitly, so the dispatch stages — and
+        # a deferred submission made much later from a predecessor's
+        # done-callback — re-attach to this request's correlation ID
+        span = self._begin_request_span()
+        try:
+            return self._update_submit(model_id, new_obs, span)
+        except BaseException as exc:
+            self._fail_request_span(span, "update", model_id, exc)
+            raise
+
+    def _update_submit(self, model_id: str, new_obs, span):
         # registry lookup first — see forecast_async: unknown ids must
         # not allocate breaker state
         try:
@@ -518,13 +734,15 @@ class MetranService:
         # enter the batcher — that wait is part of what the caller sees
         t_submit = time.monotonic()
         try:
-            out = self._enqueue_update(model_id, key, payload, t_submit)
+            out = self._enqueue_update(
+                model_id, key, payload, t_submit, trace=span,
+            )
         except BaseException:
             # batcher refused (e.g. closed): no request exists, so a
             # half-open probe slot must be released without a verdict
             breaker.record_abandoned(token)
             raise
-        self._observe(out, "update", breaker, token)
+        self._observe(out, "update", breaker, token, span, model_id)
 
         # the entry is only ever consulted while its future is
         # unresolved; drop it once done so a long-lived service does
@@ -557,9 +775,15 @@ class MetranService:
             else:
                 del self._last_update[model_id]
 
-    def _enqueue_update(self, model_id, key, payload, t_submit) -> Future:
+    def _enqueue_update(self, model_id, key, payload, t_submit,
+                        trace=None) -> Future:
         """Enqueue one validated update, preserving per-model order
         (chain on an unresolved predecessor unless provably co-batched).
+
+        ``trace`` (the originating request's span context) travels with
+        every submission path — including the deferred one, which runs
+        from a predecessor's done-callback on an arbitrary thread —
+        so the dispatch stages stay on the caller's correlation ID.
 
         The chaining DECISION is made and the entry published under
         ``_order_lock``; the batcher submission itself happens after
@@ -582,7 +806,9 @@ class MetranService:
             entry = _PendingUpdate(key, fut, prior=prior)
             self._last_update[model_id] = entry
         if prior is None:
-            self._attach_and_wire(entry, model_id, payload, t_submit)
+            self._attach_and_wire(
+                entry, model_id, payload, t_submit, trace=trace
+            )
             return fut
         if join is not None:
             # the predecessor went straight into a batcher group; join
@@ -590,7 +816,7 @@ class MetranService:
             # the batcher) — the rounds logic in _dispatch then chains
             # the duplicates
             outcome = self._attach_and_wire(
-                entry, model_id, payload, t_submit, join=join
+                entry, model_id, payload, t_submit, join=join, trace=trace
             )
             if outcome != "join_missed":
                 return fut  # enqueued, or cancelled before enqueueing
@@ -640,6 +866,15 @@ class MetranService:
                 # successfully CANCELLED predecessor had no side
                 # effect, so the chain continues from the same state)
                 self.metrics.errors.increment("chain_failures")
+                if self.events is not None:
+                    self.events.emit(
+                        "chain_break", model_id=model_id,
+                        request_id=(
+                            trace.trace_id if trace is not None else None
+                        ),
+                        fault_point="serve.order_chain",
+                        predecessor_error=repr(prior_done.exception()),
+                    )
                 try:
                     fut.set_exception(ChainedRequestError(
                         f"update for model {model_id!r} not "
@@ -650,7 +885,9 @@ class MetranService:
                     pass
                 return
             try:
-                self._attach_and_wire(entry, model_id, payload, t_submit)
+                self._attach_and_wire(
+                    entry, model_id, payload, t_submit, trace=trace
+                )
             except BaseException:  # e.g. batcher closed
                 return  # fut already resolved with the failure
 
@@ -658,7 +895,7 @@ class MetranService:
         return fut
 
     def _attach_and_wire(
-        self, entry, model_id, payload, t_submit, join=None
+        self, entry, model_id, payload, t_submit, join=None, trace=None
     ) -> str:
         """Submit the entry's update to the batcher through its outer
         future's cancel-atomic ``attach_inner``, wiring the inner future
@@ -676,7 +913,7 @@ class MetranService:
             out = fut.attach_inner(
                 lambda: self.batcher.submit_tracked(
                     entry.key, model_id, payload, join=join,
-                    enqueued_at=t_submit,
+                    enqueued_at=t_submit, trace=trace,
                 )
             )
         except BaseException as exc:
@@ -743,6 +980,9 @@ class MetranService:
             },
             "errors": self.metrics.errors.snapshot(),
             "integrity": self.registry.integrity_stats,
+            "events": (
+                self.events.counts() if self.events is not None else {}
+            ),
         })
         return snap
 
@@ -751,6 +991,10 @@ class MetranService:
         # updates that only enqueue from done-callbacks mid-drain —
         # before it starts refusing submissions
         self.batcher.close()
+        if self._owns_obs and self.events is not None:
+            # release a default bundle's owned event-sink fd (a caller-
+            # provided bundle stays open — it may outlive this service)
+            self.events.close()
 
     def __enter__(self) -> "MetranService":
         return self
@@ -763,6 +1007,27 @@ class MetranService:
     # ------------------------------------------------------------------
     def _dispatch(self, batch_key, requests):
         kind, bucket, horizon = batch_key
+        tracer = self.tracer
+        t_dispatch0 = None
+        if tracer is not None:
+            # the batcher-wait stage closes HERE, on the dispatch
+            # thread: enqueue -> claim, re-attached to each request's
+            # correlation ID via the explicitly-passed context (the
+            # deferred path backdates enqueued_at to submission, so the
+            # span covers the defer wait too — what the caller saw).
+            # Update-path only, like the dispatch span below: on the
+            # (much hotter) forecast path the wait is recoverable as
+            # [request-span start, engine-span start], and skipping the
+            # per-request record keeps full instrumentation under the
+            # 5% throughput bar
+            t_dispatch0 = tracer.clock()
+            if kind == "update":
+                tracer.record_many(
+                    "serve.batcher_wait",
+                    [(req.trace, req.enqueued_at) for req in requests
+                     if req.trace is not None],
+                    t_dispatch0,
+                )
         # fault point: injectable dispatch failures (whole batch) and
         # slow dispatches (wedged worker / slow device) for the
         # reliability test suite and `bench.py --phase serve-faults`
@@ -807,6 +1072,9 @@ class MetranService:
                         # the model's observation stream
                         for p in positions:
                             self.metrics.errors.increment("chain_failures")
+                            self._emit_chain_break(
+                                requests[p], failed=repr(failed)
+                            )
                             results[p] = ChainedRequestError(
                                 f"update for model "
                                 f"{requests[p].model_id!r} not applied: "
@@ -823,6 +1091,7 @@ class MetranService:
                     for p in positions:
                         if requests[p].model_id in broken:
                             self.metrics.errors.increment("chain_failures")
+                            self._emit_chain_break(requests[p])
                             results[p] = ChainedRequestError(
                                 f"update for model "
                                 f"{requests[p].model_id!r} not applied: "
@@ -854,7 +1123,58 @@ class MetranService:
         for req in requests:
             # queueing time + dispatch time, as the caller experienced it
             latency.record(now - req.enqueued_at)
+        if tracer is not None:
+            t_end = tracer.clock()
+            if kind == "update":
+                # one dispatch span per affected request: the shared
+                # batch execution attributed to every rider's
+                # correlation ID.  Update-path only: on the (much
+                # hotter) forecast path the dispatch interval is
+                # recoverable as [request start, engine end], and the
+                # saved record keeps full-instrumentation overhead
+                # under the 5% throughput bar
+                tracer.record_shared(
+                    "serve.dispatch",
+                    [req.trace for req in requests
+                     if req.trace is not None],
+                    t_dispatch0, t_end,
+                    {"kind": kind, "batch": len(requests)},
+                )
+            else:
+                # forecast request spans close HERE, batched (see
+                # _forecast_submit): end is a hair before the futures
+                # resolve, outcome comes from the per-slot results
+                entries = []
+                for pos, req in enumerate(requests):
+                    if req.trace is None:
+                        continue
+                    res = results[pos]
+                    entries.append((req.trace, (
+                        req.model_id
+                        if not isinstance(res, BaseException) else {
+                            "model_id": req.model_id,
+                            "outcome": "error",
+                            "error": repr(res),
+                        }
+                    )))
+                tracer.finish_many(
+                    "serve.forecast.request", entries, t_end
+                )
         return results
+
+    def _emit_chain_break(self, request, failed: Optional[str] = None):
+        """One attributed chain-break event (dispatch-side paths)."""
+        if self.events is None:
+            return
+        self.events.emit(
+            "chain_break", model_id=request.model_id,
+            request_id=(
+                request.trace.trace_id if request.trace is not None
+                else None
+            ),
+            fault_point="serve.dispatch",
+            **({"predecessor_error": failed} if failed else {}),
+        )
 
     def _lookup_states(self, requests, results):
         """Per-request registry reads: a model whose state cannot be
@@ -883,10 +1203,23 @@ class MetranService:
         states, live = self._lookup_states(requests, results)
         if not live:
             return results
+        tracer = self.tracer
         batch = stack_bucket(states, bucket)
         fn = self.registry.forecast_fn(bucket, steps)
+        t_eng0 = tracer.clock() if tracer is not None else None
         means, variances = fn(batch.ss, batch.mean, batch.cov)
         means, variances = np.asarray(means), np.asarray(variances)
+        if tracer is not None:
+            # the single batched kernel execution, attributed to every
+            # live request; the name matches the device-trace
+            # annotation the kernel runs under (engine.py)
+            t_eng1 = tracer.clock()
+            tracer.record_shared(
+                "serve.engine.forecast",
+                [requests[j].trace for j in live
+                 if requests[j].trace is not None],
+                t_eng0, t_eng1, {"batch": len(states)},
+            )
         validate = self.reliability.validate_updates
         for i, (st, j) in enumerate(zip(states, live)):
             n = st.n_series
@@ -896,6 +1229,16 @@ class MetranService:
                 np.all(np.isfinite(m)) and np.all(np.isfinite(v))
             ):
                 self.metrics.errors.increment("poisoned_forecasts")
+                if self.events is not None:
+                    self.events.emit(
+                        "poisoned_forecast", model_id=st.model_id,
+                        request_id=(
+                            requests[j].trace.trace_id
+                            if requests[j].trace is not None else None
+                        ),
+                        fault_point="serve.integrity_gate",
+                        version=st.version,
+                    )
                 results[j] = StateIntegrityError(
                     f"forecast for model {st.model_id!r} produced "
                     "non-finite moments (poisoned posterior state)"
@@ -944,6 +1287,8 @@ class MetranService:
             y[i, :, : st.n_series] = y_std
             m[i, :, : st.n_series] = mask
         fn = self.registry.update_fn(bucket, k)
+        tracer = self.tracer
+        t_eng0 = tracer.clock() if tracer is not None else None
         chol_t = None
         if sqrt_engine:
             mean_t, chol_t, sigma_t, detf_t = fn(
@@ -957,6 +1302,18 @@ class MetranService:
             cov_t = np.asarray(cov_t)
         mean_t = np.asarray(mean_t)
         sigma_t, detf_t = np.asarray(sigma_t), np.asarray(detf_t)
+        if tracer is not None:
+            # the batched kernel execution (device round-trip included
+            # — the asarray conversions block on it), attributed to
+            # each rider; name matches the device-trace annotation
+            t_eng1 = tracer.clock()
+            tracer.record_shared(
+                "serve.engine.update",
+                [requests[j].trace for j in live
+                 if requests[j].trace is not None],
+                t_eng0, t_eng1,
+                {"batch": len(states), "engine": self.registry.engine},
+            )
         validate = self.reliability.validate_updates
         for i, (st, j) in enumerate(zip(states, live)):
             # per-slot finalize: everything between here and a
@@ -970,7 +1327,13 @@ class MetranService:
             # loop's licence to resubmit).  Exception only: a
             # SimulatedCrash / KeyboardInterrupt means the process is
             # dying and must propagate.
+            trace_ctx = (
+                requests[j].trace if tracer is not None else None
+            )
             try:
+                t_gate0 = (
+                    tracer.clock() if trace_ctx is not None else None
+                )
                 idx = state_slot_index(st.n_series, st.n_factors, n_pad)
                 mean_i = mean_t[i][idx].astype(st.dtype)
                 if sqrt_engine:
@@ -1002,6 +1365,22 @@ class MetranService:
                         )
                     if fault is not None:
                         self.metrics.errors.increment("poisoned_updates")
+                        if self.events is not None:
+                            self.events.emit(
+                                "poisoned_update", model_id=st.model_id,
+                                request_id=(
+                                    trace_ctx.trace_id
+                                    if trace_ctx is not None else None
+                                ),
+                                fault_point="serve.integrity_gate",
+                                reason=str(fault), version=st.version,
+                            )
+                        if trace_ctx is not None:
+                            tracer.record(
+                                "serve.integrity_gate", trace_ctx,
+                                t_gate0, tracer.clock(),
+                                verdict="rejected", reason=str(fault),
+                            )
                         logger.error(
                             "rejecting update for model %r: %s",
                             st.model_id, fault,
@@ -1013,6 +1392,13 @@ class MetranService:
                             "state is unchanged"
                         )
                         continue
+                if trace_ctx is not None:
+                    # gate span covers slot slicing + validation — the
+                    # per-slot host cost the sqrt engine shrinks
+                    tracer.record(
+                        "serve.integrity_gate", trace_ctx, t_gate0,
+                        tracer.clock(), verdict="ok",
+                    )
                 # chol_i is None on covariance engines — which also
                 # DROPS any stale factor a sqrt-extracted state carried
                 # (the covariance kernel did not update it)
@@ -1022,6 +1408,9 @@ class MetranService:
                     mean=mean_i,
                     cov=cov_i,
                     chol=chol_i,
+                )
+                t_commit0 = (
+                    tracer.clock() if trace_ctx is not None else None
                 )
                 try:
                     self.registry.put(
@@ -1034,9 +1423,24 @@ class MetranService:
                     # (health shows it) rather than fail a caller whose
                     # observations were assimilated
                     self.metrics.errors.increment("persist_failures")
+                    if self.events is not None:
+                        self.events.emit(
+                            "persist_failure", model_id=st.model_id,
+                            request_id=(
+                                trace_ctx.trace_id
+                                if trace_ctx is not None else None
+                            ),
+                            fault_point="registry.put",
+                            version=new_state.version,
+                        )
                     logger.exception(
                         "write-through persist failed for model %r "
                         "(serving from memory)", st.model_id,
+                    )
+                if trace_ctx is not None:
+                    tracer.record(
+                        "serve.commit", trace_ctx, t_commit0,
+                        tracer.clock(), version=new_state.version,
                     )
             except Exception as exc:
                 self.metrics.errors.increment("finalize_failures")

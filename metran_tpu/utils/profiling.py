@@ -5,6 +5,16 @@ two things users actually need are (a) XLA traces viewable in
 TensorBoard/Perfetto and (b) simple fit-throughput counters for fleet
 runs.  Both are thin, dependency-free wrappers around ``jax.profiler``
 and ``time``.
+
+The serving-path instruments that historically lived here —
+:class:`LatencyRecorder`, :class:`EventCounters`,
+:class:`OccupancyCounter` — moved to :mod:`metran_tpu.obs.metrics`,
+where they are backed by the unified :class:`~metran_tpu.obs.
+MetricsRegistry` (Prometheus exposition, one scrape for the whole
+service).  They are re-exported here unchanged for back-compat; the
+host-side request *spans* that complement the device traces below live
+in :mod:`metran_tpu.obs.tracing` (matching ``TraceAnnotation`` names,
+so one Perfetto view lines both up).
 """
 
 from __future__ import annotations
@@ -16,7 +26,20 @@ from dataclasses import dataclass, field
 from logging import getLogger
 from typing import Dict, Iterator, List, Optional
 
+from ..obs.metrics import (  # noqa: F401  (back-compat re-exports)
+    EventCounters,
+    LatencyRecorder,
+    OccupancyCounter,
+)
+
 logger = getLogger(__name__)
+
+# jax.profiler.start_trace is process-global and refuses to nest; track
+# the owning thread here so a nested/concurrent trace() degrades to a
+# clear warning instead of a RuntimeError mid-workload (the outer trace
+# still captures the region, so the inner request loses nothing).
+_trace_lock = threading.Lock()
+_trace_owner: Optional[int] = None
 
 
 @contextlib.contextmanager
@@ -27,21 +50,58 @@ def trace(logdir: str, annotate: Optional[str] = None) -> Iterator[None]:
 
         with metran_tpu.utils.trace("/tmp/trace"):
             fit_fleet(fleet)
+
+    Re-entrancy-safe: ``jax.profiler.start_trace`` is process-global
+    and raises if a trace is already running, so a nested (or
+    concurrent) ``trace()`` block **no-ops with a warning** — the
+    enclosing trace keeps recording and is the one that gets written —
+    instead of killing the workload mid-run.  ``stop_trace`` only ever
+    runs when this block's own ``start_trace`` succeeded.
     """
     import jax
 
+    global _trace_owner
+    me = threading.get_ident()
+    with _trace_lock:
+        active = _trace_owner is not None
+        nested = active and _trace_owner == me
+        if not active:
+            _trace_owner = me
+    if active:
+        # no-op OUTSIDE the lock: the block may run arbitrarily long
+        # (and may itself call trace() again — re-acquiring the
+        # non-reentrant lock here would deadlock)
+        logger.warning(
+            "trace(%r) ignored: a device trace is already active "
+            "on %s — jax.profiler supports one trace per process; "
+            "the enclosing trace keeps recording",
+            logdir, "this thread" if nested else "another thread",
+        )
+        yield
+        return
     ctx = (
         jax.profiler.TraceAnnotation(annotate)
         if annotate
         else contextlib.nullcontext()
     )
-    jax.profiler.start_trace(logdir)
+    started = False
     try:
+        jax.profiler.start_trace(logdir)
+        started = True
         with ctx:
             yield
     finally:
-        jax.profiler.stop_trace()
-        logger.info("device trace written to %s", logdir)
+        # stop BEFORE releasing ownership: a concurrent trace() that
+        # claimed the freed slot while jax's trace was still active
+        # would hit start_trace's RuntimeError — the exact crash this
+        # guard exists to prevent
+        try:
+            if started:
+                jax.profiler.stop_trace()
+                logger.info("device trace written to %s", logdir)
+        finally:
+            with _trace_lock:
+                _trace_owner = None
 
 
 @contextlib.contextmanager
@@ -61,12 +121,20 @@ class ThroughputCounter:
     >>> with counter.measure(n=batch):
     ...     fit_fleet(fleet)
     >>> counter.per_second
+
+    ``total``/``seconds`` are exact lifetime accumulators; ``laps``
+    keeps only the most recent ``max_laps`` per-block records (oldest
+    half dropped beyond that, like ``LatencyRecorder.maxlen``) so a
+    long-lived service measuring every dispatch cannot leak one dict
+    per block forever.  ``n_laps`` counts every lap ever measured.
     """
 
     unit: str = "items"
     total: int = 0
     seconds: float = 0.0
     laps: List[Dict] = field(default_factory=list)
+    max_laps: int = 10_000
+    n_laps: int = 0
 
     @contextlib.contextmanager
     def measure(self, n: int = 1) -> Iterator[None]:
@@ -77,7 +145,10 @@ class ThroughputCounter:
             elapsed = time.perf_counter() - start
             self.total += n
             self.seconds += elapsed
+            self.n_laps += 1
             self.laps.append({"n": n, "seconds": elapsed})
+            if len(self.laps) > self.max_laps:
+                del self.laps[: len(self.laps) // 2]
 
     @property
     def per_second(self) -> float:
@@ -86,160 +157,7 @@ class ThroughputCounter:
     def summary(self) -> str:
         return (
             f"{self.total} {self.unit} in {self.seconds:.3f}s "
-            f"({self.per_second:.2f} {self.unit}/s over {len(self.laps)} laps)"
-        )
-
-
-@dataclass
-class LatencyRecorder:
-    """Per-request latency samples with percentile summaries.
-
-    The serving layer's request-path instrument (``metran_tpu.serve``):
-    record wall seconds per request, read p50/p99 — the numbers a
-    latency SLO is written against.  Bounded memory: beyond ``maxlen``
-    samples the oldest half is dropped (quantiles then describe recent
-    traffic, which is what an operator wants from a live service).
-    Thread-safe: the serving layer records from several dispatch
-    threads at once (background flusher + size-triggered submitters),
-    and an unlocked truncation racing an append would drop samples.
-    """
-
-    unit: str = "s"
-    maxlen: int = 100_000
-    samples: List[float] = field(default_factory=list)
-    total: int = 0
-    _lock: threading.Lock = field(
-        default_factory=threading.Lock, repr=False, compare=False
-    )
-
-    def record(self, seconds: float) -> None:
-        with self._lock:
-            self.samples.append(float(seconds))
-            self.total += 1
-            if len(self.samples) > self.maxlen:
-                del self.samples[: len(self.samples) // 2]
-
-    @contextlib.contextmanager
-    def measure(self) -> Iterator[None]:
-        start = time.perf_counter()
-        try:
-            yield
-        finally:
-            self.record(time.perf_counter() - start)
-
-    def percentile(self, q: float) -> float:
-        """q in [0, 100]; 0.0 when nothing has been recorded."""
-        with self._lock:  # snapshot only — sort outside, off the
-            samples = list(self.samples)  # dispatch threads' lock
-        if not samples:
-            return 0.0
-        ordered = sorted(samples)
-        idx = min(
-            len(ordered) - 1, max(0, round(q / 100.0 * (len(ordered) - 1)))
-        )
-        return ordered[idx]
-
-    @property
-    def p50(self) -> float:
-        return self.percentile(50.0)
-
-    @property
-    def p99(self) -> float:
-        return self.percentile(99.0)
-
-    @property
-    def mean(self) -> float:
-        with self._lock:
-            samples = list(self.samples)
-        return sum(samples) / len(samples) if samples else 0.0
-
-    def summary(self) -> str:
-        return (
-            f"{self.total} samples: p50={self.p50 * 1e3:.2f}ms "
-            f"p99={self.p99 * 1e3:.2f}ms mean={self.mean * 1e3:.2f}ms"
-        )
-
-
-@dataclass
-class EventCounters:
-    """Named lifetime event counters (thread-safe).
-
-    The error/degradation half of the serving telemetry: every
-    reliability event (a poisoned update rejected, a file quarantined, a
-    deadline missed, a breaker rejection, a retry) increments a named
-    counter here, so operators and ``bench.py`` track robustness next to
-    latency and occupancy.  Counters are exact lifetime totals — rates
-    over recent traffic live in
-    :class:`metran_tpu.reliability.health.HealthMonitor`.
-    """
-
-    counts: Dict[str, int] = field(default_factory=dict)
-    _lock: threading.Lock = field(
-        default_factory=threading.Lock, repr=False, compare=False
-    )
-
-    def increment(self, name: str, n: int = 1) -> None:
-        with self._lock:
-            self.counts[name] = self.counts.get(name, 0) + int(n)
-
-    def get(self, name: str) -> int:
-        with self._lock:
-            return self.counts.get(name, 0)
-
-    @property
-    def total(self) -> int:
-        with self._lock:
-            return sum(self.counts.values())
-
-    def snapshot(self) -> Dict[str, int]:
-        with self._lock:
-            return dict(self.counts)
-
-    def summary(self) -> str:
-        snap = self.snapshot()
-        if not snap:
-            return "no error events"
-        inner = ", ".join(f"{k}={v}" for k, v in sorted(snap.items()))
-        return f"events: {inner}"
-
-
-@dataclass
-class OccupancyCounter:
-    """Batch-occupancy accounting for the micro-batching queue.
-
-    How full device dispatches actually run — the efficiency half of
-    the serving telemetry (latency being the other): ``mean_occupancy``
-    near 1 means the batcher coalesces nothing and each request pays a
-    full dispatch.  Totals are running counters (exact over the whole
-    lifetime); ``batches`` keeps only the most recent ``maxlen`` sizes,
-    bounded like :class:`LatencyRecorder` for long-lived services, and
-    thread-safe for the same reason (concurrent dispatch threads).
-    """
-
-    maxlen: int = 100_000
-    batches: List[int] = field(default_factory=list)
-    dispatches: int = 0
-    requests: int = 0
-    _lock: threading.Lock = field(
-        default_factory=threading.Lock, repr=False, compare=False
-    )
-
-    def record(self, size: int) -> None:
-        with self._lock:
-            self.batches.append(int(size))
-            self.dispatches += 1
-            self.requests += int(size)
-            if len(self.batches) > self.maxlen:
-                del self.batches[: len(self.batches) // 2]
-
-    @property
-    def mean_occupancy(self) -> float:
-        return self.requests / self.dispatches if self.dispatches else 0.0
-
-    def summary(self) -> str:
-        return (
-            f"{self.requests} requests over {self.dispatches} dispatches "
-            f"(mean occupancy {self.mean_occupancy:.1f})"
+            f"({self.per_second:.2f} {self.unit}/s over {self.n_laps} laps)"
         )
 
 

@@ -114,6 +114,16 @@ def test_metran_solve_jax(series_list, golden):
     # nfev reports true objective evaluations (one per line-search step),
     # scipy-comparable: a real fit evaluates many times
     assert m.fit.nfev > 5
+    # the fit recorded its FitTelemetry trajectory and fit_report
+    # surfaces the one-line summary (obs satellite)
+    tele = m.fit.telemetry
+    assert tele is not None and tele.stop_reason is not None
+    assert tele.checkpoints, "no optimizer checkpoints recorded"
+    assert tele.nfev == m.fit.nfev > 0
+    assert tele.value0 is not None and tele.value is not None
+    report = m.fit_report()
+    assert "Fit telemetry" in report
+    assert f"stop={tele.stop_reason}" in report
 
 
 def test_metran_state_means(mt, golden):

@@ -1,0 +1,660 @@
+"""Observability layer (`metran_tpu.obs`) — formats, tracing, drift gates.
+
+Pins the layer's externally-consumed contracts:
+
+1. **Prometheus exposition** — `render_prometheus()` validates
+   line-by-line against the text-format grammar (name charset,
+   HELP/TYPE pairs preceding samples, histogram `_bucket`/`_sum`/
+   `_count` invariants with cumulative bucket counts), both for a
+   hand-built registry and for a live instrumented service;
+2. **request tracing** — a single `update()` yields a connected trace
+   (one correlation ID) spanning submit → batcher wait → dispatch →
+   engine → integrity gate → commit, across the batcher thread
+   boundary and the deferred-chain and retry paths; the Chrome
+   trace-event export is loadable JSON with consistent `ts`/`dur` and
+   parent containment;
+3. **event log** — attributed reliability events (poisoned update,
+   chain break, retry) carry `model_id`/`request_id`/`fault_point`
+   joinable against the trace;
+4. **drift gates** — `tools/check_metrics.py` and
+   `tools/gen_api_docs.py --check` stay green (run as subprocesses),
+   so metric-catalogue or API-doc drift fails the suite.
+
+Select alone with `pytest -m obs`; everything here is inside tier-1.
+"""
+
+import json
+import math
+import re
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from metran_tpu.obs import (
+    EventLog,
+    FitTelemetry,
+    MetricsRegistry,
+    Observability,
+    Tracer,
+)
+from metran_tpu.reliability import (
+    ChainedRequestError,
+    ReliabilityPolicy,
+    RetryPolicy,
+    StateIntegrityError,
+    faultinject,
+)
+from metran_tpu.serve import MetranService, ModelRegistry
+from metran_tpu.utils.profiling import ThroughputCounter, trace
+
+from tests.test_reliability import _make_state, _poison
+
+pytestmark = pytest.mark.obs
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+# ----------------------------------------------------------------------
+# Prometheus text-format validation (exposition grammar)
+# ----------------------------------------------------------------------
+_METRIC_NAME = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_SAMPLE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>(?:[a-zA-Z_][a-zA-Z0-9_]*="
+    r'"(?:[^"\\]|\\.)*",?)*)\})?'
+    r" (?P<value>\S+)$"
+)
+_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def validate_prometheus(text: str) -> dict:
+    """Validate exposition text line-by-line; returns
+    ``{family: {"type": kind, "samples": [(name, labels, value)]}}``.
+
+    Enforces: metric-name charset, exactly one HELP and one TYPE per
+    family with both preceding the family's samples, known TYPE
+    values, label grammar, parseable sample values, and — for
+    histograms — the `_bucket`/`_sum`/`_count` triplet with cumulative
+    non-decreasing bucket counts closing at ``le="+Inf"`` equal to
+    ``_count``.
+    """
+    assert text.endswith("\n"), "exposition must end with a newline"
+    families: dict = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        where = f"line {lineno}: {line!r}"
+        if line.startswith("# HELP "):
+            name = line[len("# HELP "):].split(" ", 1)[0]
+            assert _METRIC_NAME.match(name), where
+            assert name not in families, f"duplicate HELP ({where})"
+            families[name] = {"type": None, "samples": []}
+        elif line.startswith("# TYPE "):
+            parts = line.split(" ")
+            assert len(parts) == 4, where
+            name, kind = parts[2], parts[3]
+            assert name in families, f"TYPE before HELP ({where})"
+            assert families[name]["type"] is None, \
+                f"duplicate TYPE ({where})"
+            assert kind in _TYPES, where
+            families[name]["type"] = kind
+        elif line.startswith("#"):
+            continue  # plain comment
+        else:
+            m = _SAMPLE.match(line)
+            assert m, f"unparseable sample ({where})"
+            sname = m["name"]
+            family = sname
+            if family not in families:
+                for suffix in _HIST_SUFFIXES:
+                    if sname.endswith(suffix):
+                        family = sname[: -len(suffix)]
+                        break
+            assert family in families, f"sample without family ({where})"
+            assert families[family]["type"] is not None, \
+                f"sample before TYPE ({where})"
+            if family != sname:
+                assert families[family]["type"] == "histogram", where
+            labels = {}
+            if m["labels"]:
+                for ln_, lv in _LABEL.findall(m["labels"]):
+                    assert ln_ not in labels, f"duplicate label ({where})"
+                    labels[ln_] = lv
+            value = float(m["value"])  # accepts +Inf/-Inf/NaN
+            families[family]["samples"].append((sname, labels, value))
+
+    for family, info in families.items():
+        assert info["type"] is not None, f"{family}: HELP without TYPE"
+        if info["type"] != "histogram":
+            continue
+        buckets = [
+            (labels, v) for sname, labels, v in info["samples"]
+            if sname == family + "_bucket"
+        ]
+        sums = [v for sname, _, v in info["samples"]
+                if sname == family + "_sum"]
+        counts = [v for sname, _, v in info["samples"]
+                  if sname == family + "_count"]
+        assert buckets and len(sums) == 1 and len(counts) == 1, \
+            f"{family}: incomplete histogram triplet"
+        prev, bounds = -1.0, []
+        for labels, v in buckets:
+            assert set(labels) == {"le"}, f"{family}: bucket labels"
+            bounds.append(float(labels["le"]))
+            assert v >= prev, f"{family}: bucket counts not cumulative"
+            prev = v
+        assert bounds == sorted(bounds), f"{family}: le not sorted"
+        assert math.isinf(bounds[-1]), f"{family}: missing +Inf bucket"
+        assert buckets[-1][1] == counts[0], \
+            f"{family}: +Inf bucket != _count"
+    return families
+
+
+def test_render_prometheus_grammar_unit():
+    reg = MetricsRegistry()
+    c = reg.counter("metran_test_events_total", "events by kind",
+                    label_names=("kind",))
+    c.inc(kind="retries")
+    c.inc(3, kind="breaker_open")
+    reg.counter("metran_test_requests_total", "plain total").inc(7)
+    reg.gauge("metran_test_depth", "queue depth").set(4)
+    reg.gauge("metran_test_cb", "callback gauge", callback=lambda: 2.5)
+    h = reg.histogram("metran_test_latency_seconds", "latency",
+                      buckets=(0.001, 0.01, 0.1, 1.0))
+    for v in (0.0005, 0.004, 0.05, 0.05, 3.0):
+        h.observe(v)
+    families = validate_prometheus(reg.render_prometheus())
+    assert set(families) == set(reg.names())
+    # every registered name is package-convention snake_case too
+    assert all(re.match(r"^[a-z_][a-z0-9_]*$", n) for n in families)
+    hist = families["metran_test_latency_seconds"]
+    count = [v for n, _, v in hist["samples"]
+             if n.endswith("_count")][0]
+    assert count == 5
+    total = [v for n, lbl, v in
+             families["metran_test_events_total"]["samples"]
+             if lbl.get("kind") == "breaker_open"][0]
+    assert total == 3
+    # label values with quotes/newlines/backslashes stay parseable
+    c.inc(kind="weird")
+    g = reg.gauge("metran_test_labelled", "escapes",
+                  label_names=("path",))
+    g.set(1, path='a"b\\c\nd')
+    validate_prometheus(reg.render_prometheus())
+
+
+def test_registry_registration_semantics():
+    reg = MetricsRegistry()
+    with pytest.raises(ValueError, match="snake_case"):
+        reg.counter("NotSnake")
+    c = reg.counter("metran_x_total", "x")
+    assert reg.counter("metran_x_total") is c  # idempotent
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("metran_x_total")  # kind conflict
+    with pytest.raises(ValueError, match="already registered"):
+        reg.counter("metran_x_total", label_names=("kind",))
+    reg.histogram("metran_h_seconds", buckets=(0.1, 1.0))
+    with pytest.raises(ValueError, match="buckets"):
+        reg.histogram("metran_h_seconds", buckets=(0.5, 1.0))
+    with pytest.raises(ValueError, match="cannot decrease"):
+        c.inc(-1)
+    with pytest.raises(ValueError, match="takes labels"):
+        reg.counter("metran_l_total", label_names=("kind",)).inc(
+            wrong="x"
+        )
+    snap = reg.snapshot()
+    assert snap["metran_x_total"]["type"] == "counter"
+    json.dumps(snap)  # JSON-ready
+
+
+def test_latency_recorder_reset_keeps_lifetime_counts():
+    from metran_tpu.obs import LatencyRecorder
+
+    reg = MetricsRegistry()
+    lat = LatencyRecorder(registry=reg, name="metran_t_seconds")
+    lat.record(5.0)
+    lat.record(5.0)
+    lat.reset()
+    lat.record(0.001)
+    assert lat.p99 == pytest.approx(0.001)  # warm-up samples dropped
+    assert lat.total == 3  # lifetime count survives the reset
+    hist = reg.get("metran_t_seconds")
+    assert hist.count == 3  # registry histogram keeps lifetime too
+
+
+# ----------------------------------------------------------------------
+# tracer unit behavior
+# ----------------------------------------------------------------------
+def test_tracer_ring_bounded_and_cleared():
+    tr = Tracer(maxlen=8, clock=time.monotonic)
+    for i in range(20):
+        ctx = tr.begin()
+        tr.finish(f"span_{i}", ctx)
+    spans = tr.spans()
+    assert len(spans) == 8
+    assert tr.dropped == 12
+    assert spans[0]["name"] == "span_12"  # oldest 12 evicted
+    tr.clear()
+    assert tr.spans() == [] and tr.dropped == 0
+
+
+def test_tracer_span_nesting_and_context_propagation():
+    tr = Tracer()
+    with tr.span("outer") as outer:
+        assert tr.current() == outer.context
+        with tr.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+    assert tr.current() is None
+    spans = {s["name"]: s for s in tr.spans()}
+    assert spans["inner"]["parent_id"] == spans["outer"]["span_id"]
+    assert spans["outer"]["parent_id"] is None
+    # begin() on a thread with an active context joins its trace
+    with tr.span("root") as root:
+        ctx = tr.begin()
+    assert ctx.trace_id == root.trace_id
+    assert ctx.parent_id == root.context.span_id
+
+
+def test_tracer_bare_string_attrs_read_as_label():
+    tr = Tracer()
+    tr.finish("req", tr.begin(), "m17")
+    (span,) = tr.spans(name="req")
+    assert span["args"] == {"label": "m17"}
+
+
+def test_tracer_record_shared_and_many():
+    tr = Tracer()
+    parents = [tr.make_context() for _ in range(3)]
+    tr.record_shared("stage", parents, 1.0, 2.0, {"batch": 3})
+    stage = tr.spans(name="stage")
+    assert [s["parent_id"] for s in stage] == [p.span_id for p in parents]
+    assert all(s["dur"] == pytest.approx(1.0) for s in stage)
+    tr.record_many("wait", [(p, 0.5) for p in parents], 2.0)
+    waits = tr.spans(name="wait")
+    assert all(s["dur"] == pytest.approx(1.5) for s in waits)
+    assert {s["trace_id"] for s in waits} == {p.trace_id for p in parents}
+
+
+# ----------------------------------------------------------------------
+# end-to-end request tracing through the serve stack
+# ----------------------------------------------------------------------
+UPDATE_STAGES = {
+    "serve.update",
+    "serve.update.request",
+    "serve.batcher_wait",
+    "serve.dispatch",
+    "serve.engine.update",
+    "serve.integrity_gate",
+    "serve.commit",
+}
+
+
+def _instrumented_service(reg, **kw):
+    obs = Observability(
+        metrics=MetricsRegistry(), tracer=Tracer(), events=EventLog()
+    )
+    kw.setdefault("persist_updates", False)
+    kw.setdefault(
+        "reliability",
+        ReliabilityPolicy(
+            deadline_s=None, retry=RetryPolicy(max_attempts=1),
+            breaker_failures=1000, breaker_cooldown_s=30.0,
+        ),
+    )
+    return MetranService(reg, observability=obs, **kw), obs
+
+
+def test_update_trace_connected_across_thread_boundary(rng):
+    """Acceptance: one sync update() → one correlation ID spanning
+    submit → batcher wait → dispatch → engine → integrity gate →
+    commit, with the dispatch-side stages recorded on the batcher
+    thread and contained in the request span's interval."""
+    reg = ModelRegistry()
+    st, *_ = _make_state(rng, model_id="m0", n=3, k=1, t=40)
+    reg.put(st, persist=False)
+    svc, obs = _instrumented_service(reg, flush_deadline=0.005)
+    try:
+        svc.update("m0", rng.normal(size=(1, 3)))
+    finally:
+        svc.close()
+    tr = obs.tracer
+    roots = tr.spans(name="serve.update")
+    assert len(roots) == 1
+    tid = roots[0]["trace_id"]
+    spans = tr.spans(trace_id=tid)
+    assert {s["name"] for s in spans} == UPDATE_STAGES
+    # parent links form a tree rooted at serve.update
+    by_id = {s["span_id"]: s for s in spans}
+    for s in spans:
+        if s["name"] == "serve.update":
+            assert s["parent_id"] is None
+        else:
+            assert s["parent_id"] in by_id, s
+    # the dispatch-side stages re-attached on ANOTHER thread (rows
+    # carry the tid of the thread that recorded them: the sync root
+    # closes on the caller, the engine span on the batcher worker)...
+    root = next(s for s in spans if s["name"] == "serve.update")
+    request = next(s for s in spans if s["name"] == "serve.update.request")
+    engine = next(s for s in spans if s["name"] == "serve.engine.update")
+    assert engine["tid"] != root["tid"]
+    # ...and their intervals sit inside the request span's
+    req_end = request["ts"] + request["dur"]
+    for s in spans:
+        if s["name"] in ("serve.update", "serve.update.request"):
+            continue
+        assert s["ts"] >= request["ts"] - 1e-9
+        assert s["ts"] + s["dur"] <= req_end + 1e-9
+    assert request["args"] == {"label": "m0"}  # success fast-path attrs
+
+    # Chrome export: loadable JSON, microsecond ts/dur, correlation
+    # ids preserved in args
+    payload = json.loads(json.dumps(tr.export_chrome()))
+    events = payload["traceEvents"]
+    assert events and all(e["ph"] == "X" for e in events)
+    for e in events:
+        assert e["ts"] >= 0 and e["dur"] >= 0
+        assert isinstance(e["args"]["trace_id"], int)
+    ours = [e for e in events if e["args"]["trace_id"] == tid]
+    assert {e["name"] for e in ours} == UPDATE_STAGES
+    assert len({e["tid"] for e in ours}) >= 2  # both threads exported
+
+
+def test_deferred_chain_updates_keep_own_correlation_ids(rng):
+    """Two in-flight updates for ONE model: the second defers behind
+    the first, is submitted later from the predecessor's done-callback
+    on another thread — and still records its full stage set under its
+    own correlation ID."""
+    reg = ModelRegistry()
+    st, *_ = _make_state(rng, model_id="m0", n=3, k=1, t=40)
+    reg.put(st, persist=False)
+    svc, obs = _instrumented_service(reg, flush_deadline=None)
+    try:
+        f1 = svc.update_async("m0", rng.normal(size=(1, 3)))
+        f2 = svc.update_async("m0", rng.normal(size=(1, 3)))
+        svc.flush()
+        assert f1.result(timeout=5).version == 1
+        assert f2.result(timeout=5).version == 2
+    finally:
+        svc.close()
+    tr = obs.tracer
+    requests = tr.spans(name="serve.update.request")
+    assert len(requests) == 2
+    t1, t2 = requests[0]["trace_id"], requests[1]["trace_id"]
+    assert t1 != t2  # two requests, two correlation ids
+    stages = UPDATE_STAGES - {"serve.update"}  # async: no sync root
+    for tid in (t1, t2):
+        assert {s["name"] for s in tr.spans(trace_id=tid)} == stages
+    # the deferred request's batcher_wait covers its defer time: it
+    # starts at submission, before the predecessor resolved
+    wait2 = next(
+        s for s in tr.spans(name="serve.batcher_wait")
+        if s["trace_id"] == t2
+    )
+    assert wait2["dur"] > 0
+
+
+def test_retry_attempts_share_one_correlation_id(rng):
+    """A retried sync update keeps ONE trace: both attempts' request
+    spans nest under the same serve.update root, and the retry event
+    is attributed to that correlation ID."""
+    reg = ModelRegistry()
+    st, *_ = _make_state(rng, model_id="m0", n=3, k=1, t=40)
+    reg.put(st, persist=False)
+    svc, obs = _instrumented_service(
+        reg, flush_deadline=None,
+        reliability=ReliabilityPolicy(
+            deadline_s=10.0,
+            retry=RetryPolicy(max_attempts=2, backoff_s=0.001),
+            breaker_failures=1000, breaker_cooldown_s=30.0,
+        ),
+    )
+    try:
+        with faultinject.active() as inj:
+            inj.add("serve.dispatch", error=RuntimeError("transient"),
+                    times=1)
+            out = svc.update("m0", rng.normal(size=(1, 3)))
+        assert out.version == 1
+    finally:
+        svc.close()
+    tr = obs.tracer
+    (root,) = tr.spans(name="serve.update")
+    tid = root["trace_id"]
+    requests = tr.spans(trace_id=tid, name="serve.update.request")
+    assert len(requests) == 2  # failed attempt + successful retry
+    assert requests[0]["args"]["outcome"] == "error"
+    assert requests[0]["args"]["model_id"] == "m0"
+    assert requests[1]["args"] == {"label": "m0"}
+    assert all(r["parent_id"] == root["span_id"] for r in requests)
+    (retry_event,) = [
+        e for e in obs.events.snapshot() if e["kind"] == "retry"
+    ]
+    assert retry_event["model_id"] == "m0"
+    assert retry_event["request_id"] == tid  # joinable against trace
+
+
+# ----------------------------------------------------------------------
+# structured event log
+# ----------------------------------------------------------------------
+def test_event_log_schema_ring_and_file_sink(tmp_path):
+    sink = tmp_path / "events.jsonl"
+    log = EventLog(maxlen=4, sink=sink, clock=lambda: 1000.0)
+    for i in range(6):
+        log.emit("breaker_open", model_id=f"m{i}",
+                 fault_point="breaker", previous="closed")
+    assert len(log) == 4 and log.dropped == 2  # bounded ring
+    assert log.counts() == {"breaker_open": 6}  # lifetime counts
+    assert [e["model_id"] for e in log.tail(2)] == ["m4", "m5"]
+    assert log.for_model("m3")[0]["detail"] == {"previous": "closed"}
+    log.close()
+    lines = sink.read_text().strip().splitlines()
+    assert len(lines) == 6  # the sink saw every emit, evicted or not
+    rec = json.loads(lines[0])
+    assert set(rec) == {
+        "ts", "kind", "model_id", "request_id", "fault_point", "detail"
+    }
+    assert rec["ts"] == 1000.0 and rec["fault_point"] == "breaker"
+
+
+def test_event_log_sink_failure_degrades_not_raises(tmp_path):
+    f = open(tmp_path / "sink.jsonl", "w")
+    f.close()
+    log = EventLog(sink=f)  # already-closed file: first write fails
+    log.emit("retry", model_id="m0")  # must not raise
+    log.emit("retry", model_id="m0")
+    assert log.counts() == {"retry": 2}  # ring keeps working
+
+
+def test_service_close_releases_owned_event_sink(rng, tmp_path,
+                                                 monkeypatch):
+    """A default-constructed bundle's file sink belongs to the
+    service: close() must release the fd (a caller-provided bundle is
+    left open — it may outlive the service)."""
+    monkeypatch.setenv(
+        "METRAN_TPU_OBS_EVENT_SINK", str(tmp_path / "ev.jsonl")
+    )
+    reg = ModelRegistry()
+    st, *_ = _make_state(rng, model_id="m0", n=3, k=1, t=40)
+    reg.put(st, persist=False)
+    svc = MetranService(reg, flush_deadline=None, persist_updates=False)
+    svc.close()
+    assert svc.events._sink is None  # owned sink released
+    # an explicitly-provided bundle may outlive the service: its sink
+    # must survive close() (still writing)
+    shared = EventLog(sink=tmp_path / "shared.jsonl")
+    svc2 = MetranService(
+        reg, flush_deadline=None, persist_updates=False,
+        observability=Observability(events=shared),
+    )
+    svc2.close()
+    shared.emit("after_close", model_id="m0")
+    shared.close()
+    assert "after_close" in (tmp_path / "shared.jsonl").read_text()
+
+
+def test_poisoned_update_outage_reconstructs_from_event_log(rng):
+    """A poisoned model's failed update and its chained follower emit
+    attributed events: the post-mortem (model_id + request_id +
+    fault_point) reconstructs without touching metrics or logs."""
+    reg = ModelRegistry()
+    st, *_ = _make_state(rng, model_id="m0", n=3, k=1, t=40)
+    reg.put(_poison(st), persist=False)
+    svc, obs = _instrumented_service(reg, flush_deadline=None)
+    try:
+        f1 = svc.update_async("m0", rng.normal(size=(1, 3)))
+        f2 = svc.update_async("m0", rng.normal(size=(1, 3)))
+        svc.flush()
+        with pytest.raises(StateIntegrityError):
+            f1.result(timeout=5)
+        with pytest.raises(ChainedRequestError):
+            f2.result(timeout=5)
+    finally:
+        svc.close()
+    kinds = [e["kind"] for e in obs.events.for_model("m0")]
+    assert "poisoned_update" in kinds and "chain_break" in kinds
+    poisoned = next(e for e in obs.events.for_model("m0")
+                    if e["kind"] == "poisoned_update")
+    chain = next(e for e in obs.events.for_model("m0")
+                 if e["kind"] == "chain_break")
+    # each event is attributed to ITS request's correlation id
+    requests = obs.tracer.spans(name="serve.update.request")
+    assert poisoned["request_id"] == requests[0]["trace_id"]
+    assert chain["request_id"] == requests[1]["trace_id"]
+    assert poisoned["fault_point"] == "serve.integrity_gate"
+
+
+# ----------------------------------------------------------------------
+# live-service exposition + fit telemetry + profiling satellites
+# ----------------------------------------------------------------------
+def test_live_service_exposition_parses_and_carries_catalogue(rng):
+    reg = ModelRegistry()
+    st, *_ = _make_state(rng, model_id="m0", n=3, k=1, t=40)
+    reg.put(st, persist=False)
+    svc, obs = _instrumented_service(reg, flush_deadline=None)
+    try:
+        svc.update("m0", rng.normal(size=(1, 3)))
+        svc.forecast("m0", 5)
+    finally:
+        svc.close()
+    families = validate_prometheus(obs.metrics.render_prometheus())
+    for name in (
+        "metran_serve_update_latency_seconds",
+        "metran_serve_forecast_latency_seconds",
+        "metran_serve_batch_occupancy",
+        "metran_serve_errors_total",
+        "metran_serve_compile_seconds",
+        "metran_serve_compile_cache_misses",
+        "metran_serve_window_error_rate",
+        "metran_serve_requests_seen",
+    ):
+        assert name in families, name
+    upd = families["metran_serve_update_latency_seconds"]
+    assert [v for n, _, v in upd["samples"]
+            if n.endswith("_count")] == [1]
+    # compile telemetry: distinct kernels were built and timed
+    compile_samples = families["metran_serve_compile_seconds"]["samples"]
+    assert compile_samples and all(v > 0 for _, _, v in compile_samples)
+
+
+def test_fit_telemetry_records_trajectory_and_stop_reason():
+    import jax.numpy as jnp
+
+    from metran_tpu.models.solver import run_lbfgs
+
+    tele = FitTelemetry()
+    theta, value, iters, nfev, converged = run_lbfgs(
+        lambda x: jnp.sum((x - 1.0) ** 2), jnp.zeros(3),
+        maxiter=100, telemetry=tele,
+    )
+    assert converged and tele.converged
+    assert tele.stop_reason in ("gradient", "floor")
+    assert tele.value0 == pytest.approx(3.0)
+    assert tele.value == pytest.approx(float(value))
+    assert tele.checkpoints, "no host-side checkpoints recorded"
+    assert tele.nfev == nfev and tele.n_iters == iters
+    assert f"stop={tele.stop_reason}" in tele.summary()
+
+    # divergence diagnosis
+    tele2 = FitTelemetry()
+    with pytest.raises(Exception):
+        run_lbfgs(
+            lambda x: jnp.log(-jnp.sum(x ** 2) - 1.0), jnp.zeros(2),
+            maxiter=10, raise_on_divergence=True, telemetry=tele2,
+        )
+    assert tele2.stop_reason in ("diverged", "init_nonfinite")
+    assert tele2.converged is False
+
+
+def test_throughput_counter_laps_bounded():
+    tc = ThroughputCounter(max_laps=8)
+    for _ in range(30):
+        with tc.measure(n=2):
+            pass
+    assert len(tc.laps) <= 8  # bounded (oldest half dropped)
+    assert tc.total == 60 and tc.n_laps == 30  # exact lifetime totals
+    assert tc.seconds > 0
+
+
+def test_device_trace_reentrancy_and_concurrency_noop(tmp_path, caplog):
+    """`jax.profiler.start_trace` is process-global: a nested trace()
+    block — or one entered concurrently from another thread — must
+    no-op with a warning instead of raising RuntimeError mid-workload,
+    and the owner's trace must still be written.  One test, two
+    profiler sessions (each costs seconds)."""
+    import logging
+
+    import jax.numpy as jnp
+
+    errors = []
+
+    def worker():
+        try:
+            with trace(str(tmp_path / "worker")):  # concurrent: no-op
+                pass
+        except BaseException as exc:  # pragma: no cover - the bug
+            errors.append(exc)
+
+    with caplog.at_level(logging.WARNING, "metran_tpu.utils.profiling"):
+        with trace(str(tmp_path / "outer")):
+            with trace(str(tmp_path / "inner")):  # nested: no-op
+                # doubly-nested: regression for the no-op branch
+                # yielding while holding the module lock (deadlock)
+                with trace(str(tmp_path / "inner2")):
+                    jnp.sum(jnp.arange(8.0)).block_until_ready()
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join(timeout=10)
+    warnings = [r.message for r in caplog.records
+                if "already active" in r.message]
+    assert len(warnings) == 3  # both nestings + concurrent all warned
+    assert not errors
+    # the enclosing trace completed and wrote its capture
+    assert list((tmp_path / "outer").rglob("*")), "outer trace empty"
+    # and a fresh trace afterwards works (owner slot was released)
+    with trace(str(tmp_path / "again")):
+        pass
+    assert list((tmp_path / "again").rglob("*"))
+
+
+# ----------------------------------------------------------------------
+# drift gates (CI wiring): catalogue + API docs stay green
+# ----------------------------------------------------------------------
+def test_check_metrics_gate_green():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "check_metrics.py")],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_api_docs_gate_green():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "gen_api_docs.py"),
+         "--check"],
+        capture_output=True, text=True, cwd=REPO, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

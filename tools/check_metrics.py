@@ -1,0 +1,266 @@
+"""Static metric-registration lint for the metran_tpu package.
+
+Metric catalogues drift: someone registers a counter and never
+increments it, two subsystems claim one name, a rename breaks the
+snake_case convention the Prometheus exposition (and its tests) rely
+on.  This pass catches all three WITHOUT importing the package — pure
+``ast`` over the source tree — so it runs in CI next to
+``gen_api_docs.py --check`` (both are wired into the ``obs``-marked
+tier-1 test, ``tests/test_obs.py``).
+
+What counts as a metric registration:
+
+- a call to ``.counter(...)`` / ``.gauge(...)`` / ``.histogram(...)``
+  (the :class:`metran_tpu.obs.MetricsRegistry` API) with a literal
+  first argument — the name is checked and owned by that site;
+- a literal ``name="..."`` keyword to any call (the registry-backed
+  instrument constructors: ``LatencyRecorder(registry=..., name=...)``)
+  and the literal second argument of a ``.bind(registry, "name")``
+  call — catalogue names, checked for charset and single ownership;
+- registry-API calls with a *dynamic* name (f-strings, attributes) are
+  rendered with placeholders for the charset check and exempt from
+  ownership (several instances may legitimately build one family).
+
+Failures:
+
+1. **non-snake_case**: a (resolvable) name not matching
+   ``[a-z_][a-z0-9_]*`` — it would be refused at runtime and break the
+   exposition grammar;
+2. **duplicate name**: one literal name registered at two different
+   call sites — single ownership keeps the catalogue navigable and
+   prevents two subsystems from silently sharing a counter;
+3. **registered but never updated**: a registry-API registration whose
+   result is discarded with no ``callback=`` — nothing can ever
+   ``inc``/``set``/``observe`` it — or whose bound variable is never
+   used with an update method (``inc``/``dec``/``set``/``observe``/
+   ``labels``) nor re-aliased in its file.
+
+Usage::
+
+    python tools/check_metrics.py            # exit 1 on any violation
+    python tools/check_metrics.py --verbose  # also list every metric
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import sys
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional
+
+REPO = Path(__file__).resolve().parent.parent
+PACKAGE = REPO / "metran_tpu"
+
+NAME_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
+REGISTRY_METHODS = {"counter", "gauge", "histogram"}
+UPDATE_METHODS = ("inc", "dec", "set", "observe", "labels")
+
+
+@dataclass
+class Registration:
+    name: str
+    kind: str  # counter|gauge|histogram|instrument
+    file: str
+    lineno: int
+    dynamic: bool = False  # name contains a placeholder
+    has_callback: bool = False
+    target: Optional[str] = None  # bound identifier, when assigned
+    discarded: bool = False  # bare-statement registration
+
+
+@dataclass
+class Report:
+    registrations: List[Registration] = field(default_factory=list)
+    violations: List[str] = field(default_factory=list)
+
+
+def _literal_or_placeholder(node: ast.AST) -> "tuple[str, bool] | None":
+    """A string argument's value: ``(text, dynamic)``; None when it is
+    not string-like at all (a variable holding a name)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value, False
+    if isinstance(node, ast.JoinedStr):  # f-string: placeholder parts
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            else:
+                parts.append("x")  # stands in for a runtime fragment
+        return "".join(parts), True
+    return None
+
+
+class _FileScanner(ast.NodeVisitor):
+    """One file's registrations + the raw source for usage checks."""
+
+    def __init__(self, path: Path, source: str, report: Report):
+        self.path = path
+        self.rel = str(path.relative_to(REPO))
+        self.source = source
+        self.report = report
+        # statement-context bookkeeping: map a registration Call node
+        # to the assignment target binding it (filled in visit_Assign)
+        self._bound: Dict[int, str] = {}
+        self._stmt_exprs: set = set()
+
+    # -- statement context ---------------------------------------------
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if isinstance(node.value, ast.Call) and node.targets:
+            target = node.targets[0]
+            ident = None
+            if isinstance(target, ast.Name):
+                ident = target.id
+            elif isinstance(target, ast.Attribute):
+                ident = target.attr
+            if ident is not None:
+                self._bound[id(node.value)] = ident
+        self.generic_visit(node)
+
+    def visit_Expr(self, node: ast.Expr) -> None:
+        if isinstance(node.value, ast.Call):
+            self._stmt_exprs.add(id(node.value))
+        self.generic_visit(node)
+
+    # -- registrations --------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            if func.attr in REGISTRY_METHODS and node.args:
+                got = _literal_or_placeholder(node.args[0])
+                if got is not None:
+                    name, dynamic = got
+                    self.report.registrations.append(Registration(
+                        name=name, kind=func.attr, file=self.rel,
+                        lineno=node.lineno, dynamic=dynamic,
+                        has_callback=any(
+                            kw.arg == "callback" and not (
+                                isinstance(kw.value, ast.Constant)
+                                and kw.value.value is None
+                            )
+                            for kw in node.keywords
+                        ),
+                        target=self._bound.get(id(node)),
+                        discarded=id(node) in self._stmt_exprs,
+                    ))
+            if func.attr == "bind" and len(node.args) >= 2:
+                got = _literal_or_placeholder(node.args[1])
+                if got is not None and got[0].startswith("metran_"):
+                    self.report.registrations.append(Registration(
+                        name=got[0], kind="instrument", file=self.rel,
+                        lineno=node.lineno, dynamic=got[1],
+                    ))
+        for kw in node.keywords:
+            # instrument constructors carry the catalogue name as a
+            # name="..." keyword (registration happens inside the
+            # instrument, with a dynamic self.name)
+            if kw.arg == "name":
+                got = _literal_or_placeholder(kw.value)
+                if got is not None and got[0].startswith("metran_"):
+                    self.report.registrations.append(Registration(
+                        name=got[0], kind="instrument", file=self.rel,
+                        lineno=node.lineno, dynamic=got[1],
+                    ))
+        self.generic_visit(node)
+
+    # -- usage evidence -------------------------------------------------
+    def has_update_evidence(self, ident: str) -> bool:
+        """Whether ``ident`` is ever updated (or re-aliased) here."""
+        update = re.compile(
+            rf"\b{re.escape(ident)}\s*\.\s*({'|'.join(UPDATE_METHODS)})\s*\("
+        )
+        if update.search(self.source):
+            return True
+        # aliasing: `g = self._gauge` / `gauge = registry.get(...)` —
+        # assume the alias carries the updates
+        alias = re.compile(
+            rf"=\s*(self\s*\.\s*)?{re.escape(ident)}\b"
+        )
+        return bool(alias.search(self.source))
+
+
+def scan(verbose: bool = False) -> Report:
+    report = Report()
+    scanners: List[_FileScanner] = []
+    for path in sorted(PACKAGE.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        source = path.read_text()
+        scanner = _FileScanner(path, source, report)
+        scanner.visit(ast.parse(source, filename=str(path)))
+        scanners.append(scanner)
+    by_file = {s.rel: s for s in scanners}
+
+    # 1. charset
+    for reg in report.registrations:
+        if not NAME_RE.match(reg.name):
+            report.violations.append(
+                f"{reg.file}:{reg.lineno}: metric name {reg.name!r} is "
+                "not snake_case"
+            )
+
+    # 2. duplicate ownership (literal, non-dynamic names only)
+    owners: Dict[str, Registration] = {}
+    for reg in report.registrations:
+        if reg.dynamic:
+            continue
+        prior = owners.get(reg.name)
+        if prior is None:
+            owners[reg.name] = reg
+        elif (prior.file, prior.lineno) != (reg.file, reg.lineno):
+            report.violations.append(
+                f"{reg.file}:{reg.lineno}: metric {reg.name!r} already "
+                f"registered at {prior.file}:{prior.lineno} — one call "
+                "site must own each name"
+            )
+
+    # 3. registered but never updated (registry-API sites only)
+    for reg in report.registrations:
+        if reg.kind == "instrument" or reg.has_callback:
+            continue
+        if reg.discarded:
+            report.violations.append(
+                f"{reg.file}:{reg.lineno}: {reg.kind} {reg.name!r} is "
+                "registered but its handle is discarded (no callback, "
+                "nothing can ever update it)"
+            )
+            continue
+        if reg.target is not None:
+            scanner = by_file[reg.file]
+            if not scanner.has_update_evidence(reg.target):
+                report.violations.append(
+                    f"{reg.file}:{reg.lineno}: {reg.kind} {reg.name!r} "
+                    f"bound to {reg.target!r} but never updated "
+                    f"({'/'.join(UPDATE_METHODS)}) in {reg.file}"
+                )
+
+    if verbose:
+        for reg in sorted(report.registrations,
+                          key=lambda r: (r.name, r.file, r.lineno)):
+            flags = "".join([
+                "D" if reg.dynamic else "-",
+                "C" if reg.has_callback else "-",
+            ])
+            print(f"  [{flags}] {reg.kind:<10} {reg.name}  "
+                  f"({reg.file}:{reg.lineno})")
+    return report
+
+
+def main() -> int:
+    verbose = "--verbose" in sys.argv
+    report = scan(verbose=verbose)
+    if report.violations:
+        for v in report.violations:
+            print(f"FAIL {v}")
+        print(f"{len(report.violations)} metric violation(s)")
+        return 1
+    print(
+        f"checked {len(report.registrations)} metric registration(s): "
+        "no duplicate, non-snake_case, or never-updated metrics"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
