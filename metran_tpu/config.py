@@ -128,6 +128,14 @@ SERVE_VALIDATE_UPDATES = 1  # per-slot posterior finiteness/PSD checks
 SERVE_ENGINE = "joint"  # assimilation kernel; "sqrt" = square-root
 #                         serving (factored posteriors, PSD by
 #                         construction — the robust f32 choice)
+# observation-gate defaults (statistical input robustness; see
+# docs/concepts.md "Input robustness").  The gate ships OFF: arming it
+# is a per-deployment calibration decision (nsigma trades false
+# rejections of real level shifts against spike protection).
+SERVE_GATE_POLICY = "off"  # "reject" | "huber" | "inflate" | "off"
+SERVE_GATE_NSIGMA = 4.0  # gate at z^2 > nsigma^2 (chi-square(1) null)
+SERVE_GATE_MIN_SEEN = 32  # disarm models with t_seen below this (cold
+#                           filters' innovations are over-dispersed)
 # observability defaults (metran_tpu.obs wired into MetranService)
 OBS_TRACE = 0  # request-scoped span tracing (metrics/events stay on)
 OBS_TRACE_BUFFER = 4096  # finished spans kept in the tracer ring
@@ -193,6 +201,15 @@ def serve_defaults() -> dict:
         ),
         "engine": _env(
             "METRAN_TPU_SERVE_ENGINE", str, SERVE_ENGINE
+        ),
+        "gate_policy": _env(
+            "METRAN_TPU_SERVE_GATE_POLICY", str, SERVE_GATE_POLICY
+        ),
+        "gate_nsigma": _env(
+            "METRAN_TPU_SERVE_GATE_NSIGMA", float, SERVE_GATE_NSIGMA
+        ),
+        "gate_min_seen": _env(
+            "METRAN_TPU_SERVE_GATE_MIN_SEEN", int, SERVE_GATE_MIN_SEEN
         ),
     }
 

@@ -40,6 +40,31 @@ from typing import Dict, List, Optional
 
 logger = getLogger(__name__)
 
+#: The canonical event-kind catalogue.  Every ``kind`` the package
+#: emits must be listed here AND documented in the event-schema table
+#: of docs/concepts.md ("Structured event log") — ``tools/
+#: check_metrics.py`` AST-scans both and the ``obs``-marked tier-1
+#: drift gate fails on any mismatch, so an undeclared or undocumented
+#: kind cannot ship.  Kinds are not enforced at ``emit()`` time (the
+#: log accepts ad-hoc kinds from embedding applications); the gate
+#: governs what THIS package emits.
+EVENT_KINDS = (
+    "breaker_open",
+    "breaker_half_open",
+    "breaker_closed",
+    "quarantine",
+    "served_last_good",
+    "retry",
+    "deadline_exceeded",
+    "chain_break",
+    "poisoned_update",
+    "poisoned_forecast",
+    "persist_failure",
+    "observation_rejected",
+    "observation_downweighted",
+    "empty_update",
+)
+
 
 class EventLog:
     """Bounded structured event ring with optional JSON-lines sink.
@@ -190,4 +215,4 @@ class EventLog:
         self.close()
 
 
-__all__ = ["EventLog"]
+__all__ = ["EVENT_KINDS", "EventLog"]

@@ -281,6 +281,20 @@ class Histogram(_Metric):
             self._sum += v
             self._count += 1
 
+    def observe_many(self, values) -> None:
+        """Bulk observe under ONE lock acquisition — the serving
+        dispatch path books a whole batch's gate scores at once
+        instead of paying per-value lock traffic."""
+        vals = [float(v) for v in values]
+        if not vals:
+            return
+        idxs = [bisect.bisect_left(self.buckets, v) for v in vals]
+        with self._lock:
+            for i in idxs:
+                self._counts[i] += 1
+            self._sum += sum(vals)
+            self._count += len(vals)
+
     @property
     def count(self) -> int:
         with self._lock:

@@ -532,29 +532,46 @@ def _make_sqrt_core_step(ss: StateSpace, dtype):
         z_m = ss.z * maskf[:, None]
         r_t = jnp.where(mask_t, ss.r, 0.0) + (1.0 - maskf)
         v = jnp.where(mask_t, y_t - ss.z @ mean_p, 0.0)
-        pre = jnp.concatenate([
-            jnp.concatenate(
-                [jnp.diag(jnp.sqrt(r_t)), jnp.zeros((m, n), dtype)], axis=1
-            ),
-            jnp.concatenate([(z_m @ chol_p).T, chol_p.T], axis=1),
-        ], axis=0)
-        rfull = _sign_normalize_rows(jnp.linalg.qr(pre, mode="r"))
-        fu = rfull[:m, :m]  # F^{1/2}' (upper)
-        kbar = rfull[:m, m:].T  # P Z' F^{-T/2}
-        chol_u = rfull[m:, m:].T  # filtered factor, PSD by construction
-        d = jnp.diagonal(fu)
-        ok = jnp.all(d > 0) & jnp.all(jnp.isfinite(rfull))
-        fu_safe = jnp.where(ok, fu, eye_m)
-        w = jax.scipy.linalg.solve_triangular(fu_safe.T, v, lower=True)
-        mean_f = jnp.where(ok, mean_p + kbar @ w, mean_p)
-        chol_f = jnp.where(ok, chol_u, chol_p)
-        sigma = jnp.where(ok, jnp.sum(w * w), zero)
-        detf = jnp.where(
-            ok, 2.0 * jnp.sum(jnp.log(jnp.where(ok, d, 1.0))), inf
+        mean_f, chol_f, sigma, detf = _sqrt_qr_update(
+            z_m, r_t, v, mean_p, chol_p, n, m, eye_m, zero, inf, dtype
         )
         return mean_p, chol_p, mean_f, chol_f, sigma, detf
 
     return core
+
+
+def _sqrt_qr_update(z_m, r_t, v, mean_p, chol_p, n, m, eye_m, zero, inf,
+                    dtype):
+    """The QR array-update body of one square-root step.
+
+    Shared verbatim by the plain core (:func:`_make_sqrt_core_step`)
+    and the gated core (:func:`_make_gated_sqrt_core_step`), so the
+    gate-off/never-hit paths of the gated kernels stay *bit-identical*
+    to the plain ones — the gated callers only pre-transform the
+    masked observation row (``z_m``/``r_t``/``v``) and those
+    transforms are exact identities when no slot trips the gate.
+    """
+    pre = jnp.concatenate([
+        jnp.concatenate(
+            [jnp.diag(jnp.sqrt(r_t)), jnp.zeros((m, n), dtype)], axis=1
+        ),
+        jnp.concatenate([(z_m @ chol_p).T, chol_p.T], axis=1),
+    ], axis=0)
+    rfull = _sign_normalize_rows(jnp.linalg.qr(pre, mode="r"))
+    fu = rfull[:m, :m]  # F^{1/2}' (upper)
+    kbar = rfull[:m, m:].T  # P Z' F^{-T/2}
+    chol_u = rfull[m:, m:].T  # filtered factor, PSD by construction
+    d = jnp.diagonal(fu)
+    ok = jnp.all(d > 0) & jnp.all(jnp.isfinite(rfull))
+    fu_safe = jnp.where(ok, fu, eye_m)
+    w = jax.scipy.linalg.solve_triangular(fu_safe.T, v, lower=True)
+    mean_f = jnp.where(ok, mean_p + kbar @ w, mean_p)
+    chol_f = jnp.where(ok, chol_u, chol_p)
+    sigma = jnp.where(ok, jnp.sum(w * w), zero)
+    detf = jnp.where(
+        ok, 2.0 * jnp.sum(jnp.log(jnp.where(ok, d, 1.0))), inf
+    )
+    return mean_f, chol_f, sigma, detf
 
 
 @functools.partial(jax.jit, static_argnames=("store",))
@@ -672,6 +689,391 @@ def _sqrt_filter_append(ss, mean, chol, y_new, mask_new):
         (y_new, mask_new),
     )
     return mean_t, chol_t, sigma, detf
+
+
+# ----------------------------------------------------------------------
+# observation gating (statistical input robustness)
+# ----------------------------------------------------------------------
+#
+# A finite observation is not necessarily a TRUE observation: a sensor
+# spike, stuck gauge or unit-conversion error passes every finiteness
+# check and then corrupts the posterior permanently (serving never
+# refilters history, so there is no later pass to catch it).  Under the
+# model, each slot's one-step-ahead normalized innovation z = v/sqrt(f)
+# is standard normal, so z^2 has a known chi-square(1) null — exactly
+# the statistic the offline Ljung-Box diagnostics standardize
+# (metran_tpu/diagnostics.py) — and testing it ONLINE against a
+# configurable gate lets the update defend itself (cf. the robust /
+# heavy-tailed filtering argument of arXiv:2310.01122: outliers must be
+# downweighted inside the update, not discovered post-mortem).  Three
+# XLA-static policies for a slot whose z^2 exceeds nsigma^2:
+#
+# - ``reject``: treat the slot as missing for this step (no state
+#   update, no likelihood contribution) — the hard gate;
+# - ``huber``: scale the innovation by w = nsigma/|z| before the gain
+#   is applied (full weight inside the clip point, decaying influence
+#   beyond — the classical Huberized update);
+# - ``inflate``: inflate that slot's observation variance so its
+#   realized z^2 equals the gate (the update is tempered, never
+#   discarded — the right choice when level shifts may be real).
+#
+# All three are value-identical (bit-exact) to the ungated kernels when
+# the gate is off or never trips; ``armed`` (a traced scalar, per-model
+# under vmap) lets a serving layer disarm the gate for cold models
+# without recompiling.
+
+#: gate policies accepted by the gated kernels (XLA-static).
+GATE_POLICIES = ("off", "reject", "huber", "inflate")
+
+#: per-slot verdict codes in the gated kernels' verdict output.
+GATE_PASS = 0
+GATE_DOWNWEIGHTED = 1
+GATE_REJECTED = 2
+
+
+def _gated_sequential_update(
+    mean, cov, y, mask, z, r, dtype, policy, thresh, armed
+):
+    """Masked sequential update with per-slot innovation gating.
+
+    The gated counterpart of :func:`_sequential_update` (same slot
+    order, same rank-1 recursion): each observed slot's normalized
+    innovation ``z_i = v/sqrt(f)`` is tested against the chi-square
+    gate ``z_i^2 > thresh`` and the armed policy applied.  Every state
+    and likelihood expression is written so that a slot that does NOT
+    trip the gate computes the exact same floating-point operations as
+    the ungated update — the bit-exactness contract
+    (tests/test_gating.py).
+
+    Returns ``(mean, cov, sigma, detf, zscore, verdict)`` with
+    ``zscore`` (n_obs,) the signed normalized innovations (NaN where
+    unobserved) and ``verdict`` (n_obs,) int8 per-slot codes
+    (:data:`GATE_PASS`/:data:`GATE_DOWNWEIGHTED`/:data:`GATE_REJECTED`).
+    """
+    zero = jnp.zeros((), dtype)
+    one = jnp.ones((), dtype)
+    nan = jnp.asarray(jnp.nan, dtype)
+    t = jnp.asarray(thresh, dtype)
+
+    def step(carry, xs):
+        m, p, sigma, detf = carry
+        y_i, mask_i, z_i, r_i = xs
+        v = y_i - z_i @ m
+        d = p @ z_i
+        f = z_i @ d + r_i
+        f_safe = jnp.where(mask_i, f, one)
+        zscore = v / jnp.sqrt(f_safe)
+        score = zscore * zscore
+        hit = armed & mask_i & (score > t)
+        if policy == "reject":
+            use = mask_i & ~hit
+            k = d / f_safe
+            m_new = m + k * v
+            p_new = p - jnp.outer(k, k) * f_safe
+            m = jnp.where(use, m_new, m)
+            p = jnp.where(use, p_new, p)
+            sigma = sigma + jnp.where(use, v * v / f_safe, zero)
+            detf = detf + jnp.where(use, jnp.log(f_safe), zero)
+        elif policy == "huber":
+            # weight 1 inside the clip point, nsigma/|z| beyond; the
+            # covariance update keeps full weight (the information
+            # content of the slot is unchanged, only the innovation's
+            # influence on the mean is clipped)
+            w = jnp.where(hit, jnp.sqrt(t / score), one)
+            vv = w * v
+            k = d / f_safe
+            m_new = m + k * vv
+            p_new = p - jnp.outer(k, k) * f_safe
+            m = jnp.where(mask_i, m_new, m)
+            p = jnp.where(mask_i, p_new, p)
+            sigma = sigma + jnp.where(mask_i, vv * vv / f_safe, zero)
+            detf = detf + jnp.where(mask_i, jnp.log(f_safe), zero)
+        else:  # "inflate"
+            # inflate r so the realized v^2/f equals the gate: the
+            # update proceeds with a tempered gain instead of being
+            # discarded (f_eff = v^2/thresh > f exactly when hit)
+            f_eff = jnp.where(hit, v * v / t, f_safe)
+            k = d / f_eff
+            m_new = m + k * v
+            p_new = p - jnp.outer(k, k) * f_eff
+            m = jnp.where(mask_i, m_new, m)
+            p = jnp.where(mask_i, p_new, p)
+            sigma = sigma + jnp.where(mask_i, v * v / f_eff, zero)
+            detf = detf + jnp.where(mask_i, jnp.log(f_eff), zero)
+        verdict = jnp.where(
+            hit,
+            GATE_REJECTED if policy == "reject" else GATE_DOWNWEIGHTED,
+            GATE_PASS,
+        ).astype(jnp.int8)
+        return (m, p, sigma, detf), (
+            jnp.where(mask_i, zscore, nan), verdict
+        )
+
+    (mean, cov, sigma, detf), (zs, verdicts) = lax.scan(
+        step, (mean, cov, zero, zero), (y, mask, z, r)
+    )
+    return mean, cov, sigma, detf, zs, verdicts
+
+
+def _make_gated_core_step(ss: StateSpace, dtype, policy, thresh, armed):
+    """Predict + gated sequential update body of one filter timestep
+    (the gated twin of :func:`_make_core_step`, sequential engine)."""
+
+    def core(mean, cov, y_t, mask_t):
+        mean_p, cov_p = _predict(mean, cov, ss.phi, ss.q)
+        has_obs = jnp.any(mask_t)
+        mean_f, cov_f, sigma, detf, zs, verdicts = (
+            _gated_sequential_update(
+                mean_p, cov_p, y_t, mask_t, ss.z, ss.r, dtype,
+                policy, thresh, armed,
+            )
+        )
+        mean_f = jnp.where(has_obs, mean_f, mean_p)
+        cov_f = jnp.where(has_obs, cov_f, cov_p)
+        return mean_f, cov_f, sigma, detf, zs, verdicts
+
+    return core
+
+
+def _make_gated_sqrt_core_step(ss: StateSpace, dtype, policy, thresh,
+                               armed):
+    """Predict + gated QR update body of one square-root timestep.
+
+    Gating on the sqrt path uses each slot's *marginal* innovation
+    variance off the predicted factor (``f_i = ||(Z S_p)_i||^2 + r_i``
+    — the same vector-innovation definition :func:`innovations` uses),
+    then pre-transforms the masked observation row and hands it to the
+    SAME QR body the plain core runs (:func:`_sqrt_qr_update`):
+
+    - ``reject`` re-derives the masked quantities under the post-gate
+      mask (a rejected slot becomes a unit-pseudo-noise no-op slot);
+    - ``huber`` scales the innovation per slot;
+    - ``inflate`` adds ``v^2/thresh - f_i`` to the slot's ``r``.
+
+    A slot that does not trip computes bit-identically to the plain
+    core (the transforms are exact identities there).
+    """
+    n = ss.phi.shape[-1]
+    m = ss.z.shape[-2]
+    eye_m = jnp.eye(m, dtype=dtype)
+    q_sqrt = _q_sqrt_diag(ss.q).astype(dtype)
+    zero = jnp.zeros((), dtype)
+    one = jnp.ones((), dtype)
+    inf = jnp.asarray(jnp.inf, dtype)
+    nan = jnp.asarray(jnp.nan, dtype)
+    t = jnp.asarray(thresh, dtype)
+
+    def core(mean, chol, y_t, mask_t):
+        mean_p = ss.phi * mean
+        chol_p = _tria(jnp.concatenate(
+            [ss.phi[:, None] * chol, jnp.diag(q_sqrt)], axis=1
+        ))
+        maskf = mask_t.astype(dtype)
+        z_m = ss.z * maskf[:, None]
+        r_t = jnp.where(mask_t, ss.r, 0.0) + (1.0 - maskf)
+        v = jnp.where(mask_t, y_t - ss.z @ mean_p, 0.0)
+        f_diag = jnp.sum((z_m @ chol_p) ** 2, axis=-1) + r_t
+        zscore = v / jnp.sqrt(f_diag)
+        score = zscore * zscore
+        hit = armed & mask_t & (score > t)
+        if policy == "reject":
+            use = mask_t & ~hit
+            usef = use.astype(dtype)
+            z_u = ss.z * usef[:, None]
+            r_u = jnp.where(use, ss.r, 0.0) + (1.0 - usef)
+            v_u = jnp.where(use, y_t - ss.z @ mean_p, 0.0)
+            upd = _sqrt_qr_update(
+                z_u, r_u, v_u, mean_p, chol_p, n, m, eye_m, zero, inf,
+                dtype,
+            )
+        elif policy == "huber":
+            w_i = jnp.where(hit, jnp.sqrt(t / score), one)
+            upd = _sqrt_qr_update(
+                z_m, r_t, w_i * v, mean_p, chol_p, n, m, eye_m, zero,
+                inf, dtype,
+            )
+        else:  # "inflate"
+            # v^2/thresh > f_i exactly when hit, so the added term is
+            # positive and sqrt(r_eff) stays well-defined
+            r_i = jnp.where(hit, r_t + (v * v / t - f_diag), r_t)
+            upd = _sqrt_qr_update(
+                z_m, r_i, v, mean_p, chol_p, n, m, eye_m, zero, inf,
+                dtype,
+            )
+        mean_f, chol_f, sigma, detf = upd
+        verdict = jnp.where(
+            hit,
+            GATE_REJECTED if policy == "reject" else GATE_DOWNWEIGHTED,
+            GATE_PASS,
+        ).astype(jnp.int8)
+        return (mean_p, chol_p, mean_f, chol_f, sigma, detf,
+                jnp.where(mask_t, zscore, nan), verdict)
+
+    return core
+
+
+def gated_filter_append(
+    ss: StateSpace,
+    mean: jnp.ndarray,
+    cov: jnp.ndarray,
+    y_new: jnp.ndarray,
+    mask_new: jnp.ndarray,
+    armed=True,
+    policy: str = "reject",
+    nsigma: float = 4.0,
+) -> Tuple[jnp.ndarray, ...]:
+    """:func:`filter_append` with per-slot online innovation gating.
+
+    Sequential-processing engine only (the gate is a per-slot test, so
+    the slots must be conditioned one at a time; a serving bucket on
+    the ``joint`` engine that arms the gate switches to this kernel —
+    same posterior to float tolerance).  ``policy``/``nsigma`` are
+    XLA-static; ``armed`` is traced (a scalar bool, per-model under
+    ``vmap``) so a serving layer can disarm cold models per slot
+    without recompiling.
+
+    Returns ``(mean_T, cov_T, sigma, detf, zscore, verdict)``:
+    the first four exactly as :func:`filter_append`, plus the per-step
+    (k, n_obs) signed normalized innovations (NaN where unobserved)
+    and int8 verdicts (:data:`GATE_PASS`/:data:`GATE_DOWNWEIGHTED`/
+    :data:`GATE_REJECTED`).
+
+    Contract: with ``policy="off"`` — or an armed gate that never
+    trips (``nsigma=inf``, or clean data) — the posterior and
+    likelihood outputs are bit-identical to :func:`filter_append`
+    with ``engine="sequential"``.
+    """
+    if policy not in GATE_POLICIES:
+        raise ValueError(
+            f"unknown gate policy {policy!r}; expected one of "
+            f"{GATE_POLICIES}"
+        )
+    return _gated_filter_append(
+        ss, mean, cov, y_new, mask_new, jnp.asarray(armed, bool),
+        policy=policy, nsigma=float(nsigma),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("policy", "nsigma"))
+def _gated_filter_append(ss, mean, cov, y_new, mask_new, armed, *,
+                         policy, nsigma):
+    dtype = ss.q.dtype
+    y_new = jnp.atleast_2d(jnp.asarray(y_new, dtype))
+    mask_new = jnp.atleast_2d(jnp.asarray(mask_new, bool))
+    if policy == "off":
+        # the plain core, verbatim (bit-exactness by construction);
+        # scores/verdicts come back NaN/PASS
+        core = _make_core_step(ss, "sequential", dtype)
+
+        def step(carry, xs):
+            m, p = carry
+            y_t, mask_t = xs
+            _, _, mean_f, cov_f, sigma, detf = core(m, p, y_t, mask_t)
+            return (mean_f, cov_f), (sigma, detf)
+
+        (mean_t, cov_t), (sigma, detf) = lax.scan(
+            step, (jnp.asarray(mean, dtype), jnp.asarray(cov, dtype)),
+            (y_new, mask_new),
+        )
+        return (
+            mean_t, cov_t, sigma, detf,
+            jnp.full(y_new.shape, jnp.nan, dtype),
+            jnp.zeros(y_new.shape, jnp.int8),
+        )
+    core = _make_gated_core_step(
+        ss, dtype, policy, nsigma * nsigma, armed
+    )
+
+    def step(carry, xs):
+        m, p = carry
+        y_t, mask_t = xs
+        mean_f, cov_f, sigma, detf, zs, verdicts = core(
+            m, p, y_t, mask_t
+        )
+        return (mean_f, cov_f), (sigma, detf, zs, verdicts)
+
+    (mean_t, cov_t), (sigma, detf, zs, verdicts) = lax.scan(
+        step, (jnp.asarray(mean, dtype), jnp.asarray(cov, dtype)),
+        (y_new, mask_new),
+    )
+    return mean_t, cov_t, sigma, detf, zs, verdicts
+
+
+def gated_sqrt_filter_append(
+    ss: StateSpace,
+    mean: jnp.ndarray,
+    chol: jnp.ndarray,
+    y_new: jnp.ndarray,
+    mask_new: jnp.ndarray,
+    armed=True,
+    policy: str = "reject",
+    nsigma: float = 4.0,
+) -> Tuple[jnp.ndarray, ...]:
+    """:func:`sqrt_filter_append` with per-slot online innovation gating.
+
+    Square-root counterpart of :func:`gated_filter_append` — carries a
+    Cholesky factor, gates on the marginal normalized innovations off
+    the predicted factor, and keeps the PSD-by-construction guarantee
+    for every policy (all three only pre-transform the observation row
+    fed to the same orthogonal QR update).
+
+    Returns ``(mean_T, chol_T, sigma, detf, zscore, verdict)``; same
+    bit-exactness contract as :func:`gated_filter_append`, against
+    :func:`sqrt_filter_append`.
+    """
+    if policy not in GATE_POLICIES:
+        raise ValueError(
+            f"unknown gate policy {policy!r}; expected one of "
+            f"{GATE_POLICIES}"
+        )
+    _check_diagonal_q(ss.q)
+    return _gated_sqrt_filter_append(
+        ss, mean, chol, y_new, mask_new, jnp.asarray(armed, bool),
+        policy=policy, nsigma=float(nsigma),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("policy", "nsigma"))
+def _gated_sqrt_filter_append(ss, mean, chol, y_new, mask_new, armed, *,
+                              policy, nsigma):
+    dtype = ss.q.dtype
+    y_new = jnp.atleast_2d(jnp.asarray(y_new, dtype))
+    mask_new = jnp.atleast_2d(jnp.asarray(mask_new, bool))
+    if policy == "off":
+        core = _make_sqrt_core_step(ss, dtype)
+
+        def step(carry, xs):
+            m, s = carry
+            y_t, mask_t = xs
+            _, _, mean_f, chol_f, sigma, detf = core(m, s, y_t, mask_t)
+            return (mean_f, chol_f), (sigma, detf)
+
+        (mean_t, chol_t), (sigma, detf) = lax.scan(
+            step, (jnp.asarray(mean, dtype), jnp.asarray(chol, dtype)),
+            (y_new, mask_new),
+        )
+        return (
+            mean_t, chol_t, sigma, detf,
+            jnp.full(y_new.shape, jnp.nan, dtype),
+            jnp.zeros(y_new.shape, jnp.int8),
+        )
+    core = _make_gated_sqrt_core_step(
+        ss, dtype, policy, nsigma * nsigma, armed
+    )
+
+    def step(carry, xs):
+        m, s = carry
+        y_t, mask_t = xs
+        _, _, mean_f, chol_f, sigma, detf, zs, verdicts = core(
+            m, s, y_t, mask_t
+        )
+        return (mean_f, chol_f), (sigma, detf, zs, verdicts)
+
+    (mean_t, chol_t), (sigma, detf, zs, verdicts) = lax.scan(
+        step, (jnp.asarray(mean, dtype), jnp.asarray(chol, dtype)),
+        (y_new, mask_new),
+    )
+    return mean_t, chol_t, sigma, detf, zs, verdicts
 
 
 def deviance_terms(

@@ -14,7 +14,13 @@ holds the pieces the serving stack (``metran_tpu.serve``) wires in:
   (:class:`HealthMonitor`), surfaced through ``MetranService.health()``;
 - :mod:`~metran_tpu.reliability.faultinject` — the fault-injection
   harness that keeps every one of those failure paths exercised
-  (tests ``-m faults``; ``bench.py --phase serve-faults``).
+  (tests ``-m faults``; ``bench.py --phase serve-faults``), including
+  seeded probabilistic faults and data-corrupting sensor faults
+  (:class:`SensorFault`: spike, stuck-at, drift, unit error);
+- :mod:`~metran_tpu.reliability.scenarios` — the sensor-fault accuracy
+  harness behind the observation gate's headline claim (gated posterior
+  RMSE within 2x of clean under corrupted feeds; ``bench.py --phase
+  robust-obs``).
 
 Numerical motivation: ill-conditioned covariances and non-finite
 likelihood paths are a known failure mode of Kalman filtering at scale
@@ -23,8 +29,9 @@ fallible steps with explicit validation and recovery, not infallible
 linear algebra.
 """
 
-from .faultinject import FaultInjector, SimulatedCrash
+from .faultinject import FaultInjector, SensorFault, SimulatedCrash
 from .health import HealthMonitor
+from .scenarios import run_sensor_fault_scenario
 from .policy import (
     BreakerBoard,
     ChainedRequestError,
@@ -47,7 +54,9 @@ __all__ = [
     "HealthMonitor",
     "ReliabilityPolicy",
     "RetryPolicy",
+    "SensorFault",
     "SimulatedCrash",
     "StateIntegrityError",
     "is_retryable",
+    "run_sensor_fault_scenario",
 ]

@@ -15,10 +15,23 @@ way to arm them with failures:
 
 Armed faults can raise an exception (IO errors, device failures), sleep
 (``delay_s`` — a wedged worker or slow device), or both, optionally
-limited to the first ``times`` matches and filtered by a ``match``
+limited to the first ``times`` matches, filtered by a ``match``
 substring against the fault point's detail string (e.g. one model's
-file path).  The hot-path cost when nothing is armed is one module
-attribute read and a ``None`` check.
+file path), and fired *probabilistically* (``probability=`` with a
+``seed`` for deterministic intermittent faults — flaky links, the
+occasional sensor spike).  The hot-path cost when nothing is armed is
+one module attribute read and a ``None`` check.
+
+Besides raising/sleeping, a fault can **corrupt data in flight**: a
+rule armed with ``corrupt=`` (any ``(array) -> array`` callable —
+:class:`SensorFault` ships the four classic sensor pathologies: spike,
+stuck-at, drift, unit-conversion error) is applied by the
+:func:`corrupt` hook, which instrumented ingest paths call on their
+payload (``MetranService`` fires ``serve.update.new_obs`` on every raw
+update payload).  This is what lets the test suite and ``bench.py
+--phase robust-obs`` prove the observation gate's accuracy claims
+end to end: corrupt the feed, serve with the gate on and off, compare
+posterior RMSE.
 
 :class:`SimulatedCrash` stands in for a process death (``kill -9``
 mid-write): it deliberately derives from ``BaseException`` so ordinary
@@ -37,11 +50,14 @@ instrumented point.
 from __future__ import annotations
 
 import contextlib
+import random
 import threading
 import time
 from dataclasses import dataclass, field
 from logging import getLogger
-from typing import Dict, Iterator, List, Optional, Union
+from typing import Callable, Dict, Iterator, List, Optional, Union
+
+import numpy as np
 
 logger = getLogger(__name__)
 
@@ -62,6 +78,16 @@ class Fault:
     times : fire at most this many times (``None``: every match).
     match : only fire when this substring occurs in the point's detail
         string (e.g. a model id or file path); ``None`` matches all.
+    probability : fire each match only with this probability (``None``:
+        always).  The draw comes from the rule's own seeded generator,
+        so a fixed ``seed`` makes an intermittent fault's firing
+        pattern exactly reproducible.
+    seed : seed for the probabilistic draw (``None``: OS entropy).
+    corrupt : an ``(array) -> array`` payload transformation.  Rules
+        with a ``corrupt`` callable are applied by the data hook
+        (:meth:`FaultInjector.corrupt`) only; rules without one are
+        applied by :meth:`FaultInjector.fire` only — a corruption rule
+        can never be mistaken for an error rule at the same point.
     """
 
     point: str
@@ -69,11 +95,18 @@ class Fault:
     delay_s: float = 0.0
     times: Optional[int] = None
     match: Optional[str] = None
+    probability: Optional[float] = None
+    seed: Optional[int] = None
+    corrupt: Optional[Callable] = None
     fired: int = field(default=0, compare=False)
+    _rng: Optional[random.Random] = field(
+        default=None, compare=False, repr=False
+    )
 
 
 class FaultInjector:
-    """A set of armed :class:`Fault` rules consulted by ``fire()``."""
+    """A set of armed :class:`Fault` rules consulted by ``fire()`` (and
+    by the data-corruption hook, :meth:`corrupt`)."""
 
     def __init__(self):
         self._faults: List[Fault] = []
@@ -87,12 +120,29 @@ class FaultInjector:
         delay_s: float = 0.0,
         times: Optional[int] = None,
         match: Optional[str] = None,
+        probability: Optional[float] = None,
+        seed: Optional[int] = None,
+        corrupt: Optional[Callable] = None,
     ) -> Fault:
-        """Arm one fault rule; returns it (``.fired`` counts matches)."""
+        """Arm one fault rule; returns it (``.fired`` counts matches).
+
+        ``probability``/``seed`` make the rule fire intermittently but
+        reproducibly (one seeded draw per candidate match, taken in
+        match order — a fixed seed yields the same firing pattern on
+        every run).  ``corrupt`` arms a data-corrupting rule instead
+        of an error rule (see :class:`Fault` and :class:`SensorFault`).
+        """
+        if probability is not None and not 0.0 <= probability <= 1.0:
+            raise ValueError(
+                f"probability must be in [0, 1], got {probability!r}"
+            )
         fault = Fault(
             point=point, error=error, delay_s=float(delay_s),
-            times=times, match=match,
+            times=times, match=match, probability=probability,
+            seed=seed, corrupt=corrupt,
         )
+        if probability is not None:
+            fault._rng = random.Random(seed)
         with self._lock:
             self._faults.append(fault)
         return fault
@@ -106,21 +156,37 @@ class FaultInjector:
         with self._lock:
             self._faults.clear()
 
-    def fire(self, point: str, detail: str = "") -> None:
-        """Run every armed rule matching ``point`` (sleep, then raise)."""
-        to_apply: List[Fault] = []
+    def _claim(self, point: str, detail: str,
+               corrupting: bool) -> List[Fault]:
+        """Select (and count) the armed rules that fire for this call.
+
+        Runs entirely under the lock: the times budget and the seeded
+        probability draws are serialized, so concurrent threads cannot
+        over-fire a bounded rule or interleave a seeded generator."""
+        claimed: List[Fault] = []
         with self._lock:
             for fault in self._faults:
                 if fault.point != point:
+                    continue
+                if (fault.corrupt is not None) != corrupting:
                     continue
                 if fault.match is not None and fault.match not in detail:
                     continue
                 if fault.times is not None and fault.fired >= fault.times:
                     continue
+                if (
+                    fault.probability is not None
+                    and fault._rng.random() >= fault.probability
+                ):
+                    continue
                 fault.fired += 1
                 self.fired[point] = self.fired.get(point, 0) + 1
-                to_apply.append(fault)
-        for fault in to_apply:
+                claimed.append(fault)
+        return claimed
+
+    def fire(self, point: str, detail: str = "") -> None:
+        """Run every armed rule matching ``point`` (sleep, then raise)."""
+        for fault in self._claim(point, detail, corrupting=False):
             if fault.delay_s > 0:
                 time.sleep(fault.delay_s)
             if fault.error is not None:
@@ -133,6 +199,108 @@ class FaultInjector:
                         + (f" ({detail})" if detail else "")
                     )
                 raise fault.error
+
+    def corrupt(self, point: str, array, detail: str = ""):
+        """Apply every armed corruption rule matching ``point``.
+
+        Returns the (possibly) transformed array; the input is never
+        mutated (rules receive a float copy).  No rule matching means
+        the input comes back unchanged, identity-preserving — the
+        instrumented hot path pays one lock-free ``None`` check via the
+        module-level :func:`corrupt` and nothing else.
+        """
+        faults = self._claim(point, detail, corrupting=True)
+        if not faults:
+            return array
+        out = np.array(array, dtype=float, copy=True)
+        for fault in faults:
+            logger.info(
+                "fault injection: corrupting payload at %s (%s)",
+                point, detail,
+            )
+            out = np.asarray(fault.corrupt(out), dtype=float)
+        return out
+
+
+class SensorFault:
+    """The four classic sensor pathologies as a corruption callable.
+
+    Arm one on an injector's data hook::
+
+        inj.add("serve.update.new_obs", match="well7",
+                corrupt=SensorFault("spike", series=0, magnitude=8.0),
+                probability=0.3, seed=11)
+
+    Modes (``array`` is the raw (k, n_series) update payload, data
+    units; ``series`` picks the corrupted column — an int, a sequence
+    of ints, or ``None`` for all):
+
+    - ``"spike"``: add ``magnitude`` to row ``row`` (default 0) of the
+      chosen series — a single outlier reading per corrupted payload;
+      combine with ``probability=`` for intermittent spikes.
+    - ``"stuck"``: overwrite the series with a constant on every row —
+      a stuck gauge.  ``value=None`` latches the first corrupted
+      reading (the realistic failure: the gauge froze at a plausible
+      value and the world moved on).
+    - ``"drift"``: add a ramp growing by ``magnitude`` per corrupted
+      row, *across calls* (the callable keeps a row counter) — a
+      drifting calibration.
+    - ``"unit"``: multiply by ``factor`` — a unit-conversion error
+      (cm vs inch, m vs mm).
+
+    Deterministic: no internal randomness (intermittency belongs to
+    the rule's ``probability``/``seed``), and the drift counter
+    advances only when the rule actually fires.  Thread-safe.
+    """
+
+    MODES = ("spike", "stuck", "drift", "unit")
+
+    def __init__(self, mode: str, series=None, magnitude: float = 8.0,
+                 factor: float = 10.0, value: Optional[float] = None,
+                 row: int = 0):
+        if mode not in self.MODES:
+            raise ValueError(
+                f"unknown sensor-fault mode {mode!r}; expected one of "
+                f"{self.MODES}"
+            )
+        self.mode = mode
+        self.series = series
+        self.magnitude = float(magnitude)
+        self.factor = float(factor)
+        self.value = value
+        self.row = int(row)
+        self._rows_seen = 0  # drift state: rows corrupted so far
+        self._stuck_value = None if value is None else float(value)
+        self._lock = threading.Lock()
+
+    def _cols(self):
+        if self.series is None:
+            return slice(None)
+        if isinstance(self.series, int):
+            return [self.series]
+        return list(self.series)
+
+    def __call__(self, arr):
+        arr = np.array(arr, dtype=float, copy=True)
+        k = arr.shape[0]
+        cols = self._cols()
+        with self._lock:
+            if self.mode == "spike":
+                arr[min(self.row, k - 1), cols] += self.magnitude
+            elif self.mode == "stuck":
+                if self._stuck_value is None:
+                    # latch the first reading the fault ever touches
+                    self._stuck_value = np.array(arr[0, cols], copy=True)
+                arr[:, cols] = self._stuck_value
+            elif self.mode == "drift":
+                ramp = self.magnitude * (
+                    self._rows_seen + 1 + np.arange(k, dtype=float)
+                )
+                arr[:, cols] += ramp[:, None]
+                self._rows_seen += k
+            else:  # "unit"
+                arr[:, cols] *= self.factor
+        return arr
 
 
 # The process-global injector; ``None`` keeps every fault point a no-op.
@@ -148,6 +316,18 @@ def fire(point: str, detail: str = "") -> None:
     injector = _active
     if injector is not None:
         injector.fire(point, detail)
+
+
+def corrupt(point: str, array, detail: str = ""):
+    """Library-side data hook: pass-through unless an injector is
+    active (then :meth:`FaultInjector.corrupt` applies matching
+    corruption rules).  Instrumented ingest paths call this on their
+    raw payload; same no-op cost contract as :func:`fire`.
+    """
+    injector = _active
+    if injector is None:
+        return array
+    return injector.corrupt(point, array, detail)
 
 
 @contextlib.contextmanager
@@ -168,4 +348,12 @@ def active(injector: Optional[FaultInjector] = None) -> Iterator[FaultInjector]:
         _active = None
 
 
-__all__ = ["Fault", "FaultInjector", "SimulatedCrash", "active", "fire"]
+__all__ = [
+    "Fault",
+    "FaultInjector",
+    "SensorFault",
+    "SimulatedCrash",
+    "active",
+    "corrupt",
+    "fire",
+]

@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import threading
 from collections import deque
-from typing import Deque, Dict, Optional
+from typing import Deque, Dict, List, Optional, Tuple
 
 __all__ = ["HealthMonitor"]
 
@@ -29,12 +29,31 @@ class HealthMonitor:
     ``window`` bounds memory AND forgives: once a fault clears, the bad
     outcomes age out after ``window`` successful requests and the
     replica reads ready again — recovery needs no restart.
+
+    Besides whole-replica request outcomes, the monitor keeps a
+    **per-model observation-gate window** (:meth:`record_gate`): how
+    many of a model's recent observations the serving gate rejected.
+    A dying sensor produces observations the gate rejects while every
+    *request* still succeeds (the tempered update commits), so its
+    circuit breaker never sees an error — the rejection-rate window is
+    what flips that model to degraded (:meth:`degraded_models`) before
+    anything breaks.  ``gate_window`` bounds per-model memory (recent
+    update batches kept); ``max_rejection_rate`` is the degraded
+    threshold — the default 0.1 sits far above the gate's false-alarm
+    rate on clean data (~1e-4 per observation at nsigma=4) yet below
+    one fully-dead sensor's share of a typical panel (1/n_series).
     """
 
-    def __init__(self, window: int = 512, max_error_rate: float = 0.5):
+    def __init__(self, window: int = 512, max_error_rate: float = 0.5,
+                 gate_window: int = 128,
+                 max_rejection_rate: float = 0.1):
         self.window = int(window)
         self.max_error_rate = float(max_error_rate)
+        self.gate_window = int(gate_window)
+        self.max_rejection_rate = float(max_rejection_rate)
         self._outcomes: Deque[bool] = deque(maxlen=self.window)
+        # model_id -> recent (observed, rejected) pairs, one per update
+        self._gate: Dict[str, Deque[Tuple[int, int]]] = {}
         self._lock = threading.Lock()
         self._seen = 0
 
@@ -59,6 +78,66 @@ class HealthMonitor:
         """Error-rate verdict alone; the service ANDs in liveness."""
         return self.error_rate() <= self.max_error_rate
 
+    # -- per-model observation-gate window ------------------------------
+    def record_gate(self, model_id: str, observed: int,
+                    flagged: int) -> None:
+        """Book one update batch's gate outcome for ``model_id``:
+        ``observed`` real observations evaluated, ``flagged`` of them
+        acted on by the gate (rejected OR downweighted — under the
+        soft policies a dying sensor is downweighted every step, never
+        rejected, and must still trip degraded).  No-op when nothing
+        was observed."""
+        if observed <= 0:
+            return
+        with self._lock:
+            dq = self._gate.get(model_id)
+            if dq is None:
+                dq = self._gate[model_id] = deque(
+                    maxlen=self.gate_window
+                )
+            dq.append((int(observed), int(flagged)))
+
+    def rejection_rate(self, model_id: str) -> float:
+        """Fraction of ``model_id``'s recent observations the gate
+        acted on — rejected or downweighted (0.0 for an unknown/quiet
+        model)."""
+        with self._lock:
+            dq = self._gate.get(model_id)
+            if not dq:
+                return 0.0
+            obs = sum(o for o, _ in dq)
+            rej = sum(r for _, r in dq)
+        return rej / obs if obs else 0.0
+
+    def degraded_models(self) -> List[str]:
+        """Models whose windowed rejection rate exceeds
+        ``max_rejection_rate`` — the sensor-is-dying signal that never
+        reaches the circuit breaker (the tempered requests succeed)."""
+        with self._lock:
+            items = [
+                (mid, sum(o for o, _ in dq), sum(r for _, r in dq))
+                for mid, dq in self._gate.items()
+            ]
+        return sorted(
+            mid for mid, obs, rej in items
+            if obs and rej / obs > self.max_rejection_rate
+        )
+
+    def gate_stats(self) -> Dict[str, Dict[str, float]]:
+        """Per-model windowed gate stats (observed/rejected/rate)."""
+        with self._lock:
+            items = [
+                (mid, sum(o for o, _ in dq), sum(r for _, r in dq))
+                for mid, dq in self._gate.items()
+            ]
+        return {
+            mid: {
+                "observed": obs, "rejected": rej,
+                "rejection_rate": (rej / obs) if obs else 0.0,
+            }
+            for mid, obs, rej in items
+        }
+
     def bind_metrics(self, registry, prefix: str = "metran_serve") -> None:
         """Publish this monitor into a :class:`~metran_tpu.obs.
         MetricsRegistry` as callback gauges (evaluated at scrape time,
@@ -75,18 +154,36 @@ class HealthMonitor:
             "lifetime request outcomes recorded",
             callback=lambda: float(self.seen),
         )
+        registry.gauge(
+            f"{prefix}_gate_degraded_models",
+            "models whose windowed observation-rejection rate exceeds "
+            "the degraded threshold",
+            callback=lambda: float(len(self.degraded_models())),
+        )
 
     def snapshot(self, extra: Optional[Dict] = None) -> Dict:
-        with self._lock:
+        with self._lock:  # ONE acquisition: a consistent instant
             n = len(self._outcomes)
             errors = n - sum(self._outcomes)
             seen = self._seen
+            gate_items = [
+                (mid, sum(o for o, _ in dq), sum(r for _, r in dq))
+                for mid, dq in self._gate.items()
+            ]
         snap = {
             "window": n,
             "window_errors": int(errors),
             "error_rate": (errors / n) if n else 0.0,
             "requests_seen": seen,
             "max_error_rate": self.max_error_rate,
+            "gate": {
+                "tracked_models": len(gate_items),
+                "degraded_models": sorted(
+                    mid for mid, obs, rej in gate_items
+                    if obs and rej / obs > self.max_rejection_rate
+                ),
+                "max_rejection_rate": self.max_rejection_rate,
+            },
         }
         if extra:
             snap.update(extra)

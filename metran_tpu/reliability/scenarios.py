@@ -1,0 +1,235 @@
+"""Sensor-fault scenarios: posterior accuracy under corrupted feeds.
+
+The observation gate (``metran_tpu.serve``, docs/concepts.md "Input
+robustness") claims that a corrupted sensor feed — spike, stuck gauge,
+drifting calibration, unit-conversion error — degrades a gated model's
+posterior only mildly while it silently wrecks an ungated one.  That is
+an *accuracy* claim, and accuracy claims need a measurement, not a unit
+test of the mechanics: this module is the shared harness behind both
+the ``-m faults`` scenario tests (tests/test_sensor_faults.py) and
+``bench.py --phase robust-obs``.
+
+:func:`run_sensor_fault_scenario` builds a synthetic DFM, simulates a
+ground-truth state path from the model itself (so the truth is known
+exactly), freezes a serving :class:`~metran_tpu.serve.PosteriorState`
+from a clean history, then streams the remaining observations through
+three identically-configured :class:`~metran_tpu.serve.MetranService`
+instances:
+
+1. **clean** — uncorrupted feed (the accuracy floor);
+2. **ungated** — the feed corrupted by an armed
+   :class:`~metran_tpu.reliability.SensorFault`, gate off;
+3. **gated** — the same corruption (same seed, so the same readings
+   are hit), gate armed with the requested policy.
+
+The reported RMSE is the per-step posterior-mean error against the
+true latent states, averaged over the whole stream — the quantity
+every later forecast inherits.  The gated run also reports its verdict
+counters, event counts and the health monitor's degraded-model list,
+so the harness doubles as an end-to-end wiring check.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from . import faultinject
+from .faultinject import SensorFault
+
+__all__ = ["run_sensor_fault_scenario", "simulate_dfm_panel"]
+
+
+def simulate_dfm_panel(ss, t_steps: int, rng, missing_p: float = 0.0):
+    """Simulate ``t_steps`` of states and observations FROM the model.
+
+    Ground truth for the scenario harness: states follow the DFM's own
+    AR(1) transition (diagonal ``Phi``/``Q``), observations are the
+    exact projections ``Z x`` (the DFM's ``r = 0``), optionally with
+    Bernoulli(``missing_p``) missingness.  Returns ``(x, y, mask)``
+    with shapes (T, n_state), (T, n_obs), (T, n_obs).
+    """
+    phi = np.asarray(ss.phi)
+    q_sd = np.sqrt(np.clip(np.diagonal(np.asarray(ss.q)), 0.0, None))
+    z = np.asarray(ss.z)
+    x = np.zeros(phi.shape[0])
+    xs = np.empty((t_steps, phi.shape[0]))
+    for t in range(t_steps):
+        x = phi * x + rng.normal(size=x.shape) * q_sd
+        xs[t] = x
+    y = xs @ z.T
+    mask = (
+        rng.uniform(size=y.shape) >= missing_p
+        if missing_p > 0.0 else np.ones(y.shape, bool)
+    )
+    return xs, y, mask
+
+
+def _stream_rmse(service, model_id, y_stream, x_truth, slot_index):
+    """Stream one row per update; return posterior-mean RMSE vs truth.
+
+    The error is read from the committed registry state after every
+    update (what the next forecast would serve from), against the true
+    latent state at the same timestep, over the model's real state
+    slots.  A rejected-by-integrity-gate update leaves the prior state
+    in place — that state still serves, so it still scores.
+    """
+    errs = []
+    for t in range(y_stream.shape[0]):
+        try:
+            service.update(model_id, y_stream[t][None, :])
+        except Exception:
+            pass  # a failed update still leaves a servable posterior
+        state = service.registry.get(model_id)
+        errs.append(state.mean - x_truth[t][slot_index])
+    errs = np.asarray(errs)
+    return float(np.sqrt(np.mean(errs**2)))
+
+
+def run_sensor_fault_scenario(
+    mode: str,
+    policy: str = "reject",
+    nsigma: float = 4.0,
+    n_series: int = 6,
+    n_factors: int = 1,
+    t_hist: int = 300,
+    n_steps: int = 60,
+    seed: int = 0,
+    series: int = 0,
+    magnitude: Optional[float] = None,
+    factor: float = 10.0,
+    probability: Optional[float] = None,
+    missing_p: float = 0.25,
+    engine: str = "joint",
+    min_seen: int = 32,
+) -> dict:
+    """One fault mode, measured gated vs ungated vs clean (module doc).
+
+    ``mode`` is a :class:`SensorFault` mode; per-mode defaults when
+    ``magnitude``/``probability`` are not given: spikes are +8 data
+    units fired on ~30% of updates (seeded — the gated and ungated
+    runs corrupt the *same* readings), stuck/unit fire every update,
+    drift ramps 0.75/step.  Returns a dict with ``rmse_clean``,
+    ``rmse_ungated``, ``rmse_gated``, their ratios, and the gated
+    run's verdict/event/health evidence.
+    """
+    from ..ops import dfm_statespace, kalman_filter, sqrt_kalman_filter
+    from ..serve import GateSpec, MetranService, ModelRegistry, PosteriorState
+    from ..serve.engine import state_slot_index
+
+    rng = np.random.default_rng(seed)
+    loadings = rng.uniform(0.4, 0.7, (n_series, n_factors))
+    loadings /= np.sqrt(n_factors)
+    alpha_sdf = rng.uniform(5.0, 40.0, n_series)
+    alpha_cdf = rng.uniform(10.0, 60.0, n_factors)
+    ss = dfm_statespace(alpha_sdf, alpha_cdf, loadings, 1.0)
+
+    xs, y_all, mask_all = simulate_dfm_panel(
+        ss, t_hist + n_steps, rng, missing_p=missing_p
+    )
+    y_hist = np.where(mask_all[:t_hist], y_all[:t_hist], 0.0)
+    sqrt_engine = engine in ("sqrt", "sqrt_parallel")
+    if sqrt_engine:
+        filt = sqrt_kalman_filter(ss, y_hist, mask_all[:t_hist])
+        chol0 = np.asarray(filt.chol_f[-1])
+        cov0 = chol0 @ chol0.T
+    else:
+        filt = kalman_filter(ss, y_hist, mask_all[:t_hist], engine=engine)
+        chol0, cov0 = None, np.asarray(filt.cov_f[-1])
+
+    def make_state(model_id):
+        return PosteriorState(
+            model_id=model_id, version=0, t_seen=t_hist,
+            mean=np.asarray(filt.mean_f[-1]), cov=cov0,
+            params=np.concatenate([alpha_sdf, alpha_cdf]),
+            loadings=loadings, dt=1.0,
+            scaler_mean=np.zeros(n_series),
+            scaler_std=np.ones(n_series),
+            names=tuple(f"s{j}" for j in range(n_series)),
+            chol=chol0,
+        )
+
+    # the stream carries missingness as NaN, like a real feed
+    y_stream = np.where(
+        mask_all[t_hist:], y_all[t_hist:], np.nan
+    )
+    x_stream = xs[t_hist:]
+    slot = state_slot_index(n_series, n_factors, n_series)
+
+    if magnitude is None:
+        magnitude = {"spike": 8.0, "stuck": 8.0, "drift": 0.75,
+                     "unit": 8.0}[mode]
+    if probability is None and mode == "spike":
+        probability = 0.3
+
+    def make_fault():
+        # a FRESH SensorFault per run (drift/stuck carry state), but
+        # identical construction + an identical probability seed: the
+        # gated and ungated runs corrupt the same readings the same way.
+        # The stuck gauge latches at a rail/fill value (``magnitude``):
+        # a gauge stuck at its last PLAUSIBLE reading is invisible to
+        # any one-step innovation test — the filter keeps adapting to
+        # it — and catching that class needs the offline whiteness
+        # diagnostics, not the online gate (documented limitation).
+        return SensorFault(
+            mode, series=series, magnitude=magnitude, factor=factor,
+            value=magnitude if mode == "stuck" else None,
+        )
+
+    def run(corrupted: bool, gate: "GateSpec") -> tuple:
+        reg = ModelRegistry(root=None, engine=engine)
+        mid = f"scenario-{mode}"
+        reg.put(make_state(mid), persist=False)
+        svc = MetranService(
+            reg, flush_deadline=None, persist_updates=False, gate=gate,
+        )
+        try:
+            if corrupted:
+                with faultinject.active() as inj:
+                    inj.add(
+                        "serve.update.new_obs", match=mid,
+                        corrupt=make_fault(),
+                        probability=probability, seed=seed + 1,
+                    )
+                    rmse = _stream_rmse(svc, mid, y_stream, x_stream, slot)
+            else:
+                rmse = _stream_rmse(svc, mid, y_stream, x_stream, slot)
+            return rmse, svc
+        finally:
+            svc.close()
+
+    gate_off = GateSpec(policy="off")
+    gate_on = GateSpec(policy=policy, nsigma=nsigma, min_seen=min_seen)
+
+    rmse_clean, _ = run(False, gate_off)
+    rmse_ungated, svc_ungated = run(True, gate_off)
+    rmse_gated, svc_gated = run(True, gate_on)
+
+    events = (
+        svc_gated.events.counts() if svc_gated.events is not None else {}
+    )
+    out = {
+        "mode": mode,
+        "policy": policy,
+        "nsigma": nsigma,
+        "engine": engine,
+        "n_steps": n_steps,
+        "rmse_clean": rmse_clean,
+        "rmse_ungated": rmse_ungated,
+        "rmse_gated": rmse_gated,
+        "gated_vs_clean": rmse_gated / max(rmse_clean, 1e-12),
+        "ungated_vs_clean": rmse_ungated / max(rmse_clean, 1e-12),
+        "ungated_vs_gated": rmse_ungated / max(rmse_gated, 1e-12),
+        "verdicts": svc_gated.metrics.gate_verdicts.snapshot(),
+        "ungated_verdicts": svc_ungated.metrics.gate_verdicts.snapshot(),
+        "events": {
+            k: v for k, v in events.items()
+            if k.startswith("observation_")
+        },
+        "degraded_models": svc_gated.monitor.degraded_models(),
+        "rejection_rate": svc_gated.monitor.rejection_rate(
+            f"scenario-{mode}"
+        ),
+    }
+    return out

@@ -32,7 +32,13 @@ from ..reliability.policy import (
     StateIntegrityError,
 )
 from .batching import MicroBatcher
-from .engine import forecast_bucket, posterior_fault, stack_bucket, update_bucket
+from .engine import (
+    GateSpec,
+    forecast_bucket,
+    posterior_fault,
+    stack_bucket,
+    update_bucket,
+)
 from .registry import CompiledFnCache, ModelRegistry
 from .service import Forecast, MetranService, ServeMetrics
 from .state import (
@@ -47,6 +53,7 @@ __all__ = [
     "CompiledFnCache",
     "DeadlineExceededError",
     "Forecast",
+    "GateSpec",
     "MetranService",
     "MicroBatcher",
     "ModelRegistry",

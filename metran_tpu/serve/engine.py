@@ -20,14 +20,76 @@ real slots' moments; a padded state slot starts at the filter's
 from __future__ import annotations
 
 import functools
-from typing import List, NamedTuple, Tuple
+from typing import List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops import filter_append, forecast_observation_moments, sqrt_filter_append
+from ..ops import (
+    GATE_POLICIES,
+    filter_append,
+    forecast_observation_moments,
+    gated_filter_append,
+    gated_sqrt_filter_append,
+    sqrt_filter_append,
+)
 from ..ops.statespace import StateSpace, dfm_statespace
+
+
+class GateSpec(NamedTuple):
+    """Observation-gate policy for the serving update path.
+
+    ``policy`` is one of :data:`metran_tpu.ops.GATE_POLICIES`
+    (``"off"``/``"reject"``/``"huber"``/``"inflate"``): what happens to
+    an observed slot whose squared normalized innovation exceeds
+    ``nsigma**2`` (chi-square(1) under the model — see the gated
+    kernels in :mod:`metran_tpu.ops.kalman`).  ``min_seen`` disarms the
+    gate for models with fewer assimilated grid steps: a cold model's
+    filter has not forgotten its ``N(0, I)`` init yet, so its early
+    innovations are over-dispersed and a live gate would reject real
+    data.  ``policy``/``nsigma`` are compile-time constants of the
+    update kernel (part of the registry's compile key); ``min_seen``
+    is evaluated host-side per model per dispatch (the kernel's traced
+    ``armed`` flag), so models crossing the threshold never recompile.
+
+    Defaults come from :func:`metran_tpu.config.serve_defaults`
+    (``METRAN_TPU_SERVE_GATE_{POLICY,NSIGMA,MIN_SEEN}``); the shipped
+    default is ``policy="off"`` — gating is opt-in.
+    """
+
+    policy: str = "off"
+    nsigma: float = 4.0
+    min_seen: int = 32
+
+    @property
+    def enabled(self) -> bool:
+        return self.policy != "off"
+
+    @classmethod
+    def from_defaults(cls) -> "GateSpec":
+        from ..config import serve_defaults
+
+        d = serve_defaults()
+        spec = cls(
+            policy=str(d["gate_policy"]),
+            nsigma=float(d["gate_nsigma"]),
+            min_seen=int(d["gate_min_seen"]),
+        )
+        spec.validate()
+        return spec
+
+    def validate(self) -> "GateSpec":
+        if self.policy not in GATE_POLICIES:
+            raise ValueError(
+                f"unknown gate policy {self.policy!r}; expected one of "
+                f"{GATE_POLICIES}"
+            )
+        if self.enabled and not self.nsigma > 0:
+            raise ValueError(
+                f"gate nsigma must be > 0, got {self.nsigma!r}"
+            )
+        return self
 
 
 class BucketBatch(NamedTuple):
@@ -238,7 +300,7 @@ def _annotated(fn, name: str):
     return annotated
 
 
-def make_update_fn(engine: str = "joint"):
+def make_update_fn(engine: str = "joint", gate: Optional[GateSpec] = None):
     """A fresh jitted batched incremental-update kernel.
 
     ``fn(ss, mean, cov, y_new, mask_new) -> (mean_T, cov_T, sigma,
@@ -252,7 +314,43 @@ def make_update_fn(engine: str = "joint"):
     actually frees the underlying executables (a module-level jit would
     pin every bucket's compilation forever).  Calls run under
     :data:`UPDATE_ANNOTATION` for device-trace attribution.
+
+    With an **enabled** ``gate`` (:class:`GateSpec`), the kernel is the
+    gated variant: it takes one extra batch-leading argument ``armed``
+    ((B,) bool — the host's per-model ``t_seen >= min_seen`` verdict)
+    and returns two extra outputs, the per-slot normalized innovations
+    and int8 gate verdicts ((B, k, N) each).  Square-root buckets run
+    :func:`metran_tpu.ops.gated_sqrt_filter_append`; covariance
+    buckets run :func:`metran_tpu.ops.gated_filter_append`, which is
+    sequential-processing — a ``joint``-engine registry arming the
+    gate serves updates through the gated *sequential* kernel (the
+    gate is a per-slot test; posteriors agree to float tolerance).
     """
+    if gate is not None and gate.enabled:
+        gate.validate()
+        policy, nsigma = gate.policy, float(gate.nsigma)
+        if engine in ("sqrt", "sqrt_parallel"):
+
+            @jax.jit
+            def fn(ss, mean, chol, y_new, mask_new, armed):
+                return jax.vmap(
+                    lambda s, m, c, y, k, a: gated_sqrt_filter_append(
+                        s, m, c, y, k, armed=a, policy=policy,
+                        nsigma=nsigma,
+                    )
+                )(ss, mean, chol, y_new, mask_new, armed)
+
+            return _annotated(fn, UPDATE_ANNOTATION)
+
+        @jax.jit
+        def fn(ss, mean, cov, y_new, mask_new, armed):
+            return jax.vmap(
+                lambda s, m, c, y, k, a: gated_filter_append(
+                    s, m, c, y, k, armed=a, policy=policy, nsigma=nsigma
+                )
+            )(ss, mean, cov, y_new, mask_new, armed)
+
+        return _annotated(fn, UPDATE_ANNOTATION)
     if engine in ("sqrt", "sqrt_parallel"):
 
         @jax.jit
@@ -316,6 +414,7 @@ def forecast_bucket(ss, mean, cov, steps: int):
 __all__ = [
     "BucketBatch",
     "FORECAST_ANNOTATION",
+    "GateSpec",
     "UPDATE_ANNOTATION",
     "forecast_bucket",
     "make_forecast_fn",

@@ -470,13 +470,22 @@ class ModelRegistry:
         # is [sdf * n_pad | cdf...], so n_state_pad >= n_pad always
         return (n_pad, pad_to_multiple(n_pad + state.n_factors, m))
 
-    def update_fn(self, bucket: ShapeBucket, k: int):
-        """Compiled assimilation kernel for ``k`` appended steps."""
+    def update_fn(self, bucket: ShapeBucket, k: int, gate=None):
+        """Compiled assimilation kernel for ``k`` appended steps.
+
+        ``gate`` (an enabled :class:`~metran_tpu.serve.engine.
+        GateSpec`) selects the gated kernel variant; its static half
+        (policy, nsigma) joins the compile key, so flipping the gate
+        policy builds a distinct executable while ``min_seen`` changes
+        never recompile (that knob is the kernel's traced ``armed``
+        argument)."""
         from .engine import make_update_fn
 
+        key = ("update", bucket, int(k), self.engine)
+        if gate is not None and getattr(gate, "enabled", False):
+            key = key + ("gate", gate.policy, float(gate.nsigma))
         return self._compiled.get_or_create(
-            ("update", bucket, int(k), self.engine),
-            lambda: make_update_fn(engine=self.engine),
+            key, lambda: make_update_fn(engine=self.engine, gate=gate),
         )
 
     def forecast_fn(self, bucket: ShapeBucket, steps: int):

@@ -61,7 +61,8 @@ from typing import NamedTuple, Optional, Tuple
 import numpy as np
 
 from ..obs import Observability
-from ..reliability.faultinject import fire
+from ..ops.kalman import GATE_DOWNWEIGHTED, GATE_REJECTED
+from ..reliability.faultinject import corrupt, fire
 from ..reliability.health import HealthMonitor
 from ..reliability.policy import (
     BreakerBoard,
@@ -74,10 +75,19 @@ from ..reliability.policy import (
 )
 from ..utils.profiling import EventCounters, LatencyRecorder, OccupancyCounter
 from .batching import MicroBatcher
+from .engine import GateSpec
 from .registry import ModelRegistry
 from .state import PosteriorState
 
 logger = getLogger(__name__)
+
+#: gate-score histogram buckets: the score is a squared normalized
+#: innovation, chi-square(1) under the model, so the mass sits below ~4
+#: and the tail above ``nsigma**2`` is what the gate acts on — bounds
+#: bracket both (the common nsigma range 3-6 maps to 9-36).
+GATE_SCORE_BUCKETS = (
+    0.1, 0.5, 1.0, 2.0, 4.0, 9.0, 16.0, 25.0, 50.0, 100.0,
+)
 
 
 def _transfer(src: Future, dst: Future) -> None:
@@ -219,6 +229,15 @@ class ServeMetrics:
     )
     occupancy: OccupancyCounter = field(default_factory=OccupancyCounter)
     errors: EventCounters = field(default_factory=EventCounters)
+    #: observation-gate verdicts by kind (``rejected``/``downweighted``)
+    gate_verdicts: EventCounters = field(default_factory=EventCounters)
+    #: input data-quality events by kind (``masked_values`` — NaN cells
+    #: mapped to missing at submission; ``empty_updates`` — all-NaN
+    #: batches that still committed ``version+1``)
+    data_quality: EventCounters = field(default_factory=EventCounters)
+    #: gate-score histogram (squared normalized innovation per observed
+    #: slot); only present on registry-backed instances
+    gate_scores: Optional[object] = None
 
     @classmethod
     def registered(cls, registry) -> "ServeMetrics":
@@ -248,6 +267,24 @@ class ServeMetrics:
                 name="metran_serve_errors_total",
                 help="reliability/degradation events by kind",
             ),
+            gate_verdicts=EventCounters(
+                registry=registry,
+                name="metran_serve_gate_verdicts_total",
+                help="observation-gate verdicts by kind "
+                     "(rejected/downweighted)",
+            ),
+            data_quality=EventCounters(
+                registry=registry,
+                name="metran_serve_data_quality_total",
+                help="input data-quality events by kind "
+                     "(masked_values, empty_updates)",
+            ),
+            gate_scores=registry.histogram(
+                "metran_serve_gate_score",
+                "squared normalized innovation per observed slot at "
+                "update time (chi-square(1) under the model)",
+                buckets=GATE_SCORE_BUCKETS,
+            ),
         )
 
     def summary(self) -> str:
@@ -275,6 +312,20 @@ class MetranService:
     reliability : deadline/retry/breaker/validation policy
         (:class:`~metran_tpu.reliability.ReliabilityPolicy`); default
         from :func:`metran_tpu.config.serve_defaults`.
+    gate : observation-gate policy for the update path
+        (:class:`~metran_tpu.serve.engine.GateSpec`); default from
+        ``serve_defaults()`` (``METRAN_TPU_SERVE_GATE_*``, shipped
+        ``policy="off"``).  With an enabled gate, each update's
+        per-slot normalized innovations are tested against the
+        chi-square gate inside the kernel and the policy applied
+        (reject / Huber-downweight / variance-inflate); verdicts are
+        booked per observation (``gate_verdicts`` counters, the
+        ``metran_serve_gate_score`` histogram,
+        ``observation_rejected``/``observation_downweighted`` events)
+        and a per-model rejection-rate window in the health monitor
+        flags dying sensors as degraded.  Models with
+        ``t_seen < gate.min_seen`` are disarmed (cold filters reject
+        real data).
     observability : metrics/tracing/event bundle
         (:class:`~metran_tpu.obs.Observability`); default from
         :meth:`~metran_tpu.obs.Observability.default` (metrics + event
@@ -290,6 +341,7 @@ class MetranService:
         persist_updates: bool = True,
         reliability: Optional[ReliabilityPolicy] = None,
         observability: Optional[Observability] = None,
+        gate: Optional[GateSpec] = None,
     ):
         from ..config import serve_defaults
 
@@ -316,6 +368,10 @@ class MetranService:
         self.reliability = (
             reliability if reliability is not None
             else ReliabilityPolicy.from_defaults()
+        )
+        self.gate = (
+            gate.validate() if gate is not None
+            else GateSpec.from_defaults()
         )
         on_transition = None
         if self.events is not None:
@@ -699,6 +755,12 @@ class MetranService:
             self._record_failure_without_request("update", model_id)
             raise
         new_obs = np.atleast_2d(np.asarray(new_obs, float))
+        # data-corrupting fault point: sensor faults (spike, stuck-at,
+        # drift, unit-error) injected on the raw payload exactly as a
+        # broken upstream feed would deliver them — what the
+        # observation gate exists to catch (reliability.faultinject;
+        # `-m faults` tests and `bench.py --phase robust-obs`)
+        new_obs = corrupt("serve.update.new_obs", new_obs, detail=model_id)
         if new_obs.shape[1] != state.n_series:
             self.metrics.errors.increment("validation_errors")
             raise ValueError(
@@ -721,6 +783,14 @@ class MetranService:
             self.metrics.errors.increment("breaker_rejections")
             raise
         mask = np.isfinite(new_obs)
+        # NaN cells are mapped to missing BY DESIGN — but never again
+        # silently: the masked-cell count is booked so a feed that
+        # quietly turns all-NaN shows up in the metrics (the
+        # all-NaN-batch commit additionally emits an `empty_update`
+        # event at dispatch, where the commit happens)
+        n_masked = int(mask.size - np.count_nonzero(mask))
+        if n_masked:
+            self.metrics.data_quality.increment("masked_values", n_masked)
         # standardize at the boundary; masked slots go to 0 like the
         # panel packer does (ignored under mask either way)
         y_std = np.where(
@@ -1162,6 +1232,53 @@ class MetranService:
                 )
         return results
 
+    def _book_gate_verdicts(self, st, zs, verdicts, trace_ctx) -> None:
+        """Book one batch slot's observation-gate outcome.
+
+        ``zs``/``verdicts`` are the model's real-series slices of the
+        gated kernel's outputs ((k, n_series) each; ``zs`` is NaN
+        where unobserved).  Every observed slot's score feeds the
+        gate-score histogram, verdict counts feed the labelled counter
+        family and the per-model rejection-rate window
+        (:meth:`~metran_tpu.reliability.HealthMonitor.record_gate` —
+        the dying-sensor signal), and each rejected/downweighted
+        observation becomes one attributed event with model/slot/score
+        so a post-mortem can name the exact sensor and reading.
+        """
+        obs = np.isfinite(zs)
+        n_obs = int(np.count_nonzero(obs))
+        n_rej = int(np.count_nonzero(verdicts == GATE_REJECTED))
+        n_dw = int(np.count_nonzero(verdicts == GATE_DOWNWEIGHTED))
+        if n_obs:
+            hist = self.metrics.gate_scores
+            if hist is not None:
+                hist.observe_many(np.square(zs[obs]))
+            # flagged = rejected OR downweighted: the soft policies
+            # never reject, and a sensor they downweight every step is
+            # just as dead
+            self.monitor.record_gate(st.model_id, n_obs, n_rej + n_dw)
+        if n_rej:
+            self.metrics.gate_verdicts.increment("rejected", n_rej)
+        if n_dw:
+            self.metrics.gate_verdicts.increment("downweighted", n_dw)
+        if (n_rej or n_dw) and self.events is not None:
+            request_id = (
+                trace_ctx.trace_id if trace_ctx is not None else None
+            )
+            for row, col in zip(*np.nonzero(verdicts)):
+                kind = (
+                    "observation_rejected"
+                    if verdicts[row, col] == GATE_REJECTED
+                    else "observation_downweighted"
+                )
+                self.events.emit(
+                    kind, model_id=st.model_id, request_id=request_id,
+                    fault_point="serve.observation_gate",
+                    slot=st.names[int(col)], step=int(row),
+                    score=float(zs[row, col] ** 2),
+                    policy=self.gate.policy,
+                )
+
     def _emit_chain_break(self, request, failed: Optional[str] = None):
         """One attributed chain-break event (dispatch-side paths)."""
         if self.events is None:
@@ -1286,11 +1403,35 @@ class MetranService:
             y_std, mask = requests[live[i]].payload
             y[i, :, : st.n_series] = y_std
             m[i, :, : st.n_series] = mask
-        fn = self.registry.update_fn(bucket, k)
+        gate = self.gate
+        gated = gate.enabled
+        fn = self.registry.update_fn(
+            bucket, k, gate=gate if gated else None
+        )
         tracer = self.tracer
         t_eng0 = tracer.clock() if tracer is not None else None
-        chol_t = None
-        if sqrt_engine:
+        chol_t = z_t = verdict_t = None
+        if gated:
+            # the gate disarms per model below min_seen assimilated
+            # steps (a cold filter's innovations are over-dispersed
+            # until it forgets its N(0, I) init — a live gate would
+            # reject real data); traced, so crossing the threshold
+            # never recompiles
+            armed = np.array(
+                [st.t_seen >= gate.min_seen for st in states], bool
+            )
+            if sqrt_engine:
+                mean_t, chol_t, sigma_t, detf_t, z_t, verdict_t = fn(
+                    batch.ss, batch.mean, batch.chol, y, m, armed
+                )
+                chol_t = np.asarray(chol_t)
+            else:
+                mean_t, cov_t, sigma_t, detf_t, z_t, verdict_t = fn(
+                    batch.ss, batch.mean, batch.cov, y, m, armed
+                )
+                cov_t = np.asarray(cov_t)
+            z_t, verdict_t = np.asarray(z_t), np.asarray(verdict_t)
+        elif sqrt_engine:
             mean_t, chol_t, sigma_t, detf_t = fn(
                 batch.ss, batch.mean, batch.chol, y, m
             )
@@ -1331,6 +1472,16 @@ class MetranService:
                 requests[j].trace if tracer is not None else None
             )
             try:
+                if gated:
+                    # book this slot's gate outcome BEFORE the
+                    # integrity gate: the observations were evaluated
+                    # either way, and a dying sensor must show up in
+                    # the rejection-rate window even while its
+                    # (tempered) updates keep committing
+                    self._book_gate_verdicts(
+                        st, z_t[i, :, : st.n_series],
+                        verdict_t[i, :, : st.n_series], trace_ctx,
+                    )
                 t_gate0 = (
                     tracer.clock() if trace_ctx is not None else None
                 )
@@ -1442,6 +1593,24 @@ class MetranService:
                         "serve.commit", trace_ctx, t_commit0,
                         tracer.clock(), version=new_state.version,
                     )
+                if not m[i].any():
+                    # an all-NaN batch still commits version+1 /
+                    # t_seen+k having assimilated NOTHING (the masked
+                    # filter no-ops every step) — by design, but never
+                    # again silently: counted and attributed so a feed
+                    # gone all-NaN is visible before anyone trusts the
+                    # bumped version
+                    self.metrics.data_quality.increment("empty_updates")
+                    if self.events is not None:
+                        self.events.emit(
+                            "empty_update", model_id=st.model_id,
+                            request_id=(
+                                trace_ctx.trace_id
+                                if trace_ctx is not None else None
+                            ),
+                            fault_point="serve.commit",
+                            version=new_state.version, k=k,
+                        )
             except Exception as exc:
                 self.metrics.errors.increment("finalize_failures")
                 logger.exception(

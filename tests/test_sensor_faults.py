@@ -1,0 +1,321 @@
+"""Sensor-fault injection and the serving observation gate, end to end.
+
+Three layers:
+
+1. **injector mechanics** — seeded probabilistic firing is
+   deterministic; `SensorFault` corruption modes transform payloads as
+   specified; the `corrupt` hook respects `match`/`times` and never
+   mutates its input;
+2. **service wiring** — an armed corruption reaches `_update_submit`,
+   the gated kernel rejects it, and every verdict is attributed
+   (events with model/slot/score, counters, the gate-score histogram,
+   the per-model rejection window flipping a model to degraded);
+   `min_seen` disarms cold models; NaN masking and all-NaN commits are
+   traced (the `masked_values` counter and the `empty_update` event);
+3. **the accuracy claim** — under each sensor-fault mode, gated
+   serving keeps posterior RMSE within 2x of the clean-data run while
+   ungated serving measurably degrades
+   (`reliability.scenarios.run_sensor_fault_scenario`, the same
+   harness `bench.py --phase robust-obs` reports from).
+"""
+
+import numpy as np
+import pytest
+
+from metran_tpu.obs import EVENT_KINDS, EventLog, MetricsRegistry, Observability
+from metran_tpu.reliability import FaultInjector, SensorFault, faultinject
+from metran_tpu.reliability.scenarios import run_sensor_fault_scenario
+from metran_tpu.serve import GateSpec, MetranService, ModelRegistry
+
+from tests.test_serve import _make_state
+
+pytestmark = pytest.mark.faults
+
+
+# ----------------------------------------------------------------------
+# 1. injector mechanics
+# ----------------------------------------------------------------------
+def test_probabilistic_firing_is_seeded_and_deterministic():
+    def pattern(seed):
+        inj = FaultInjector()
+        fault = inj.add("p", probability=0.3, seed=seed,
+                        error=RuntimeError)
+        fired = []
+        for _ in range(200):
+            try:
+                inj.fire("p")
+                fired.append(False)
+            except RuntimeError:
+                fired.append(True)
+        return fired, fault.fired
+
+    a, n_a = pattern(11)
+    b, n_b = pattern(11)
+    c, n_c = pattern(12)
+    assert a == b and n_a == n_b  # same seed, same pattern
+    assert a != c  # different seed, different pattern
+    assert 30 <= n_a <= 90  # ~Binomial(200, 0.3)
+
+
+def test_probability_validation_and_times_interaction():
+    inj = FaultInjector()
+    with pytest.raises(ValueError):
+        inj.add("p", probability=1.5)
+    fault = inj.add("p", probability=1.0, seed=0, times=2,
+                    error=RuntimeError)
+    hits = 0
+    for _ in range(5):
+        try:
+            inj.fire("p")
+        except RuntimeError:
+            hits += 1
+    assert hits == 2 and fault.fired == 2
+
+
+def test_sensor_fault_modes_transform_payloads():
+    base = np.arange(12, dtype=float).reshape(3, 4)
+
+    spiked = SensorFault("spike", series=1, magnitude=5.0)(base)
+    assert spiked[0, 1] == base[0, 1] + 5.0
+    assert np.array_equal(np.delete(spiked, 1, axis=1),
+                          np.delete(base, 1, axis=1))
+
+    stuck = SensorFault("stuck", series=2)
+    out1 = stuck(base)
+    assert np.all(out1[:, 2] == base[0, 2])  # latched first reading
+    out2 = stuck(base + 100.0)
+    assert np.all(out2[:, 2] == base[0, 2])  # stays latched across calls
+    assert np.all(SensorFault("stuck", series=2, value=7.5)(base)[:, 2]
+                  == 7.5)
+
+    drift = SensorFault("drift", series=0, magnitude=0.5)
+    d1 = drift(np.zeros((2, 4)))
+    d2 = drift(np.zeros((2, 4)))  # the ramp continues across calls
+    np.testing.assert_allclose(d1[:, 0], [0.5, 1.0])
+    np.testing.assert_allclose(d2[:, 0], [1.5, 2.0])
+
+    unit = SensorFault("unit", series=None, factor=10.0)(base)
+    np.testing.assert_allclose(unit, base * 10.0)
+
+    with pytest.raises(ValueError):
+        SensorFault("nope")
+
+
+def test_corrupt_hook_match_and_no_mutation():
+    base = np.ones((2, 3))
+    with faultinject.active() as inj:
+        inj.add("serve.update.new_obs", match="m1",
+                corrupt=SensorFault("unit", factor=2.0))
+        same = faultinject.corrupt("serve.update.new_obs", base,
+                                   detail="other-model")
+        assert same is base  # no matching rule: identity, no copy
+        out = faultinject.corrupt("serve.update.new_obs", base,
+                                  detail="m1")
+        np.testing.assert_allclose(out, 2.0)
+        np.testing.assert_allclose(base, 1.0)  # input never mutated
+    # inactive: pass-through
+    assert faultinject.corrupt("serve.update.new_obs", base) is base
+
+
+# ----------------------------------------------------------------------
+# 2. service wiring
+# ----------------------------------------------------------------------
+def _gated_service(state, policy="reject", nsigma=4.0, min_seen=32,
+                   engine="joint"):
+    reg = ModelRegistry(root=None, engine=engine)
+    reg.put(state, persist=False)
+    obs = Observability(
+        metrics=MetricsRegistry(), tracer=None, events=EventLog()
+    )
+    svc = MetranService(
+        reg, flush_deadline=None, persist_updates=False,
+        observability=obs,
+        gate=GateSpec(policy=policy, nsigma=nsigma, min_seen=min_seen),
+    )
+    return svc
+
+
+def test_gate_rejects_corrupted_update_and_attributes_everything(rng):
+    state, ss, y, mask = _make_state(rng, t=250)
+    svc = _gated_service(state)
+    clean_row = np.asarray(
+        (np.zeros(state.n_series) * state.scaler_std) + state.scaler_mean
+    )[None, :]
+    with faultinject.active() as inj:
+        inj.add("serve.update.new_obs", match="m0",
+                corrupt=SensorFault("spike", series=2,
+                                    magnitude=40.0 *
+                                    float(state.scaler_std[2])))
+        new_state = svc.update("m0", clean_row)
+    # the update COMMITTED (version bumped) with the spike tempered out
+    assert new_state.version == state.version + 1
+    assert svc.metrics.gate_verdicts.get("rejected") == 1
+    events = [e for e in svc.events.snapshot()
+              if e["kind"] == "observation_rejected"]
+    assert len(events) == 1
+    ev = events[0]
+    assert ev["model_id"] == "m0"
+    assert ev["detail"]["slot"] == state.names[2]
+    assert ev["detail"]["score"] > 16.0  # past the nsigma=4 gate
+    assert ev["kind"] in EVENT_KINDS
+    # the score histogram saw every observed slot of the batch
+    hist = svc.obs.metrics.get("metran_serve_gate_score")
+    assert hist.count == state.n_series
+    svc.close()
+
+
+def test_rejected_spike_leaves_posterior_on_the_clean_path(rng):
+    """The tempered posterior equals the one from an update where the
+    spiked cell simply never arrived."""
+    state, ss, y, mask = _make_state(rng, t=250)
+    row = state.scaler_mean.copy()[None, :]
+
+    svc = _gated_service(state)
+    with faultinject.active() as inj:
+        inj.add("serve.update.new_obs",
+                corrupt=SensorFault("spike", series=2,
+                                    magnitude=40.0 *
+                                    float(state.scaler_std[2])))
+        got = svc.update("m0", row)
+    svc.close()
+
+    ref_svc = _gated_service(state)
+    masked = row.copy()
+    masked[0, 2] = np.nan  # the spiked cell, as missing
+    want = ref_svc.update("m0", masked)
+    ref_svc.close()
+    np.testing.assert_allclose(got.mean, want.mean, rtol=1e-9,
+                               atol=1e-11)
+    np.testing.assert_allclose(got.cov, want.cov, rtol=1e-9, atol=1e-11)
+
+
+def test_repeated_rejections_flip_model_to_degraded(rng):
+    state, *_ = _make_state(rng, t=250)
+    svc = _gated_service(state)
+    row = state.scaler_mean.copy()[None, :]
+    with faultinject.active() as inj:
+        inj.add("serve.update.new_obs",
+                corrupt=SensorFault("stuck", series=0,
+                                    value=float(state.scaler_mean[0]
+                                                + 30.0 *
+                                                state.scaler_std[0])))
+        for _ in range(8):
+            svc.update("m0", row)
+    assert svc.monitor.rejection_rate("m0") > 0.1
+    assert svc.monitor.degraded_models() == ["m0"]
+    health = svc.health()
+    assert health["gate"]["degraded_models"] == ["m0"]
+    # the dying sensor never produced a request error: breaker closed
+    assert svc.breakers.get("m0").state == "closed"
+    svc.close()
+
+
+def test_min_seen_disarms_cold_models(rng):
+    state, *_ = _make_state(rng, t=250)
+    cold = state._replace(t_seen=5)
+    svc = _gated_service(cold, min_seen=100)
+    row = cold.scaler_mean.copy()[None, :]
+    row[0, 2] += 40.0 * float(cold.scaler_std[2])  # a blatant spike
+    new_state = svc.update("m0", row)
+    assert new_state.version == cold.version + 1
+    assert svc.metrics.gate_verdicts.snapshot() == {}  # disarmed
+    svc.close()
+
+
+def test_soft_policies_report_downweighted(rng):
+    state, *_ = _make_state(rng, t=250)
+    for policy in ("huber", "inflate"):
+        svc = _gated_service(state, policy=policy)
+        row = state.scaler_mean.copy()[None, :]
+        row[0, 2] += 40.0 * float(state.scaler_std[2])
+        svc.update("m0", row)
+        assert svc.metrics.gate_verdicts.get("downweighted") == 1, policy
+        kinds = [e["kind"] for e in svc.events.snapshot()]
+        assert "observation_downweighted" in kinds, policy
+        svc.close()
+
+
+def test_masked_values_counter_and_empty_update_event(rng):
+    state, *_ = _make_state(rng, t=250)
+    svc = _gated_service(state)
+    row = state.scaler_mean.copy()[None, :]
+    row[0, 1] = np.nan
+    row[0, 3] = np.nan
+    svc.update("m0", row)
+    assert svc.metrics.data_quality.get("masked_values") == 2
+    assert svc.metrics.data_quality.get("empty_updates") == 0
+
+    all_nan = np.full((2, state.n_series), np.nan)
+    new_state = svc.update("m0", all_nan)
+    # the all-NaN batch still committed version+1/t_seen+k — by
+    # design, but now counted and attributed
+    assert new_state.version == state.version + 2
+    assert new_state.t_seen == state.t_seen + 3
+    assert svc.metrics.data_quality.get("empty_updates") == 1
+    ev = [e for e in svc.events.snapshot() if e["kind"] == "empty_update"]
+    assert len(ev) == 1 and ev[0]["model_id"] == "m0"
+    assert (
+        svc.metrics.data_quality.get("masked_values")
+        == 2 + all_nan.size
+    )
+    svc.close()
+
+
+def test_sqrt_bucket_gate_rejects_too(rng):
+    state, *_ = _make_state(rng, t=250, engine="joint")
+    svc = _gated_service(state, engine="sqrt")
+    row = state.scaler_mean.copy()[None, :]
+    row[0, 2] += 40.0 * float(state.scaler_std[2])
+    new_state = svc.update("m0", row)
+    assert new_state.version == state.version + 1
+    assert new_state.chol is not None  # stayed in factored form
+    assert svc.metrics.gate_verdicts.get("rejected") == 1
+    svc.close()
+
+
+def test_gate_off_is_the_default_and_everything_passes(rng):
+    state, *_ = _make_state(rng, t=250)
+    reg = ModelRegistry(root=None)
+    reg.put(state, persist=False)
+    svc = MetranService(reg, flush_deadline=None, persist_updates=False)
+    assert not svc.gate.enabled  # shipped default: off
+    row = state.scaler_mean.copy()[None, :]
+    row[0, 2] += 40.0 * float(state.scaler_std[2])
+    svc.update("m0", row)  # assimilated at face value
+    assert svc.metrics.gate_verdicts.snapshot() == {}
+    svc.close()
+
+
+def test_gate_spec_validation():
+    with pytest.raises(ValueError):
+        GateSpec(policy="nope").validate()
+    with pytest.raises(ValueError):
+        GateSpec(policy="reject", nsigma=0.0).validate()
+    assert GateSpec().validate().policy == "off"
+
+
+# ----------------------------------------------------------------------
+# 3. the accuracy claim (the acceptance criterion)
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("mode", ["spike", "stuck", "drift", "unit"])
+def test_scenario_gated_rmse_within_2x_while_ungated_degrades(mode):
+    out = run_sensor_fault_scenario(
+        mode, policy="reject", nsigma=4.0, n_steps=40, seed=0
+    )
+    # gated serving stays within 2x of the clean-data run...
+    assert out["gated_vs_clean"] <= 2.0, out
+    # ...while ungated serving measurably degrades
+    assert out["ungated_vs_gated"] >= 1.5, out
+    # and every rejection was attributed in the event log
+    rejected = out["verdicts"].get("rejected", 0)
+    assert rejected > 0
+    assert out["events"].get("observation_rejected") == rejected
+
+
+def test_scenario_soft_policies_still_beat_ungated():
+    for policy in ("huber", "inflate"):
+        out = run_sensor_fault_scenario(
+            "spike", policy=policy, nsigma=4.0, n_steps=40, seed=0
+        )
+        assert out["rmse_gated"] < out["rmse_ungated"], out
+        assert out["verdicts"].get("downweighted", 0) > 0

@@ -35,6 +35,17 @@ Failures:
    used with an update method (``inc``/``dec``/``set``/``observe``/
    ``labels``) nor re-aliased in its file.
 
+**Event-kind drift gate.**  The same pass also keeps the structured
+event log's schema honest: every literal ``kind`` passed to an
+``.emit(...)`` call inside the package must be declared in
+``metran_tpu/obs/events.py::EVENT_KINDS`` (the canonical catalogue),
+every declared kind must be documented in the event-schema table of
+docs/concepts.md (the table whose header row contains "event kind"),
+and a *dynamic* emit kind (an f-string such as ``f"breaker_{new}"``)
+must match at least one declared kind when its runtime fragments are
+wildcarded.  An event nobody documented is an event no post-mortem
+can interpret.
+
 Usage::
 
     python tools/check_metrics.py            # exit 1 on any violation
@@ -52,6 +63,8 @@ from typing import Dict, List, Optional
 
 REPO = Path(__file__).resolve().parent.parent
 PACKAGE = REPO / "metran_tpu"
+EVENTS_MODULE = PACKAGE / "obs" / "events.py"
+CONCEPTS_DOC = REPO / "docs" / "concepts.md"
 
 NAME_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
 REGISTRY_METHODS = {"counter", "gauge", "histogram"}
@@ -71,8 +84,19 @@ class Registration:
 
 
 @dataclass
+class EmitSite:
+    """One ``.emit(<kind>, ...)`` call site found in the package."""
+
+    kind: str  # literal text, with "x" placeholders when dynamic
+    file: str
+    lineno: int
+    dynamic: bool = False
+
+
+@dataclass
 class Report:
     registrations: List[Registration] = field(default_factory=list)
+    emits: List[EmitSite] = field(default_factory=list)
     violations: List[str] = field(default_factory=list)
 
 
@@ -144,6 +168,31 @@ class _FileScanner(ast.NodeVisitor):
                         target=self._bound.get(id(node)),
                         discarded=id(node) in self._stmt_exprs,
                     ))
+            if func.attr == "emit" and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and isinstance(
+                    arg.value, str
+                ):
+                    self.report.emits.append(EmitSite(
+                        kind=arg.value, file=self.rel,
+                        lineno=node.lineno,
+                    ))
+                elif isinstance(arg, ast.JoinedStr):
+                    # dynamic kind (f"breaker_{new}"): keep it as a
+                    # regex whose runtime fragments are wildcards, to
+                    # be matched against the declared catalogue
+                    parts = []
+                    for v in arg.values:
+                        if isinstance(v, ast.Constant) and isinstance(
+                            v.value, str
+                        ):
+                            parts.append(re.escape(v.value))
+                        else:
+                            parts.append("[a-z0-9_]+")
+                    self.report.emits.append(EmitSite(
+                        kind="".join(parts), file=self.rel,
+                        lineno=node.lineno, dynamic=True,
+                    ))
             if func.attr == "bind" and len(node.args) >= 2:
                 got = _literal_or_placeholder(node.args[1])
                 if got is not None and got[0].startswith("metran_"):
@@ -178,6 +227,84 @@ class _FileScanner(ast.NodeVisitor):
             rf"=\s*(self\s*\.\s*)?{re.escape(ident)}\b"
         )
         return bool(alias.search(self.source))
+
+
+def declared_event_kinds() -> List[str]:
+    """The ``EVENT_KINDS`` tuple literal from ``obs/events.py`` (pure
+    AST — no import)."""
+    tree = ast.parse(
+        EVENTS_MODULE.read_text(), filename=str(EVENTS_MODULE)
+    )
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for target in node.targets:
+            if isinstance(target, ast.Name) and target.id == "EVENT_KINDS":
+                value = ast.literal_eval(node.value)
+                return [str(v) for v in value]
+    raise SystemExit(
+        f"FAIL {EVENTS_MODULE}: no EVENT_KINDS tuple found — the event "
+        "catalogue must be declared there"
+    )
+
+
+def documented_event_kinds() -> List[str]:
+    """Event kinds named in docs/concepts.md's event-schema table.
+
+    The table is located by its header row (a markdown ``|``-row whose
+    first cell says "event kind", case-insensitive); the backticked
+    first cell of every subsequent row is a documented kind.
+    """
+    kinds: List[str] = []
+    in_table = False
+    for line in CONCEPTS_DOC.read_text().splitlines():
+        stripped = line.strip()
+        if not stripped.startswith("|"):
+            in_table = False
+            continue
+        cells = [c.strip() for c in stripped.strip("|").split("|")]
+        if not cells:
+            continue
+        first = cells[0].strip("`").strip().lower()
+        if first == "event kind":
+            in_table = True
+            continue
+        if in_table:
+            if set(first) <= {"-", " ", ":"}:
+                continue  # the header separator row
+            m = re.match(r"`([a-z0-9_]+)`", cells[0])
+            if m:
+                kinds.append(m.group(1))
+    return kinds
+
+
+def check_event_kinds(report: Report) -> None:
+    """Append event-schema drift violations (see module docstring)."""
+    declared = declared_event_kinds()
+    documented = set(documented_event_kinds())
+    declared_set = set(declared)
+    for site in report.emits:
+        if site.dynamic:
+            pat = re.compile(f"^{site.kind}$")
+            if not any(pat.match(k) for k in declared):
+                report.violations.append(
+                    f"{site.file}:{site.lineno}: dynamic event kind "
+                    f"/{site.kind}/ matches no declared kind in "
+                    "obs/events.py::EVENT_KINDS"
+                )
+        elif site.kind not in declared_set:
+            report.violations.append(
+                f"{site.file}:{site.lineno}: event kind {site.kind!r} "
+                "is emitted but not declared in "
+                "obs/events.py::EVENT_KINDS"
+            )
+    for kind in declared:
+        if kind not in documented:
+            report.violations.append(
+                f"{EVENTS_MODULE.relative_to(REPO)}: event kind "
+                f"{kind!r} is declared but not documented in the "
+                f"event-schema table of {CONCEPTS_DOC.relative_to(REPO)}"
+            )
 
 
 def scan(verbose: bool = False) -> Report:
@@ -235,6 +362,9 @@ def scan(verbose: bool = False) -> Report:
                     f"({'/'.join(UPDATE_METHODS)}) in {reg.file}"
                 )
 
+    # 4. event-kind drift (declared vs emitted vs documented)
+    check_event_kinds(report)
+
     if verbose:
         for reg in sorted(report.registrations,
                           key=lambda r: (r.name, r.file, r.lineno)):
@@ -244,6 +374,11 @@ def scan(verbose: bool = False) -> Report:
             ])
             print(f"  [{flags}] {reg.kind:<10} {reg.name}  "
                   f"({reg.file}:{reg.lineno})")
+        for site in sorted(report.emits,
+                           key=lambda s: (s.kind, s.file, s.lineno)):
+            flags = "D" if site.dynamic else "-"
+            print(f"  [{flags}-] {'event':<10} {site.kind}  "
+                  f"({site.file}:{site.lineno})")
     return report
 
 
@@ -256,8 +391,10 @@ def main() -> int:
         print(f"{len(report.violations)} metric violation(s)")
         return 1
     print(
-        f"checked {len(report.registrations)} metric registration(s): "
-        "no duplicate, non-snake_case, or never-updated metrics"
+        f"checked {len(report.registrations)} metric registration(s) "
+        f"and {len(report.emits)} event emit site(s): no duplicate, "
+        "non-snake_case, or never-updated metrics; all event kinds "
+        "declared and documented"
     )
     return 0
 

@@ -53,6 +53,11 @@ MODULES = [
     "metran_tpu.reliability.policy",
     "metran_tpu.reliability.health",
     "metran_tpu.reliability.faultinject",
+    "metran_tpu.reliability.scenarios",
+    "metran_tpu.obs.metrics",
+    "metran_tpu.obs.tracing",
+    "metran_tpu.obs.events",
+    "metran_tpu.obs.telemetry",
     "metran_tpu.data",
     "metran_tpu.diagnostics",
     "metran_tpu.io",
