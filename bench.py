@@ -986,6 +986,367 @@ def run_serve_bench(out_path: str, budget_s: float) -> dict:
     progress("serve_update", p50_ms=out["update"]["p50_ms"],
              p99_ms=out["update"]["p99_ms"])
     write_partial(out_path, out)
+
+    # ------------------------------------------------------------------
+    # arena vs dict registry at batch 512 (ROADMAP item 1's acceptance
+    # measurement): the same update+forecast workload — one tick (k=1)
+    # plus one forecast for every model — through (a) the device-
+    # resident state arena's bulk fleet API and (b) the dict registry's
+    # per-request path (the only path it has), paired interleaved laps
+    # (AB/BA), ratio of medians.  Measured twice:
+    #
+    # - in-memory: both sides with persistence off — the pure
+    #   host-work + transfer comparison (the device kernels are shared
+    #   math, so they floor the ratio on a CPU host);
+    # - durable: both sides at their PRODUCTION durability contract —
+    #   the dict registry write-through-persists every update (its
+    #   documented default), the arena dirties rows in place and
+    #   checkpoints every `ckpt_every` ticks (spill time is charged to
+    #   the arena's laps).
+    #
+    # Per-request HOST work on the arena path = bulk-lap host time /
+    # batch, reported explicitly so the bound is a number.
+    # ------------------------------------------------------------------
+    b_arena = 32 if os.environ.get("METRAN_TPU_BENCH_SMALL") else 512
+    if time.monotonic() < deadline - 60:
+        import shutil
+        import tempfile
+
+        from metran_tpu.serve import ModelRegistry as _Reg
+
+        tiles = -(-b_arena // n_models)  # posteriors tiled; ids unique
+        arena_states = [
+            PosteriorState(
+                model_id=f"a{j}", version=0, t_seen=t_hist,
+                mean=means[j % n_models], cov=covs[j % n_models],
+                params=np.concatenate(
+                    [alpha_sdf[j % n_models], alpha_cdf[j % n_models]]
+                ),
+                loadings=loadings[j % n_models], dt=1.0,
+                scaler_mean=np.zeros(n), scaler_std=np.ones(n),
+                names=tuple(f"s{i}" for i in range(n)),
+            )
+            for j in range(min(b_arena, tiles * n_models))
+        ]
+        ids = [st.model_id for st in arena_states]
+        upd = rng.normal(size=(1, n))
+        obs_batch = [upd] * len(ids)
+
+        def _build(arena: bool, root=None, persist=False):
+            reg2 = _Reg(
+                root=root, arena=arena, arena_rows=b_arena,
+                arena_mesh=0,
+            )
+            for st in arena_states:
+                reg2.put(st, persist=persist)
+            return MetranService(
+                reg2, flush_deadline=None, max_batch=4 * b_arena,
+                persist_updates=persist,
+            )
+
+        def _lap_arena(svc2):
+            t0 = time.perf_counter()
+            svc2.update_batch(ids, obs_batch)
+            svc2.forecast_batch(ids, steps)
+            return time.perf_counter() - t0
+
+        def _lap_dict(svc2):
+            t0 = time.perf_counter()
+            futs2 = [svc2.update_async(m, upd) for m in ids]
+            svc2.flush()
+            [f.result() for f in futs2]
+            futs2 = [svc2.forecast_async(m, steps) for m in ids]
+            svc2.flush()
+            [f.result() for f in futs2]
+            return time.perf_counter() - t0
+
+        svc_arena, svc_dict = _build(True), _build(False)
+        _lap_arena(svc_arena)  # compile + warm (excluded)
+        _lap_dict(svc_dict)
+        pairs = []
+        while len(pairs) < 4 and time.monotonic() < deadline - 40:
+            if len(pairs) % 2 == 0:
+                ta = _lap_arena(svc_arena)
+                td = _lap_dict(svc_dict)
+            else:
+                td = _lap_dict(svc_dict)
+                ta = _lap_arena(svc_arena)
+            pairs.append((ta, td))
+        svc_arena.close()
+        svc_dict.close()
+        if pairs:
+            ta_s = [a for a, _ in pairs]
+            td_s = [d for _, d in pairs]
+            out["arena_vs_dict"] = {
+                "batch": len(ids),
+                "requests_per_lap": 2 * len(ids),
+                "pairs": len(pairs),
+                "arena_laps_s": [round(x, 4) for x in ta_s],
+                "dict_laps_s": [round(x, 4) for x in td_s],
+                "arena_qps": round(
+                    2 * len(ids) / float(np.median(ta_s)), 1
+                ),
+                "dict_qps": round(
+                    2 * len(ids) / float(np.median(td_s)), 1
+                ),
+                "arena_speedup": round(float(np.median(
+                    [d / a for a, d in pairs]
+                )), 2),
+                # the whole arena lap is host work + shared device
+                # kernels; per-request host budget = lap / requests
+                "arena_us_per_request": round(
+                    1e6 * float(np.median(ta_s)) / (2 * len(ids)), 1
+                ),
+                "dict_us_per_request": round(
+                    1e6 * float(np.median(td_s)) / (2 * len(ids)), 1
+                ),
+            }
+            progress(
+                "serve_arena_vs_dict",
+                batch=len(ids),
+                arena_qps=out["arena_vs_dict"]["arena_qps"],
+                dict_qps=out["arena_vs_dict"]["dict_qps"],
+                speedup=out["arena_vs_dict"]["arena_speedup"],
+            )
+            write_partial(out_path, out)
+
+        # durable variant: each path at its production durability
+        ckpt_every = 16
+        if time.monotonic() < deadline - 30:
+            droot = tempfile.mkdtemp(prefix="bench_arena_")
+            try:
+                svc_arena = _build(
+                    True, root=os.path.join(droot, "arena"),
+                    persist=True,
+                )
+                svc_dict = _build(
+                    False, root=os.path.join(droot, "dict"),
+                    persist=True,
+                )
+                _lap_arena(svc_arena)
+                svc_arena.registry.spill()
+                _lap_dict(svc_dict)  # warm (excluded)
+                t0 = time.perf_counter()
+                laps_done = 0
+                while (
+                    laps_done < ckpt_every
+                    and time.monotonic() < deadline - 15
+                ):
+                    _lap_arena(svc_arena)
+                    laps_done += 1
+                svc_arena.registry.spill()  # the checkpoint the laps
+                #                             amortize (charged here)
+                ta_dur = (time.perf_counter() - t0) / max(laps_done, 1)
+                td_dur = _lap_dict(svc_dict)
+                out["arena_vs_dict_durable"] = {
+                    "batch": len(ids),
+                    "dict_mode": "write-through npz per update "
+                                 "(registry default)",
+                    "arena_mode": (
+                        f"in-place dirty rows, checkpoint spill every "
+                        f"{ckpt_every} ticks (spill charged to laps)"
+                    ),
+                    "arena_laps": laps_done,
+                    "arena_lap_s": round(ta_dur, 4),
+                    "dict_lap_s": round(td_dur, 4),
+                    "arena_qps": round(2 * len(ids) / ta_dur, 1),
+                    "dict_qps": round(2 * len(ids) / td_dur, 1),
+                    "arena_speedup": round(td_dur / ta_dur, 2),
+                }
+                progress(
+                    "serve_arena_vs_dict_durable",
+                    speedup=out["arena_vs_dict_durable"]["arena_speedup"],
+                    arena_qps=out["arena_vs_dict_durable"]["arena_qps"],
+                    dict_qps=out["arena_vs_dict_durable"]["dict_qps"],
+                )
+                svc_arena.close()
+                svc_dict.close()
+            finally:
+                shutil.rmtree(droot, ignore_errors=True)
+        write_partial(out_path, out)
+    return out
+
+
+def run_serve_load_bench(out_path: str, budget_s: float) -> dict:
+    """Open-loop load generator against the arena serving path.
+
+    Mixed read/write traffic at a FIXED arrival rate (open loop: the
+    generator never slows down for the server, so falling behind shows
+    up as queueing latency — the honest way to measure a latency SLO,
+    unlike closed-loop benchmarks whose arrival rate collapses to the
+    service rate).  Each request's latency is measured from its
+    *scheduled* arrival instant to future resolution and reported as
+    p50/p99/p999 against a stated SLO.
+    """
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", JAX_CACHE + "-cpu")
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from metran_tpu.ops import dfm_statespace, kalman_filter
+    from metran_tpu.serve import (
+        MetranService, ModelRegistry, PosteriorState,
+    )
+
+    n_models, n, k_fct, t_hist = 64, 8, 1, 200
+    rate_rps = float(os.environ.get("METRAN_TPU_BENCH_LOAD_RPS", "400"))
+    duration_s = 15.0
+    write_frac = 0.1
+    slo_p99_ms = 50.0
+    steps = 14
+    if os.environ.get("METRAN_TPU_BENCH_SMALL"):
+        n_models, t_hist, rate_rps, duration_s = 16, 60, 100.0, 4.0
+    deadline = time.monotonic() + budget_s
+    out = {
+        "platform": jax.default_backend(),
+        "mode": "arena",
+        "n_models": n_models,
+        "rate_rps": rate_rps,
+        "duration_s": duration_s,
+        "write_frac": write_frac,
+        "slo_p99_ms": slo_p99_ms,
+    }
+
+    rng = np.random.default_rng(23)
+    alpha_sdf = rng.uniform(5.0, 40.0, (n_models, n))
+    alpha_cdf = rng.uniform(10.0, 60.0, (n_models, k_fct))
+    loadings = rng.uniform(0.3, 0.8, (n_models, n, k_fct)) / np.sqrt(k_fct)
+    y = rng.normal(size=(n_models, t_hist, n))
+    mask = rng.uniform(size=y.shape) > MISSING
+    y = np.where(mask, y, 0.0)
+
+    def one(a_s, a_c, ld, yy, mm):
+        ss = dfm_statespace(a_s, a_c, ld, 1.0)
+        res = kalman_filter(ss, yy, mm, engine="joint", store=False)
+        return res.mean_f, res.cov_f
+
+    means, covs = jax.jit(jax.vmap(one))(
+        jnp.asarray(alpha_sdf), jnp.asarray(alpha_cdf),
+        jnp.asarray(loadings), jnp.asarray(y), jnp.asarray(mask),
+    )
+    means, covs = np.asarray(means), np.asarray(covs)
+    reg = ModelRegistry(root=None, arena=True, arena_rows=n_models)
+    for i in range(n_models):
+        reg.put(PosteriorState(
+            model_id=f"m{i}", version=0, t_seen=t_hist,
+            mean=means[i], cov=covs[i],
+            params=np.concatenate([alpha_sdf[i], alpha_cdf[i]]),
+            loadings=loadings[i], dt=1.0,
+            scaler_mean=np.zeros(n), scaler_std=np.ones(n),
+            names=tuple(f"s{j}" for j in range(n)),
+        ), persist=False)
+    new_obs = rng.normal(size=(1, n))
+    # warm every power-of-two dispatch width the generator can hit
+    # (arena dispatches pad to powers of two, so these are ALL the
+    # widths; a cold compile mid-run would stall the open loop and
+    # snowball the backlog).  Manual-flush warm service pins each
+    # width; compiled kernels live in the shared registry.
+    warm_svc = MetranService(
+        reg, flush_deadline=None, persist_updates=False
+    )
+    w = 1
+    while w <= n_models:
+        futs = [
+            warm_svc.update_async(f"m{i}", new_obs) for i in range(w)
+        ]
+        warm_svc.flush()
+        [f.result() for f in futs]
+        futs = [
+            warm_svc.forecast_async(f"m{i}", steps) for i in range(w)
+        ]
+        warm_svc.flush()
+        [f.result() for f in futs]
+        w *= 2
+    warm_svc.close()
+    svc = MetranService(reg, flush_deadline=0.002, persist_updates=False)
+    progress("serve_load_warm")
+
+    duration_s = min(duration_s, max(deadline - time.monotonic() - 20, 2))
+    n_requests = int(rate_rps * duration_s)
+    lat_lock = threading.Lock()
+    read_lat: list = []
+    write_lat: list = []
+    failures = [0]
+
+    def _record(scheduled, sink):
+        def _done(f):
+            now = time.monotonic()
+            try:
+                f.result()
+            except Exception:
+                failures[0] += 1
+                return
+            with lat_lock:
+                sink.append(now - scheduled)
+
+        return _done
+
+    is_write = rng.uniform(size=n_requests) < write_frac
+    targets = rng.integers(0, n_models, size=n_requests)
+    t_start = time.monotonic() + 0.05
+    behind_max = 0.0
+    for i in range(n_requests):
+        scheduled = t_start + i / rate_rps
+        delay = scheduled - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        else:
+            behind_max = max(behind_max, -delay)
+        try:
+            if is_write[i]:
+                fut = svc.update_async(f"m{targets[i]}", new_obs)
+                fut.add_done_callback(_record(scheduled, write_lat))
+            else:
+                fut = svc.forecast_async(f"m{targets[i]}", steps)
+                fut.add_done_callback(_record(scheduled, read_lat))
+        except Exception:
+            failures[0] += 1
+    # drain: everything submitted resolves through the background
+    # flusher; bounded wait so a wedged worker cannot hang the bench
+    t_end = time.monotonic() + 30.0
+    while time.monotonic() < t_end:
+        with lat_lock:
+            done = len(read_lat) + len(write_lat)
+        if done + failures[0] >= n_requests:
+            break
+        time.sleep(0.05)
+    wall = time.monotonic() - t_start
+
+    def _pcts(xs):
+        if not xs:
+            return {}
+        arr = np.sort(np.asarray(xs))
+
+        def pct(q):
+            return round(
+                1e3 * float(arr[min(int(q * len(arr)), len(arr) - 1)]), 3
+            )
+
+        return {
+            "n": len(arr), "p50_ms": pct(0.50), "p99_ms": pct(0.99),
+            "p999_ms": pct(0.999), "max_ms": round(1e3 * arr[-1], 3),
+        }
+
+    out["requests"] = n_requests
+    out["achieved_rps"] = round((n_requests - failures[0]) / wall, 1)
+    out["failures"] = failures[0]
+    out["generator_max_behind_s"] = round(behind_max, 4)
+    out["read"] = _pcts(read_lat)
+    out["write"] = _pcts(write_lat)
+    p99_all = _pcts(read_lat + write_lat)
+    out["overall"] = p99_all
+    out["slo_met"] = bool(
+        p99_all and p99_all["p99_ms"] <= slo_p99_ms and not failures[0]
+    )
+    out["errors"] = svc.metrics.errors.snapshot()
+    out["arena_stats"] = dict(reg.arena_stats)
+    svc.close()
+    progress(
+        "serve_load", rps=out["achieved_rps"],
+        p99_ms=p99_all.get("p99_ms"), slo_met=out["slo_met"],
+    )
+    write_partial(out_path, out)
     return out
 
 
@@ -1842,6 +2203,19 @@ def main() -> None:
     mesh_budget = max(min(420.0, budget - elapsed() - 120.0), 60.0)
     mesh_proc = _spawn("mesh", mesh_path, mesh_budget, cpu_env)
 
+    # the serving scenario (arena-vs-dict, qps, latency) spawns HERE,
+    # alongside the device/mesh children rather than after them: a
+    # device-stage budget blowout could previously starve it out of
+    # the round JSON entirely (the serve numbers were asserted in-PR
+    # but never captured).  CPU contention from the mesh child is
+    # acceptable — the arena-vs-dict headline is a PAIRED interleaved
+    # ratio, so contention hits both sides of each pair.
+    serve_path = os.path.join(CACHE_DIR, "bench_serve.json")
+    if os.path.exists(serve_path):
+        os.remove(serve_path)
+    serve_budget = max(min(300.0, budget * 0.35), 60.0)
+    serve_proc = _spawn("serve", serve_path, serve_budget, cpu_env)
+
     init_timeout = float(
         os.environ.get("METRAN_TPU_BENCH_INIT_TIMEOUT_S", "300")
     )
@@ -1897,17 +2271,23 @@ def main() -> None:
     _wait(mesh_proc, max(budget - elapsed() - 15.0, 5.0), "mesh")
     mesh = _read_json(mesh_path) or {}
 
-    # serving-path scenario (batched forecast qps, update latency):
-    # CPU-pinned so a wedged device tunnel cannot hang it
-    serve = {}
-    if budget - elapsed() > 120:
-        serve_path = os.path.join(CACHE_DIR, "bench_serve.json")
-        if os.path.exists(serve_path):
-            os.remove(serve_path)
-        serve_budget = max(min(240.0, budget - elapsed() - 60.0), 60.0)
-        serve_proc = _spawn("serve", serve_path, serve_budget, cpu_env)
-        _wait(serve_proc, serve_budget + 15.0, "serve")
-        serve = _read_json(serve_path) or {}
+    # serving-path scenario (spawned early, above): collect it now —
+    # it normally finished while the device child ran
+    _wait(serve_proc, max(serve_budget + 15.0 - elapsed(), 10.0), "serve")
+    serve = _read_json(serve_path) or {}
+
+    # open-loop load generator (ROADMAP item 2's measurement story):
+    # p50/p99 of mixed read/write traffic at a fixed arrival rate
+    # against a stated SLO, on the arena serving path
+    serve_load = {}
+    if budget - elapsed() > 90:
+        sl_path = os.path.join(CACHE_DIR, "bench_serve_load.json")
+        if os.path.exists(sl_path):
+            os.remove(sl_path)
+        sl_budget = max(min(120.0, budget - elapsed() - 60.0), 45.0)
+        sl_proc = _spawn("serve-load", sl_path, sl_budget, cpu_env)
+        _wait(sl_proc, sl_budget + 15.0, "serve_load")
+        serve_load = _read_json(sl_path) or {}
 
     # fault-injection robustness scenario (CPU-pinned like serve):
     # error/degradation counters land in BENCH_*.json next to the perf
@@ -1937,6 +2317,7 @@ def main() -> None:
 
     detail = {"device": device, "cpu_baseline": cpu,
               "mesh_cpu_virtual": mesh, "serve": serve,
+              "serve_load": serve_load,
               "serve_faults": serve_faults,
               "workload": {"n_series": N_SERIES, "n_factors": N_FACTORS,
                            "t_steps": T_STEPS, "missing": MISSING,
@@ -1965,8 +2346,8 @@ if __name__ == "__main__":
     parser.add_argument("--phase", default="main",
                         choices=["main", "cpu", "device", "device-cpu",
                                  "mesh", "mesh-solo", "serve",
-                                 "serve-faults", "sqrt", "obs",
-                                 "robust-obs"])
+                                 "serve-load", "serve-faults", "sqrt",
+                                 "obs", "robust-obs"])
     parser.add_argument("--out", default=None)
     parser.add_argument("--budget", type=float, default=900.0)
     args = parser.parse_args()
@@ -1989,6 +2370,24 @@ if __name__ == "__main__":
                 "metric": "serve batched forecast queries/s",
                 "value": qps, "unit": "queries/s", "vs_baseline": 0.0,
                 "detail": serve_out,
+            }), flush=True)
+    elif args.phase == "serve-load":
+        out_path = args.out or os.path.join(
+            CACHE_DIR, "bench_serve_load.json"
+        )
+        os.makedirs(CACHE_DIR, exist_ok=True)
+        sl_out = run_serve_load_bench(out_path, args.budget)
+        if args.out is None:
+            # standalone run: emit the BENCH_r* result-line schema with
+            # the SLO headline (overall p99 at the stated arrival rate)
+            print(json.dumps({
+                "metric": (
+                    f"serve p99 latency at {sl_out.get('rate_rps')} "
+                    "req/s open-loop (mixed read/write)"
+                ),
+                "value": (sl_out.get("overall") or {}).get("p99_ms", 0.0),
+                "unit": "ms", "vs_baseline": 0.0,
+                "detail": sl_out,
             }), flush=True)
     elif args.phase == "serve-faults":
         out_path = args.out or os.path.join(

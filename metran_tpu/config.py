@@ -128,6 +128,15 @@ SERVE_VALIDATE_UPDATES = 1  # per-slot posterior finiteness/PSD checks
 SERVE_ENGINE = "joint"  # assimilation kernel; "sqrt" = square-root
 #                         serving (factored posteriors, PSD by
 #                         construction — the robust f32 choice)
+# device-resident state arena (docs/concepts.md "Scale & sharding").
+# OFF by default: the arena changes the durability contract (updates
+# persist on spill/checkpoint, not per request) and the update() return
+# type (a lightweight ack instead of a materialized PosteriorState), so
+# arming it is a deployment decision.
+SERVE_ARENA = 0  # 1 = serve from device-resident sharded state arenas
+SERVE_ARENA_ROWS = 1024  # per-bucket arena capacity (rows preallocated)
+SERVE_ARENA_MESH = 0  # devices to shard each arena across (0 = single
+#                       device / no mesh; -1 = every visible device)
 # observation-gate defaults (statistical input robustness; see
 # docs/concepts.md "Input robustness").  The gate ships OFF: arming it
 # is a per-deployment calibration decision (nsigma trades false
@@ -201,6 +210,15 @@ def serve_defaults() -> dict:
         ),
         "engine": _env(
             "METRAN_TPU_SERVE_ENGINE", str, SERVE_ENGINE
+        ),
+        "arena": _env(
+            "METRAN_TPU_SERVE_ARENA", int, SERVE_ARENA
+        ),
+        "arena_rows": _env(
+            "METRAN_TPU_SERVE_ARENA_ROWS", int, SERVE_ARENA_ROWS
+        ),
+        "arena_mesh": _env(
+            "METRAN_TPU_SERVE_ARENA_MESH", int, SERVE_ARENA_MESH
         ),
         "gate_policy": _env(
             "METRAN_TPU_SERVE_GATE_POLICY", str, SERVE_GATE_POLICY

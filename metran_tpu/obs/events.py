@@ -63,6 +63,8 @@ EVENT_KINDS = (
     "observation_rejected",
     "observation_downweighted",
     "empty_update",
+    "arena_load",
+    "arena_spill",
 )
 
 

@@ -62,6 +62,25 @@ class HealthMonitor:
             self._outcomes.append(bool(ok))
             self._seen += 1
 
+    def record_many(self, n_ok: int, n_err: int) -> None:
+        """Bulk outcome booking (one lock acquisition for a whole
+        fleet-tick dispatch).  When the tick exceeds the window, the
+        kept sample PRESERVES the tick's success/failure ratio — all
+        outcomes in one tick are equally recent, so truncating
+        err-first (or ok-first) would let one oversized tick read as
+        100% failed (or 100% healthy) and flip readiness spuriously."""
+        n_ok, n_err = int(n_ok), int(n_err)
+        total = n_ok + n_err
+        with self._lock:
+            keep_ok, keep_err = n_ok, n_err
+            if total > self.window:
+                keep_err = round(self.window * n_err / total)
+                keep_ok = self.window - keep_err
+            self._outcomes.extend(
+                [False] * keep_err + [True] * keep_ok
+            )
+            self._seen += total
+
     @property
     def seen(self) -> int:
         with self._lock:

@@ -35,19 +35,26 @@ from .batching import MicroBatcher
 from .engine import (
     GateSpec,
     forecast_bucket,
+    make_arena_forecast_fn,
+    make_arena_update_fn,
     posterior_fault,
     stack_bucket,
     update_bucket,
 )
 from .registry import CompiledFnCache, ModelRegistry
-from .service import Forecast, MetranService, ServeMetrics
+from .service import ArenaUpdateAck, Forecast, MetranService, ServeMetrics
 from .state import (
+    ArenaLostError,
+    ModelMeta,
     PosteriorState,
+    StateArena,
     posterior_state_from_metran,
     posterior_states_from_fleet,
 )
 
 __all__ = [
+    "ArenaLostError",
+    "ArenaUpdateAck",
     "ChainedRequestError",
     "CircuitOpenError",
     "CompiledFnCache",
@@ -56,11 +63,15 @@ __all__ = [
     "GateSpec",
     "MetranService",
     "MicroBatcher",
+    "ModelMeta",
     "ModelRegistry",
     "PosteriorState",
     "ServeMetrics",
+    "StateArena",
     "StateIntegrityError",
     "forecast_bucket",
+    "make_arena_forecast_fn",
+    "make_arena_update_fn",
     "posterior_fault",
     "posterior_state_from_metran",
     "posterior_states_from_fleet",

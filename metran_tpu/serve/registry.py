@@ -38,7 +38,7 @@ from ..io import sweep_stale_tmps
 from ..parallel.mesh import pad_to_multiple
 from ..reliability.policy import StateIntegrityError
 from ..utils.profiling import EventCounters
-from .state import PosteriorState
+from .state import ModelMeta, PosteriorState, StateArena
 
 logger = getLogger(__name__)
 
@@ -181,6 +181,21 @@ class ModelRegistry:
         service's write-path gate uses, so states an operator chose to
         tolerate at write time are not quarantined at the next restart).
         File-integrity checks (parse, checksum) always run.
+    arena : serve from **device-resident state arenas** (default
+        ``serve_defaults()["arena"]``, env ``METRAN_TPU_SERVE_ARENA``;
+        shipped off).  Each bucket's posteriors live in one
+        preallocated :class:`~metran_tpu.serve.state.StateArena` on
+        device, updated in place via buffer donation; the host keeps a
+        ``model_id -> (bucket, row)`` indirection, LRU row eviction
+        spills to the usual per-model ``.npz``, and durability moves
+        from write-through to checkpoint spills (:meth:`spill`,
+        :meth:`evict`, ``MetranService.close``).  See docs/concepts.md
+        "Scale & sharding".
+    arena_rows : per-bucket arena capacity (rows preallocated; one
+        scratch row is added internally for width-bucketed dispatch).
+    arena_mesh : devices to shard each arena across with explicit
+        ``NamedSharding``/``PartitionSpec`` over the batch axis
+        (0 = single device, -1 = every visible device).
     """
 
     def __init__(
@@ -190,6 +205,9 @@ class ModelRegistry:
         max_compiled: Optional[int] = None,
         engine: Optional[str] = None,
         validate: Optional[bool] = None,
+        arena: Optional[bool] = None,
+        arena_rows: Optional[int] = None,
+        arena_mesh: Optional[int] = None,
     ):
         from ..config import serve_defaults
 
@@ -202,6 +220,12 @@ class ModelRegistry:
             max_compiled = defaults["max_compiled"]
         if validate is None:
             validate = bool(defaults["validate_updates"])
+        if arena is None:
+            arena = bool(defaults["arena"])
+        if arena_rows is None:
+            arena_rows = int(defaults["arena_rows"])
+        if arena_mesh is None:
+            arena_mesh = int(defaults["arena_mesh"])
         self.validate = bool(validate)
         self.root = Path(root) if root is not None else None
         self.integrity = EventCounters()
@@ -220,6 +244,34 @@ class ModelRegistry:
         self.engine = engine
         self._states: Dict[str, PosteriorState] = {}
         self._compiled = CompiledFnCache(max_compiled)
+        # --- device-resident state arena (docs/concepts.md "Scale &
+        # sharding").  When enabled, each bucket's posteriors live in
+        # ONE preallocated device-resident StateArena; the host keeps
+        # only the model_id -> (bucket, row) indirection, the immutable
+        # ModelMeta per model, and an LRU for row eviction.  `_states`
+        # keeps each model's last PACKED/SPILLED state as the
+        # last-good fallback (an arena lost to a failed donation
+        # rebuilds from it).
+        self.arena_enabled = bool(arena)
+        self.arena_rows = int(arena_rows)
+        self.arena_mesh = int(arena_mesh)
+        self._mesh = None
+        self._arenas: Dict[ShapeBucket, StateArena] = {}
+        self._arena_meta: Dict[str, ModelMeta] = {}
+        self._row_map: Dict[str, Tuple[ShapeBucket, int]] = {}
+        self._arena_lru: "OrderedDict[str, None]" = OrderedDict()
+        # guards the indirection tables + LRU (each arena's device
+        # leaves have their own lock); RLock: eviction runs inside
+        # ensure_resident
+        self._arena_lock = threading.RLock()
+        # models whose rows an in-flight dispatch has resolved
+        # (model_id -> pin refcount): eviction must never reassign a
+        # pinned row — a later cold model in the SAME batch, or a
+        # concurrent submit-path load, evicting an already-resolved
+        # row would put duplicate/stale rows into one kernel call and
+        # cross-corrupt states (rows_for(pin=True) / release_rows)
+        self._pinned: Dict[str, int] = {}
+        self.arena_events = EventCounters()
         # structured event log (metran_tpu.obs.EventLog); attached by
         # bind_observability — usually the owning service's log, so
         # quarantine/load events land next to breaker/retry events
@@ -245,6 +297,29 @@ class ModelRegistry:
                 "failures, last-good fallbacks, temp sweeps)",
             )
             self._compiled.bind_metrics(metrics)
+            if self.arena_enabled:
+                self.arena_events.bind(
+                    metrics, "metran_serve_arena_events_total",
+                    "state-arena lifecycle events by kind (loads, "
+                    "spills, evictions, rebuilds)",
+                )
+                metrics.gauge(
+                    "metran_serve_arena_rows_resident",
+                    "models resident in device-arena rows, all buckets",
+                    callback=lambda: float(self._arena_rows_count()[0]),
+                )
+                metrics.gauge(
+                    "metran_serve_arena_rows_free",
+                    "free (allocatable) device-arena rows, all buckets",
+                    callback=lambda: float(self._arena_rows_count()[1]),
+                )
+                metrics.gauge(
+                    "metran_serve_arena_evictions",
+                    "lifetime arena row evictions (spill + free)",
+                    callback=lambda: float(
+                        self.arena_events.get("evictions")
+                    ),
+                )
         if events is not None:
             self.events = events
 
@@ -283,9 +358,29 @@ class ModelRegistry:
 
     def put(self, state: PosteriorState, persist: bool = True) -> PosteriorState:
         """Insert/replace a model's state (write-through when ``persist``
-        and the registry has a root)."""
+        and the registry has a root).  When the model is arena-resident,
+        its device row is re-packed in place (same bucket) or released
+        (shape changed — it re-packs into the right arena on the next
+        touch), so a ``put`` can never leave a stale row serving."""
         self.check_model_id(state.model_id)
         self._states[state.model_id] = state
+        if self.arena_enabled:
+            with self._arena_lock:
+                hit = self._row_map.get(state.model_id)
+                if hit is not None:
+                    bucket, row = hit
+                    arena = self._arenas.get(bucket)
+                    if arena is None or arena.lost:
+                        self._drop_lost_arena(bucket)
+                    elif self.bucket_of(state) == bucket:
+                        arena.write_row(row, state)
+                        self._arena_meta[state.model_id] = (
+                            ModelMeta.of(state)
+                        )
+                    else:
+                        arena.clear_row(row)
+                        del self._row_map[state.model_id]
+                        self._arena_lru.pop(state.model_id, None)
         if persist and self.root is not None:
             state.save(self.path_for(state.model_id))
         return state
@@ -361,14 +456,34 @@ class ModelRegistry:
         return state
 
     def get(self, model_id: str, refresh: bool = False) -> PosteriorState:
-        """The model's current state (memory first, then disk).
+        """The model's current state (arena row first, then memory,
+        then disk).
 
         ``refresh=True`` forces a disk re-read (replica catch-up after
-        another writer's update).  A corrupt disk file is quarantined
-        and the last-good in-memory state served instead when one
-        exists — degradation, not an outage; with no fallback the
+        another writer's update); an **arena-resident** model ignores
+        it — its device row IS the newest state (disk only catches up
+        on spill), so a refresh must never roll it back.  A corrupt
+        disk file is quarantined and the last-good in-memory state
+        served instead when one exists — degradation, not an outage;
+        with no fallback the
         :class:`~metran_tpu.reliability.StateIntegrityError` propagates.
         """
+        if self.arena_enabled:
+            with self._arena_lock:
+                hit = self._row_map.get(model_id)
+                if hit is not None:
+                    bucket, row = hit
+                    arena = self._arenas.get(bucket)
+                    if arena is not None and not arena.lost:
+                        return arena.materialize(
+                            row, self._arena_meta[model_id]
+                        )
+                    self._drop_lost_arena(bucket)
+        return self._base_get(model_id, refresh)
+
+    def _base_get(self, model_id: str, refresh: bool = False) -> PosteriorState:
+        """The dict-registry lookup path (memory, then disk) — also the
+        arena's backing store for non-resident models."""
         state = self._states.get(model_id)
         if state is not None and not refresh:
             return state
@@ -460,6 +575,332 @@ class ModelRegistry:
         return len(self._states)
 
     # ------------------------------------------------------------------
+    # device-resident state arena (indirection, allocation, eviction)
+    # ------------------------------------------------------------------
+    @property
+    def _sqrt_engine(self) -> bool:
+        return self.engine in ("sqrt", "sqrt_parallel")
+
+    def _arena_mesh_obj(self):
+        """The (lazily built) device mesh arenas shard across, or
+        ``None`` when ``arena_mesh == 0`` (single-device arenas)."""
+        if self.arena_mesh == 0:
+            return None
+        if self._mesh is None:
+            import jax
+
+            from ..parallel.mesh import make_mesh
+
+            n_avail = len(jax.devices())
+            n = n_avail if self.arena_mesh < 0 else min(
+                self.arena_mesh, n_avail
+            )
+            self._mesh = make_mesh(n)
+        return self._mesh
+
+    def arena_for(self, bucket: ShapeBucket, dtype=None) -> StateArena:
+        """The bucket's arena, created on first use (capacity
+        ``arena_rows``, sharded per ``arena_mesh``); a lost arena (a
+        donating kernel died mid-flight) is dropped and rebuilt empty —
+        its models re-pack lazily from their last-good states."""
+        with self._arena_lock:
+            arena = self._arenas.get(bucket)
+            if arena is not None and arena.lost:
+                self._drop_lost_arena(bucket)
+                arena = None
+            if arena is None:
+                arena = self._arenas[bucket] = StateArena(
+                    bucket, self.arena_rows, dtype=dtype,
+                    sqrt=self._sqrt_engine, mesh=self._arena_mesh_obj(),
+                )
+            return arena
+
+    def _drop_lost_arena(self, bucket: ShapeBucket) -> None:
+        """Forget a lost arena and every row mapping into it; resident
+        models fall back to their last-good packed/spilled states and
+        re-pack on the next touch."""
+        with self._arena_lock:
+            arena = self._arenas.pop(bucket, None)
+            if arena is None:
+                return
+            dropped = [
+                mid for mid, (b, _) in self._row_map.items() if b == bucket
+            ]
+            for mid in dropped:
+                del self._row_map[mid]
+                self._arena_lru.pop(mid, None)
+            self.arena_events.increment("rebuilds")
+            logger.error(
+                "dropped lost arena %s (%d resident model(s) fall back "
+                "to last-good states)", bucket, len(dropped),
+            )
+
+    def meta(self, model_id: str):
+        """The model's immutable serving metadata — the submit-path
+        accessor.  Dict mode returns the full state (exactly what the
+        submit paths read before the arena existed); arena mode returns
+        the host-side :class:`~metran_tpu.serve.state.ModelMeta`,
+        making the model resident first if needed (same KeyError /
+        StateIntegrityError contract as :meth:`get`)."""
+        if not self.arena_enabled:
+            return self.get(model_id)
+        with self._arena_lock:
+            if model_id in self._row_map:
+                return self._arena_meta[model_id]
+        self.ensure_resident(model_id)
+        return self._arena_meta[model_id]
+
+    def ensure_resident(self, model_id: str) -> Tuple[ShapeBucket, int]:
+        """Make the model arena-resident; returns its ``(bucket, row)``.
+
+        The warm path is one dict hit.  A cold model loads through the
+        SAME path as a dict-mode :meth:`get` (memory → disk, checksum +
+        numerical validation, quarantine on corruption), allocates a
+        free row — evicting the bucket's least-recently-touched model
+        first when the arena is full — and packs in.  Fault point
+        ``serve.state.load`` and the quarantine lifecycle therefore
+        behave identically in both modes.
+        """
+        if not self.arena_enabled:
+            raise ValueError("registry has no arena (arena=False)")
+        with self._arena_lock:
+            hit = self._row_map.get(model_id)
+            if hit is not None:
+                arena = self._arenas.get(hit[0])
+                if arena is not None and not arena.lost:
+                    self._arena_lru.move_to_end(model_id)
+                    return hit
+                self._drop_lost_arena(hit[0])
+            state = self._base_get(model_id)
+            bucket = self.bucket_of(state)
+            arena = self.arena_for(bucket, dtype=state.dtype)
+            row = arena.alloc()
+            while row is None:
+                # least-recently-touched UNPINNED model in this bucket:
+                # rows resolved by an in-flight dispatch are immovable
+                victim = next(
+                    (m for m in self._arena_lru
+                     if self._row_map[m][0] == bucket
+                     and m not in self._pinned), None,
+                )
+                if victim is None:
+                    raise RuntimeError(
+                        f"arena {bucket} is full and every resident "
+                        "row is pinned by in-flight dispatches; size "
+                        "arena_rows to the working fleet (or retry)"
+                    )
+                self.evict(victim)
+                row = arena.alloc()
+            arena.write_row(row, state)
+            self._arena_meta[model_id] = ModelMeta.of(state)
+            self._row_map[model_id] = (bucket, row)
+            self._arena_lru[model_id] = None
+            self._arena_lru.move_to_end(model_id)
+            self.arena_events.increment("loads")
+            if self.events is not None:
+                self.events.emit(
+                    "arena_load", model_id=model_id,
+                    fault_point="registry.arena",
+                    bucket=str(bucket), row=int(row),
+                    version=state.version,
+                )
+            return (bucket, row)
+
+    def rows_for(self, model_ids, pin: bool = False):
+        """Bulk :meth:`ensure_resident`: one lock acquisition for a
+        whole fleet tick.  Returns ``(hits, errs)`` — ``hits[i]`` is
+        ``(bucket, row)`` or ``None`` where ``errs[i]`` carries that
+        model's exception (per-slot isolation; a crash signal still
+        escapes).
+
+        ``pin=True`` PINS every successfully resolved model until the
+        caller's matching :meth:`release_rows`: a pinned row cannot be
+        evicted, so neither a colder model later in this same batch
+        nor a concurrent submit-path load can reassign a row the
+        dispatch already resolved — without the pin, the kernel could
+        receive duplicate/stale rows and scatter one model's posterior
+        into another's.  Resolution that would REQUIRE evicting a
+        pinned row fails that model's slot instead.
+        """
+        hits, errs = [], []
+        with self._arena_lock:
+            for mid in model_ids:
+                try:
+                    hit = self.ensure_resident(mid)
+                    if pin:
+                        self._pinned[mid] = self._pinned.get(mid, 0) + 1
+                    hits.append(hit)
+                    errs.append(None)
+                except Exception as exc:  # noqa: BLE001 - per-slot
+                    hits.append(None)
+                    errs.append(exc)
+        return hits, errs
+
+    def release_rows(self, model_ids) -> None:
+        """Undo one :meth:`rows_for` ``pin=True`` (refcounted; call
+        from a ``finally`` so a failed dispatch cannot leak pins)."""
+        with self._arena_lock:
+            for mid in model_ids:
+                count = self._pinned.get(mid)
+                if count is None:
+                    continue
+                if count <= 1:
+                    del self._pinned[mid]
+                else:
+                    self._pinned[mid] = count - 1
+
+    def arena_of(self, bucket: ShapeBucket) -> StateArena:
+        """The bucket's EXISTING arena — never creates or rebuilds.
+        Dispatch paths use this after resolving rows so a concurrent
+        lost-arena rebuild can never hand them a fresh EMPTY arena
+        whose rows no longer hold the resolved models (the old arena
+        object's own ``lost`` flag then fails the dispatch cleanly)."""
+        with self._arena_lock:
+            arena = self._arenas.get(bucket)
+            if arena is None:
+                raise StateIntegrityError(
+                    f"arena {bucket} is not available (dropped after "
+                    "a failed dispatch); rows re-pack on next touch"
+                )
+            return arena
+
+    def evict(self, model_id: str) -> Optional[PosteriorState]:
+        """Spill one resident model to its ``.npz`` and free its row.
+
+        Crash-consistent ordering: the state is persisted (atomically)
+        BEFORE the row is released or the mapping dropped, so a crash
+        anywhere in between leaves either a still-resident row (with
+        an old-or-new complete file) or a fully spilled model — never
+        a freed row whose state exists nowhere.  Returns the spilled
+        state (``None`` when the model was not resident)."""
+        with self._arena_lock:
+            hit = self._row_map.get(model_id)
+            if hit is None:
+                return None
+            if model_id in self._pinned:
+                raise RuntimeError(
+                    f"model {model_id!r} is pinned by an in-flight "
+                    "dispatch and cannot be evicted right now"
+                )
+            bucket, row = hit
+            arena = self._arenas.get(bucket)
+            if arena is None or arena.lost:
+                self._drop_lost_arena(bucket)
+                return None
+            state = arena.materialize(row, self._arena_meta[model_id])
+            if self.root is not None:
+                state.save(self.path_for(model_id))
+                self.arena_events.increment("spills")
+            self._states[model_id] = state  # last-good fallback
+            arena.clear_row(row)
+            del self._row_map[model_id]
+            self._arena_lru.pop(model_id, None)
+            self.arena_events.increment("evictions")
+            if self.events is not None:
+                self.events.emit(
+                    "arena_spill", model_id=model_id,
+                    fault_point="registry.arena",
+                    bucket=str(bucket), row=int(row),
+                    version=state.version, evicted=True,
+                )
+            return state
+
+    def spill(self, dirty_only: bool = True) -> int:
+        """Checkpoint resident rows to disk WITHOUT freeing them
+        (``registry.root`` required; no-op otherwise).  The arena's
+        durability contract: updates dirty their row in place, and
+        dirty rows persist here — on :meth:`MetranService.close`, or
+        on an operator-driven checkpoint cadence.  Returns the number
+        of rows written."""
+        if not self.arena_enabled or self.root is None:
+            return 0
+        # snapshot phase, under the lock: pick the dirty rows and pull
+        # their values (ONE device→host gather per leaf per bucket —
+        # spill at fleet size is transfer-bound otherwise)
+        snapshots: list = []
+        with self._arena_lock:
+            by_bucket: Dict[ShapeBucket, list] = {}
+            for mid, (bucket, row) in self._row_map.items():
+                arena = self._arenas.get(bucket)
+                if arena is None or arena.lost:
+                    continue
+                if dirty_only and not arena.dirty[row]:
+                    continue
+                by_bucket.setdefault(bucket, []).append((mid, row))
+            for bucket, entries in by_bucket.items():
+                arena = self._arenas[bucket]
+                means, facs = arena.read_rows([r for _, r in entries])
+                for (mid, row), mean_p, fac_p in zip(
+                    entries, means, facs
+                ):
+                    snapshots.append((arena, bucket, mid, row,
+                                      arena.materialize_values(
+                                          mean_p, fac_p, row,
+                                          self._arena_meta[mid],
+                                      )))
+                    # pinned for the write phase: a concurrent
+                    # EVICTION would persist a newer version and this
+                    # spill's older snapshot must not overwrite it on
+                    # disk (concurrent updates are fine — they only
+                    # re-dirty the row, caught below)
+                    self._pinned[mid] = self._pinned.get(mid, 0) + 1
+        # write phase, OUTSIDE the lock: one .npz per row is
+        # milliseconds each, and holding the global arena lock across
+        # a fleet-sized checkpoint would stall every submit-path
+        # lookup for the whole spill
+        n = 0
+        try:
+            for arena, bucket, mid, row, state in snapshots:
+                state.save(self.path_for(mid))
+                with self._arena_lock:
+                    # the row stays spill-clean only if nothing moved
+                    # or updated it while we wrote: a concurrent
+                    # update (new version) or a re-pack must keep its
+                    # own dirtiness — never mark newer data persisted
+                    if (
+                        self._row_map.get(mid) == (bucket, row)
+                        and arena is self._arenas.get(bucket)
+                        and not arena.lost
+                        and int(arena.version_host[row]) == state.version
+                    ):
+                        with arena.lock:
+                            arena.dirty[row] = False
+                    prev = self._states.get(mid)
+                    if prev is None or prev.version <= state.version:
+                        self._states[mid] = state
+                self.arena_events.increment("spills")
+                if self.events is not None:
+                    self.events.emit(
+                        "arena_spill", model_id=mid,
+                        fault_point="registry.arena",
+                        bucket=str(bucket), row=int(row),
+                        version=state.version, evicted=False,
+                    )
+                n += 1
+        finally:
+            self.release_rows([mid for _, _, mid, _, _ in snapshots])
+        return n
+
+    @property
+    def arena_stats(self) -> Dict[str, int]:
+        """Arena occupancy + lifetime lifecycle counters (loads,
+        spills, evictions, rebuilds)."""
+        resident, free = self._arena_rows_count()
+        return {
+            "arenas": len(self._arenas),
+            "rows_resident": resident,
+            "rows_free": free,
+            **self.arena_events.snapshot(),
+        }
+
+    def _arena_rows_count(self) -> Tuple[int, int]:
+        with self._arena_lock:
+            arenas = list(self._arenas.values())
+        resident = sum(a.occupied_rows for a in arenas)
+        free = sum(a.free_rows for a in arenas)
+        return resident, free
+
+    # ------------------------------------------------------------------
     # shape buckets & compiled kernels
     # ------------------------------------------------------------------
     def bucket_of(self, state: PosteriorState) -> ShapeBucket:
@@ -495,6 +936,35 @@ class ModelRegistry:
         return self._compiled.get_or_create(
             ("forecast", bucket, int(steps)),
             lambda: make_forecast_fn(int(steps)),
+        )
+
+    def arena_update_fn(self, bucket: ShapeBucket, k: int, gate=None,
+                        validate: bool = True):
+        """Compiled arena assimilation kernel (donating, in-place) for
+        ``k`` appended steps — same compile-key discipline as
+        :meth:`update_fn` plus the ``validate`` bit (the on-device
+        integrity gate is compiled in or out)."""
+        from .engine import make_arena_update_fn
+
+        key = ("arena_update", bucket, int(k), self.engine,
+               bool(validate))
+        if gate is not None and getattr(gate, "enabled", False):
+            key = key + ("gate", gate.policy, float(gate.nsigma))
+        return self._compiled.get_or_create(
+            key,
+            lambda: make_arena_update_fn(
+                engine=self.engine, gate=gate, validate=validate
+            ),
+        )
+
+    def arena_forecast_fn(self, bucket: ShapeBucket, steps: int):
+        """Compiled arena forecast kernel (read-only row gather)."""
+        from .engine import make_arena_forecast_fn
+
+        sqrt = self._sqrt_engine
+        return self._compiled.get_or_create(
+            ("arena_forecast", bucket, int(steps), sqrt),
+            lambda: make_arena_forecast_fn(int(steps), sqrt=sqrt),
         )
 
     @property

@@ -203,6 +203,24 @@ class Forecast(NamedTuple):
     version: int
 
 
+class ArenaUpdateAck(NamedTuple):
+    """What an **arena-path** update resolves to.
+
+    The whole point of the device-resident arena is that the updated
+    posterior never crosses back to the host per request, so the
+    caller gets the commit acknowledgement — the bumped ``version``
+    and ``t_seen`` (the same optimistic-concurrency tokens a
+    :class:`PosteriorState` result carried) — instead of a
+    materialized state.  ``service.registry.get(model_id)`` reads the
+    full posterior back when one is actually needed (a cold path:
+    one device→host row gather).
+    """
+
+    model_id: str
+    version: int
+    t_seen: int
+
+
 @dataclass
 class ServeMetrics:
     """Request/dispatch telemetry (see ``metran_tpu.obs.metrics``).
@@ -521,9 +539,13 @@ class MetranService:
         # registry lookup BEFORE any breaker exists: a breaker per
         # caller-supplied id would let typo'd/enumerated ids grow
         # BreakerBoard without bound on a long-lived service — only
-        # ids the registry actually knows earn breaker state
+        # ids the registry actually knows earn breaker state.
+        # `meta` is the full state on a dict registry and the host-side
+        # ModelMeta on an arena registry (same KeyError /
+        # StateIntegrityError contract; an arena registry also makes
+        # the model device-resident here, so dispatch is row lookups).
         try:
-            state = self.registry.get(model_id)
+            state = self.registry.meta(model_id)
         except StateIntegrityError:
             # the model's own stored state is bad: a real per-model
             # failure, and the breaker should learn it (a KNOWN id)
@@ -748,9 +770,10 @@ class MetranService:
 
     def _update_submit(self, model_id: str, new_obs, span):
         # registry lookup first — see forecast_async: unknown ids must
-        # not allocate breaker state
+        # not allocate breaker state (`meta`: full state on a dict
+        # registry, host-side ModelMeta + residency on an arena one)
         try:
-            state = self.registry.get(model_id)
+            state = self.registry.meta(model_id)
         except StateIntegrityError:
             self._record_failure_without_request("update", model_id)
             raise
@@ -1023,6 +1046,412 @@ class MetranService:
             if n == 0:
                 return total
 
+    # ------------------------------------------------------------------
+    # bulk (fleet-tick) API: the whole fleet in one dispatch per bucket
+    # ------------------------------------------------------------------
+    def update_batch(self, model_ids, new_obs) -> list:
+        """Assimilate one **fleet tick**: ``k`` new observation rows
+        for G DISTINCT models, one device dispatch per shape bucket.
+
+        This is the arena's native ingestion path — the per-request
+        machinery (futures, micro-batcher, per-model breakers, spans)
+        exists to coalesce *independent* callers, and a fleet feed
+        that already arrives as one tick for every model needs none of
+        it: the host work is vectorized validation + standardization
+        against the arena's scaler mirrors, and the per-request cost
+        is a few microseconds.  ``new_obs`` is ``(G, k, n)`` for a
+        same-width fleet or a sequence of ``(k, n_i)`` arrays (data
+        units, NaN = missing).  Returns one entry per model IN ORDER:
+        an :class:`ArenaUpdateAck` (arena registries), or the
+        exception that failed that model alone — exceptions are
+        returned, not raised, exactly like the dispatch contract.
+
+        Semantics: runs under the same update lock as dispatched
+        batches, and the on-device integrity gate, observation gating,
+        health booking and event emission all behave as on the
+        per-request path.  Per-model ordering against concurrently
+        in-flight *async* updates of the same model is NOT chained
+        here — a fleet feed owns its own tick ordering.  On a
+        dict-registry service this degrades gracefully to the
+        per-request path (same results, none of the bulk speedup).
+        """
+        ids = [str(m) for m in model_ids]
+        if len(set(ids)) != len(ids):
+            raise ValueError(
+                "update_batch model_ids must be distinct (duplicate "
+                "ticks for one model have no defined order inside one "
+                "dispatch)"
+            )
+        obs_list = [
+            np.atleast_2d(np.asarray(o, float)) for o in new_obs
+        ]
+        if len(obs_list) != len(ids):
+            raise ValueError(
+                f"got {len(ids)} model_ids but {len(obs_list)} "
+                "observation blocks"
+            )
+        ks = {o.shape[0] for o in obs_list}
+        if len(ks) > 1:
+            raise ValueError(
+                f"all observation blocks in one tick must append the "
+                f"same k rows; got {sorted(ks)}"
+            )
+        if not self.registry.arena_enabled:
+            return self._batch_via_requests(
+                ids, [("update", o) for o in obs_list]
+            )
+        return self._update_batch_arena(ids, obs_list)
+
+    def forecast_batch(self, model_ids, steps: int) -> list:
+        """Forecast G models ``steps`` periods ahead, one dispatch per
+        bucket (the read half of the fleet-tick API; see
+        :meth:`update_batch`).  Returns one :class:`Forecast` or
+        exception per model, in order."""
+        ids = [str(m) for m in model_ids]
+        steps = int(steps)
+        if steps < 1:
+            self.metrics.errors.increment("validation_errors")
+            raise ValueError(f"forecast steps must be >= 1, got {steps}")
+        if not self.registry.arena_enabled:
+            return self._batch_via_requests(
+                ids, [("forecast", steps)] * len(ids)
+            )
+        return self._forecast_batch_arena(ids, steps)
+
+    def _batch_via_requests(self, ids, specs) -> list:
+        """Dict-registry fallback for the bulk API: route through the
+        per-request submission path and collect (per-slot isolation
+        preserved — a model's failure lands in its slot)."""
+        futs: list = []
+        for mid, spec in zip(ids, specs):
+            try:
+                if spec[0] == "update":
+                    futs.append(self.update_async(mid, spec[1]))
+                else:
+                    futs.append(self.forecast_async(mid, spec[1]))
+            except Exception as exc:  # noqa: BLE001 - per-slot channel
+                futs.append(exc)
+        if self.batcher.flush_deadline is None:
+            self.flush()
+        out: list = []
+        for f in futs:
+            if isinstance(f, Exception):
+                out.append(f)
+                continue
+            try:
+                out.append(f.result(timeout=self.reliability.deadline_s))
+            except Exception as exc:  # noqa: BLE001 - per-slot channel
+                out.append(exc)
+        return out
+
+    def _bucket_groups(self, hits, live):
+        """Group live batch indices by shape bucket."""
+        groups: dict = {}
+        for i in live:
+            groups.setdefault(hits[i][0], []).append(i)
+        return groups
+
+    def _update_batch_arena(self, ids, obs_list) -> list:
+        t0 = time.monotonic()
+        g_total = len(ids)
+        results: list = [None] * g_total
+        with self._update_lock:
+            hits, errs = self.registry.rows_for(ids, pin=True)
+            live, pinned = [], []
+            for i, err in enumerate(errs):
+                if err is None:
+                    live.append(i)
+                    pinned.append(ids[i])
+                else:
+                    self.metrics.errors.increment("lookup_failures")
+                    results[i] = err
+            try:
+                self._update_batch_buckets(
+                    ids, obs_list, hits, live, results
+                )
+            finally:
+                self.registry.release_rows(pinned)
+        n_err = sum(isinstance(r, BaseException) for r in results)
+        self.monitor.record_many(g_total - n_err, n_err)
+        if n_err:
+            self.metrics.errors.increment("update_errors", n_err)
+        self.metrics.occupancy.record(g_total)
+        # one latency sample for the whole tick: the feed sees one
+        # call, and G copies of the same value would drown the
+        # per-request percentiles
+        self.metrics.update_latency.record(time.monotonic() - t0)
+        return results
+
+    def _update_batch_buckets(self, ids, obs_list, hits, live, results):
+        """Per-bucket dispatch loop of :meth:`_update_batch_arena`
+        (rows already resolved and pinned by the caller)."""
+        gate = self.gate
+        gated = gate.enabled
+        validate = self.reliability.validate_updates
+        for bucket, idxs in self._bucket_groups(hits, live).items():
+            try:
+                arena = self.registry.arena_of(bucket)
+            except Exception as exc:  # noqa: BLE001 - per-bucket
+                for i in idxs:
+                    results[i] = exc
+                continue
+            n_pad = bucket[0]
+            k = obs_list[idxs[0]].shape[0]
+            rows_arr = np.asarray(
+                [hits[i][1] for i in idxs], np.int32
+            )
+            y_raw = np.zeros((len(idxs), k, n_pad))
+            n_expect = arena.n_series_host[rows_arr]
+            good = []
+            for gi, i in enumerate(idxs):
+                obs = corrupt(
+                    "serve.update.new_obs", obs_list[i],
+                    detail=ids[i],
+                )
+                n_i = obs.shape[1]
+                if n_i != n_expect[gi]:
+                    self.metrics.errors.increment(
+                        "validation_errors"
+                    )
+                    results[i] = ValueError(
+                        f"new_obs has {n_i} series, model "
+                        f"{ids[i]!r} has {int(n_expect[gi])}"
+                    )
+                    continue
+                if np.isinf(obs).any():
+                    self.metrics.errors.increment(
+                        "validation_errors"
+                    )
+                    results[i] = ValueError(
+                        f"new_obs for model {ids[i]!r} contains "
+                        "infinite values; use NaN to mark missing "
+                        "observations"
+                    )
+                    continue
+                y_raw[gi, :, :n_i] = np.where(
+                    np.isfinite(obs), obs, np.nan
+                )
+                good.append(gi)
+            if not good:
+                continue
+            if len(good) < len(idxs):
+                sel = np.asarray(good)
+                y_raw, rows_arr = y_raw[sel], rows_arr[sel]
+                idxs = [idxs[gi] for gi in good]
+            # padded columns (zeros, finite) are masked off via
+            # each row's true series count; only real-slot NaNs
+            # count as masked data
+            n_sl = arena.n_series_host[rows_arr]
+            real = (
+                np.arange(n_pad)[None, None, :] < n_sl[:, None, None]
+            )
+            mask = np.isfinite(y_raw)
+            n_masked = int(np.count_nonzero(real & ~mask))
+            if n_masked:
+                self.metrics.data_quality.increment(
+                    "masked_values", n_masked
+                )
+            # vectorized standardization against the arena's host
+            # scaler mirrors (padded cols have mean 0 / std 1)
+            sm = arena.scaler_mean[rows_arr][:, None, :]
+            sd = arena.scaler_std[rows_arr][:, None, :]
+            # standardized in f64 (like the per-request path), cast
+            # to the arena dtype so bulk and per-request dispatches
+            # share ONE compiled executable per (bucket, k)
+            y = np.where(mask, (y_raw - sm) / sd, 0.0).astype(
+                arena.dtype, copy=False
+            )
+            m = mask & real
+            fn = self.registry.arena_update_fn(
+                bucket, k, gate=gate if gated else None,
+                validate=validate,
+            )
+            g = len(rows_arr)
+            rows_p, (y_p, m_p) = self._pad_dispatch(
+                rows_arr, arena.scratch_row, (y, m)
+            )
+            zs = verdicts = None
+            if gated:
+                ok, _sigma, _detf, zs, verdicts = arena.apply(
+                    fn, rows_p, y_p, m_p, np.int32(gate.min_seen)
+                )
+                zs = np.asarray(zs)[:g]
+                verdicts = np.asarray(verdicts)[:g]
+            else:
+                ok, _sigma, _detf = arena.apply(fn, rows_p, y_p, m_p)
+            ok = np.asarray(ok)[:g]
+            arena.commit_rows(rows_arr, ok, k)
+            if gated:
+                self._book_gate_verdicts_bulk(
+                    idxs, ids, zs, verdicts, n_sl
+                )
+            versions = arena.version_host[rows_arr]
+            t_seens = arena.t_seen_host[rows_arr]
+            empty = ~m.any(axis=(1, 2))
+            n_empty = int(np.count_nonzero(empty & ok))
+            if n_empty:
+                self.metrics.data_quality.increment(
+                    "empty_updates", n_empty
+                )
+            for gi, i in enumerate(idxs):
+                if ok[gi]:
+                    results[i] = ArenaUpdateAck(
+                        ids[i], int(versions[gi]), int(t_seens[gi])
+                    )
+                    if empty[gi] and self.events is not None:
+                        self.events.emit(
+                            "empty_update", model_id=ids[i],
+                            fault_point="serve.commit",
+                            version=int(versions[gi]), k=k,
+                        )
+                else:
+                    self.metrics.errors.increment(
+                        "poisoned_updates"
+                    )
+                    if self.events is not None:
+                        self.events.emit(
+                            "poisoned_update", model_id=ids[i],
+                            fault_point="serve.integrity_gate",
+                            reason="on-device arena integrity "
+                                   "gate rejected the posterior",
+                            version=int(versions[gi]),
+                        )
+                    results[i] = StateIntegrityError(
+                        f"update for model {ids[i]!r} produced an "
+                        "invalid posterior; the request was not "
+                        "applied and the arena row is unchanged"
+                    )
+
+    def _book_gate_verdicts_bulk(self, idxs, ids, zs, verdicts, n_sl):
+        """Vectorized gate-outcome booking for one bulk dispatch:
+        scores feed the histogram in one ``observe_many``, verdict
+        counts in two bulk increments, the per-model rejection window
+        per model, and per-observation events only for the (rare)
+        flagged slots."""
+        n_pad = zs.shape[2]
+        real = np.arange(n_pad)[None, None, :] < n_sl[:, None, None]
+        obs = np.isfinite(zs) & real
+        hist = self.metrics.gate_scores
+        if hist is not None and obs.any():
+            hist.observe_many(np.square(zs[obs]))
+        rej = (verdicts == GATE_REJECTED) & real
+        dw = (verdicts == GATE_DOWNWEIGHTED) & real
+        n_rej, n_dw = int(rej.sum()), int(dw.sum())
+        if n_rej:
+            self.metrics.gate_verdicts.increment("rejected", n_rej)
+        if n_dw:
+            self.metrics.gate_verdicts.increment("downweighted", n_dw)
+        n_obs_m = obs.sum(axis=(1, 2))
+        n_flag_m = (rej | dw).sum(axis=(1, 2))
+        for gi, i in enumerate(idxs):
+            if n_obs_m[gi]:
+                self.monitor.record_gate(
+                    ids[i], int(n_obs_m[gi]), int(n_flag_m[gi])
+                )
+        if (n_rej or n_dw) and self.events is not None:
+            for gi, row, col in zip(*np.nonzero(rej | dw)):
+                i = idxs[gi]
+                names = self.registry.meta(ids[i]).names
+                self.events.emit(
+                    "observation_rejected" if rej[gi, row, col]
+                    else "observation_downweighted",
+                    model_id=ids[i],
+                    fault_point="serve.observation_gate",
+                    slot=names[int(col)], step=int(row),
+                    score=float(zs[gi, row, col] ** 2),
+                    policy=self.gate.policy,
+                )
+
+    def _forecast_batch_query(self, bucket, rows, steps: int):
+        """One bucket's pinned-row forecast query: kernel + consistent
+        version/scaler snapshot, transferred to host.  Returns
+        ``(means, variances, versions, sm, sd)`` or the exception that
+        failed the whole bucket (per-bucket channel)."""
+        try:
+            arena = self.registry.arena_of(bucket)
+            fn = self.registry.arena_forecast_fn(bucket, steps)
+            rows_arr = np.asarray(rows, np.int32)
+            rows_p, _ = self._pad_dispatch(
+                rows_arr, arena.scratch_row, ()
+            )
+            with arena.lock:
+                out = arena.query(fn, rows_p)
+                versions = arena.version_host[rows_arr].copy()
+                sm = arena.scaler_mean[rows_arr][:, None, :]
+                sd = arena.scaler_std[rows_arr][:, None, :]
+            g = len(rows_arr)
+            return (
+                np.asarray(out[0])[:g], np.asarray(out[1])[:g],
+                versions, sm, sd,
+            )
+        except Exception as exc:  # noqa: BLE001 - per-bucket channel
+            return exc
+
+    def _forecast_batch_arena(self, ids, steps: int) -> list:
+        t0 = time.monotonic()
+        results: list = [None] * len(ids)
+        hits, errs = self.registry.rows_for(ids, pin=True)
+        live, pinned = [], []
+        for i, err in enumerate(errs):
+            if err is None:
+                live.append(i)
+                pinned.append(ids[i])
+            else:
+                self.metrics.errors.increment("lookup_failures")
+                results[i] = err
+        validate = self.reliability.validate_updates
+        try:
+            groups = [
+                (bucket, idxs, self._forecast_batch_query(
+                    bucket, [hits[i][1] for i in idxs], steps
+                ))
+                for bucket, idxs in
+                self._bucket_groups(hits, live).items()
+            ]
+        finally:
+            self.registry.release_rows(pinned)
+        for bucket, idxs, queried in groups:
+            if isinstance(queried, BaseException):
+                for i in idxs:
+                    results[i] = queried
+                continue
+            means, variances, versions, sm, sd = queried
+            means_d = means * sd + sm
+            vars_d = variances * sd**2
+            bad = ~(
+                np.isfinite(means).all(axis=(1, 2))
+                & np.isfinite(variances).all(axis=(1, 2))
+            ) if validate else np.zeros(len(idxs), bool)
+            for gi, i in enumerate(idxs):
+                meta = self.registry.meta(ids[i])
+                if bad[gi]:
+                    self.metrics.errors.increment("poisoned_forecasts")
+                    if self.events is not None:
+                        self.events.emit(
+                            "poisoned_forecast", model_id=ids[i],
+                            fault_point="serve.integrity_gate",
+                            version=int(versions[gi]),
+                        )
+                    results[i] = StateIntegrityError(
+                        f"forecast for model {ids[i]!r} produced "
+                        "non-finite moments (poisoned posterior state)"
+                    )
+                    continue
+                n = meta.n_series
+                results[i] = Forecast(
+                    means=means_d[gi, :, :n],
+                    variances=vars_d[gi, :, :n],
+                    names=meta.names,
+                    version=int(versions[gi]),
+                )
+        n_err = sum(isinstance(r, BaseException) for r in results)
+        self.monitor.record_many(len(ids) - n_err, n_err)
+        if n_err:
+            self.metrics.errors.increment("forecast_errors", n_err)
+        self.metrics.occupancy.record(len(ids))
+        self.metrics.forecast_latency.record(time.monotonic() - t0)
+        return results
+
     def health(self) -> dict:
         """Readiness/health snapshot for probes.
 
@@ -1053,6 +1482,8 @@ class MetranService:
             "events": (
                 self.events.counts() if self.events is not None else {}
             ),
+            **({"arena": self.registry.arena_stats}
+               if self.registry.arena_enabled else {}),
         })
         return snap
 
@@ -1061,6 +1492,16 @@ class MetranService:
         # updates that only enqueue from done-callbacks mid-drain —
         # before it starts refusing submissions
         self.batcher.close()
+        if self.registry.arena_enabled and self.persist_updates:
+            # the arena's durability frontier: updates dirtied rows in
+            # place on device, and a clean shutdown spills them so the
+            # next process warm-starts from disk (crash windows are
+            # bounded by the last spill/evict — see docs/concepts.md
+            # "Scale & sharding")
+            try:
+                self.registry.spill(dirty_only=True)
+            except Exception:  # pragma: no cover - disk trouble
+                logger.exception("arena spill on close failed")
         if self._owns_obs and self.events is not None:
             # release a default bundle's owned event-sink fd (a caller-
             # provided bundle stays open — it may outlive this service)
@@ -1316,6 +1757,8 @@ class MetranService:
         whose posterior propagates to non-finite moments fails alone)."""
         from .engine import stack_bucket
 
+        if self.registry.arena_enabled:
+            return self._run_forecast_arena(bucket, steps, requests)
         results: list = [None] * len(requests)
         states, live = self._lookup_states(requests, results)
         if not live:
@@ -1385,6 +1828,8 @@ class MetranService:
         """
         from .engine import posterior_fault, stack_bucket, state_slot_index
 
+        if self.registry.arena_enabled:
+            return self._run_update_arena(bucket, k, requests)
         results: list = [None] * len(requests)
         states, live = self._lookup_states(requests, results)
         if not live:
@@ -1622,5 +2067,253 @@ class MetranService:
             results[j] = new_state
         return results
 
+    # ------------------------------------------------------------------
+    # arena dispatch: rows in, acks out — the state never leaves device
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _pad_dispatch(rows_arr, scratch_row, arrays):
+        """Pad an arena dispatch to the next power-of-two width with
+        scratch-row entries (all-masked no-op updates of the arena's
+        reserved scratch row), so the jitted kernels compile for
+        O(log max_batch) distinct widths instead of one executable per
+        request count — the difference between a bounded compile
+        budget and a compile storm under open-loop traffic whose batch
+        widths vary per flush.  Returns the padded row vector and
+        arrays; callers slice every output back to the true width."""
+        g = len(rows_arr)
+        gp = 1 << max(g - 1, 0).bit_length()
+        if gp == g:
+            return rows_arr, arrays
+        rows_p = np.concatenate([
+            rows_arr,
+            np.full(gp - g, scratch_row, rows_arr.dtype),
+        ])
+        padded = []
+        for a in arrays:
+            ap = np.zeros((gp,) + a.shape[1:], a.dtype)
+            ap[:g] = a
+            padded.append(ap)
+        return rows_p, padded
 
-__all__ = ["Forecast", "MetranService", "ServeMetrics"]
+    def _lookup_rows(self, requests, results):
+        """Per-request row resolution (arena mode): ensure each model
+        is device-resident and collect its row + host metadata, with
+        every resolved row PINNED (``registry.rows_for(pin=True)``) so
+        neither a colder model later in this batch nor a concurrent
+        load can evict-and-reassign a row the dispatch already holds.
+        A model that cannot be made resident (unknown id, quarantined
+        file, arena full of pinned rows) fails ITS slot and leaves the
+        rest of the batch serviceable — the arena counterpart of
+        ``_lookup_states``.  Callers MUST ``registry.release_rows``
+        the returned ``pinned`` list in a ``finally``."""
+        ids = [req.model_id for req in requests]
+        hits, errs = self.registry.rows_for(ids, pin=True)
+        rows, metas, live, pinned = [], [], [], []
+        for j, (hit, err) in enumerate(zip(hits, errs)):
+            if err is None:
+                rows.append(hit[1])
+                metas.append(self.registry.meta(ids[j]))
+                live.append(j)
+                pinned.append(ids[j])
+            else:
+                self.metrics.errors.increment("lookup_failures")
+                results[j] = err
+        return rows, metas, live, pinned
+
+    def _run_forecast_arena(self, bucket, steps: int, requests):
+        """One batched arena forecast: a row gather + the closed-form
+        horizon kernel, entirely on device — no state stacking, no
+        (B, S, S) host transfer.  Per-slot isolation as in
+        ``_run_forecast`` (non-finite moments fail that slot alone)."""
+        results: list = [None] * len(requests)
+        rows, metas, live, pinned = self._lookup_rows(requests, results)
+        try:
+            if not live:
+                return results
+            arena = self.registry.arena_of(bucket)
+            fn = self.registry.arena_forecast_fn(bucket, steps)
+            tracer = self.tracer
+            t_eng0 = tracer.clock() if tracer is not None else None
+            rows_arr = np.asarray(rows, np.int32)
+            rows_p, _ = self._pad_dispatch(
+                rows_arr, arena.scratch_row, ()
+            )
+            with arena.lock:  # versions must match the snapshot served
+                out = arena.query(fn, rows_p)
+                versions = arena.version_host[rows_arr].copy()
+        finally:
+            self.registry.release_rows(pinned)
+        g = len(rows_arr)
+        means = np.asarray(out[0])[:g]
+        variances = np.asarray(out[1])[:g]
+        if tracer is not None:
+            t_eng1 = tracer.clock()
+            tracer.record_shared(
+                "serve.engine.forecast",
+                [requests[j].trace for j in live
+                 if requests[j].trace is not None],
+                t_eng0, t_eng1, {"batch": len(live), "arena": True},
+            )
+        validate = self.reliability.validate_updates
+        for i, (meta, j) in enumerate(zip(metas, live)):
+            n = meta.n_series
+            m = means[i, :, :n]
+            v = variances[i, :, :n]
+            if validate and not (
+                np.all(np.isfinite(m)) and np.all(np.isfinite(v))
+            ):
+                self.metrics.errors.increment("poisoned_forecasts")
+                if self.events is not None:
+                    self.events.emit(
+                        "poisoned_forecast", model_id=meta.model_id,
+                        request_id=(
+                            requests[j].trace.trace_id
+                            if requests[j].trace is not None else None
+                        ),
+                        fault_point="serve.integrity_gate",
+                        version=int(versions[i]),
+                    )
+                results[j] = StateIntegrityError(
+                    f"forecast for model {meta.model_id!r} produced "
+                    "non-finite moments (poisoned posterior state)"
+                )
+                continue
+            results[j] = Forecast(
+                means=m * meta.scaler_std + meta.scaler_mean,
+                variances=v * meta.scaler_std**2,
+                names=meta.names,
+                version=int(versions[i]),
+            )
+        return results
+
+    def _run_update_arena(self, bucket, k: int, requests):
+        """One batched arena assimilation, in place via buffer donation.
+
+        The kernel gathers the requests' rows, appends the ``k`` new
+        observations, runs the on-device integrity gate, and scatters
+        committed rows back — a rejected row is masked out of the
+        scatter, so per-slot failure isolation holds with its stored
+        state untouched.  Callers get :class:`ArenaUpdateAck`\\ s (the
+        posterior stays on device); only the observations go up and
+        the (G,)-sized verdicts come down.  Runs under
+        ``_update_lock`` like ``_run_update`` (same-model chains stay
+        sequential); a kernel-call failure AFTER donation marks the
+        arena lost — this round's requests fail, and the registry
+        rebuilds the arena from last-good states on the next touch.
+        """
+        results: list = [None] * len(requests)
+        rows, metas, live, pinned = self._lookup_rows(requests, results)
+        try:
+            if not live:
+                return results
+            arena = self.registry.arena_of(bucket)
+            n_pad = bucket[0]
+            y = np.zeros((len(live), k, n_pad), arena.dtype)
+            m = np.zeros((len(live), k, n_pad), bool)
+            for i, meta in enumerate(metas):
+                y_std, mask = requests[live[i]].payload
+                y[i, :, : meta.n_series] = y_std
+                m[i, :, : meta.n_series] = mask
+            gate = self.gate
+            gated = gate.enabled
+            validate = self.reliability.validate_updates
+            fn = self.registry.arena_update_fn(
+                bucket, k, gate=gate if gated else None,
+                validate=validate,
+            )
+            tracer = self.tracer
+            t_eng0 = tracer.clock() if tracer is not None else None
+            rows_arr = np.asarray(rows, np.int32)
+            g = len(rows_arr)
+            rows_p, (y_p, m_p) = self._pad_dispatch(
+                rows_arr, arena.scratch_row, (y, m)
+            )
+            zs = verdicts = None
+            if gated:
+                ok, sigma, detf, zs, verdicts = arena.apply(
+                    fn, rows_p, y_p, m_p, np.int32(gate.min_seen)
+                )
+                zs = np.asarray(zs)[:g]
+                verdicts = np.asarray(verdicts)[:g]
+            else:
+                ok, sigma, detf = arena.apply(fn, rows_p, y_p, m_p)
+            ok = np.asarray(ok)[:g]
+            arena.commit_rows(rows_arr, ok, k)
+            # mirror snapshot BEFORE the pins release: an eviction
+            # after release may clear these rows' mirrors
+            versions = arena.version_host[rows_arr].copy()
+            t_seens = arena.t_seen_host[rows_arr].copy()
+        finally:
+            self.registry.release_rows(pinned)
+        if tracer is not None:
+            t_eng1 = tracer.clock()
+            tracer.record_shared(
+                "serve.engine.update",
+                [requests[j].trace for j in live
+                 if requests[j].trace is not None],
+                t_eng0, t_eng1,
+                {"batch": len(live), "engine": self.registry.engine,
+                 "arena": True},
+            )
+        for i, (meta, j) in enumerate(zip(metas, live)):
+            trace_ctx = (
+                requests[j].trace if tracer is not None else None
+            )
+            try:
+                if gated:
+                    self._book_gate_verdicts(
+                        meta, zs[i, :, : meta.n_series],
+                        verdicts[i, :, : meta.n_series], trace_ctx,
+                    )
+                if not ok[i]:
+                    self.metrics.errors.increment("poisoned_updates")
+                    if self.events is not None:
+                        self.events.emit(
+                            "poisoned_update", model_id=meta.model_id,
+                            request_id=(
+                                trace_ctx.trace_id
+                                if trace_ctx is not None else None
+                            ),
+                            fault_point="serve.integrity_gate",
+                            reason="on-device arena integrity gate "
+                                   "rejected the posterior",
+                            version=int(versions[i]),
+                        )
+                    logger.error(
+                        "rejecting arena update for model %r (row "
+                        "masked out of the scatter)", meta.model_id,
+                    )
+                    results[j] = StateIntegrityError(
+                        f"update for model {meta.model_id!r} produced "
+                        "an invalid posterior; the request was not "
+                        "applied and the arena row is unchanged"
+                    )
+                    continue
+                ack = ArenaUpdateAck(
+                    model_id=meta.model_id,
+                    version=int(versions[i]),
+                    t_seen=int(t_seens[i]),
+                )
+                if not m[i].any():
+                    self.metrics.data_quality.increment("empty_updates")
+                    if self.events is not None:
+                        self.events.emit(
+                            "empty_update", model_id=meta.model_id,
+                            request_id=(
+                                trace_ctx.trace_id
+                                if trace_ctx is not None else None
+                            ),
+                            fault_point="serve.commit",
+                            version=ack.version, k=k,
+                        )
+                results[j] = ack
+            except Exception as exc:
+                self.metrics.errors.increment("finalize_failures")
+                logger.exception(
+                    "arena finalize failed for model %r", meta.model_id,
+                )
+                results[j] = exc
+        return results
+
+
+__all__ = ["ArenaUpdateAck", "Forecast", "MetranService", "ServeMetrics"]

@@ -22,9 +22,11 @@ each other), round-tripping bit-identically.
 
 from __future__ import annotations
 
+import functools
+import threading
 import zlib
 from pathlib import Path
-from typing import Dict, NamedTuple, Optional, Sequence, Tuple
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -397,9 +399,451 @@ def posterior_states_from_fleet(
     return states
 
 
+# ----------------------------------------------------------------------
+# device-resident state arena
+# ----------------------------------------------------------------------
+#
+# The dict-of-PosteriorState registry pays host↔device transfer and
+# per-model host work on EVERY dispatch: stack_bucket pads B (S, S)
+# covariances on the host, ships them up, and the results come all the
+# way back down just to be re-packed next request.  The arena inverts
+# that: each shape bucket owns preallocated (B, ...) stacked posterior
+# arrays that LIVE on device — only row indices and the new
+# observations cross the host boundary, and updates land in place via
+# buffer donation (``jax.jit(..., donate_argnums=...)``), so an
+# assimilation step is a gather → kernel → masked scatter entirely on
+# device.  Sharded along the batch axis with a ``NamedSharding`` over a
+# device mesh, one arena serves its bucket's whole fleet from N chips.
+
+
+class ModelMeta(NamedTuple):
+    """The immutable half of one arena-resident model's state.
+
+    Everything in a :class:`PosteriorState` except the filtered
+    posterior moments and the version counters: the host keeps these
+    (they never change between re-fits) so submit-path validation,
+    standardization and forecast de-standardization need no device
+    read, while ``mean``/``chol|cov``/``t_seen``/``version`` live in
+    the :class:`StateArena`.  Shares the shape accessors with
+    :class:`PosteriorState`, so ``ModelRegistry.bucket_of`` and the
+    service's submit paths accept either.
+    """
+
+    model_id: str
+    params: np.ndarray
+    loadings: np.ndarray
+    dt: float
+    scaler_mean: np.ndarray
+    scaler_std: np.ndarray
+    names: Tuple[str, ...]
+    dtype: np.dtype
+
+    @property
+    def n_series(self) -> int:
+        return int(self.loadings.shape[0])
+
+    @property
+    def n_factors(self) -> int:
+        return int(self.loadings.shape[1])
+
+    @classmethod
+    def of(cls, state: PosteriorState) -> "ModelMeta":
+        return cls(
+            model_id=state.model_id,
+            params=np.asarray(state.params),
+            loadings=np.asarray(state.loadings),
+            dt=float(state.dt),
+            scaler_mean=np.asarray(state.scaler_mean),
+            scaler_std=np.asarray(state.scaler_std),
+            names=tuple(state.names),
+            dtype=np.dtype(state.dtype),
+        )
+
+
+def _arena_write_fn():
+    """The (module-cached) donating row writer: scatter one row's
+    values into every arena leaf in place.  One jit for all arenas —
+    it retraces per distinct leaf-shape set, which is bounded by the
+    number of live bucket shapes."""
+    import jax
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def write(leaves, row, vals):
+        return tuple(
+            leaf.at[row].set(val) for leaf, val in zip(leaves, vals)
+        )
+
+    return write
+
+
+_ARENA_WRITE = None
+
+
+@functools.lru_cache(maxsize=32)
+def _identity_row_ss(bucket: Tuple[int, int], dtype_str: str):
+    """The built state-space leaves of a FREE arena row (padded-slot
+    identity model: alpha 1, zero loadings), host-side, cached per
+    bucket shape — what :meth:`StateArena.clear_row` scatters back."""
+    from ..ops.statespace import dfm_statespace
+
+    n_pad, s_pad = bucket
+    dt = np.dtype(dtype_str)
+    ss = dfm_statespace(
+        np.ones(n_pad, dt), np.ones(s_pad - n_pad, dt),
+        np.zeros((n_pad, s_pad - n_pad), dt), 1.0,
+    )
+    return tuple(np.asarray(leaf) for leaf in ss)
+
+
+class ArenaLostError(StateIntegrityError):
+    """The arena's device buffers are gone (a kernel failed AFTER its
+    donated inputs were consumed).  Rows must be re-packed from the
+    last-good host/disk states; :class:`~metran_tpu.serve.registry.
+    ModelRegistry` does that automatically on the next touch."""
+
+
+class StateArena:
+    """One shape bucket's models as device-resident stacked arrays.
+
+    Layout (``B`` = ``capacity`` rows, bucket = padded ``(N, S)``):
+
+    - dynamic leaves, replaced wholesale by each donating update:
+      ``mean (B, S)``, ``fac (B, S, S)`` (Cholesky factors under a
+      square-root engine, covariances otherwise), ``t_seen (B,)`` and
+      ``version (B,)`` (int32);
+    - static leaves, written only when a row is (re)packed: the
+      **built** state-space matrices ``phi (B, S)``, ``q (B, S, S)``,
+      ``z (B, N, S)``, ``r (B, N)`` — built ONCE per row at pack time
+      (``dfm_statespace`` on device), so dispatches gather ready
+      matrices instead of re-deriving them from parameters every call
+      (the dict path pays that rebuild per dispatch).
+
+    The host additionally mirrors each row's standardization constants
+    (``scaler_mean``/``scaler_std``, (B, N) numpy) so the bulk serving
+    APIs standardize and de-standardize whole batches with vectorized
+    gathers instead of per-request dict lookups.
+
+    A free row holds the padded-slot identity values (mean 0, factor
+    ``I``, alpha 1, zero loadings) — invisible to real rows under the
+    fleet padding contract, and always a valid kernel input, so a
+    dispatch never needs to mask free rows out.
+
+    **Donation contract.**  All device access goes through
+    :meth:`apply` (donating updates) and :meth:`query` (read-only
+    kernels), both serialized under ``self.lock``: an update kernel
+    consumes the dynamic leaves (``donate_argnums``) and the references
+    are swapped to its outputs before the lock is released, so no
+    thread can ever hand a donated buffer to a later dispatch.  If an
+    update kernel raises after tracing (its donated inputs may already
+    be consumed), the arena marks itself **lost** and every subsequent
+    access raises :class:`ArenaLostError` — the registry then rebuilds
+    the arena from last-good states rather than serving freed memory.
+
+    ``mesh`` (a ``jax.sharding.Mesh``) shards every leaf along the
+    batch axis with an explicit ``PartitionSpec``; ``capacity`` is
+    rounded up so shards stay even.  Knobs:
+    ``METRAN_TPU_SERVE_ARENA{,_ROWS,_MESH}``
+    (:func:`metran_tpu.config.serve_defaults`).
+    """
+
+    def __init__(
+        self,
+        bucket: Tuple[int, int],
+        capacity: int,
+        dtype=None,
+        sqrt: bool = False,
+        mesh=None,
+    ):
+        import jax
+
+        from ..parallel.mesh import batch_sharding, pad_to_multiple
+
+        n_pad, s_pad = int(bucket[0]), int(bucket[1])
+        self.bucket = (n_pad, s_pad)
+        self.sqrt = bool(sqrt)
+        self.mesh = mesh
+        if dtype is None:
+            from ..config import default_dtype
+
+            dtype = default_dtype()
+        self.dtype = np.dtype(dtype)
+        # one extra SCRATCH row (never allocated): dispatches pad their
+        # row vector to a power-of-two width with scratch entries, so
+        # the jitted kernels compile for a bounded set of batch widths
+        # instead of one executable per distinct request count.  A
+        # scratch gather/scatter is an all-masked no-op update of the
+        # identity row — every duplicate writes the same value, so the
+        # scatter stays deterministic.
+        capacity = int(capacity) + 1
+        if mesh is not None:
+            capacity = pad_to_multiple(capacity, mesh.devices.size)
+        self.capacity = capacity
+        self.scratch_row = capacity - 1
+        self.lock = threading.RLock()
+        self._lost = False
+        # host mirrors of the device counters, advanced deterministically
+        # from each dispatch's ok flags — serving answers (versions,
+        # forecast attribution) never need a device read
+        self.t_seen_host = np.zeros(capacity, np.int64)
+        self.version_host = np.zeros(capacity, np.int64)
+        #: rows updated since their last spill (durability frontier)
+        self.dirty = np.zeros(capacity, bool)
+        #: host mirrors of each row's standardization constants, for
+        #: vectorized (de)standardization in the bulk serving APIs
+        self.scaler_mean = np.zeros((capacity, n_pad))
+        self.scaler_std = np.ones((capacity, n_pad))
+        #: each row's true (unpadded) series count — bulk payload
+        #: validation without per-model meta lookups (0 = free row)
+        self.n_series_host = np.zeros(capacity, np.int64)
+        self._free: List[int] = list(range(capacity - 2, -1, -1))
+        dt = self.dtype
+
+        def _place(host_arr):
+            if mesh is None:
+                return jax.device_put(host_arr)
+            return jax.device_put(
+                host_arr, batch_sharding(mesh, host_arr.ndim)
+            )
+
+        self._mean = _place(np.zeros((capacity, s_pad), dt))
+        self._fac = _place(np.broadcast_to(
+            np.eye(s_pad, dtype=dt), (capacity, s_pad, s_pad)
+        ).copy())
+        self._t_seen = _place(np.zeros(capacity, np.int32))
+        self._version = _place(np.zeros(capacity, np.int32))
+        phi0, q0, z0, r0 = _identity_row_ss(self.bucket, self.dtype.str)
+        self._phi = _place(np.broadcast_to(
+            phi0, (capacity, s_pad)).copy())
+        self._q = _place(np.broadcast_to(
+            q0, (capacity, s_pad, s_pad)).copy())
+        self._z = _place(np.broadcast_to(
+            z0, (capacity, n_pad, s_pad)).copy())
+        self._r = _place(np.broadcast_to(r0, (capacity, n_pad)).copy())
+
+    # -- row bookkeeping ------------------------------------------------
+    @property
+    def free_rows(self) -> int:
+        with self.lock:
+            return len(self._free)
+
+    @property
+    def occupied_rows(self) -> int:
+        with self.lock:  # the scratch row is neither free nor occupied
+            return self.capacity - 1 - len(self._free)
+
+    @property
+    def lost(self) -> bool:
+        return self._lost
+
+    def alloc(self) -> Optional[int]:
+        """Take a free row (``None`` when the arena is full — the
+        caller evicts and retries)."""
+        with self.lock:
+            return self._free.pop() if self._free else None
+
+    def _check(self) -> None:
+        if self._lost:
+            raise ArenaLostError(
+                f"arena {self.bucket} lost its device buffers (a "
+                "donating update failed mid-flight); rows must be "
+                "re-packed from last-good states"
+            )
+
+    # -- device access (donation discipline lives HERE) -----------------
+    def _dynamic(self):
+        return (self._mean, self._fac, self._t_seen, self._version)
+
+    def _static(self):
+        return (self._phi, self._q, self._z, self._r)
+
+    def apply(self, fn, *args):
+        """Run a donating update kernel ``fn(dynamic, static, *args)``
+        against this arena's leaves and swap in the new dynamic leaves
+        it returns as its first output; the remaining outputs are
+        returned.  See the class docstring for the donation contract.
+        """
+        with self.lock:
+            self._check()
+            try:
+                out = fn(self._dynamic(), self._static(), *args)
+                (self._mean, self._fac, self._t_seen, self._version) = out[0]
+            except BaseException:
+                # the donated leaves may or may not have been consumed:
+                # either way they can no longer be trusted as the
+                # arena's contents
+                self._lost = True
+                raise
+            return out[1:]
+
+    def query(self, fn, *args):
+        """Run a read-only kernel ``fn(mean, fac, static, *args)``
+        under the arena lock (so it can never race a donating swap)."""
+        with self.lock:
+            self._check()
+            return fn(self._mean, self._fac, self._static(), *args)
+
+    def commit_rows(self, rows, ok, k: int) -> None:
+        """Advance the host mirrors for the rows a dispatch committed
+        (``ok`` per-row flags from the kernel's integrity gate)."""
+        rows = np.asarray(rows, np.int64)
+        good = rows[np.asarray(ok, bool)]
+        with self.lock:
+            self.t_seen_host[good] += int(k)
+            self.version_host[good] += 1
+            self.dirty[good] = True
+
+    # -- pack / unpack ---------------------------------------------------
+    def write_row(self, row: int, state: PosteriorState) -> None:
+        """(Re)pack one model's state into ``row`` — padded exactly
+        like ``stack_bucket`` pads a dict-registry dispatch, the
+        state-space matrices built ONCE here (same vmapped
+        ``dfm_statespace`` body the dict path runs per dispatch, so
+        the two paths serve from identical matrices), everything
+        scattered in place by the donating row writer."""
+        from .engine import _build_statespace, pad_state_arrays
+
+        global _ARENA_WRITE
+        a_sdf, a_cdf, lds, mean, cov, chol = pad_state_arrays(
+            state, self.bucket, self.dtype, sqrt=self.sqrt
+        )
+        fac = chol if self.sqrt else cov
+        ss = _build_statespace(
+            a_sdf[None], a_cdf[None], lds[None],
+            np.asarray([state.dt], self.dtype),
+        )
+        vals = (
+            mean, fac,
+            np.int32(state.t_seen), np.int32(state.version),
+            ss.phi[0], ss.q[0], ss.z[0], ss.r[0],
+        )
+        with self.lock:
+            self._check()
+            if _ARENA_WRITE is None:
+                _ARENA_WRITE = _arena_write_fn()
+            leaves = self._dynamic() + self._static()
+            try:
+                new = _ARENA_WRITE(leaves, np.int32(row), vals)
+            except BaseException:
+                self._lost = True
+                raise
+            (self._mean, self._fac, self._t_seen, self._version) = new[:4]
+            (self._phi, self._q, self._z, self._r) = new[4:]
+            self.t_seen_host[row] = int(state.t_seen)
+            self.version_host[row] = int(state.version)
+            self.dirty[row] = False
+            n = state.n_series
+            self.scaler_mean[row, :] = 0.0
+            self.scaler_std[row, :] = 1.0
+            self.scaler_mean[row, :n] = np.asarray(state.scaler_mean)
+            self.scaler_std[row, :n] = np.asarray(state.scaler_std)
+            self.n_series_host[row] = n
+
+    def read_row(self, row: int) -> Tuple[np.ndarray, np.ndarray, int, int]:
+        """One row's dynamic values back on the host:
+        ``(mean (S,), fac (S, S), t_seen, version)`` — the cold path
+        (eviction, spill, ``registry.get`` materialization)."""
+        with self.lock:
+            self._check()
+            mean = np.asarray(self._mean[row])
+            fac = np.asarray(self._fac[row])
+            return (
+                mean, fac,
+                int(self.t_seen_host[row]), int(self.version_host[row]),
+            )
+
+    def read_rows(self, rows) -> Tuple[np.ndarray, np.ndarray]:
+        """Bulk device→host gather of several rows' ``(mean, fac)``
+        (ONE transfer per leaf instead of one per row) — the spill /
+        checkpoint path at fleet size."""
+        rows = np.asarray(rows, np.int64)
+        with self.lock:
+            self._check()
+            return (
+                np.asarray(self._mean[rows]), np.asarray(self._fac[rows])
+            )
+
+    def materialize_values(
+        self, mean: np.ndarray, fac: np.ndarray, row: int,
+        meta: ModelMeta,
+    ) -> PosteriorState:
+        """Assemble one row's :class:`PosteriorState` from already-
+        fetched padded values (see :meth:`read_rows`) plus the host
+        mirrors/metadata — slicing the true slots out of the padded
+        layout."""
+        from .engine import state_slot_index
+
+        n_pad = self.bucket[0]
+        idx = state_slot_index(meta.n_series, meta.n_factors, n_pad)
+        sub = fac[np.ix_(idx, idx)]
+        if self.sqrt:
+            chol = sub
+            cov = chol @ chol.T
+        else:
+            chol = None
+            cov = sub
+        with self.lock:
+            t_seen = int(self.t_seen_host[row])
+            version = int(self.version_host[row])
+        return PosteriorState(
+            model_id=meta.model_id,
+            version=version,
+            t_seen=t_seen,
+            mean=mean[idx],
+            cov=cov,
+            params=meta.params,
+            loadings=meta.loadings,
+            dt=meta.dt,
+            scaler_mean=meta.scaler_mean,
+            scaler_std=meta.scaler_std,
+            names=meta.names,
+            chol=chol,
+        )
+
+    def materialize(self, row: int, meta: ModelMeta) -> PosteriorState:
+        """Reconstruct the full :class:`PosteriorState` of the model in
+        ``row`` (slicing its true slots out of the padded layout)."""
+        mean, fac, _, _ = self.read_row(row)
+        return self.materialize_values(mean, fac, row, meta)
+
+    def clear_row(self, row: int) -> None:
+        """Reset ``row`` to the padded-slot identity values and return
+        it to the free list (eviction's last step)."""
+        global _ARENA_WRITE
+        n_pad, s_pad = self.bucket
+        dt = self.dtype
+        phi0, q0, z0, r0 = _identity_row_ss(self.bucket, dt.str)
+        vals = (
+            np.zeros(s_pad, dt), np.eye(s_pad, dtype=dt),
+            np.int32(0), np.int32(0),
+            phi0, q0, z0, r0,
+        )
+        with self.lock:
+            self._check()
+            if _ARENA_WRITE is None:
+                _ARENA_WRITE = _arena_write_fn()
+            leaves = self._dynamic() + self._static()
+            try:
+                new = _ARENA_WRITE(leaves, np.int32(row), vals)
+            except BaseException:
+                self._lost = True
+                raise
+            (self._mean, self._fac, self._t_seen, self._version) = new[:4]
+            (self._phi, self._q, self._z, self._r) = new[4:]
+            self.t_seen_host[row] = 0
+            self.version_host[row] = 0
+            self.dirty[row] = False
+            self.scaler_mean[row, :] = 0.0
+            self.scaler_std[row, :] = 1.0
+            self.n_series_host[row] = 0
+            self._free.append(int(row))
+
+
 __all__ = [
     "STATE_FORMAT_VERSION",
+    "ArenaLostError",
+    "ModelMeta",
     "PosteriorState",
+    "StateArena",
     "posterior_state_from_metran",
     "posterior_states_from_fleet",
 ]
