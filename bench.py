@@ -1167,7 +1167,10 @@ def run_serve_bench(out_path: str, budget_s: float) -> dict:
     return out
 
 
-def run_serve_load_bench(out_path: str, budget_s: float) -> dict:
+def run_serve_load_bench(out_path: str, budget_s: float,
+                         rps: "float | None" = None,
+                         read_fraction: "float | None" = None,
+                         cached_rps: "float | None" = None) -> dict:
     """Open-loop load generator against the arena serving path.
 
     Mixed read/write traffic at a FIXED arrival rate (open loop: the
@@ -1176,7 +1179,24 @@ def run_serve_load_bench(out_path: str, budget_s: float) -> dict:
     unlike closed-loop benchmarks whose arrival rate collapses to the
     service rate).  Each request's latency is measured from its
     *scheduled* arrival instant to future resolution and reported as
-    p50/p99/p999 against a stated SLO.
+    p50/p99/p999 plus the SLO-violation fraction against a stated SLO.
+
+    Two sections share the discipline:
+
+    - **dispatch** (the PR-6 path): every request rides the
+      micro-batcher to a device dispatch, at ``--rps`` total arrivals;
+    - **cached** (the materialized read path, ``serve.readpath``):
+      reads are snapshot hits served from host memory at
+      ``--cached-rps * read_fraction`` arrivals on the generator
+      thread, while a writer thread sustains the remaining write
+      fraction as arena fleet ticks whose commits republish the
+      snapshots — the read-dominated regime the cache exists for,
+      measured with hit-rate and fallback counts.
+
+    ``--rps``/``--read-fraction``/``--cached-rps`` (CLI) or the
+    ``METRAN_TPU_BENCH_LOAD_RPS``/``METRAN_TPU_BENCH_READ_FRACTION``/
+    ``METRAN_TPU_BENCH_CACHED_RPS`` env knobs make the regime
+    reproducible from the command line.
     """
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", JAX_CACHE + "-cpu")
     import threading
@@ -1190,13 +1210,31 @@ def run_serve_load_bench(out_path: str, budget_s: float) -> dict:
     )
 
     n_models, n, k_fct, t_hist = 64, 8, 1, 200
-    rate_rps = float(os.environ.get("METRAN_TPU_BENCH_LOAD_RPS", "400"))
+    rate_rps = float(
+        rps if rps is not None
+        else os.environ.get("METRAN_TPU_BENCH_LOAD_RPS", "400")
+    )
+    read_frac = float(
+        read_fraction if read_fraction is not None
+        else os.environ.get("METRAN_TPU_BENCH_READ_FRACTION", "0.9")
+    )
+    cached_total_rps = float(
+        cached_rps if cached_rps is not None
+        else os.environ.get("METRAN_TPU_BENCH_CACHED_RPS", "120000")
+    )
     duration_s = 15.0
-    write_frac = 0.1
+    cached_duration_s = 6.0
+    write_frac = 1.0 - read_frac
     slo_p99_ms = 50.0
+    slo_cached_read_p99_ms = 1.0
     steps = 14
     if os.environ.get("METRAN_TPU_BENCH_SMALL"):
-        n_models, t_hist, rate_rps, duration_s = 16, 60, 100.0, 4.0
+        n_models, t_hist, duration_s = 16, 60, 4.0
+        cached_duration_s = 2.0
+        if rps is None:
+            rate_rps = 100.0
+        if cached_rps is None:
+            cached_total_rps = 30000.0
     deadline = time.monotonic() + budget_s
     out = {
         "platform": jax.default_backend(),
@@ -1204,6 +1242,7 @@ def run_serve_load_bench(out_path: str, budget_s: float) -> dict:
         "n_models": n_models,
         "rate_rps": rate_rps,
         "duration_s": duration_s,
+        "read_fraction": read_frac,
         "write_frac": write_frac,
         "slo_p99_ms": slo_p99_ms,
     }
@@ -1313,8 +1352,8 @@ def run_serve_load_bench(out_path: str, budget_s: float) -> dict:
         time.sleep(0.05)
     wall = time.monotonic() - t_start
 
-    def _pcts(xs):
-        if not xs:
+    def _pcts(xs, slo_ms=None):
+        if len(xs) == 0:
             return {}
         arr = np.sort(np.asarray(xs))
 
@@ -1323,18 +1362,27 @@ def run_serve_load_bench(out_path: str, budget_s: float) -> dict:
                 1e3 * float(arr[min(int(q * len(arr)), len(arr) - 1)]), 3
             )
 
-        return {
+        res = {
             "n": len(arr), "p50_ms": pct(0.50), "p99_ms": pct(0.99),
             "p999_ms": pct(0.999), "max_ms": round(1e3 * arr[-1], 3),
         }
+        if slo_ms is not None:
+            # the fraction of requests over the SLO — the quantity an
+            # error budget is written against (a single p99 number
+            # cannot say HOW MUCH of the traffic violated)
+            res["slo_ms"] = slo_ms
+            res["slo_violation_fraction"] = round(
+                float(np.count_nonzero(arr > slo_ms / 1e3)) / len(arr), 6
+            )
+        return res
 
     out["requests"] = n_requests
     out["achieved_rps"] = round((n_requests - failures[0]) / wall, 1)
     out["failures"] = failures[0]
     out["generator_max_behind_s"] = round(behind_max, 4)
-    out["read"] = _pcts(read_lat)
-    out["write"] = _pcts(write_lat)
-    p99_all = _pcts(read_lat + write_lat)
+    out["read"] = _pcts(read_lat, slo_ms=slo_p99_ms)
+    out["write"] = _pcts(write_lat, slo_ms=slo_p99_ms)
+    p99_all = _pcts(read_lat + write_lat, slo_ms=slo_p99_ms)
     out["overall"] = p99_all
     out["slo_met"] = bool(
         p99_all and p99_all["p99_ms"] <= slo_p99_ms and not failures[0]
@@ -1344,7 +1392,199 @@ def run_serve_load_bench(out_path: str, budget_s: float) -> dict:
     svc.close()
     progress(
         "serve_load", rps=out["achieved_rps"],
-        p99_ms=p99_all.get("p99_ms"), slo_met=out["slo_met"],
+        p99_ms=p99_all.get("p99_ms"),
+        p999_ms=p99_all.get("p999_ms"),
+        slo_violation=p99_all.get("slo_violation_fraction"),
+        slo_met=out["slo_met"],
+    )
+    write_partial(out_path, out)
+
+    # ------------------------------------------------------------------
+    # cached section: the materialized read path under the same
+    # open-loop discipline.  Reads are snapshot hits (lock-free host
+    # memory, no batcher/device) generated at a fixed rate on this
+    # thread; a writer thread sustains the write fraction as arena
+    # fleet ticks (`update_batch`) whose commits republish every
+    # written model's snapshot — so reads keep hitting at full version
+    # freshness while the posterior actually moves.
+    # ------------------------------------------------------------------
+    import gc
+    import sys
+
+    read_rps = cached_total_rps * read_frac
+    write_rps = max(cached_total_rps - read_rps, 1.0)
+    tick_w = min(n_models, 32)
+    tick_interval = tick_w / write_rps
+    cached_duration_s = min(
+        cached_duration_s, max(deadline - time.monotonic() - 20, 1.0)
+    )
+    n_reads = int(read_rps * cached_duration_s)
+
+    reg_c = ModelRegistry(root=None, arena=True, arena_rows=n_models)
+    for i in range(n_models):
+        reg_c.put(PosteriorState(
+            model_id=f"m{i}", version=0, t_seen=t_hist,
+            mean=means[i], cov=covs[i],
+            params=np.concatenate([alpha_sdf[i], alpha_cdf[i]]),
+            loadings=loadings[i], dt=1.0,
+            scaler_mean=np.zeros(n), scaler_std=np.ones(n),
+            names=tuple(f"s{j}" for j in range(n)),
+        ), persist=False)
+    svc_c = MetranService(
+        reg_c, flush_deadline=None, persist_updates=False,
+        readpath=True, horizons=f"1-{steps}",
+    )
+    ids = [f"m{i}" for i in range(n_models)]
+    # one warm tick compiles the fused kernel and publishes every
+    # model's snapshot; a second with a different width warms the
+    # writer's tick shape
+    svc_c.update_batch(ids, rng.normal(size=(n_models, 1, n)))
+    svc_c.update_batch(ids[:tick_w], rng.normal(size=(tick_w, 1, n)))
+    progress("serve_load_cached_warm")
+
+    stop = threading.Event()
+    tick_lat: list = []
+    writes_done = [0]
+
+    def writer():
+        wrng = np.random.default_rng(99)
+        j = 0
+        nxt = time.monotonic()
+        while not stop.is_set():
+            nxt += tick_interval
+            d = nxt - time.monotonic()
+            if d > 0:
+                time.sleep(d)
+            sel = [ids[(j + x) % n_models] for x in range(tick_w)]
+            j = (j + tick_w) % n_models
+            t0 = time.monotonic()
+            svc_c.update_batch(sel, wrng.normal(size=(tick_w, 1, n)))
+            tick_lat.append(time.monotonic() - t0)
+            writes_done[0] += tick_w
+
+    rng_t = np.random.default_rng(5)
+    rid = [ids[t] for t in rng_t.integers(0, n_models, size=n_reads)]
+    lat = np.empty(n_reads)
+    fc = svc_c.forecast
+    mono = time.monotonic
+    inv = 1.0 / read_rps
+    store = svc_c.readpath
+    # microsecond-scale reads: shrink the GIL switch interval so the
+    # writer thread's host phases cannot hold readers for the default
+    # 5 ms, and keep the collector out of the measurement
+    old_si = sys.getswitchinterval()
+    sys.setswitchinterval(2e-4)
+    gc_was = gc.isenabled()
+    gc.disable()
+
+    def read_loop(n: int, sink: np.ndarray) -> float:
+        t_start = mono() + 0.02
+        for i in range(n):
+            scheduled = t_start + i * inv
+            now = mono()
+            if now < scheduled:
+                d = scheduled - now
+                if d > 1e-3:
+                    time.sleep(d - 5e-4)
+                while mono() < scheduled:
+                    pass
+            fc(rid[i], steps)
+            sink[i] = mono() - scheduled
+        return mono() - t_start
+
+    wt = None
+    try:
+        # read-only leg first: the cached path at the TARGET read rate
+        # with no concurrent writes — the cache's intrinsic capability,
+        # separated from single-core read/write CPU contention (the
+        # mixed leg below measures that contention honestly)
+        n_ro = min(n_reads, int(read_rps * 1.5))
+        lat_ro = np.empty(n_ro)
+        h0, m0, s0 = store.hits, store.misses, store.stale
+        wall_ro = read_loop(n_ro, lat_ro)
+        ro_stats = _pcts(lat_ro, slo_ms=slo_cached_read_p99_ms)
+        ro_cache = (store.hits - h0, store.misses - m0, store.stale - s0)
+        # mixed leg: writer ticks running concurrently
+        h0, m0, s0 = store.hits, store.misses, store.stale
+        wt = threading.Thread(target=writer, daemon=True)
+        wt.start()
+        wall_c = read_loop(n_reads, lat)
+    finally:
+        stop.set()
+        if wt is not None:
+            wt.join(timeout=10.0)
+        sys.setswitchinterval(old_si)
+        if gc_was:
+            gc.enable()
+    dh = store.hits - h0
+    dm = store.misses - m0
+    ds = store.stale - s0
+    read_stats = _pcts(lat, slo_ms=slo_cached_read_p99_ms)
+    cached = {
+        "mode": "arena + materialized readpath (snapshot hits)",
+        "horizons": f"1-{steps}",
+        "cpus": os.cpu_count(),
+        "target_total_rps": cached_total_rps,
+        "read_fraction": read_frac,
+        "read_only": {
+            # no concurrent writes: the read path's own capability at
+            # the target arrival rate
+            "reads": n_ro,
+            "achieved_read_rps": round(n_ro / wall_ro, 1),
+            "read": ro_stats,
+            "cache": {
+                "hits": ro_cache[0], "misses": ro_cache[1],
+                "stale": ro_cache[2],
+                "fallbacks": ro_cache[1] + ro_cache[2],
+                "hit_rate": round(
+                    ro_cache[0] / max(sum(ro_cache), 1), 6
+                ),
+            },
+            "slo_met": bool(
+                ro_stats
+                and ro_stats["p99_ms"] <= slo_cached_read_p99_ms
+            ),
+        },
+        "duration_s": round(wall_c, 3),
+        "reads": n_reads,
+        "achieved_read_rps": round(n_reads / wall_c, 1),
+        "writes": writes_done[0],
+        "achieved_write_rps": round(writes_done[0] / wall_c, 1),
+        "write_tick": {
+            "size": tick_w,
+            **{k: v for k, v in _pcts(tick_lat).items()
+               if k in ("n", "p50_ms", "p99_ms", "max_ms")},
+        },
+        "read": read_stats,
+        "cache": {
+            "hits": dh, "misses": dm, "stale": ds,
+            "fallbacks": dm + ds,
+            "hit_rate": round(dh / max(dh + dm + ds, 1), 6),
+        },
+        "slo_read_p99_ms": slo_cached_read_p99_ms,
+        "slo_met": bool(
+            read_stats
+            and read_stats["p99_ms"] <= slo_cached_read_p99_ms
+        ),
+    }
+    out["cached"] = cached
+    out["cached_stats"] = store.stats()
+    svc_c.close()
+    progress(
+        "serve_load_cached_readonly",
+        read_rps=cached["read_only"]["achieved_read_rps"],
+        p99_ms=ro_stats.get("p99_ms"),
+        p999_ms=ro_stats.get("p999_ms"),
+        slo_met=cached["read_only"]["slo_met"],
+    )
+    progress(
+        "serve_load_cached",
+        read_rps=cached["achieved_read_rps"],
+        p99_ms=read_stats.get("p99_ms"),
+        p999_ms=read_stats.get("p999_ms"),
+        slo_violation=read_stats.get("slo_violation_fraction"),
+        hit_rate=cached["cache"]["hit_rate"],
+        slo_met=cached["slo_met"],
     )
     write_partial(out_path, out)
     return out
@@ -1864,6 +2104,89 @@ def run_obs_bench(out_path: str, budget_s: float) -> dict:
     }
     progress("obs_overhead", **out["overhead"])
     write_partial(out_path, out)
+
+    # ------------------------------------------------------------------
+    # cached-read path (serve.readpath): full instrumentation vs
+    # disabled on SNAPSHOT HITS.  The cached read is a ~2µs host-memory
+    # path with no span/breaker/batcher (the short-circuit in
+    # forecast/forecast_async), and its cache counters are callback
+    # gauges read at scrape time — so the 5% bar must hold with huge
+    # margin here, and this measures that it does.
+    # ------------------------------------------------------------------
+    cr_reads = 2000 if os.environ.get("METRAN_TPU_BENCH_SMALL") else 20000
+    cr_rounds = 5 if os.environ.get("METRAN_TPU_BENCH_SMALL") else 15
+
+    def make_cached_service(bundle):
+        reg = ModelRegistry(root=None, arena=True, arena_rows=n_models)
+        for i in range(n_models):
+            reg.put(PosteriorState(
+                model_id=f"m{i}", version=0, t_seen=t_hist,
+                mean=means[i], cov=covs[i],
+                params=np.concatenate([alpha_sdf[i], alpha_cdf[i]]),
+                loadings=loadings[i], dt=1.0,
+                scaler_mean=np.zeros(n), scaler_std=np.ones(n),
+                names=tuple(f"s{j}" for j in range(n)),
+            ), persist=False)
+        svc = MetranService(
+            reg, flush_deadline=None, persist_updates=False,
+            observability=bundle, readpath=True, horizons=f"1-{steps}",
+        )
+        # one bulk tick publishes every model's snapshot
+        svc.update_batch(
+            [f"m{i}" for i in range(n_models)],
+            np.broadcast_to(new_obs, (n_models, 1, n)),
+        )
+        return svc
+
+    cached_svcs = {
+        "off": make_cached_service(Observability.disabled()),
+        "on": make_cached_service(Observability(
+            metrics=MetricsRegistry(), tracer=Tracer(), events=EventLog(),
+        )),
+    }
+
+    def cached_lap(svc) -> float:
+        fcf = svc.forecast
+        t0 = time.perf_counter()
+        for i in range(cr_reads):
+            fcf(f"m{i % n_models}", steps)
+        return time.perf_counter() - t0
+
+    for svc in cached_svcs.values():  # warm
+        cached_lap(svc)
+    cr_ratios, cr_laps = [], {"off": [], "on": []}
+    for r in range(cr_rounds):
+        if time.monotonic() > deadline - 5:
+            break
+        order = ("off", "on") if r % 2 == 0 else ("on", "off")
+        pair = {mode: cached_lap(cached_svcs[mode]) for mode in order}
+        for mode, dt in pair.items():
+            cr_laps[mode].append(dt)
+        cr_ratios.append(pair["on"] / pair["off"])
+    cr_ratio = float(np.median(cr_ratios)) if cr_ratios else 1.0
+    out["cached_read"] = {
+        "reads_per_lap": cr_reads,
+        "off_reads_per_s": (
+            round(cr_reads / float(np.median(cr_laps["off"])), 1)
+            if cr_laps["off"] else 0.0
+        ),
+        "on_reads_per_s": (
+            round(cr_reads / float(np.median(cr_laps["on"])), 1)
+            if cr_laps["on"] else 0.0
+        ),
+        "hits_on": cached_svcs["on"].readpath.hits,
+        # positive = instrumentation costs cached-read throughput;
+        # the bar is the same 5% the dispatch path carries
+        "overhead_pct": round(100.0 * (1.0 - 1.0 / cr_ratio), 2),
+    }
+    for svc in cached_svcs.values():
+        svc.close()
+    progress(
+        "obs_cached_read",
+        on_reads_per_s=out["cached_read"]["on_reads_per_s"],
+        overhead_pct=out["cached_read"]["overhead_pct"],
+    )
+    write_partial(out_path, out)
     return out
 
 
@@ -2350,6 +2673,24 @@ if __name__ == "__main__":
                                  "obs", "robust-obs"])
     parser.add_argument("--out", default=None)
     parser.add_argument("--budget", type=float, default=900.0)
+    parser.add_argument(
+        "--rps", type=float, default=None,
+        help="serve-load: total open-loop arrival rate of the "
+             "dispatch section (default 400, env "
+             "METRAN_TPU_BENCH_LOAD_RPS)",
+    )
+    parser.add_argument(
+        "--read-fraction", type=float, default=None,
+        help="serve-load: fraction of requests that are forecast "
+             "reads in both sections (default 0.9, env "
+             "METRAN_TPU_BENCH_READ_FRACTION)",
+    )
+    parser.add_argument(
+        "--cached-rps", type=float, default=None,
+        help="serve-load: total arrival rate of the cached "
+             "(materialized read path) section (default 120000, env "
+             "METRAN_TPU_BENCH_CACHED_RPS)",
+    )
     args = parser.parse_args()
     if args.phase == "main":
         main()
@@ -2376,17 +2717,24 @@ if __name__ == "__main__":
             CACHE_DIR, "bench_serve_load.json"
         )
         os.makedirs(CACHE_DIR, exist_ok=True)
-        sl_out = run_serve_load_bench(out_path, args.budget)
+        sl_out = run_serve_load_bench(
+            out_path, args.budget, rps=args.rps,
+            read_fraction=args.read_fraction, cached_rps=args.cached_rps,
+        )
         if args.out is None:
             # standalone run: emit the BENCH_r* result-line schema with
-            # the SLO headline (overall p99 at the stated arrival rate)
+            # the cached-read headline (the scale number this phase
+            # exists to measure); the dispatch-path SLO rides in detail
+            cached = sl_out.get("cached") or {}
             print(json.dumps({
                 "metric": (
-                    f"serve p99 latency at {sl_out.get('rate_rps')} "
-                    "req/s open-loop (mixed read/write)"
+                    "cached forecast reads/s (materialized read path, "
+                    f"{sl_out.get('read_fraction')} read fraction, "
+                    f"read p99 {(cached.get('read') or {}).get('p99_ms')}"
+                    " ms)"
                 ),
-                "value": (sl_out.get("overall") or {}).get("p99_ms", 0.0),
-                "unit": "ms", "vs_baseline": 0.0,
+                "value": cached.get("achieved_read_rps", 0.0),
+                "unit": "reads/s", "vs_baseline": 0.0,
                 "detail": sl_out,
             }), flush=True)
     elif args.phase == "serve-faults":

@@ -137,6 +137,15 @@ SERVE_ARENA = 0  # 1 = serve from device-resident sharded state arenas
 SERVE_ARENA_ROWS = 1024  # per-bucket arena capacity (rows preallocated)
 SERVE_ARENA_MESH = 0  # devices to shard each arena across (0 = single
 #                       device / no mesh; -1 = every visible device)
+# materialized forecast read path (docs/concepts.md "Read path &
+# caching").  OFF by default like the arena: the cache trades update
+# cost (one fused horizon pass per commit) for lock-free µs-scale
+# reads, and arming it is a deployment decision.  Results are
+# bit-identical to the compute path at matching version (f64), so the
+# switch changes economics, not answers.
+SERVE_READPATH = 0  # 1 = serve forecasts from commit-time snapshots
+SERVE_HORIZONS = "1-30"  # horizon set precomputed at commit time
+#                          ("1-30", "1,7,30", "1-14,30" all parse)
 # observation-gate defaults (statistical input robustness; see
 # docs/concepts.md "Input robustness").  The gate ships OFF: arming it
 # is a per-deployment calibration decision (nsigma trades false
@@ -219,6 +228,12 @@ def serve_defaults() -> dict:
         ),
         "arena_mesh": _env(
             "METRAN_TPU_SERVE_ARENA_MESH", int, SERVE_ARENA_MESH
+        ),
+        "readpath": _env(
+            "METRAN_TPU_SERVE_READPATH", int, SERVE_READPATH
+        ),
+        "horizons": _env(
+            "METRAN_TPU_SERVE_HORIZONS", str, SERVE_HORIZONS
         ),
         "gate_policy": _env(
             "METRAN_TPU_SERVE_GATE_POLICY", str, SERVE_GATE_POLICY

@@ -65,6 +65,7 @@ EVENT_KINDS = (
     "empty_update",
     "arena_load",
     "arena_spill",
+    "snapshot_publish",
 )
 
 

@@ -91,3 +91,28 @@ def _forecast_from_filtered(ss, mean_f_last, cov_f_last, steps: int):
     return forecast_observation_moments(
         ss, mean_f_last, cov_f_last, horizons
     )
+
+
+@functools.partial(jax.jit, static_argnames=("sqrt",))
+def forecast_horizons(
+    ss: StateSpace, mean_last: jnp.ndarray, fac_last: jnp.ndarray,
+    horizons: jnp.ndarray, sqrt: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The commit-time horizon pass of the materialized read path.
+
+    Predictive observation means/variances (H, N) at an **arbitrary
+    horizon set** from either posterior carry form: ``fac_last`` is the
+    filtered covariance (``sqrt=False``) or its Cholesky factor
+    (``sqrt=True``, reconstituted here — the one ``chol chol'`` a
+    square-root serving path pays per *commit* instead of per read).
+    Fused into the serving update kernels (``serve.engine.
+    make_update_fn``/``make_arena_update_fn(horizons=...)``) this runs
+    in the same dispatch that commits the posterior, so a snapshot read
+    path (``serve.readpath``) can answer forecasts without any device
+    work; the moments are exactly :func:`forecast_observation_moments`
+    of the committed posterior — per-horizon rows are independent, so
+    the first ``s`` rows of a ``1..H`` set equal a ``steps=s`` compute
+    call's output.
+    """
+    cov = fac_last @ fac_last.T if sqrt else fac_last
+    return forecast_observation_moments(ss, mean_last, cov, horizons)

@@ -16,6 +16,10 @@ refilters history:
 - :mod:`~metran_tpu.serve.batching` — :class:`MicroBatcher`: deadline/
   size-bounded coalescing of concurrent requests into single device
   dispatches;
+- :mod:`~metran_tpu.serve.readpath` — :class:`SnapshotStore`: the
+  materialized forecast read path — commit-time precomputed horizon
+  moments served lock-free from immutable versioned snapshots
+  (``METRAN_TPU_SERVE_READPATH``);
 - :mod:`~metran_tpu.serve.service` — :class:`MetranService`, the
   in-process ``update``/``forecast`` API with latency and occupancy
   telemetry, hard request deadlines, per-model circuit breakers, and
@@ -41,6 +45,12 @@ from .engine import (
     stack_bucket,
     update_bucket,
 )
+from .readpath import (
+    ForecastSnapshot,
+    SnapshotEntry,
+    SnapshotStore,
+    parse_horizons,
+)
 from .registry import CompiledFnCache, ModelRegistry
 from .service import ArenaUpdateAck, Forecast, MetranService, ServeMetrics
 from .state import (
@@ -60,6 +70,7 @@ __all__ = [
     "CompiledFnCache",
     "DeadlineExceededError",
     "Forecast",
+    "ForecastSnapshot",
     "GateSpec",
     "MetranService",
     "MicroBatcher",
@@ -67,11 +78,14 @@ __all__ = [
     "ModelRegistry",
     "PosteriorState",
     "ServeMetrics",
+    "SnapshotEntry",
+    "SnapshotStore",
     "StateArena",
     "StateIntegrityError",
     "forecast_bucket",
     "make_arena_forecast_fn",
     "make_arena_update_fn",
+    "parse_horizons",
     "posterior_fault",
     "posterior_state_from_metran",
     "posterior_states_from_fleet",

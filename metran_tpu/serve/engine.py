@@ -29,6 +29,7 @@ import numpy as np
 from ..ops import (
     GATE_POLICIES,
     filter_append,
+    forecast_horizons,
     forecast_observation_moments,
     gated_filter_append,
     gated_sqrt_filter_append,
@@ -300,7 +301,20 @@ def _annotated(fn, name: str):
     return annotated
 
 
-def make_update_fn(engine: str = "joint", gate: Optional[GateSpec] = None):
+def _horizon_pass(ss, mean_t, fac_t, horizons: Tuple[int, ...],
+                  sqrt_engine: bool):
+    """The fused commit-time forecast pass: batched
+    :func:`~metran_tpu.ops.forecast_horizons` of the just-committed
+    posteriors, (B, H, N) means/variances in the same dispatch —
+    what the materialized read path (``serve.readpath``) serves."""
+    hz = jnp.asarray(horizons)
+    return jax.vmap(
+        lambda s, m, c: forecast_horizons(s, m, c, hz, sqrt=sqrt_engine)
+    )(ss, mean_t, fac_t)
+
+
+def make_update_fn(engine: str = "joint", gate: Optional[GateSpec] = None,
+                   horizons: Optional[Tuple[int, ...]] = None):
     """A fresh jitted batched incremental-update kernel.
 
     ``fn(ss, mean, cov, y_new, mask_new) -> (mean_T, cov_T, sigma,
@@ -325,49 +339,63 @@ def make_update_fn(engine: str = "joint", gate: Optional[GateSpec] = None):
     sequential-processing — a ``joint``-engine registry arming the
     gate serves updates through the gated *sequential* kernel (the
     gate is a per-slot test; posteriors agree to float tolerance).
+
+    With a non-empty ``horizons`` tuple (the materialized read path,
+    ``serve.readpath``), the kernel additionally runs the fused
+    :func:`~metran_tpu.ops.forecast_horizons` pass over the NEW
+    posteriors and returns ``(fmeans, fvars)`` ((B, H, N) each,
+    standardized units) appended after every other output — the
+    commit-time precompute, one extra closed-form pass amortized
+    across the batch, no second kernel launch.
     """
-    if gate is not None and gate.enabled:
+    sqrt_engine = engine in ("sqrt", "sqrt_parallel")
+    gated = gate is not None and gate.enabled
+    if gated:
         gate.validate()
         policy, nsigma = gate.policy, float(gate.nsigma)
-        if engine in ("sqrt", "sqrt_parallel"):
+        if sqrt_engine:
 
-            @jax.jit
-            def fn(ss, mean, chol, y_new, mask_new, armed):
+            def core(ss, mean, chol, y_new, mask_new, armed):
                 return jax.vmap(
                     lambda s, m, c, y, k, a: gated_sqrt_filter_append(
                         s, m, c, y, k, armed=a, policy=policy,
                         nsigma=nsigma,
                     )
                 )(ss, mean, chol, y_new, mask_new, armed)
+        else:
 
-            return _annotated(fn, UPDATE_ANNOTATION)
+            def core(ss, mean, cov, y_new, mask_new, armed):
+                return jax.vmap(
+                    lambda s, m, c, y, k, a: gated_filter_append(
+                        s, m, c, y, k, armed=a, policy=policy,
+                        nsigma=nsigma,
+                    )
+                )(ss, mean, cov, y_new, mask_new, armed)
+    elif sqrt_engine:
 
-        @jax.jit
-        def fn(ss, mean, cov, y_new, mask_new, armed):
-            return jax.vmap(
-                lambda s, m, c, y, k, a: gated_filter_append(
-                    s, m, c, y, k, armed=a, policy=policy, nsigma=nsigma
-                )
-            )(ss, mean, cov, y_new, mask_new, armed)
-
-        return _annotated(fn, UPDATE_ANNOTATION)
-    if engine in ("sqrt", "sqrt_parallel"):
-
-        @jax.jit
-        def fn(ss, mean, chol, y_new, mask_new):
+        def core(ss, mean, chol, y_new, mask_new):
             return jax.vmap(
                 lambda s, m, c, y, k: sqrt_filter_append(s, m, c, y, k)
             )(ss, mean, chol, y_new, mask_new)
+    else:
 
-        return _annotated(fn, UPDATE_ANNOTATION)
+        def core(ss, mean, cov, y_new, mask_new):
+            return jax.vmap(
+                lambda s, m, c, y, k: filter_append(
+                    s, m, c, y, k, engine=engine
+                )
+            )(ss, mean, cov, y_new, mask_new)
 
-    @jax.jit
-    def fn(ss, mean, cov, y_new, mask_new):
-        return jax.vmap(
-            lambda s, m, c, y, k: filter_append(s, m, c, y, k, engine=engine)
-        )(ss, mean, cov, y_new, mask_new)
+    if horizons:
+        hz = tuple(int(h) for h in horizons)
 
-    return _annotated(fn, UPDATE_ANNOTATION)
+        def fused(ss, mean, fac, y_new, mask_new, *extra):
+            out = core(ss, mean, fac, y_new, mask_new, *extra)
+            fm, fv = _horizon_pass(ss, out[0], out[1], hz, sqrt_engine)
+            return out + (fm, fv)
+
+        return _annotated(jax.jit(fused), UPDATE_ANNOTATION)
+    return _annotated(jax.jit(core), UPDATE_ANNOTATION)
 
 
 def make_forecast_fn(steps: int):
@@ -448,6 +476,7 @@ def _arena_posterior_ok(mean_n, fac_n, sigma, detf, sqrt_engine: bool):
 def make_arena_update_fn(
     engine: str = "joint", gate: Optional[GateSpec] = None,
     validate: bool = True,
+    horizons: Optional[Tuple[int, ...]] = None,
 ):
     """A fresh jitted **arena** assimilation kernel (in-place).
 
@@ -474,10 +503,17 @@ def make_arena_update_fn(
     verdicts after ``ok``/``sigma``/``detf``.
 
     Only ``rows``, the new observations, and the (G,)-sized outputs
-    cross the host boundary — the (B, S, S) state never does.
+    cross the host boundary — the (B, S, S) state never does.  With a
+    non-empty ``horizons`` tuple the kernel appends the fused
+    commit-time forecast pass's ``(fmeans, fvars)`` ((G, H, N),
+    standardized units) as its last outputs, computed from the
+    WRITTEN row values — a rejected row's moments therefore describe
+    its unchanged prior posterior, consistent with what the row
+    serves (``serve.readpath``).
     """
     sqrt_engine = engine in ("sqrt", "sqrt_parallel")
     gated = gate is not None and gate.enabled
+    hz = tuple(int(h) for h in horizons) if horizons else ()
     if gated:
         gate.validate()
         policy, nsigma = gate.policy, float(gate.nsigma)
@@ -537,6 +573,11 @@ def make_arena_update_fn(
             t_a.at[rows].add(bump * k),
             v_a.at[rows].add(bump),
         )
+        if hz:
+            # fused commit-time forecast of the WRITTEN values: what a
+            # read-after-commit gather would see, in the same dispatch
+            fm, fv = _horizon_pass(ss, mean_w, fac_w, hz, sqrt_engine)
+            extra = extra + (fm, fv)
         return (new_dyn, ok, sigma, detf) + extra
 
     if gated:

@@ -276,6 +276,11 @@ class ModelRegistry:
         # bind_observability — usually the owning service's log, so
         # quarantine/load events land next to breaker/retry events
         self.events = None
+        # commit observers (model_id, version) — the materialized read
+        # path's invalidation feed: a put() from ANY writer (served
+        # update, refit hot-swap, operator restore) marks the model's
+        # snapshot entries stale (serve.readpath.SnapshotStore)
+        self._commit_hooks: List[Callable[[str, int], None]] = []
 
     def bind_observability(self, metrics=None, events=None) -> None:
         """Attach this registry to an observability bundle.
@@ -356,6 +361,31 @@ class ModelRegistry:
             raise ValueError("in-memory registry has no storage root")
         return self.root / f"{self.check_model_id(model_id)}.npz"
 
+    def on_commit(self, callback: Callable[[str, int], None]) -> None:
+        """Register a ``(model_id, version)`` observer fired on every
+        :meth:`put` once the in-memory/arena state is replaced (before
+        the disk write-through — memory IS the committed state).  A
+        failing observer is logged, never raised: telemetry and cache
+        invalidation must not take down the write path."""
+        self._commit_hooks.append(callback)
+
+    def remove_commit_hook(self, callback) -> None:
+        """Unregister an :meth:`on_commit` observer (idempotent).
+        Services detach their snapshot store here on close, so a
+        long-lived registry shared across service restarts neither
+        leaks stores nor fires dead callbacks on every put."""
+        try:
+            self._commit_hooks.remove(callback)
+        except ValueError:
+            pass
+
+    def _notify_commit(self, model_id: str, version: int) -> None:
+        for cb in self._commit_hooks:
+            try:
+                cb(model_id, version)
+            except Exception:  # pragma: no cover - observer bug
+                logger.exception("commit observer failed for %r", model_id)
+
     def put(self, state: PosteriorState, persist: bool = True) -> PosteriorState:
         """Insert/replace a model's state (write-through when ``persist``
         and the registry has a root).  When the model is arena-resident,
@@ -381,6 +411,7 @@ class ModelRegistry:
                         arena.clear_row(row)
                         del self._row_map[state.model_id]
                         self._arena_lru.pop(state.model_id, None)
+        self._notify_commit(state.model_id, state.version)
         if persist and self.root is not None:
             state.save(self.path_for(state.model_id))
         return state
@@ -911,7 +942,8 @@ class ModelRegistry:
         # is [sdf * n_pad | cdf...], so n_state_pad >= n_pad always
         return (n_pad, pad_to_multiple(n_pad + state.n_factors, m))
 
-    def update_fn(self, bucket: ShapeBucket, k: int, gate=None):
+    def update_fn(self, bucket: ShapeBucket, k: int, gate=None,
+                  horizons=None):
         """Compiled assimilation kernel for ``k`` appended steps.
 
         ``gate`` (an enabled :class:`~metran_tpu.serve.engine.
@@ -919,14 +951,21 @@ class ModelRegistry:
         (policy, nsigma) joins the compile key, so flipping the gate
         policy builds a distinct executable while ``min_seen`` changes
         never recompile (that knob is the kernel's traced ``armed``
-        argument)."""
+        argument).  A non-empty ``horizons`` tuple selects the fused
+        commit-time forecast variant (``serve.readpath``) — the
+        horizon set is XLA-static, so it joins the key too."""
         from .engine import make_update_fn
 
         key = ("update", bucket, int(k), self.engine)
         if gate is not None and getattr(gate, "enabled", False):
             key = key + ("gate", gate.policy, float(gate.nsigma))
+        if horizons:
+            horizons = tuple(int(h) for h in horizons)
+            key = key + ("hz", horizons)
         return self._compiled.get_or_create(
-            key, lambda: make_update_fn(engine=self.engine, gate=gate),
+            key, lambda: make_update_fn(
+                engine=self.engine, gate=gate, horizons=horizons
+            ),
         )
 
     def forecast_fn(self, bucket: ShapeBucket, steps: int):
@@ -939,7 +978,7 @@ class ModelRegistry:
         )
 
     def arena_update_fn(self, bucket: ShapeBucket, k: int, gate=None,
-                        validate: bool = True):
+                        validate: bool = True, horizons=None):
         """Compiled arena assimilation kernel (donating, in-place) for
         ``k`` appended steps — same compile-key discipline as
         :meth:`update_fn` plus the ``validate`` bit (the on-device
@@ -950,10 +989,14 @@ class ModelRegistry:
                bool(validate))
         if gate is not None and getattr(gate, "enabled", False):
             key = key + ("gate", gate.policy, float(gate.nsigma))
+        if horizons:
+            horizons = tuple(int(h) for h in horizons)
+            key = key + ("hz", horizons)
         return self._compiled.get_or_create(
             key,
             lambda: make_arena_update_fn(
-                engine=self.engine, gate=gate, validate=validate
+                engine=self.engine, gate=gate, validate=validate,
+                horizons=horizons,
             ),
         )
 

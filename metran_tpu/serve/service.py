@@ -76,6 +76,8 @@ from ..reliability.policy import (
 from ..utils.profiling import EventCounters, LatencyRecorder, OccupancyCounter
 from .batching import MicroBatcher
 from .engine import GateSpec
+from .readpath import ForecastSnapshot, SnapshotEntry, SnapshotStore, \
+    parse_horizons
 from .registry import ModelRegistry
 from .state import PosteriorState
 
@@ -349,6 +351,23 @@ class MetranService:
         :meth:`~metran_tpu.obs.Observability.default` (metrics + event
         ring on, tracing per ``METRAN_TPU_OBS_TRACE``).  Pass
         ``Observability.disabled()`` to turn every instrument off.
+    readpath : serve forecasts from the **materialized read path**
+        (:mod:`metran_tpu.serve.readpath`; default from
+        ``serve_defaults()`` — ``METRAN_TPU_SERVE_READPATH``, shipped
+        off).  When on, every committed update runs a fused
+        commit-time horizon pass in the same dispatch and publishes
+        the de-standardized moments into a lock-free versioned
+        snapshot store; ``forecast``/``forecast_async``/
+        ``forecast_batch`` consult it first and a hit is answered in
+        microseconds with no batcher, breaker, span or device work —
+        bit-identical (f64) to the compute path at matching version.
+        A miss or stale entry falls through to the normal path, so
+        semantics are unchanged.
+    horizons : the horizon set precomputed at commit time (tuple of
+        ints or a spec string — see :func:`~metran_tpu.serve.readpath.
+        parse_horizons`; default ``METRAN_TPU_SERVE_HORIZONS``).
+        ``forecast(steps=s)`` is cacheable iff the set contains the
+        contiguous prefix ``1..s``.
     """
 
     def __init__(
@@ -360,6 +379,8 @@ class MetranService:
         reliability: Optional[ReliabilityPolicy] = None,
         observability: Optional[Observability] = None,
         gate: Optional[GateSpec] = None,
+        readpath: "bool | str" = "default",
+        horizons=None,
     ):
         from ..config import serve_defaults
 
@@ -368,6 +389,11 @@ class MetranService:
             flush_deadline = defaults["flush_deadline_s"]
         if max_batch is None:
             max_batch = defaults["max_batch"]
+        if readpath == "default":
+            readpath = bool(defaults["readpath"])
+        if horizons is None:
+            horizons = defaults["horizons"]
+        self.horizons = parse_horizons(horizons)
         self.registry = registry
         self.persist_updates = persist_updates
         # a default-constructed bundle is OURS to close (its event log
@@ -390,6 +416,14 @@ class MetranService:
         self.gate = (
             gate.validate() if gate is not None
             else GateSpec.from_defaults()
+        )
+        # materialized forecast read path (serve.readpath): commit-time
+        # snapshots served lock-free, version-checked against every
+        # registry commit; a miss/stale read falls through to the
+        # compute path below, so arming this changes economics only
+        self.readpath = (
+            SnapshotStore(self.horizons) if readpath and self.horizons
+            else None
         )
         on_transition = None
         if self.events is not None:
@@ -447,6 +481,13 @@ class MetranService:
         self.registry.bind_observability(
             metrics=self.obs.metrics, events=self.events
         )
+        if self.readpath is not None:
+            self.readpath.events = self.events
+            if self.obs.metrics is not None:
+                self.readpath.bind_metrics(self.obs.metrics)
+            # invalidation feed: ANY registry.put (served update, refit
+            # hot-swap, operator restore) marks the model's entry stale
+            self.registry.on_commit(self.readpath.note_commit)
         if self.obs.metrics is not None:
             m = self.obs.metrics
             self.monitor.bind_metrics(m)
@@ -485,13 +526,61 @@ class MetranService:
         :class:`~metran_tpu.reliability.DeadlineExceededError` rather
         than ever blocking past it.  Transient failures retry with
         backoff inside the deadline budget.
+
+        With the materialized read path armed (``readpath=True``), a
+        snapshot hit is returned HERE, before any root span, breaker
+        admission, batcher hop or device work — the lock-free
+        microsecond path.  Hits are version-checked (bit-identical to
+        the compute answer at f64) and booked in the cache telemetry;
+        they bypass the circuit breaker deliberately: a breaker
+        protects compute, and a model whose breaker is open still
+        serves its last committed forecast (degraded-but-available,
+        like ``served_last_good``).
         """
+        if self.readpath is not None and type(steps) is int:
+            entry = self.readpath.read(model_id, steps)
+            if entry is not None:
+                return self._cached_forecast(entry, steps)
+        # _forecast_async_compute, not forecast_async: the cache was
+        # consulted once above — a miss must not be double-counted
         return self._call(
             "forecast", model_id,
-            lambda: self.forecast_async(model_id, steps), deadline,
+            lambda: self._forecast_async_compute(model_id, steps),
+            deadline,
+        )
+
+    @staticmethod
+    def _cached_forecast(entry: SnapshotEntry, steps: int) -> Forecast:
+        """A snapshot hit as a :class:`Forecast` — two array views
+        (the entry's rows ARE horizons ``1..steps``, data units,
+        immutable by the store's publish contract) and the version the
+        posterior carried when the moments were computed."""
+        return Forecast(
+            means=entry.means[:steps],
+            variances=entry.variances[:steps],
+            names=entry.names,
+            version=entry.version,
         )
 
     def forecast_async(self, model_id: str, steps: int) -> "Future[Forecast]":
+        # materialized-read-path short-circuit: a snapshot hit is a
+        # pure host-memory read, so it books the hit and resolves
+        # immediately WITHOUT the attempt-level span, breaker
+        # admission or batcher machinery the compute path needs —
+        # full instrumentation on the cached path must stay under the
+        # 5% overhead bar (bench.py --phase obs), and a span per
+        # microsecond read would not
+        if self.readpath is not None and type(steps) is int:
+            entry = self.readpath.read(model_id, steps)
+            if entry is not None:
+                fut: "Future[Forecast]" = Future()
+                fut.set_result(self._cached_forecast(entry, steps))
+                return fut
+        return self._forecast_async_compute(model_id, steps)
+
+    def _forecast_async_compute(self, model_id: str, steps: int):
+        """The dispatching half of :meth:`forecast_async` (cache
+        misses, or the read path off)."""
         # attempt-level span, submit -> future resolution: nested under
         # the sync call's root when one is active (contextvars), a
         # fresh trace for bare async use.  The span identity is
@@ -1112,6 +1201,32 @@ class MetranService:
         if steps < 1:
             self.metrics.errors.increment("validation_errors")
             raise ValueError(f"forecast steps must be >= 1, got {steps}")
+        rp = self.readpath
+        if rp is not None:
+            # snapshot pass first: hits are answered from host memory,
+            # only the misses (cold/stale/uncovered models) pay the
+            # dispatch — a fully warm fleet tick does no device work
+            results: list = [None] * len(ids)
+            miss_idx = []
+            for i, mid in enumerate(ids):
+                entry = rp.read(mid, steps)
+                if entry is not None:
+                    results[i] = self._cached_forecast(entry, steps)
+                else:
+                    miss_idx.append(i)
+            if not miss_idx:
+                return results
+            computed = self._forecast_batch_compute(
+                [ids[i] for i in miss_idx], steps
+            )
+            for i, res in zip(miss_idx, computed):
+                results[i] = res
+            return results
+        return self._forecast_batch_compute(ids, steps)
+
+    def _forecast_batch_compute(self, ids, steps: int) -> list:
+        """The dispatching half of :meth:`forecast_batch` (cache
+        misses, or the whole batch with the read path off)."""
         if not self.registry.arena_enabled:
             return self._batch_via_requests(
                 ids, [("forecast", steps)] * len(ids)
@@ -1128,7 +1243,12 @@ class MetranService:
                 if spec[0] == "update":
                     futs.append(self.update_async(mid, spec[1]))
                 else:
-                    futs.append(self.forecast_async(mid, spec[1]))
+                    # _forecast_async_compute: forecast_batch already
+                    # consulted the cache for these ids — a miss must
+                    # not be double-counted
+                    futs.append(
+                        self._forecast_async_compute(mid, spec[1])
+                    )
             except Exception as exc:  # noqa: BLE001 - per-slot channel
                 futs.append(exc)
         if self.batcher.flush_deadline is None:
@@ -1262,31 +1382,50 @@ class MetranService:
                 arena.dtype, copy=False
             )
             m = mask & real
+            rp = self.readpath
             fn = self.registry.arena_update_fn(
                 bucket, k, gate=gate if gated else None,
                 validate=validate,
+                horizons=self.horizons if rp is not None else None,
             )
             g = len(rows_arr)
             rows_p, (y_p, m_p) = self._pad_dispatch(
                 rows_arr, arena.scratch_row, (y, m)
             )
             zs = verdicts = None
+            fm = fv = None
+            # one lock region kernel→mirror bump, as in
+            # _run_update_arena: no forecast may see new moments with
+            # an old version label
+            with arena.lock:
+                if gated:
+                    outs = arena.apply(
+                        fn, rows_p, y_p, m_p, np.int32(gate.min_seen)
+                    )
+                else:
+                    outs = arena.apply(fn, rows_p, y_p, m_p)
+                if rp is not None:
+                    outs, fm, fv = outs[:-2], outs[-2], outs[-1]
+                if gated:
+                    ok, _sigma, _detf, zs, verdicts = outs
+                else:
+                    ok, _sigma, _detf = outs
+                ok = np.asarray(ok)[:g]
+                versions, t_seens = arena.commit_rows(rows_arr, ok, k)
             if gated:
-                ok, _sigma, _detf, zs, verdicts = arena.apply(
-                    fn, rows_p, y_p, m_p, np.int32(gate.min_seen)
-                )
                 zs = np.asarray(zs)[:g]
                 verdicts = np.asarray(verdicts)[:g]
-            else:
-                ok, _sigma, _detf = arena.apply(fn, rows_p, y_p, m_p)
-            ok = np.asarray(ok)[:g]
-            arena.commit_rows(rows_arr, ok, k)
+            if rp is not None:
+                self._publish_arena_snapshot(
+                    bucket, arena, rows_arr, versions,
+                    np.asarray(fm)[:g], np.asarray(fv)[:g],
+                    [ids[i] for i in idxs],
+                    [self.registry.meta(ids[i]).names for i in idxs],
+                )
             if gated:
                 self._book_gate_verdicts_bulk(
                     idxs, ids, zs, verdicts, n_sl
                 )
-            versions = arena.version_host[rows_arr]
-            t_seens = arena.t_seen_host[rows_arr]
             empty = ~m.any(axis=(1, 2))
             n_empty = int(np.count_nonzero(empty & ok))
             if n_empty:
@@ -1484,6 +1623,8 @@ class MetranService:
             ),
             **({"arena": self.registry.arena_stats}
                if self.registry.arena_enabled else {}),
+            **({"readpath": self.readpath.stats()}
+               if self.readpath is not None else {}),
         })
         return snap
 
@@ -1492,6 +1633,11 @@ class MetranService:
         # updates that only enqueue from done-callbacks mid-drain —
         # before it starts refusing submissions
         self.batcher.close()
+        if self.readpath is not None:
+            # detach the snapshot store's invalidation hook: a shared
+            # registry outliving this service must not keep the store
+            # alive or call into it after close
+            self.registry.remove_commit_hook(self.readpath.note_commit)
         if self.registry.arena_enabled and self.persist_updates:
             # the arena's durability frontier: updates dirtied rows in
             # place on device, and a clean shutdown spills them so the
@@ -1850,12 +1996,19 @@ class MetranService:
             m[i, :, : st.n_series] = mask
         gate = self.gate
         gated = gate.enabled
+        rp = self.readpath
+        # a non-None horizons set selects the fused commit-time horizon
+        # pass (serve.readpath): the kernel appends (B, H, N) forecast
+        # moments of the NEW posteriors — same dispatch, no second
+        # launch
         fn = self.registry.update_fn(
-            bucket, k, gate=gate if gated else None
+            bucket, k, gate=gate if gated else None,
+            horizons=self.horizons if rp is not None else None,
         )
         tracer = self.tracer
         t_eng0 = tracer.clock() if tracer is not None else None
-        chol_t = z_t = verdict_t = None
+        chol_t = cov_t = z_t = verdict_t = None
+        fac_b = batch.chol if sqrt_engine else batch.cov
         if gated:
             # the gate disarms per model below min_seen assimilated
             # steps (a cold filter's innovations are over-dispersed
@@ -1865,27 +2018,22 @@ class MetranService:
             armed = np.array(
                 [st.t_seen >= gate.min_seen for st in states], bool
             )
-            if sqrt_engine:
-                mean_t, chol_t, sigma_t, detf_t, z_t, verdict_t = fn(
-                    batch.ss, batch.mean, batch.chol, y, m, armed
-                )
-                chol_t = np.asarray(chol_t)
-            else:
-                mean_t, cov_t, sigma_t, detf_t, z_t, verdict_t = fn(
-                    batch.ss, batch.mean, batch.cov, y, m, armed
-                )
-                cov_t = np.asarray(cov_t)
-            z_t, verdict_t = np.asarray(z_t), np.asarray(verdict_t)
-        elif sqrt_engine:
-            mean_t, chol_t, sigma_t, detf_t = fn(
-                batch.ss, batch.mean, batch.chol, y, m
-            )
-            chol_t = np.asarray(chol_t)
+            outs = fn(batch.ss, batch.mean, fac_b, y, m, armed)
         else:
-            mean_t, cov_t, sigma_t, detf_t = fn(
-                batch.ss, batch.mean, batch.cov, y, m
-            )
-            cov_t = np.asarray(cov_t)
+            outs = fn(batch.ss, batch.mean, fac_b, y, m)
+        fm_t = fv_t = None
+        if rp is not None:
+            fm_t, fv_t = np.asarray(outs[-2]), np.asarray(outs[-1])
+            outs = outs[:-2]
+        if gated:
+            mean_t, fac_t, sigma_t, detf_t, z_t, verdict_t = outs
+            z_t, verdict_t = np.asarray(z_t), np.asarray(verdict_t)
+        else:
+            mean_t, fac_t, sigma_t, detf_t = outs
+        if sqrt_engine:
+            chol_t = np.asarray(fac_t)
+        else:
+            cov_t = np.asarray(fac_t)
         mean_t = np.asarray(mean_t)
         sigma_t, detf_t = np.asarray(sigma_t), np.asarray(detf_t)
         if tracer is not None:
@@ -1901,6 +2049,7 @@ class MetranService:
                 {"batch": len(states), "engine": self.registry.engine},
             )
         validate = self.reliability.validate_updates
+        snap_entries: list = []
         for i, (st, j) in enumerate(zip(states, live)):
             # per-slot finalize: everything between here and a
             # successful registry.put can raise on one slot's own data
@@ -2065,6 +2214,36 @@ class MetranService:
                 results[j] = exc
                 continue
             results[j] = new_state
+            if rp is not None:
+                # snapshot entry for the committed slot, de-standardized
+                # exactly like the compute path (_run_forecast).  Its
+                # OWN guard: the update IS applied, and a cache-publish
+                # hiccup must never relabel it failed.
+                try:
+                    n = st.n_series
+                    snap_entries.append(SnapshotEntry(
+                        model_id=st.model_id,
+                        version=new_state.version,
+                        means=(
+                            fm_t[i][:, :n] * st.scaler_std
+                            + st.scaler_mean
+                        ),
+                        variances=fv_t[i][:, :n] * st.scaler_std**2,
+                        names=st.names,
+                        published_at=0.0,  # stamped at publish
+                    ))
+                except Exception:  # pragma: no cover - cache only
+                    logger.exception(
+                        "snapshot build failed for model %r (cache "
+                        "only; the update is applied)", st.model_id,
+                    )
+        if rp is not None and snap_entries:
+            # published BEFORE the dispatch returns (and the callers'
+            # futures resolve): read-your-writes for acked updates
+            try:
+                rp.publish_entries(snap_entries)
+            except Exception:  # pragma: no cover - cache only
+                logger.exception("snapshot publish failed (cache only)")
         return results
 
     # ------------------------------------------------------------------
@@ -2094,6 +2273,32 @@ class MetranService:
             ap[:g] = a
             padded.append(ap)
         return rows_p, padded
+
+    def _publish_arena_snapshot(self, bucket, arena, rows_arr, versions,
+                                fm, fv, model_ids, names) -> None:
+        """Publish one arena dispatch's fused forecast moments as a
+        per-bucket :class:`ForecastSnapshot` (serve.readpath).
+
+        ``fm``/``fv`` are the kernel's (G, H, n_pad) standardized
+        moments of the WRITTEN row values; de-standardization is one
+        vectorized pass off the arena's host scaler mirrors (safe to
+        read unlocked: the rows are pinned, so no re-pack can move
+        them under us).  Cache-only: a failure here is logged, never
+        raised — the updates are already committed."""
+        try:
+            sm = arena.scaler_mean[rows_arr][:, None, :]
+            sd = arena.scaler_std[rows_arr][:, None, :]
+            self.readpath.publish(ForecastSnapshot(
+                bucket=bucket,
+                model_ids=tuple(model_ids),
+                versions=versions,
+                means=fm * sd + sm,
+                variances=fv * sd**2,
+                n_series=arena.n_series_host[rows_arr].copy(),
+                names=tuple(names),
+            ))
+        except Exception:  # pragma: no cover - cache only
+            logger.exception("snapshot publish failed (cache only)")
 
     def _lookup_rows(self, requests, results):
         """Per-request row resolution (arena mode): ensure each model
@@ -2217,9 +2422,11 @@ class MetranService:
             gate = self.gate
             gated = gate.enabled
             validate = self.reliability.validate_updates
+            rp = self.readpath
             fn = self.registry.arena_update_fn(
                 bucket, k, gate=gate if gated else None,
                 validate=validate,
+                horizons=self.horizons if rp is not None else None,
             )
             tracer = self.tracer
             t_eng0 = tracer.clock() if tracer is not None else None
@@ -2229,20 +2436,43 @@ class MetranService:
                 rows_arr, arena.scratch_row, (y, m)
             )
             zs = verdicts = None
+            fm = fv = None
+            # ONE arena-lock region from the donating kernel through
+            # the mirror bump (RLock — apply/commit_rows re-enter it):
+            # a concurrent forecast must never observe the new device
+            # state with the old version mirror, or it would serve
+            # moments NEWER than their labeled version
+            with arena.lock:
+                if gated:
+                    outs = arena.apply(
+                        fn, rows_p, y_p, m_p, np.int32(gate.min_seen)
+                    )
+                else:
+                    outs = arena.apply(fn, rows_p, y_p, m_p)
+                if rp is not None:
+                    outs, fm, fv = outs[:-2], outs[-2], outs[-1]
+                if gated:
+                    ok, sigma, detf, zs, verdicts = outs
+                else:
+                    ok, sigma, detf = outs
+                ok = np.asarray(ok)[:g]
+                # mirror snapshot taken by commit_rows, BEFORE the
+                # pins release: an eviction after release may clear
+                # these rows' mirrors
+                versions, t_seens = arena.commit_rows(rows_arr, ok, k)
             if gated:
-                ok, sigma, detf, zs, verdicts = arena.apply(
-                    fn, rows_p, y_p, m_p, np.int32(gate.min_seen)
-                )
                 zs = np.asarray(zs)[:g]
                 verdicts = np.asarray(verdicts)[:g]
-            else:
-                ok, sigma, detf = arena.apply(fn, rows_p, y_p, m_p)
-            ok = np.asarray(ok)[:g]
-            arena.commit_rows(rows_arr, ok, k)
-            # mirror snapshot BEFORE the pins release: an eviction
-            # after release may clear these rows' mirrors
-            versions = arena.version_host[rows_arr].copy()
-            t_seens = arena.t_seen_host[rows_arr].copy()
+            if rp is not None:
+                # published before the callers' futures resolve
+                # (read-your-writes), while the pins still hold the
+                # scaler mirrors in place
+                self._publish_arena_snapshot(
+                    bucket, arena, rows_arr, versions,
+                    np.asarray(fm)[:g], np.asarray(fv)[:g],
+                    [m.model_id for m in metas],
+                    [m.names for m in metas],
+                )
         finally:
             self.registry.release_rows(pinned)
         if tracer is not None:

@@ -682,15 +682,25 @@ class StateArena:
             self._check()
             return fn(self._mean, self._fac, self._static(), *args)
 
-    def commit_rows(self, rows, ok, k: int) -> None:
+    def commit_rows(self, rows, ok, k: int) -> Tuple[np.ndarray, np.ndarray]:
         """Advance the host mirrors for the rows a dispatch committed
-        (``ok`` per-row flags from the kernel's integrity gate)."""
+        (``ok`` per-row flags from the kernel's integrity gate).
+
+        Returns the post-commit ``(versions, t_seen)`` of ALL the
+        dispatched rows, snapshotted under the arena lock — one
+        consistent view for the dispatch's acks and its snapshot
+        publication (``serve.readpath``), immune to a concurrent
+        eviction clearing the mirrors after the lock is released."""
         rows = np.asarray(rows, np.int64)
         good = rows[np.asarray(ok, bool)]
         with self.lock:
             self.t_seen_host[good] += int(k)
             self.version_host[good] += 1
             self.dirty[good] = True
+            return (
+                self.version_host[rows].copy(),
+                self.t_seen_host[rows].copy(),
+            )
 
     # -- pack / unpack ---------------------------------------------------
     def write_row(self, row: int, state: PosteriorState) -> None:

@@ -1,0 +1,423 @@
+"""Materialized forecast read path (`serve.readpath`).
+
+Pins the snapshot cache's contracts:
+
+1. **bit-identity** — a cached read equals the compute path at matching
+   model version: bit-identical at f64 for joint/sqrt engines, gate on
+   and off, arena and dict registries (one documented eps-level
+   exception: the dict-registry sqrt engine with an armed gate, where
+   the fused pass reconstitutes ``chol·cholᵀ`` on device while the
+   compute path reconstituted it host-side at finalize — agreement to
+   a few ulps), and within documented float tolerance at f32;
+2. **invalidation** — a committed update invalidates exactly the
+   written model's entry (the version bump is observed by the next
+   read); an external ``registry.put`` marks the entry stale and the
+   read falls through to the compute path;
+3. **consistency under concurrency** — snapshot reads racing writes
+   never return a torn value or one newer than a committed posterior
+   (threaded, marker-checked like the arena's concurrency tests);
+4. **fallthrough semantics** — misses (no entry, steps beyond the
+   contiguous horizon prefix) and stale entries fall through to the
+   compute path with identical results, booked in the cache counters.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from metran_tpu.ops import dfm_statespace, kalman_filter
+from metran_tpu.serve import (
+    GateSpec,
+    MetranService,
+    ModelRegistry,
+    PosteriorState,
+    SnapshotStore,
+    parse_horizons,
+)
+from metran_tpu.serve.readpath import contiguous_prefix
+
+
+def _make_states(rng, n_models=4, n=5, kf=1, t=60, dtype=np.float64):
+    states = []
+    for i in range(n_models):
+        loadings = (rng.uniform(0.3, 0.8, (n, kf)) / np.sqrt(kf)).astype(
+            dtype
+        )
+        a_s = rng.uniform(5.0, 40.0, n).astype(dtype)
+        a_c = rng.uniform(10.0, 60.0, kf).astype(dtype)
+        ss = dfm_statespace(a_s, a_c, loadings, 1.0)
+        y = rng.normal(size=(t, n))
+        mask = rng.uniform(size=(t, n)) > 0.3
+        y = np.where(mask, y, 0.0)
+        res = kalman_filter(ss, y.astype(dtype), mask, engine="joint")
+        states.append(PosteriorState(
+            model_id=f"m{i}", version=0, t_seen=t,
+            mean=np.asarray(res.mean_f[-1], dtype),
+            cov=np.asarray(res.cov_f[-1], dtype),
+            params=np.concatenate([a_s, a_c]),
+            loadings=loadings, dt=1.0,
+            scaler_mean=rng.normal(size=n).astype(dtype),
+            scaler_std=rng.uniform(0.5, 2.0, n).astype(dtype),
+            names=tuple(f"s{j}" for j in range(n)),
+        ))
+    return states
+
+
+def _service(states, readpath, horizons="1-5", engine="joint", gate=None,
+             arena=False, observability=None):
+    reg = ModelRegistry(
+        root=None, engine=engine, arena=arena, arena_rows=16,
+    )
+    for st in states:
+        reg.put(st, persist=False)
+    svc = MetranService(
+        reg, flush_deadline=None, persist_updates=False, gate=gate,
+        readpath=readpath, horizons=horizons,
+        observability=observability,
+    )
+    return reg, svc
+
+
+def _update_all(svc, n_models, obs):
+    futs = [svc.update_async(f"m{i}", obs[i]) for i in range(n_models)]
+    svc.flush()
+    return [f.result() for f in futs]
+
+
+def _forecast_compute(svc, model_id, steps):
+    """A forecast through the dispatch path (async submit + flush),
+    bypassing any sync-path cache consultation."""
+    fut = svc.forecast_async(model_id, steps)
+    svc.flush()
+    return fut.result()
+
+
+# ----------------------------------------------------------------------
+# horizon-spec parsing
+# ----------------------------------------------------------------------
+def test_parse_horizons_and_prefix():
+    assert parse_horizons("1,7,30") == (1, 7, 30)
+    assert parse_horizons("1-5") == (1, 2, 3, 4, 5)
+    assert parse_horizons("1-3,7, 30") == (1, 2, 3, 7, 30)
+    assert parse_horizons((3, 1, 2, 2)) == (1, 2, 3)
+    assert parse_horizons("") == ()
+    assert contiguous_prefix((1, 2, 3, 7)) == 3
+    assert contiguous_prefix((1, 7, 30)) == 1
+    assert contiguous_prefix((2, 3)) == 0
+    with pytest.raises(ValueError):
+        parse_horizons("0-3")
+    with pytest.raises(ValueError):
+        SnapshotStore(())
+
+
+# ----------------------------------------------------------------------
+# 1. cached read == compute path at matching version
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("engine,policy,arena,dtype", [
+    ("joint", "off", False, np.float64),
+    ("joint", "reject", True, np.float64),
+    ("sqrt", "off", True, np.float64),
+    ("sqrt", "reject", True, np.float64),
+    ("sqrt", "reject", False, np.float64),
+    ("sqrt", "reject", True, np.float32),
+])
+def test_cached_reads_match_compute_path(rng, engine, policy, arena, dtype):
+    """A snapshot hit equals what the dispatch path computes from the
+    same posterior version — bit-identical at f64 (the dict-sqrt-gated
+    combo to a few ulps, see module docstring), 2e-5 at f32."""
+    n_models, steps = 4, 5
+    states = _make_states(rng, n_models=n_models, dtype=dtype)
+    gate = (
+        None if policy == "off"
+        else GateSpec(policy=policy, nsigma=4.0, min_seen=10)
+    )
+    obs = rng.normal(size=(n_models, 2, 5))
+    obs[1, 0, 2] = 30.0  # make an armed gate actually trip
+
+    _, svc_c = _service(states, True, engine=engine, gate=gate,
+                        arena=arena)
+    _, svc_p = _service(states, False, engine=engine, gate=gate,
+                        arena=arena)
+    _update_all(svc_c, n_models, obs)
+    _update_all(svc_p, n_models, obs)
+
+    h0 = svc_c.readpath.hits
+    for i in range(n_models):
+        cached = svc_c.forecast(f"m{i}", steps)
+        computed = _forecast_compute(svc_p, f"m{i}", steps)
+        assert cached.version == computed.version == 1
+        assert cached.names == computed.names
+        if dtype == np.float64:
+            assert np.array_equal(cached.means, computed.means)
+            if engine == "sqrt" and policy != "off" and not arena:
+                # documented exception: device vs host chol·cholᵀ
+                np.testing.assert_allclose(
+                    cached.variances, computed.variances,
+                    rtol=1e-13, atol=1e-15,
+                )
+            else:
+                assert np.array_equal(
+                    cached.variances, computed.variances
+                )
+        else:
+            np.testing.assert_allclose(
+                cached.means, computed.means, rtol=2e-5, atol=1e-6
+            )
+            np.testing.assert_allclose(
+                cached.variances, computed.variances, rtol=2e-5,
+                atol=1e-6,
+            )
+    assert svc_c.readpath.hits - h0 == n_models
+    svc_c.close()
+    svc_p.close()
+
+
+def test_cached_prefix_rows_match_longer_compute(rng):
+    """steps beyond the horizon prefix MISS and fall through; the
+    compute result's leading rows equal the cached rows (per-horizon
+    independence of the closed-form pass)."""
+    states = _make_states(rng)
+    _, svc = _service(states, True, horizons="1-5", arena=True)
+    _update_all(svc, 4, rng.normal(size=(4, 1, 5)))
+    cached = svc.forecast("m0", 5)
+    m0 = svc.readpath.misses
+    longer = svc.forecast("m0", 9)  # 9 > prefix 5: compute path
+    assert svc.readpath.misses == m0 + 1
+    assert longer.version == cached.version
+    assert np.array_equal(longer.means[:5], cached.means)
+    svc.close()
+
+
+# ----------------------------------------------------------------------
+# 2. invalidation
+# ----------------------------------------------------------------------
+def test_commit_invalidates_exactly_the_written_model(rng):
+    states = _make_states(rng)
+    _, svc = _service(states, True, arena=True)
+    _update_all(svc, 4, rng.normal(size=(4, 1, 5)))
+    before = {i: svc.forecast(f"m{i}", 3) for i in range(4)}
+    assert all(f.version == 1 for f in before.values())
+    # write m1 ONLY: its next read observes version 2 (a fresh hit —
+    # the commit republished its snapshot in the same dispatch);
+    # every other model's entry is untouched
+    futs = [svc.update_async("m1", rng.normal(size=(1, 5)))]
+    svc.flush()
+    [f.result() for f in futs]
+    h0, s0 = svc.readpath.hits, svc.readpath.stale
+    after = {i: svc.forecast(f"m{i}", 3) for i in range(4)}
+    assert after[1].version == 2
+    assert not np.array_equal(after[1].means, before[1].means)
+    for i in (0, 2, 3):
+        assert after[i].version == 1
+        assert np.array_equal(after[i].means, before[i].means)
+    assert svc.readpath.hits - h0 == 4 and svc.readpath.stale == s0
+    svc.close()
+
+
+def test_external_put_marks_entry_stale_and_read_falls_through(rng):
+    """A registry.put from OUTSIDE the service (refit hot-swap,
+    operator restore) has no fused snapshot — the commit hook marks
+    the entry stale and the next read computes from the new state."""
+    states = _make_states(rng)
+    reg, svc = _service(states, True, arena=False)
+    _update_all(svc, 4, rng.normal(size=(4, 1, 5)))
+    hit = svc.forecast("m2", 3)
+    assert hit.version == 1
+    swapped = reg.get("m2")._replace(version=7)
+    reg.put(swapped, persist=False)
+    s0 = svc.readpath.stale
+    fresh = svc.forecast("m2", 3)
+    assert svc.readpath.stale == s0 + 1
+    assert fresh.version == 7
+    expected = _forecast_compute(svc, "m2", 3)
+    assert np.array_equal(fresh.means, expected.means)
+    # a version-REGRESSING put (refit hot-swap: fresh extractions
+    # restart at 0) must invalidate too — the committed registry state
+    # is the truth whatever its counter says — and later commits must
+    # be able to publish past the old higher-versioned entry
+    reverted = states[2]  # version 0, the pre-update posterior
+    reg.put(reverted, persist=False)
+    s1 = svc.readpath.stale
+    back = svc.forecast("m2", 3)
+    assert svc.readpath.stale == s1 + 1
+    assert back.version == 0
+    fut = svc.update_async("m2", rng.normal(size=(1, 5)))
+    svc.flush()
+    fut.result()
+    again = svc.forecast("m2", 3)  # republished: a fresh hit at v1
+    assert again.version == 1
+    assert np.array_equal(
+        again.means, _forecast_compute(svc, "m2", 3).means
+    )
+    svc.close()
+
+
+# ----------------------------------------------------------------------
+# 3. snapshot reads under concurrent writes
+# ----------------------------------------------------------------------
+def test_concurrent_reads_never_torn_or_newer_than_committed(rng):
+    """Readers hammer one model while a writer commits updates: every
+    read's moments must equal the exact per-version reference (not
+    torn), its version must never exceed the highest version the
+    writer could have committed, and a read started after an ack must
+    see at least that acked version (read-your-writes)."""
+    n_versions, steps = 12, 3
+    states = _make_states(rng, n_models=2)
+    obs_seq = [rng.normal(size=(1, 5)) for _ in range(n_versions)]
+    # per-version references from a cache-less shadow service fed the
+    # same observations (arena f64: bit-identical to the cached path)
+    _, shadow = _service(states, False, arena=True)
+    expected = {}
+    for v, obs in enumerate(obs_seq, start=1):
+        fut = shadow.update_async("m0", obs)
+        shadow.flush()
+        fut.result()
+        expected[v] = _forecast_compute(shadow, "m0", steps)
+    shadow.close()
+
+    _, svc = _service(states, True, arena=True)
+    # publish the v1 base from the SAME first observation the shadow
+    # assimilated, so expected[1] is this service's version-1 truth
+    fut = svc.update_async("m0", obs_seq[0])
+    svc.flush()
+    fut.result()
+    base = svc.forecast("m0", steps)
+    assert np.array_equal(base.means, expected[1].means)
+    allowed_max = [1]  # bumped BEFORE each submit
+    acked = [1]  # bumped AFTER each ack
+    failures: list = []
+    done = threading.Event()
+
+    def writer():
+        try:
+            for v, obs in enumerate(obs_seq[1:], start=2):
+                allowed_max[0] = v
+                fut = svc.update_async("m0", obs)
+                svc.flush()
+                fut.result()
+                acked[0] = v
+        except Exception as exc:  # pragma: no cover - fail the test
+            failures.append(f"writer: {exc!r}")
+        finally:
+            done.set()
+
+    def reader():
+        while not done.is_set() and not failures:
+            lo = acked[0]
+            f = svc.forecast("m0", steps)
+            hi = allowed_max[0]
+            if not (lo <= f.version <= hi):
+                failures.append(
+                    f"version {f.version} outside [{lo}, {hi}]"
+                )
+                return
+            ref = expected.get(f.version, base)
+            if not (
+                np.array_equal(f.means, ref.means)
+                and np.array_equal(f.variances, ref.variances)
+            ):
+                failures.append(f"torn read at version {f.version}")
+                return
+
+    threads = [threading.Thread(target=reader) for _ in range(2)]
+    wt = threading.Thread(target=writer)
+    for t in threads:
+        t.start()
+    wt.start()
+    wt.join(30)
+    for t in threads:
+        t.join(30)
+    assert not failures, failures[:3]
+    final = svc.forecast("m0", steps)
+    assert final.version == n_versions
+    assert np.array_equal(final.means, expected[n_versions].means)
+    svc.close()
+
+
+# ----------------------------------------------------------------------
+# 4. service semantics around the cache
+# ----------------------------------------------------------------------
+def test_forecast_batch_serves_hits_and_computes_misses(rng):
+    states = _make_states(rng, n_models=6)
+    _, svc = _service(states, True, arena=True)
+    # warm/publish only the first three models
+    futs = [svc.update_async(f"m{i}", rng.normal(size=(1, 5)))
+            for i in range(3)]
+    svc.flush()
+    [f.result() for f in futs]
+    h0, m0 = svc.readpath.hits, svc.readpath.misses
+    out = svc.forecast_batch([f"m{i}" for i in range(6)], 4)
+    assert svc.readpath.hits - h0 == 3
+    assert svc.readpath.misses - m0 == 3
+    for i, fc in enumerate(out):
+        assert fc.version == (1 if i < 3 else 0)
+        ref = _forecast_compute(svc, f"m{i}", 4)
+        assert np.array_equal(fc.means, ref.means)
+    svc.close()
+
+
+def test_async_hit_short_circuits_span_and_breaker(rng):
+    """A cached hit resolves immediately with no trace span and no
+    breaker admission — and still serves while the model's breaker is
+    OPEN (the breaker protects compute; the snapshot costs none)."""
+    from metran_tpu.obs import EventLog, MetricsRegistry, Observability, \
+        Tracer
+    from metran_tpu.reliability import CircuitOpenError
+
+    obs = Observability(
+        metrics=MetricsRegistry(), tracer=Tracer(), events=EventLog(),
+    )
+    states = _make_states(rng)
+    _, svc = _service(states, True, arena=True, observability=obs)
+    _update_all(svc, 4, rng.normal(size=(4, 1, 5)))
+    n_spans = len(obs.tracer.spans())
+    fut = svc.forecast_async("m0", 3)
+    assert fut.done()
+    assert fut.result().version == 1
+    assert len(obs.tracer.spans()) == n_spans  # no request span
+    assert len(svc.breakers) == 0 or "m0" not in svc.breakers.open_models()
+    # open m0's breaker: compute paths reject, the cached read serves
+    breaker = svc.breakers.get("m0")
+    for _ in range(svc.reliability.breaker_failures + 1):
+        breaker.record_failure()
+    with pytest.raises(CircuitOpenError):
+        svc.forecast("m0", 99)  # beyond prefix: falls through, breaker
+    assert svc.forecast("m0", 3).version == 1  # hit bypasses breaker
+    svc.close()
+
+
+def test_metrics_and_publish_event(rng):
+    from metran_tpu.obs import EventLog, MetricsRegistry, Observability
+
+    bundle = Observability(
+        metrics=MetricsRegistry(), tracer=None, events=EventLog(),
+    )
+    states = _make_states(rng)
+    _, svc = _service(states, True, arena=True, observability=bundle)
+    _update_all(svc, 4, rng.normal(size=(4, 1, 5)))
+    assert bundle.events.counts().get("snapshot_publish", 0) >= 1
+    hit = svc.forecast("m0", 3)
+    # served views are read-only: a caller mutating them in place
+    # would corrupt every later read of this version
+    with pytest.raises(ValueError):
+        hit.means[0, 0] = 1.0
+    svc.forecast("m0", 99)  # miss (beyond prefix)
+    text = bundle.metrics.render_prometheus()
+    assert "metran_serve_forecast_cache_hits_total 1" in text
+    assert "metran_serve_forecast_cache_misses_total" in text
+    assert "metran_serve_forecast_cache_stale_total" in text
+    assert "metran_serve_forecast_snapshot_age_seconds" in text
+    assert "metran_serve_forecast_snapshot_entries 4" in text
+    assert svc.health()["readpath"]["entries"] == 4
+    svc.close()
+
+
+def test_readpath_off_has_no_store_and_identical_results(rng):
+    states = _make_states(rng)
+    _, svc = _service(states, False, arena=False)
+    assert svc.readpath is None
+    acks = _update_all(svc, 4, rng.normal(size=(4, 1, 5)))
+    assert all(a.version == 1 for a in acks)
+    assert "readpath" not in svc.health()
+    svc.close()

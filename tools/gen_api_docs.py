@@ -49,6 +49,7 @@ MODULES = [
     "metran_tpu.serve.engine",
     "metran_tpu.serve.registry",
     "metran_tpu.serve.batching",
+    "metran_tpu.serve.readpath",
     "metran_tpu.serve.service",
     "metran_tpu.reliability.policy",
     "metran_tpu.reliability.health",
