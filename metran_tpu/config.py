@@ -154,6 +154,17 @@ SERVE_GATE_POLICY = "off"  # "reject" | "huber" | "inflate" | "off"
 SERVE_GATE_NSIGMA = 4.0  # gate at z^2 > nsigma^2 (chi-square(1) null)
 SERVE_GATE_MIN_SEEN = 32  # disarm models with t_seen below this (cold
 #                           filters' innovations are over-dispersed)
+# steady-state (frozen-gain) serving defaults (docs/concepts.md
+# "Bounded-cost serving").  Ships OFF (tol = 0.0): freezing trades a
+# bounded, measured posterior deviation (within the freeze tolerance)
+# for ≥2x update throughput, and that trade is a deployment decision.
+SERVE_STEADY_TOL = 0.0  # freeze when the posterior factor moves <= tol
+#                         across a fully-observed append (0 disables)
+SERVE_STEADY_MIN_SEEN = 256  # assimilated-steps floor before freezing
+# fixed-lag smoothed products (MetranService.smoothed): window length
+# in grid steps; 0 disables tracking (the rolling anchor costs one
+# O(k) replay kernel per commit once armed).
+SERVE_FIXED_LAG = 0
 # observability defaults (metran_tpu.obs wired into MetranService)
 OBS_TRACE = 0  # request-scoped span tracing (metrics/events stay on)
 OBS_TRACE_BUFFER = 4096  # finished spans kept in the tracer ring
@@ -243,6 +254,16 @@ def serve_defaults() -> dict:
         ),
         "gate_min_seen": _env(
             "METRAN_TPU_SERVE_GATE_MIN_SEEN", int, SERVE_GATE_MIN_SEEN
+        ),
+        "steady_tol": _env(
+            "METRAN_TPU_SERVE_STEADY_TOL", float, SERVE_STEADY_TOL
+        ),
+        "steady_min_seen": _env(
+            "METRAN_TPU_SERVE_STEADY_MIN_SEEN", int,
+            SERVE_STEADY_MIN_SEEN,
+        ),
+        "fixed_lag": _env(
+            "METRAN_TPU_SERVE_FIXED_LAG", int, SERVE_FIXED_LAG
         ),
     }
 

@@ -66,6 +66,8 @@ EVENT_KINDS = (
     "arena_load",
     "arena_spill",
     "snapshot_publish",
+    "steady_freeze",
+    "steady_thaw",
 )
 
 

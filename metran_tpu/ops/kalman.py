@@ -1076,6 +1076,486 @@ def _gated_sqrt_filter_append(ss, mean, chol, y_new, mask_new, armed, *,
     return mean_t, chol_t, sigma, detf, zs, verdicts
 
 
+# ----------------------------------------------------------------------
+# steady-state serving (bounded-cost hot path)
+# ----------------------------------------------------------------------
+#
+# For a time-invariant model with a fixed missing pattern, the Kalman
+# covariance recursion converges to the stabilizing solution of the
+# discrete algebraic Riccati equation (DARE) and the gain freezes with
+# it — after which every update's covariance work (the QR of stacked
+# factor blocks, the O(S^3) part of a serving step) recomputes the same
+# numbers.  The utilities here let a serving layer collapse the hot
+# path to an O(S·N) mean-only recursion once a model has converged
+# (the calibrated-approximation framing of arXiv:2405.08971: spend the
+# covariance compute ONCE, serve from the frozen summary, and fall
+# back to the exact kernel the moment time-invariance breaks):
+#
+# - :func:`dare_solve`: the steady predicted covariance, by
+#   Newton-Kleinman iteration with each Lyapunov solve evaluated by
+#   doubling (quadratic convergence; handles the DFM's exact r = 0
+#   observation noise, where the classical symplectic/SDA doubling
+#   needs R^{-1} and cannot start);
+# - :func:`steady_gains`: the frozen per-slot gain, innovation
+#   variances and steady filtered covariance derived from it;
+# - :func:`steady_filter_append`: the frozen-gain mean recursion over
+#   k appended rows, with on-kernel detection of every condition that
+#   breaks time-invariance (missing slots, a tripped observation gate)
+#   so the caller can thaw back to the exact kernel.
+
+
+class SteadyGains(NamedTuple):
+    """The frozen serving summary of a converged filter.
+
+    ``kgain`` is the steady Kalman gain ``K = P Z' F^{-1}`` (S, N) for
+    the fully-observed pattern, ``fdiag`` the (N,) marginal innovation
+    variances ``diag(F)`` with padded (zero-``Z``-row) slots carrying
+    1.0, ``p_pred``/``p_filt`` the steady predicted and filtered state
+    covariances.  ``kgain_seq``/``fdiag_seq`` are the frozen
+    SEQUENTIAL-PROCESSING per-slot quantities — the rank-1 gain and
+    conditional innovation variance of each slot GIVEN the slots
+    before it, read off the same per-slot recursion the sequential
+    filter runs, evaluated at the fixed point.  At the steady state
+    these are constants too, and they are what a frozen gate on a
+    sequential-gated (covariance-engine) serving path must test
+    against: the conditional variances are smaller than the marginal
+    ones, so gating on marginals would silently pass observations the
+    exact kernel rejects (square-root engines gate on marginals by
+    design, so they use ``fdiag``).  Everything a steady-path update
+    or forecast needs; nothing depends on the data, so it is computed
+    once per model at freeze time and reused for every subsequent
+    step.
+    """
+
+    kgain: jnp.ndarray  # (S, N)
+    fdiag: jnp.ndarray  # (N,)
+    p_pred: jnp.ndarray  # (S, S)
+    p_filt: jnp.ndarray  # (S, S)
+    kgain_seq: jnp.ndarray  # (S, N) per-slot sequential gains
+    fdiag_seq: jnp.ndarray  # (N,) per-slot conditional variances
+
+
+def _real_slots(z: jnp.ndarray) -> jnp.ndarray:
+    """(N,) True where an observation slot is real (nonzero ``Z`` row).
+
+    Correct for TRUE-dimension state spaces (the DFM observation
+    matrix is ``[I | Λ]`` — every real series owns an identity
+    column).  NOT correct for bucket-PADDED state spaces: the padded
+    layout keeps the identity block over all ``n_pad`` sdf slots, so a
+    padded slot's ``Z`` row is nonzero too — padded-bucket callers
+    (the serving kernels) must pass their explicit ``real`` mask from
+    the host-side series counts instead.
+    """
+    return jnp.any(z != 0.0, axis=-1)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("newton_iters", "doubling_iters")
+)
+def dare_solve(
+    ss: StateSpace,
+    newton_iters: int = 24,
+    doubling_iters: int = 32,
+) -> jnp.ndarray:
+    """Steady-state *predicted* covariance of the masked filter (DARE).
+
+    Solves ``P = Phi (P - P Z'(Z P Z' + R)^{-1} Z P) Phi' + Q`` for the
+    fully-observed missing pattern (padded zero-``Z``-row slots carry
+    the same unit pseudo-noise the masked update gives them, so they
+    contribute exactly nothing) by **Newton-Kleinman iteration with
+    doubled Lyapunov solves**:
+
+    - each Newton step fixes the gain ``K_j = P_j Z' F_j^{-1}`` and
+      solves the Joseph-form Lyapunov equation ``P = A P A' + B`` with
+      ``A = Phi (I - K_j Z)`` and ``B = Phi K_j R K_j' Phi' + Q``;
+    - each Lyapunov solve runs the classical doubling recursion
+      ``S <- S + M S M'``, ``M <- M M`` (``2^m`` series terms after
+      ``m`` steps), so even the near-unit-root regime (``phi ->
+      0.99997``, contraction 1 - 3e-5 per step) converges inside the
+      fixed iteration budget — ``2^32`` effective steps.
+
+    Newton-Kleinman converges quadratically from any stabilizing gain;
+    ``K_0 = 0`` is stabilizing because the DFM transition is strictly
+    stable (``|phi| < 1``).  Unlike the symplectic/SDA doubling it
+    never forms ``R^{-1}``, which does not exist for the DFM (exact
+    observations, ``r = 0``).  Fixed iteration counts keep it jittable
+    and vmappable; with f64 inputs the fixed point is tight to ~1e-14
+    and the unit test pins 1e-10 against the filter-converged
+    covariance across all alpha regimes (tests/test_steady.py).
+    """
+    dtype = ss.q.dtype
+    phi, q, z, r = ss.phi, ss.q, ss.z, ss.r
+    s_dim = phi.shape[-1]
+    eye = jnp.eye(s_dim, dtype=dtype)
+    real = _real_slots(z)
+    realf = real.astype(dtype)
+    z_m = z * realf[:, None]
+    # unit pseudo-noise on padded slots (the masked-update convention):
+    # their F rows become e_i, their gain columns exactly zero
+    r_eff = jnp.where(real, r, 0.0) + (1.0 - realf)
+
+    def lyap(a, b):
+        """Fixed point of ``X = a X a' + b`` by doubling."""
+
+        def body(carry, _):
+            m, s = carry
+            s = s + m @ s @ m.T
+            s = 0.5 * (s + s.T)
+            return (m @ m, s), None
+
+        (_, s), _ = lax.scan(
+            body, (a, b), None, length=doubling_iters
+        )
+        return s
+
+    p0 = lyap(jnp.diag(phi), q)  # K = 0: the stationary prior
+
+    def newton(p, _):
+        f = z_m @ p @ z_m.T + jnp.diag(r_eff)
+        chol = jnp.linalg.cholesky(0.5 * (f + f.T))
+        kt = jax.scipy.linalg.cho_solve((chol, True), z_m @ p)  # K'
+        a = phi[:, None] * (eye - kt.T @ z_m)
+        b = (
+            phi[:, None]
+            * ((kt.T * r_eff[None, :]) @ kt)
+            * phi[None, :]
+            + q
+        )
+        p_new = lyap(a, b)
+        return 0.5 * (p_new + p_new.T), None
+
+    p, _ = lax.scan(newton, p0, None, length=newton_iters)
+    return p
+
+
+@jax.jit
+def steady_gains(
+    ss: StateSpace, p_pred: Optional[jnp.ndarray] = None
+) -> SteadyGains:
+    """The frozen serving summary from a steady predicted covariance.
+
+    ``p_pred`` defaults to :func:`dare_solve`'s fixed point.  Padded
+    (zero-``Z``-row) slots get unit innovation variance and an exactly
+    zero gain column, matching the masked update's no-op semantics, so
+    the returned arrays are safe to use at any bucket padding.
+    """
+    if p_pred is None:
+        p_pred = dare_solve(ss)
+    dtype = ss.q.dtype
+    z, r = ss.z, ss.r
+    real = _real_slots(z)
+    realf = real.astype(dtype)
+    z_m = z * realf[:, None]
+    r_eff = jnp.where(real, r, 0.0) + (1.0 - realf)
+    f = z_m @ p_pred @ z_m.T + jnp.diag(r_eff)
+    chol = jnp.linalg.cholesky(0.5 * (f + f.T))
+    kt = jax.scipy.linalg.cho_solve((chol, True), z_m @ p_pred)
+    kgain = kt.T
+    p_filt = p_pred - kgain @ f @ kt
+    p_filt = 0.5 * (p_filt + p_filt.T)
+
+    # the frozen sequential-processing per-slot quantities: the same
+    # rank-1 recursion _sequential_update runs, evaluated at P∞ (a
+    # padded slot's zero Z row gives f = 1, gain exactly 0 — a no-op)
+    def seq_step(p, xs):
+        z_i, r_i = xs
+        d = p @ z_i
+        f_i = z_i @ d + r_i
+        k_i = d / f_i
+        return p - jnp.outer(k_i, k_i) * f_i, (k_i, f_i)
+
+    _, (ks, fs) = lax.scan(seq_step, p_pred, (z_m, r_eff))
+    return SteadyGains(
+        kgain=kgain,
+        fdiag=jnp.diagonal(f),
+        p_pred=p_pred,
+        p_filt=p_filt,
+        kgain_seq=ks.T,
+        fdiag_seq=fs,
+    )
+
+
+def steady_filter_append(
+    ss: StateSpace,
+    mean: jnp.ndarray,
+    kgain: jnp.ndarray,
+    fdiag: jnp.ndarray,
+    y_new: jnp.ndarray,
+    mask_new: jnp.ndarray,
+    armed=True,
+    policy: str = "off",
+    nsigma: float = 4.0,
+    real=None,
+    sequential_gate: bool = False,
+) -> Tuple[jnp.ndarray, ...]:
+    """Assimilate ``k`` appended rows through the FROZEN steady gain.
+
+    The bounded-cost serving hot path: a mean-only recursion
+    ``m <- Phi m + K (y - Z Phi m)`` per step — O(S·N), no QR, no
+    covariance propagation at all — valid exactly when the model is at
+    its steady state and every step keeps the fully-observed pattern
+    the gain was solved for.  Branch-free: every condition that breaks
+    that premise is *detected* (the sticky ``broke`` flag) rather than
+    branched on, and a broken row's result is simply discarded by the
+    caller, which replays the rows through the exact kernel (thaw —
+    ``serve/engine.py``).  ``broke`` trips on:
+
+    - any step whose mask differs from the full real-slot pattern
+      (missing/NaN-masked observations — the covariance would have
+      widened);
+    - an armed observation gate firing under ``policy="reject"`` or
+      ``"inflate"`` (both modify the covariance recursion; ``huber``
+      only reweights the mean innovation, so the frozen gain absorbs
+      it exactly and serving stays steady);
+    - a non-finite mean result.
+
+    ``sequential_gate`` selects which frozen gate the kernel applies
+    (it must MATCH the exact kernel the model would thaw back to):
+
+    - ``False`` (default): vector form — one fused matvec per step
+      through ``kgain``/``fdiag`` = the JOINT gain and *marginal*
+      innovation variances (:class:`SteadyGains` ``.kgain``/
+      ``.fdiag``).  This is the right gate for square-root serving
+      paths, whose exact gated kernel tests marginal innovations by
+      design, and for any ungated path.
+    - ``True``: per-slot form — the same slot-ordered rank-1
+      recursion :func:`_gated_sequential_update` runs, through the
+      frozen per-slot gains and CONDITIONAL variances
+      (``.kgain_seq``/``.fdiag_seq``).  The right gate for
+      covariance-engine (sequential-gated) serving paths: conditional
+      variances are smaller than marginal ones, so the vector gate
+      would silently pass observations the exact kernel rejects.
+      Same O(S·N) flops per step, scanned instead of fused.
+
+    ``sigma``/``detf`` and z-scores come from the corresponding
+    frozen variances — steady-state diagnostics; the posterior MEAN
+    is the quantity with an equivalence contract (frozen ≡ exact
+    within the freeze tolerance, tests/test_steady.py; with no gate
+    hit the two forms are the same affine map, associativity aside).
+
+    Returns ``(mean_T, sigma, detf, broke, zscore, verdict)`` with
+    ``zscore``/``verdict`` shaped (k, N) like the gated kernels'.
+
+    ``real`` is the (N,) true-observation-slot mask the full pattern
+    is tested against; defaults to the nonzero-``Z``-row slots —
+    correct for true-dimension state spaces, while bucket-PADDED
+    callers must pass theirs explicitly (see :func:`_real_slots`).
+    """
+    if policy not in GATE_POLICIES:
+        raise ValueError(
+            f"unknown gate policy {policy!r}; expected one of "
+            f"{GATE_POLICIES}"
+        )
+    if real is None:
+        real = _real_slots(ss.z)
+    return _steady_filter_append(
+        ss, mean, kgain, fdiag, y_new, mask_new,
+        jnp.asarray(armed, bool), jnp.asarray(real, bool),
+        policy=policy, nsigma=float(nsigma),
+        sequential_gate=bool(sequential_gate),
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("policy", "nsigma", "sequential_gate")
+)
+def _steady_filter_append(ss, mean, kgain, fdiag, y_new, mask_new,
+                          armed, real, *, policy, nsigma,
+                          sequential_gate=False):
+    dtype = ss.q.dtype
+    y_new = jnp.atleast_2d(jnp.asarray(y_new, dtype))
+    mask_new = jnp.atleast_2d(jnp.asarray(mask_new, bool))
+    kgain = jnp.asarray(kgain, dtype)
+    fdiag = jnp.asarray(fdiag, dtype)
+    f_safe = jnp.where(fdiag > 0, fdiag, 1.0)
+    sqrt_f = jnp.sqrt(f_safe)
+    log_f = jnp.where(real, jnp.log(f_safe), 0.0)
+    zero = jnp.zeros((), dtype)
+    one = jnp.ones((), dtype)
+    nan = jnp.asarray(jnp.nan, dtype)
+    t = jnp.asarray(nsigma * nsigma, dtype)
+    verdict_hit = (
+        GATE_REJECTED if policy == "reject" else GATE_DOWNWEIGHTED
+    )
+
+    if sequential_gate and policy != "off":
+        # per-slot form: the frozen twin of _gated_sequential_update
+        # (same slot order, same interim-mean innovations) with the
+        # per-slot gains/conditional variances constants
+        kgain_cols = kgain.T  # (N, S): slot i's rank-1 gain
+
+        def step(carry, xs):
+            m, sigma, detf, broke = carry
+            y_t, mask_t = xs
+            m_p = ss.phi * m
+            full = jnp.all(mask_t == real)
+
+            def slot(c, s_xs):
+                m_s, sig, det, gate_break = c
+                y_i, mask_i, z_i, k_i, f_i, lf_i = s_xs
+                v = y_i - z_i @ m_s
+                zsc = v / jnp.sqrt(f_i)
+                score = zsc * zsc
+                hit = armed & mask_i & (score > t)
+                if policy == "huber":
+                    w = jnp.where(
+                        hit,
+                        jnp.sqrt(t / jnp.where(hit, score, one)), one,
+                    )
+                else:  # reject/inflate break the frozen recursion
+                    w = one
+                    gate_break = gate_break | hit
+                wv = w * v
+                m_s = jnp.where(mask_i, m_s + k_i * wv, m_s)
+                sig = sig + jnp.where(mask_i, wv * wv / f_i, zero)
+                det = det + jnp.where(mask_i, lf_i, zero)
+                verdict = jnp.where(
+                    hit, verdict_hit, GATE_PASS
+                ).astype(jnp.int8)
+                return (m_s, sig, det, gate_break), (
+                    jnp.where(mask_i, zsc, nan), verdict
+                )
+
+            (m_f, sigma, detf, gate_break), (zs_t, verd_t) = lax.scan(
+                slot, (m_p, sigma, detf, jnp.zeros((), bool)),
+                (y_t, mask_t, ss.z, kgain_cols, f_safe, log_f),
+            )
+            broke = broke | ~full | gate_break
+            return (m_f, sigma, detf, broke), (zs_t, verd_t)
+
+    else:
+
+        def step(carry, xs):
+            m, sigma, detf, broke = carry
+            y_t, mask_t = xs
+            m_p = ss.phi * m
+            v = jnp.where(mask_t, y_t - ss.z @ m_p, 0.0)
+            zs = v / sqrt_f
+            score = zs * zs
+            full = jnp.all(mask_t == real)
+            if policy == "off":
+                hit = jnp.zeros_like(mask_t)
+                w = jnp.ones_like(v)
+                gate_break = jnp.zeros((), bool)
+            else:
+                hit = armed & mask_t & (score > t)
+                if policy == "huber":
+                    w = jnp.where(
+                        hit,
+                        jnp.sqrt(t / jnp.where(hit, score, one)), one,
+                    )
+                    gate_break = jnp.zeros((), bool)
+                else:  # reject/inflate change the covariance recursion
+                    w = jnp.ones_like(v)
+                    gate_break = jnp.any(hit)
+            wv = w * v
+            m_f = m_p + kgain @ wv
+            sigma = sigma + jnp.sum(
+                jnp.where(mask_t, wv * wv / f_safe, zero)
+            )
+            detf = detf + jnp.sum(jnp.where(mask_t, log_f, zero))
+            broke = broke | ~full | gate_break
+            verdict = jnp.where(
+                hit, verdict_hit, GATE_PASS
+            ).astype(jnp.int8)
+            return (m_f, sigma, detf, broke), (
+                jnp.where(mask_t, zs, nan), verdict
+            )
+
+    (mean_t, sigma, detf, broke), (zs, verdicts) = lax.scan(
+        step,
+        (jnp.asarray(mean, dtype), zero, zero, jnp.zeros((), bool)),
+        (y_new, mask_new),
+    )
+    broke = broke | ~jnp.all(jnp.isfinite(mean_t))
+    return mean_t, sigma, detf, broke, zs, verdicts
+
+
+def steady_converged(
+    fac_before: jnp.ndarray,
+    fac_after: jnp.ndarray,
+    mask: jnp.ndarray,
+    real: jnp.ndarray,
+    tol,
+) -> jnp.ndarray:
+    """Per-row convergence verdict of one batched exact update.
+
+    ``True`` where (a) every appended step carried the FULL real-slot
+    observation pattern (time-invariance — a masked step widens the
+    covariance again) and (b) the posterior factor/covariance moved by
+    at most ``tol`` (max-abs over the (S, S) block) across the whole
+    append.  All leading axes batched: ``fac`` is (..., S, S), ``mask``
+    (..., k, N), ``real`` the (..., N) true-observation-slot flags
+    (from the host-side series counts — a padded bucket's ``Z`` rows
+    cannot distinguish padding, see :func:`_real_slots`).  The
+    on-device half of steady-state detection — the serving layer ANDs
+    in its host-side conditions (``t_seen`` floor, no gate verdicts)
+    before freezing.
+    """
+    full = jnp.all(mask == real[..., None, :], axis=(-2, -1))
+    delta = jnp.max(jnp.abs(fac_after - fac_before), axis=(-2, -1))
+    return full & (delta <= tol) & jnp.isfinite(delta)
+
+
+# ----------------------------------------------------------------------
+# fixed-lag smoothing (recent-window products at O(L) cost)
+# ----------------------------------------------------------------------
+
+
+def fixed_lag_smooth(
+    ss: StateSpace,
+    mean: jnp.ndarray,
+    chol: jnp.ndarray,
+    y_win: jnp.ndarray,
+    mask_win: jnp.ndarray,
+) -> SqrtSmootherResult:
+    """Smoothed state moments for the trailing ``L``-step window.
+
+    Runs the square-root filter over ONLY the ``L`` windowed rows,
+    starting from the carried filtered posterior ``N(mean, chol chol')``
+    at the step before the window, then the square-root RTS smoother
+    backward across the window — O(L) work however long the full
+    history is.  Because the filter is Markov, the windowed forward
+    pass reproduces the full filter's moments for those steps exactly
+    (same ``_make_sqrt_core_step`` body, same carry), and RTS smoothing
+    at step ``t`` depends only on filtered/predicted moments from ``t``
+    forward — so the result is **bit-identical (f64) to running the
+    full filter + smoother over the entire history and slicing its
+    last ``L`` steps** (tests/test_steady.py pins this).  The one
+    approximation a fixed-lag product carries is the window boundary
+    itself: steps older than the window are not revised.
+
+    Returns the smoothed means (L, S) and covariance factors
+    (L, S, S), PSD by construction like every square-root path.
+    """
+    _check_diagonal_q(ss.q)
+    return _fixed_lag_smooth(ss, mean, chol, y_win, mask_win)
+
+
+@jax.jit
+def _fixed_lag_smooth(ss, mean, chol, y_win, mask_win):
+    dtype = ss.q.dtype
+    y_win = jnp.atleast_2d(jnp.asarray(y_win, dtype))
+    mask_win = jnp.atleast_2d(jnp.asarray(mask_win, bool))
+    core = _make_sqrt_core_step(ss, dtype)
+
+    def step(carry, xs):
+        m, s = carry
+        y_t, mask_t = xs
+        mean_p, chol_p, mean_f, chol_f, sigma, detf = core(
+            m, s, y_t, mask_t
+        )
+        return (mean_f, chol_f), (mean_p, chol_p, mean_f, chol_f,
+                                  sigma, detf)
+
+    (_, _), outs = lax.scan(
+        step, (jnp.asarray(mean, dtype), jnp.asarray(chol, dtype)),
+        (y_win, mask_win),
+    )
+    filt = SqrtFilterResult(*outs)
+    return sqrt_rts_smoother(ss, filt)
+
+
 def deviance_terms(
     sigma: jnp.ndarray, detf: jnp.ndarray, mask: jnp.ndarray, warmup: int = 1
 ) -> jnp.ndarray:

@@ -330,6 +330,16 @@ def corrupt(point: str, array, detail: str = ""):
     return injector.corrupt(point, array, detail)
 
 
+def corrupting() -> bool:
+    """Whether ANY injector is active (so :func:`corrupt` could
+    transform a payload).  Bulk ingest paths use this to skip G
+    per-model hook calls per fleet tick when nothing is armed — the
+    overwhelmingly common case; with an injector active they fall
+    back to the per-model calls so ``match=``/``detail`` semantics
+    are untouched."""
+    return _active is not None
+
+
 @contextlib.contextmanager
 def active(injector: Optional[FaultInjector] = None) -> Iterator[FaultInjector]:
     """Activate ``injector`` (or a fresh one) for the enclosed block.

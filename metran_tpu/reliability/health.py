@@ -116,6 +116,23 @@ class HealthMonitor:
                 )
             dq.append((int(observed), int(flagged)))
 
+    def record_gate_many(self, entries) -> None:
+        """Bulk :meth:`record_gate`: ``entries`` is an iterable of
+        ``(model_id, observed, flagged)`` triples booked under ONE
+        lock acquisition — the fleet-tick path books G models per
+        dispatch and G lock round-trips were measurable there."""
+        with self._lock:
+            gate = self._gate
+            for model_id, observed, flagged in entries:
+                if observed <= 0:
+                    continue
+                dq = gate.get(model_id)
+                if dq is None:
+                    dq = gate[model_id] = deque(
+                        maxlen=self.gate_window
+                    )
+                dq.append((int(observed), int(flagged)))
+
     def rejection_rate(self, model_id: str) -> float:
         """Fraction of ``model_id``'s recent observations the gate
         acted on — rejected or downweighted (0.0 for an unknown/quiet

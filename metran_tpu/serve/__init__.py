@@ -38,9 +38,12 @@ from ..reliability.policy import (
 from .batching import MicroBatcher
 from .engine import (
     GateSpec,
+    SteadySpec,
     forecast_bucket,
     make_arena_forecast_fn,
+    make_arena_steady_update_fn,
     make_arena_update_fn,
+    make_steady_update_fn,
     posterior_fault,
     stack_bucket,
     update_bucket,
@@ -53,6 +56,7 @@ from .readpath import (
 )
 from .registry import CompiledFnCache, ModelRegistry
 from .service import ArenaUpdateAck, Forecast, MetranService, ServeMetrics
+from .smoothing import FixedLagTracker, SmoothedWindow
 from .state import (
     ArenaLostError,
     ModelMeta,
@@ -69,6 +73,7 @@ __all__ = [
     "CircuitOpenError",
     "CompiledFnCache",
     "DeadlineExceededError",
+    "FixedLagTracker",
     "Forecast",
     "ForecastSnapshot",
     "GateSpec",
@@ -78,13 +83,17 @@ __all__ = [
     "ModelRegistry",
     "PosteriorState",
     "ServeMetrics",
+    "SmoothedWindow",
     "SnapshotEntry",
     "SnapshotStore",
     "StateArena",
     "StateIntegrityError",
+    "SteadySpec",
     "forecast_bucket",
     "make_arena_forecast_fn",
+    "make_arena_steady_update_fn",
     "make_arena_update_fn",
+    "make_steady_update_fn",
     "parse_horizons",
     "posterior_fault",
     "posterior_state_from_metran",

@@ -34,6 +34,8 @@ from ..ops import (
     gated_filter_append,
     gated_sqrt_filter_append,
     sqrt_filter_append,
+    steady_converged,
+    steady_filter_append,
 )
 from ..ops.statespace import StateSpace, dfm_statespace
 
@@ -89,6 +91,55 @@ class GateSpec(NamedTuple):
         if self.enabled and not self.nsigma > 0:
             raise ValueError(
                 f"gate nsigma must be > 0, got {self.nsigma!r}"
+            )
+        return self
+
+
+class SteadySpec(NamedTuple):
+    """Steady-state gain-freeze policy for the serving update path.
+
+    Once a model's covariance recursion has converged — successive
+    posterior factors move by at most ``tol`` across a fully-observed
+    append, with at least ``min_seen`` grid steps assimilated — the
+    service **freezes** its Kalman gain (:func:`metran_tpu.ops.
+    dare_solve` / :func:`~metran_tpu.ops.steady_gains`) and serves its
+    updates through the O(S·N) mean-only steady kernel instead of the
+    full QR covariance propagation.  Any step that breaks
+    time-invariance (missing/NaN-masked slots, an observation gate
+    firing under ``reject``/``inflate``, a registry ``put`` replacing
+    the posterior) **thaws** the model back to the exact kernel
+    automatically, so results stay within a measured, bounded
+    deviation of the exact filter (tests/test_steady.py; docs/
+    concepts.md "Bounded-cost serving").
+
+    ``tol`` is the freeze threshold on the max-abs posterior-factor
+    delta in standardized units (0.0 disables the whole path — the
+    shipped default); ``min_seen`` is the assimilated-steps floor.
+    Defaults from :func:`metran_tpu.config.serve_defaults`
+    (``METRAN_TPU_SERVE_STEADY_{TOL,MIN_SEEN}``).
+    """
+
+    tol: float = 0.0
+    min_seen: int = 256
+
+    @property
+    def enabled(self) -> bool:
+        return self.tol > 0.0
+
+    @classmethod
+    def from_defaults(cls) -> "SteadySpec":
+        from ..config import serve_defaults
+
+        d = serve_defaults()
+        return cls(
+            tol=float(d["steady_tol"]),
+            min_seen=int(d["steady_min_seen"]),
+        ).validate()
+
+    def validate(self) -> "SteadySpec":
+        if self.tol < 0.0:
+            raise ValueError(
+                f"steady tol must be >= 0 (0 disables), got {self.tol!r}"
             )
         return self
 
@@ -195,7 +246,7 @@ def psd_factor(cov: np.ndarray) -> np.ndarray:
 
 
 def pad_state_arrays(state, bucket: Tuple[int, int], dtype=None,
-                     sqrt: bool = False):
+                     sqrt: bool = False, factors: bool = True):
     """Pad one PosteriorState's arrays into bucket shape ``(N, S)``.
 
     Returns ``(alpha_sdf (N,), alpha_cdf (S-N,), loadings (N, S-N),
@@ -233,6 +284,11 @@ def pad_state_arrays(state, bucket: Tuple[int, int], dtype=None,
     mean = np.zeros(s_pad, dtype)
     mean[idx] = state.mean
     cov = chol = None
+    if not factors:
+        # the steady (frozen-gain) kernels never read a covariance OR
+        # a factor — skip the O(S^2) pad entirely (the mean recursion
+        # is the whole point of that path)
+        return alpha[:n_pad], alpha[n_pad:], loadings, mean, cov, chol
     if sqrt:
         # the factored kernels never read the covariance stack — skip
         # the O(S^2) pad and its device transfer on the hot path
@@ -249,25 +305,32 @@ def pad_state_arrays(state, bucket: Tuple[int, int], dtype=None,
 
 
 def stack_bucket(states: List, bucket: Tuple[int, int], dtype=None,
-                 sqrt: bool = False) -> BucketBatch:
+                 sqrt: bool = False, factors: bool = True) -> BucketBatch:
     """Stack heterogeneous same-bucket models into one :class:`BucketBatch`.
 
     The state-space build itself (``dfm_statespace``) runs vmapped on
     device, so the host only stacks small parameter arrays.
     ``sqrt=True`` stacks covariance factors too (see
-    :func:`pad_state_arrays`) for the square-root update kernels.
+    :func:`pad_state_arrays`) for the square-root update kernels;
+    ``factors=False`` stacks neither covariances nor factors (the
+    steady frozen-gain path — mean-only).
     """
     if dtype is None:
         dtype = states[0].dtype
-    padded = [pad_state_arrays(st, bucket, dtype, sqrt=sqrt) for st in states]
+    padded = [
+        pad_state_arrays(st, bucket, dtype, sqrt=sqrt, factors=factors)
+        for st in states
+    ]
     a_sdf, a_cdf, lds, means = (
         jnp.asarray(np.stack(part)) for part in zip(*[p[:4] for p in padded])
     )
     covs = (
-        None if sqrt else jnp.asarray(np.stack([p[4] for p in padded]))
+        None if (sqrt or not factors)
+        else jnp.asarray(np.stack([p[4] for p in padded]))
     )
     chols = (
-        jnp.asarray(np.stack([p[5] for p in padded])) if sqrt else None
+        jnp.asarray(np.stack([p[5] for p in padded]))
+        if (sqrt and factors) else None
     )
     dts = jnp.asarray(np.array([st.dt for st in states], dtype))
     ss = _build_statespace(a_sdf, a_cdf, lds, dts)
@@ -398,6 +461,93 @@ def make_update_fn(engine: str = "joint", gate: Optional[GateSpec] = None,
     return _annotated(jax.jit(core), UPDATE_ANNOTATION)
 
 
+def _steady_horizon_means(ss, mean_t, horizons: Tuple[int, ...]):
+    """The steady path's commit-time horizon pass: MEANS ONLY.
+
+    A frozen row's posterior covariance never changes, so its horizon
+    *variances* are constants precomputed once at freeze time
+    (``serve.service`` caches them per model) — one forecast pass
+    amortized across all future commits.  Per commit only the mean
+    half is recomputed: ``Z (phi^h ∘ m)``, a stack of matvecs instead
+    of the (H, S, S) covariance propagation the exact fused pass pays.
+    Returns (B, H, N) standardized means.
+    """
+    hz = jnp.asarray(horizons)
+
+    def one(ss_i, m):
+        h = hz.astype(m.dtype)[:, None]  # (H, 1)
+        mean_h = ss_i.phi[None, :] ** h * m[None, :]
+        return mean_h @ ss_i.z.T
+
+    return jax.vmap(one)(ss, mean_t)
+
+
+def make_steady_update_fn(gate: Optional[GateSpec] = None,
+                          horizons: Optional[Tuple[int, ...]] = None,
+                          sequential_gate: bool = False):
+    """A fresh jitted batched **steady** (frozen-gain) update kernel.
+
+    ``fn(ss, mean, kgain, fdiag, real, y_new, mask_new[, armed]) ->
+    (mean_T, sigma, detf, broke[, zscore, verdict][, fmeans])`` —
+    the dict-registry twin of the exact :func:`make_update_fn`, but
+    per-model the body is :func:`metran_tpu.ops.steady_filter_append`:
+    a mean-only recursion through the frozen gain, no QR, no factor
+    stacking, no covariance output at all.  Engine-agnostic: joint and
+    square-root registries share it (the frozen gain IS the engine).
+
+    ``broke`` is the per-row thaw verdict — a True row's result must
+    be discarded and its rows replayed through the exact kernel (the
+    service does this inside the same dispatch).  ``real`` is the
+    (B, N) true-observation-slot mask from the host-side series
+    counts (a padded bucket's ``Z`` rows cannot mark padding).
+    ``sequential_gate`` must match the exact kernel the rows thaw
+    back to (True on gated covariance-engine registries — the frozen
+    leaves then carry the per-slot sequential gains/conditional
+    variances; see :func:`metran_tpu.ops.steady_filter_append`).
+    With ``horizons`` the kernel appends the MEAN half of the fused
+    commit-time forecast pass (:func:`_steady_horizon_means`); the
+    variance half is a frozen constant the caller caches.
+    """
+    gated = gate is not None and gate.enabled
+    if gated:
+        gate.validate()
+        policy, nsigma = gate.policy, float(gate.nsigma)
+    else:
+        policy, nsigma = "off", 4.0
+    hz = tuple(int(h) for h in horizons) if horizons else ()
+    seq = bool(sequential_gate) and gated
+
+    def core(ss, mean, kgain, fdiag, real, y_new, mask_new, armed):
+        out = jax.vmap(
+            lambda s, m, kg, fd, r, y, k, a: steady_filter_append(
+                s, m, kg, fd, y, k, armed=a, policy=policy,
+                nsigma=nsigma, real=r, sequential_gate=seq,
+            )
+        )(ss, mean, kgain, fdiag, real, y_new, mask_new, armed)
+        mean_t, sigma, detf, broke, zs, verdicts = out
+        res = (mean_t, sigma, detf, broke)
+        if gated:
+            res = res + (zs, verdicts)
+        if hz:
+            res = res + (_steady_horizon_means(ss, mean_t, hz),)
+        return res
+
+    if gated:
+
+        def fn(ss, mean, kgain, fdiag, real, y_new, mask_new, armed):
+            return core(ss, mean, kgain, fdiag, real, y_new,
+                        mask_new, armed)
+
+    else:
+
+        def fn(ss, mean, kgain, fdiag, real, y_new, mask_new):
+            armed = jnp.zeros(mean.shape[0], bool)
+            return core(ss, mean, kgain, fdiag, real, y_new,
+                        mask_new, armed)
+
+    return _annotated(jax.jit(fn), UPDATE_ANNOTATION)
+
+
 def make_forecast_fn(steps: int):
     """A fresh jitted batched forecast kernel.
 
@@ -477,6 +627,7 @@ def make_arena_update_fn(
     engine: str = "joint", gate: Optional[GateSpec] = None,
     validate: bool = True,
     horizons: Optional[Tuple[int, ...]] = None,
+    steady_tol: float = 0.0,
 ):
     """A fresh jitted **arena** assimilation kernel (in-place).
 
@@ -510,6 +661,14 @@ def make_arena_update_fn(
     WRITTEN row values — a rejected row's moments therefore describe
     its unchanged prior posterior, consistent with what the row
     serves (``serve.readpath``).
+
+    With ``steady_tol > 0`` the kernel additionally appends a (G,)
+    ``conv`` flag — the ON-DEVICE half of steady-state detection
+    (:func:`metran_tpu.ops.steady_converged`): the row's posterior
+    factor moved at most ``steady_tol`` across a fully-observed
+    append.  The service ANDs in its host-side conditions (``t_seen``
+    floor, no gate verdicts) before freezing the row's gain
+    (docs/concepts.md "Bounded-cost serving").
     """
     sqrt_engine = engine in ("sqrt", "sqrt_parallel")
     gated = gate is not None and gate.enabled
@@ -518,7 +677,7 @@ def make_arena_update_fn(
         gate.validate()
         policy, nsigma = gate.policy, float(gate.nsigma)
 
-    def _body(dyn, static, rows, y, mask, armed):
+    def _body(dyn, static, rows, y, mask, armed, real=None):
         mean_a, fac_a, t_a, v_a = dyn
         phi_a, q_a, z_a, r_a = static
         k = y.shape[1]
@@ -578,20 +737,134 @@ def make_arena_update_fn(
             # read-after-commit gather would see, in the same dispatch
             fm, fv = _horizon_pass(ss, mean_w, fac_w, hz, sqrt_engine)
             extra = extra + (fm, fv)
+        if steady_tol > 0.0:
+            # on-device convergence detection, LAST output by contract
+            extra = extra + (steady_converged(
+                fac_g, fac_w, mask, real,
+                jnp.asarray(steady_tol, mean_a.dtype),
+            ),)
         return (new_dyn, ok, sigma, detf) + extra
 
-    if gated:
+    # the convergence detector needs the (G, N) real-slot mask (host
+    # series counts — padded Z rows cannot mark padding), so arming
+    # steady_tol appends one trailing argument to the signature
+    if gated and steady_tol > 0.0:
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def fn(dyn, static, rows, y, mask, min_seen, real):
+            armed = dyn[2][rows] >= min_seen
+            return _body(dyn, static, rows, y, mask, armed, real)
+
+    elif gated:
 
         @functools.partial(jax.jit, donate_argnums=(0,))
         def fn(dyn, static, rows, y, mask, min_seen):
             armed = dyn[2][rows] >= min_seen
             return _body(dyn, static, rows, y, mask, armed)
 
+    elif steady_tol > 0.0:
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def fn(dyn, static, rows, y, mask, real):
+            return _body(dyn, static, rows, y, mask, None, real)
+
     else:
 
         @functools.partial(jax.jit, donate_argnums=(0,))
         def fn(dyn, static, rows, y, mask):
             return _body(dyn, static, rows, y, mask, None)
+
+    return _annotated(fn, UPDATE_ANNOTATION)
+
+
+def make_arena_steady_update_fn(
+    gate: Optional[GateSpec] = None,
+    horizons: Optional[Tuple[int, ...]] = None,
+    sequential_gate: bool = False,
+):
+    """A fresh jitted **arena steady** (frozen-gain) update kernel.
+
+    ``fn(dynamic, static, steady_leaves, rows, real, y, mask
+    [, min_seen]) -> (dynamic', applied, sigma, detf[, zscore,
+    verdict][, fmeans])``
+    where ``steady_leaves`` is the arena's ``(steady, kgain, fdiag)``
+    tuple (read-only — only the dynamic leaves are donated).  The
+    bounded-cost hot path: gather rows → the fused mean-only append
+    (:func:`metran_tpu.ops.steady_filter_append` vmapped — no QR, no
+    (G, S, S) factor gather, no factor scatter) → scatter the new
+    means.  Branch-free per-row selection: a row is ``applied`` only
+    when its device-resident ``steady`` flag is set AND nothing broke
+    time-invariance in this append (missing slots, a ``reject``/
+    ``inflate`` gate hit, a non-finite mean); everything else writes
+    back bit-identically unchanged and the service replays those rows
+    through the exact kernel (thaw).  ``t_seen``/``version`` advance
+    on applied rows only, exactly like the exact kernel's commit.
+
+    The factor leaf passes through untouched — frozen means frozen —
+    so the kernel moves O(G·S·N) bytes where the exact one moves
+    O(G·S²), and does O(k·S·N) flops per row where the exact one pays
+    the O(k·S³) QR.  With ``horizons`` the MEAN half of the fused
+    forecast pass rides along (:func:`_steady_horizon_means`); the
+    variance half is the frozen constant cached at freeze time.
+    """
+    gated = gate is not None and gate.enabled
+    if gated:
+        gate.validate()
+        policy, nsigma = gate.policy, float(gate.nsigma)
+    else:
+        policy, nsigma = "off", 4.0
+    hz = tuple(int(h) for h in horizons) if horizons else ()
+    seq = bool(sequential_gate) and gated
+
+    def _body(dyn, static, steady_leaves, rows, real, y, mask, armed):
+        mean_a, fac_a, t_a, v_a = dyn
+        phi_a, q_a, z_a, r_a = static
+        steady_a, kgain_a, fdiag_a = steady_leaves
+        k = y.shape[1]
+        ss = StateSpace(
+            phi=phi_a[rows], q=q_a[rows], z=z_a[rows], r=r_a[rows]
+        )
+        mean_g = mean_a[rows]
+        out = jax.vmap(
+            lambda s, m, kg, fd, r, yy, kk, a: steady_filter_append(
+                s, m, kg, fd, yy, kk, armed=a, policy=policy,
+                nsigma=nsigma, real=r, sequential_gate=seq,
+            )
+        )(ss, mean_g, kgain_a[rows], fdiag_a[rows], real, y, mask,
+          armed)
+        mean_n, sigma, detf, broke, zs, verdicts = out
+        applied = steady_a[rows] & ~broke
+        mean_w = jnp.where(applied[:, None], mean_n, mean_g)
+        bump = applied.astype(t_a.dtype)
+        new_dyn = (
+            mean_a.at[rows].set(mean_w),
+            fac_a,  # frozen: the factor leaf is never touched
+            t_a.at[rows].add(bump * k),
+            v_a.at[rows].add(bump),
+        )
+        extra = ()
+        if gated:
+            extra = (zs, verdicts)
+        if hz:
+            extra = extra + (_steady_horizon_means(ss, mean_w, hz),)
+        return (new_dyn, applied, sigma, detf) + extra
+
+    if gated:
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def fn(dyn, static, steady_leaves, rows, real, y, mask,
+               min_seen):
+            armed = dyn[2][rows] >= min_seen
+            return _body(dyn, static, steady_leaves, rows, real, y,
+                         mask, armed)
+
+    else:
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def fn(dyn, static, steady_leaves, rows, real, y, mask):
+            armed = jnp.zeros(rows.shape, bool)
+            return _body(dyn, static, steady_leaves, rows, real, y,
+                         mask, armed)
 
     return _annotated(fn, UPDATE_ANNOTATION)
 
@@ -653,11 +926,14 @@ __all__ = [
     "BucketBatch",
     "FORECAST_ANNOTATION",
     "GateSpec",
+    "SteadySpec",
     "UPDATE_ANNOTATION",
     "forecast_bucket",
     "make_arena_forecast_fn",
+    "make_arena_steady_update_fn",
     "make_arena_update_fn",
     "make_forecast_fn",
+    "make_steady_update_fn",
     "make_update_fn",
     "pad_state_arrays",
     "posterior_fault",

@@ -754,12 +754,31 @@ class ModelRegistry:
         pinned row fails that model's slot instead.
         """
         hits, errs = [], []
+        row_map = self._row_map
+        arenas = self._arenas
+        lru = self._arena_lru
+        pinned = self._pinned
         with self._arena_lock:
             for mid in model_ids:
+                # warm fast path: already-resident models are the
+                # overwhelming case on a fleet tick, and the full
+                # ensure_resident ceremony (nested call, try frame,
+                # re-entrant lock) costs more than the lookup itself
+                # at batch size — measured ~0.8 ms/tick at G=256
+                hit = row_map.get(mid)
+                if hit is not None:
+                    arena = arenas.get(hit[0])
+                    if arena is not None and not arena.lost:
+                        lru.move_to_end(mid)
+                        if pin:
+                            pinned[mid] = pinned.get(mid, 0) + 1
+                        hits.append(hit)
+                        errs.append(None)
+                        continue
                 try:
                     hit = self.ensure_resident(mid)
                     if pin:
-                        self._pinned[mid] = self._pinned.get(mid, 0) + 1
+                        pinned[mid] = pinned.get(mid, 0) + 1
                     hits.append(hit)
                     errs.append(None)
                 except Exception as exc:  # noqa: BLE001 - per-slot
@@ -978,11 +997,15 @@ class ModelRegistry:
         )
 
     def arena_update_fn(self, bucket: ShapeBucket, k: int, gate=None,
-                        validate: bool = True, horizons=None):
+                        validate: bool = True, horizons=None,
+                        steady_tol: float = 0.0):
         """Compiled arena assimilation kernel (donating, in-place) for
         ``k`` appended steps — same compile-key discipline as
         :meth:`update_fn` plus the ``validate`` bit (the on-device
-        integrity gate is compiled in or out)."""
+        integrity gate is compiled in or out) and, when the service
+        arms steady-state serving, the convergence-detection tolerance
+        (``steady_tol`` — the on-device freeze detector is compiled in
+        or out with it)."""
         from .engine import make_arena_update_fn
 
         key = ("arena_update", bucket, int(k), self.engine,
@@ -992,13 +1015,81 @@ class ModelRegistry:
         if horizons:
             horizons = tuple(int(h) for h in horizons)
             key = key + ("hz", horizons)
+        if steady_tol > 0.0:
+            key = key + ("conv", float(steady_tol))
         return self._compiled.get_or_create(
             key,
             lambda: make_arena_update_fn(
                 engine=self.engine, gate=gate, validate=validate,
-                horizons=horizons,
+                horizons=horizons, steady_tol=float(steady_tol),
             ),
         )
+
+    def steady_update_fn(self, bucket: ShapeBucket, k: int, gate=None,
+                         horizons=None):
+        """Compiled **steady** (frozen-gain, mean-only) update kernel
+        for ``k`` appended steps — the dict-registry bounded-cost hot
+        path (:func:`~metran_tpu.serve.engine.make_steady_update_fn`).
+        Ungated, the kernel is engine-agnostic (the frozen gain IS the
+        engine) and joint/sqrt registries share one executable per
+        (bucket, k); an enabled gate selects the gate FORM the exact
+        kernel this registry thaws back to uses — per-slot sequential
+        on covariance engines, marginal on square-root ones — so the
+        flag joins the key."""
+        from .engine import make_steady_update_fn
+
+        seq = (
+            gate is not None and getattr(gate, "enabled", False)
+            and not self._sqrt_engine
+        )
+        key = ("steady_update", bucket, int(k))
+        if gate is not None and getattr(gate, "enabled", False):
+            key = key + ("gate", gate.policy, float(gate.nsigma))
+            if seq:
+                key = key + ("seqgate",)
+        if horizons:
+            horizons = tuple(int(h) for h in horizons)
+            key = key + ("hz", horizons)
+        return self._compiled.get_or_create(
+            key,
+            lambda: make_steady_update_fn(
+                gate=gate, horizons=horizons, sequential_gate=seq
+            ),
+        )
+
+    def arena_steady_update_fn(self, bucket: ShapeBucket, k: int,
+                               gate=None, horizons=None):
+        """Compiled **arena steady** update kernel (donating, mean-only
+        scatter) — :func:`~metran_tpu.serve.engine.
+        make_arena_steady_update_fn` under the same LRU and gate-form
+        discipline as :meth:`steady_update_fn`."""
+        from .engine import make_arena_steady_update_fn
+
+        seq = (
+            gate is not None and getattr(gate, "enabled", False)
+            and not self._sqrt_engine
+        )
+        key = ("arena_steady_update", bucket, int(k))
+        if gate is not None and getattr(gate, "enabled", False):
+            key = key + ("gate", gate.policy, float(gate.nsigma))
+            if seq:
+                key = key + ("seqgate",)
+        if horizons:
+            horizons = tuple(int(h) for h in horizons)
+            key = key + ("hz", horizons)
+        return self._compiled.get_or_create(
+            key,
+            lambda: make_arena_steady_update_fn(
+                gate=gate, horizons=horizons, sequential_gate=seq
+            ),
+        )
+
+    def steady_rows_count(self) -> int:
+        """Frozen (steady) rows across every arena — the
+        ``metran_serve_steady_rows`` gauge's arena-mode source."""
+        with self._arena_lock:
+            arenas = list(self._arenas.values())
+        return sum(a.steady_rows for a in arenas)
 
     def arena_forecast_fn(self, bucket: ShapeBucket, steps: int):
         """Compiled arena forecast kernel (read-only row gather)."""

@@ -1,0 +1,242 @@
+"""Fixed-lag smoothed products for serving: O(L) recent-window smoothing.
+
+Serving answers filtered (causal) posteriors; many monitoring products
+want *smoothed* ones — the best estimate of the recent past given
+everything seen since.  The classical route (RTS over the full
+history) is O(T) per query and T grows forever in production.  The
+fixed-lag route bounds it: keep, per model, a rolling **anchor**
+posterior at ``t_seen - L`` plus the L observation rows since, and a
+query is one O(L) windowed filter + smoother pass
+(:func:`metran_tpu.ops.fixed_lag_smooth`) — flat in T by
+construction, and *exactly* equal to the full smoother on those last
+L steps (the filter is Markov; tests/test_steady.py pins bit-level
+f64 equality).
+
+:class:`FixedLagTracker` is the host-side bookkeeping: the serving
+dispatch paths feed every committed update's standardized rows into
+:meth:`FixedLagTracker.observe`, which maintains the anchor by
+replaying the rows that fall off the window through the square-root
+incremental filter (one O(k) kernel per commit once the window is
+full — the textbook fixed-lag cost, paid only when the feature is
+armed: ``METRAN_TPU_SERVE_FIXED_LAG``, shipped 0/off).
+``MetranService.smoothed(model_id, lag=L)`` is the query API.
+
+Tracking (re)starts from the posterior AFTER a commit whenever the
+stream's continuity breaks (first touch, an external ``registry.put``
+hot-swap, a rejected update) — the window then refills over the next
+L commits; :meth:`smooth` reports how much of it is available.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["FixedLagTracker", "SmoothedWindow"]
+
+
+class SmoothedWindow(NamedTuple):
+    """One model's smoothed trailing window, data units.
+
+    ``means``/``variances`` are (L, n_series) smoothed observation-
+    space moments (de-standardized); ``state_means`` the (L, n_state)
+    smoothed state means in standardized units (the sdf/cdf
+    decomposition inputs); ``t_end`` the grid index of the last
+    smoothed step (== the model's ``t_seen`` at query time); ``lag``
+    the realized window length (may be shorter than requested while
+    the window refills after a tracking restart).
+    """
+
+    means: np.ndarray
+    variances: np.ndarray
+    state_means: np.ndarray
+    names: Tuple[str, ...]
+    t_end: int
+    lag: int
+
+
+class _Track:
+    """One model's window state (guarded by the tracker lock)."""
+
+    __slots__ = (
+        "params", "loadings", "dt", "names", "scaler_mean",
+        "scaler_std", "anchor_mean", "anchor_chol", "anchor_t_seen",
+        "rows",
+    )
+
+    def __init__(self, state, anchor_mean, anchor_chol):
+        self.params = np.asarray(state.params, float)
+        self.loadings = np.asarray(state.loadings, float)
+        self.dt = float(state.dt)
+        self.names = tuple(state.names)
+        self.scaler_mean = np.asarray(state.scaler_mean, float)
+        self.scaler_std = np.asarray(state.scaler_std, float)
+        self.anchor_mean = anchor_mean
+        self.anchor_chol = anchor_chol
+        self.anchor_t_seen = int(state.t_seen)
+        #: buffered (y_std (n,), mask (n,)) rows SINCE the anchor
+        self.rows: List[Tuple[np.ndarray, np.ndarray]] = []
+
+    def statespace(self):
+        from ..ops import dfm_statespace
+
+        n = self.loadings.shape[0]
+        return dfm_statespace(
+            self.params[:n], self.params[n:], self.loadings, self.dt
+        )
+
+
+def _anchor_factor(state) -> np.ndarray:
+    """The anchor posterior's covariance factor: the state's own
+    Cholesky factor when it carries one (square-root serving), else
+    the eigh-based :func:`~metran_tpu.serve.engine.psd_factor` (the
+    same covariance→factor migration the sqrt serving path uses —
+    ``np.linalg.cholesky`` would refuse the DFM's structurally
+    singular filtered covariances)."""
+    from .engine import psd_factor
+
+    chol = getattr(state, "chol", None)
+    if chol is not None:
+        return np.asarray(chol, float)
+    return psd_factor(np.asarray(state.cov, float))
+
+
+class FixedLagTracker:
+    """Per-model rolling anchors + observation windows (see module
+    docstring).  Thread-safe; every kernel call happens under the
+    tracker lock (queries are rare next to the dispatch paths, and
+    the replay work per commit is one O(k) incremental filter)."""
+
+    def __init__(self, lag: int):
+        if int(lag) < 1:
+            raise ValueError(f"fixed-lag window must be >= 1, got {lag}")
+        self.lag = int(lag)
+        self._lock = threading.RLock()
+        self._tracks: Dict[str, _Track] = {}
+
+    def __len__(self) -> int:
+        return len(self._tracks)
+
+    def tracking(self, model_id: str) -> bool:
+        with self._lock:
+            return model_id in self._tracks
+
+    def forget(self, model_id: str) -> None:
+        with self._lock:
+            self._tracks.pop(model_id, None)
+
+    def observe(self, model_id: str, y_std: np.ndarray,
+                mask: np.ndarray, t_seen_after: int,
+                post_state_fn, clean: bool = True) -> None:
+        """Feed one committed update's ``k`` standardized rows.
+
+        ``t_seen_after`` is the model's ``t_seen`` AFTER the commit;
+        when it does not line up with the tracked window (first touch,
+        an external hot-swap, an intervening rejected/failed update),
+        tracking restarts from ``post_state_fn()`` — the posterior
+        after this commit — and the window refills from the next
+        commit on.  ``clean=False`` forces the same restart: the
+        serving layer passes it when the observation gate ACTED on
+        this commit (rejected or downweighted a slot) — the served
+        filter then differs from what replaying the raw rows through
+        the ungated window kernels would compute, so buffering them
+        would silently diverge the smoothed window from the posterior
+        the service actually carries.  Never raises: window
+        maintenance must not fail a caller whose update already
+        committed.
+        """
+        y_std = np.atleast_2d(np.asarray(y_std, float))
+        mask = np.atleast_2d(np.asarray(mask, bool))
+        k = y_std.shape[0]
+        with self._lock:
+            tr = self._tracks.get(model_id)
+            if (
+                not clean
+                or tr is None
+                or tr.anchor_t_seen + len(tr.rows) + k != int(t_seen_after)
+            ):
+                try:
+                    state = post_state_fn()
+                    self._tracks[model_id] = _Track(
+                        state, np.asarray(state.mean, float),
+                        _anchor_factor(state),
+                    )
+                except Exception:  # pragma: no cover - tracking only
+                    self._tracks.pop(model_id, None)
+                return
+            for i in range(k):
+                tr.rows.append((y_std[i], mask[i]))
+            self._advance(tr)
+
+    def _advance(self, tr: _Track) -> None:
+        """Replay the rows that fell off the window into the anchor
+        (one :func:`~metran_tpu.ops.sqrt_filter_append` call; in a
+        steady stream the replay chunk is the commit's own ``k``, so
+        the jit cache sees a bounded shape set)."""
+        from ..ops import sqrt_filter_append
+
+        excess = len(tr.rows) - self.lag
+        if excess <= 0:
+            return
+        y = np.stack([r[0] for r in tr.rows[:excess]])
+        m = np.stack([r[1] for r in tr.rows[:excess]])
+        mean, chol, _, _ = sqrt_filter_append(
+            tr.statespace(), tr.anchor_mean, tr.anchor_chol, y, m
+        )
+        tr.anchor_mean = np.asarray(mean)
+        tr.anchor_chol = np.asarray(chol)
+        tr.anchor_t_seen += excess
+        del tr.rows[:excess]
+
+    def smooth(self, model_id: str,
+               lag: Optional[int] = None) -> SmoothedWindow:
+        """Smoothed moments for the model's trailing window.
+
+        ``lag`` caps the returned window (default: the configured
+        lag); the realized window is additionally capped by how many
+        rows have streamed through since tracking (re)started —
+        :class:`SmoothedWindow` ``.lag`` reports it.  Raises
+        ``KeyError`` for an untracked model and ``ValueError`` while
+        the window is still empty.
+        """
+        from ..ops import chol_outer, fixed_lag_smooth, project
+
+        want = self.lag if lag is None else int(lag)
+        if want < 1:
+            raise ValueError(f"lag must be >= 1, got {lag}")
+        with self._lock:
+            tr = self._tracks.get(model_id)
+            if tr is None:
+                raise KeyError(
+                    f"model {model_id!r} is not tracked yet — smoothed "
+                    "windows build from updates streamed through the "
+                    "service after fixed-lag tracking was armed"
+                )
+            if not tr.rows:
+                raise ValueError(
+                    f"model {model_id!r} has an empty smoothing window "
+                    "(tracking just (re)started); stream more updates"
+                )
+            ss = tr.statespace()
+            y = np.stack([r[0] for r in tr.rows])
+            m = np.stack([r[1] for r in tr.rows])
+            sm = fixed_lag_smooth(
+                ss, tr.anchor_mean, tr.anchor_chol, y, m
+            )
+            take = min(want, len(tr.rows))
+            mean_s = np.asarray(sm.mean_s)[-take:]
+            cov_s = np.asarray(chol_outer(sm.chol_s[-take:]))
+            means, variances = project(ss.z, mean_s, cov_s)
+            means = np.asarray(means)
+            variances = np.asarray(variances) + np.asarray(ss.r)[None]
+            t_end = tr.anchor_t_seen + len(tr.rows)
+        return SmoothedWindow(
+            means=means * tr.scaler_std + tr.scaler_mean,
+            variances=variances * tr.scaler_std**2,
+            state_means=mean_s,
+            names=tr.names,
+            t_end=int(t_end),
+            lag=int(take),
+        )

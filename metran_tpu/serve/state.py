@@ -479,6 +479,27 @@ def _arena_write_fn():
 _ARENA_WRITE = None
 
 
+def _steady_write_fn():
+    """The (module-cached) donating steady-leaf writer: scatter a row
+    batch's steady flag / frozen gain / innovation variances in place
+    (freeze and thaw both go through it)."""
+    import jax
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def write(leaves, rows, flags, kgains, fdiags):
+        steady, kgain, fdiag = leaves
+        return (
+            steady.at[rows].set(flags),
+            kgain.at[rows].set(kgains),
+            fdiag.at[rows].set(fdiags),
+        )
+
+    return write
+
+
+_STEADY_WRITE = None
+
+
 @functools.lru_cache(maxsize=32)
 def _identity_row_ss(bucket: Tuple[int, int], dtype_str: str):
     """The built state-space leaves of a FREE arena row (padded-slot
@@ -619,6 +640,19 @@ class StateArena:
         self._z = _place(np.broadcast_to(
             z0, (capacity, n_pad, s_pad)).copy())
         self._r = _place(np.broadcast_to(r0, (capacity, n_pad)).copy())
+        # --- steady-state (frozen-gain) leaves: written only at
+        # freeze/thaw, read by the steady update kernel per dispatch.
+        # A frozen row's mean updates through its resident gain with
+        # the factor leaf untouched; `steady` is the device-resident
+        # row selector (host mirror below, like t_seen/version), reset
+        # by every (re)pack so a registry.put can never leave a stale
+        # frozen gain serving a replaced posterior.
+        self._steady = _place(np.zeros(capacity, bool))
+        self._kgain = _place(np.zeros((capacity, s_pad, n_pad), dt))
+        self._fdiag = _place(np.ones((capacity, n_pad), dt))
+        #: host mirror of the device steady flags — the dispatch-time
+        #: row partition reads this, never the device
+        self.steady_host = np.zeros(capacity, bool)
 
     # -- row bookkeeping ------------------------------------------------
     @property
@@ -656,6 +690,9 @@ class StateArena:
     def _static(self):
         return (self._phi, self._q, self._z, self._r)
 
+    def _steady_leaves(self):
+        return (self._steady, self._kgain, self._fdiag)
+
     def apply(self, fn, *args):
         """Run a donating update kernel ``fn(dynamic, static, *args)``
         against this arena's leaves and swap in the new dynamic leaves
@@ -671,6 +708,25 @@ class StateArena:
                 # the donated leaves may or may not have been consumed:
                 # either way they can no longer be trusted as the
                 # arena's contents
+                self._lost = True
+                raise
+            return out[1:]
+
+    def apply_steady(self, fn, *args):
+        """Run the donating **steady** update kernel
+        ``fn(dynamic, static, steady_leaves, *args)`` (from
+        :func:`~metran_tpu.serve.engine.make_arena_steady_update_fn`)
+        — same donation contract as :meth:`apply`, with the read-only
+        steady leaves threaded in under the same lock."""
+        with self.lock:
+            self._check()
+            try:
+                out = fn(
+                    self._dynamic(), self._static(),
+                    self._steady_leaves(), *args,
+                )
+                (self._mean, self._fac, self._t_seen, self._version) = out[0]
+            except BaseException:
                 self._lost = True
                 raise
             return out[1:]
@@ -702,6 +758,63 @@ class StateArena:
                 self.t_seen_host[rows].copy(),
             )
 
+    # -- steady (frozen-gain) rows ---------------------------------------
+    def freeze_rows(self, rows, kgains, fdiags) -> None:
+        """Mark ``rows`` steady, scattering their frozen gains and
+        innovation variances into the steady leaves (padded (S, N)/(N,)
+        arrays per row — :func:`metran_tpu.ops.steady_gains` output
+        scattered into the bucket layout by the caller).  The steady
+        update kernel serves these rows mean-only from the next
+        dispatch on."""
+        global _STEADY_WRITE
+        rows = np.asarray(rows, np.int32)
+        with self.lock:
+            self._check()
+            if _STEADY_WRITE is None:
+                _STEADY_WRITE = _steady_write_fn()
+            try:
+                new = _STEADY_WRITE(
+                    self._steady_leaves(), rows,
+                    np.ones(len(rows), bool),
+                    np.asarray(kgains, self.dtype),
+                    np.asarray(fdiags, self.dtype),
+                )
+            except BaseException:
+                self._lost = True
+                raise
+            (self._steady, self._kgain, self._fdiag) = new
+            self.steady_host[rows] = True
+
+    def thaw_rows(self, rows) -> None:
+        """Clear ``rows``' steady flags (the gains stay resident but
+        unreachable — a later re-freeze overwrites them); the exact
+        kernel serves these rows again from the next dispatch on."""
+        global _STEADY_WRITE
+        rows = np.asarray(rows, np.int32)
+        n_pad, s_pad = self.bucket
+        with self.lock:
+            self._check()
+            if _STEADY_WRITE is None:
+                _STEADY_WRITE = _steady_write_fn()
+            try:
+                new = _STEADY_WRITE(
+                    self._steady_leaves(), rows,
+                    np.zeros(len(rows), bool),
+                    np.zeros((len(rows), s_pad, n_pad), self.dtype),
+                    np.ones((len(rows), n_pad), self.dtype),
+                )
+            except BaseException:
+                self._lost = True
+                raise
+            (self._steady, self._kgain, self._fdiag) = new
+            self.steady_host[rows] = False
+
+    @property
+    def steady_rows(self) -> int:
+        """Currently frozen rows (the steady-rows gauge's source)."""
+        with self.lock:
+            return int(np.count_nonzero(self.steady_host))
+
     # -- pack / unpack ---------------------------------------------------
     def write_row(self, row: int, state: PosteriorState) -> None:
         """(Re)pack one model's state into ``row`` — padded exactly
@@ -721,23 +834,31 @@ class StateArena:
             a_sdf[None], a_cdf[None], lds[None],
             np.asarray([state.dt], self.dtype),
         )
+        n_pad, s_pad = self.bucket
         vals = (
             mean, fac,
             np.int32(state.t_seen), np.int32(state.version),
             ss.phi[0], ss.q[0], ss.z[0], ss.r[0],
+            # every (re)pack THAWS the row: a put() that replaced the
+            # posterior (refit hot-swap, operator restore) must never
+            # leave a stale frozen gain serving the new parameters
+            False, np.zeros((s_pad, n_pad), self.dtype),
+            np.ones(n_pad, self.dtype),
         )
         with self.lock:
             self._check()
             if _ARENA_WRITE is None:
                 _ARENA_WRITE = _arena_write_fn()
-            leaves = self._dynamic() + self._static()
+            leaves = self._dynamic() + self._static() + self._steady_leaves()
             try:
                 new = _ARENA_WRITE(leaves, np.int32(row), vals)
             except BaseException:
                 self._lost = True
                 raise
             (self._mean, self._fac, self._t_seen, self._version) = new[:4]
-            (self._phi, self._q, self._z, self._r) = new[4:]
+            (self._phi, self._q, self._z, self._r) = new[4:8]
+            (self._steady, self._kgain, self._fdiag) = new[8:]
+            self.steady_host[row] = False
             self.t_seen_host[row] = int(state.t_seen)
             self.version_host[row] = int(state.version)
             self.dirty[row] = False
@@ -826,19 +947,22 @@ class StateArena:
             np.zeros(s_pad, dt), np.eye(s_pad, dtype=dt),
             np.int32(0), np.int32(0),
             phi0, q0, z0, r0,
+            False, np.zeros((s_pad, n_pad), dt), np.ones(n_pad, dt),
         )
         with self.lock:
             self._check()
             if _ARENA_WRITE is None:
                 _ARENA_WRITE = _arena_write_fn()
-            leaves = self._dynamic() + self._static()
+            leaves = self._dynamic() + self._static() + self._steady_leaves()
             try:
                 new = _ARENA_WRITE(leaves, np.int32(row), vals)
             except BaseException:
                 self._lost = True
                 raise
             (self._mean, self._fac, self._t_seen, self._version) = new[:4]
-            (self._phi, self._q, self._z, self._r) = new[4:]
+            (self._phi, self._q, self._z, self._r) = new[4:8]
+            (self._steady, self._kgain, self._fdiag) = new[8:]
+            self.steady_host[row] = False
             self.t_seen_host[row] = 0
             self.version_host[row] = 0
             self.dirty[row] = False

@@ -51,6 +51,7 @@ MODULES = [
     "metran_tpu.serve.batching",
     "metran_tpu.serve.readpath",
     "metran_tpu.serve.service",
+    "metran_tpu.serve.smoothing",
     "metran_tpu.reliability.policy",
     "metran_tpu.reliability.health",
     "metran_tpu.reliability.faultinject",
