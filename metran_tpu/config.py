@@ -165,6 +165,26 @@ SERVE_STEADY_MIN_SEEN = 256  # assimilated-steps floor before freezing
 # in grid steps; 0 disables tracking (the rolling anchor costs one
 # O(k) replay kernel per commit once armed).
 SERVE_FIXED_LAG = 0
+# continuous adaptation: background refit + champion/challenger
+# promotion (docs/concepts.md "Continuous adaptation").  Ships OFF:
+# arming it spends fit compute on serving hosts and lets the service
+# replace its own parameters, both deployment decisions.
+SERVE_REFIT = 0  # 1 = run the background RefitWorker inside the service
+SERVE_REFIT_INTERVAL_S = 30.0  # scan cadence of the background thread
+SERVE_REFIT_TAIL = 256  # observation rows retained per model
+SERVE_REFIT_HOLDOUT = 32  # held-out rows for the shadow comparison
+SERVE_REFIT_MIN_TAIL = 64  # candidates need at least this many rows
+SERVE_REFIT_MAX_BATCH = 32  # candidates refit per cycle
+SERVE_REFIT_MAXITER = 40  # L-BFGS iterations per refit
+SERVE_REFIT_MARGIN = 0.0  # challenger must beat champion held-out
+#                           deviance by this much to promote
+SERVE_REFIT_STALENESS_OBS = 0  # refit after this many obs since last
+#                                fit (0 = degradation-triggered only)
+SERVE_REFIT_STALENESS_AGE_S = 0.0  # ... or this many seconds (0 = off)
+SERVE_REFIT_COOLDOWN_S = 60.0  # hysteresis after any refit outcome
+SERVE_REFIT_DEADLINE_S = 120.0  # fit wall-clock budget per cycle;
+#                                 an overrun rejects (champion keeps
+#                                 serving) instead of promoting late
 # observability defaults (metran_tpu.obs wired into MetranService)
 OBS_TRACE = 0  # request-scoped span tracing (metrics/events stay on)
 OBS_TRACE_BUFFER = 4096  # finished spans kept in the tracer ring
@@ -264,6 +284,48 @@ def serve_defaults() -> dict:
         ),
         "fixed_lag": _env(
             "METRAN_TPU_SERVE_FIXED_LAG", int, SERVE_FIXED_LAG
+        ),
+        "refit": _env(
+            "METRAN_TPU_SERVE_REFIT", int, SERVE_REFIT
+        ),
+        "refit_interval_s": _env(
+            "METRAN_TPU_SERVE_REFIT_INTERVAL_S", float,
+            SERVE_REFIT_INTERVAL_S,
+        ),
+        "refit_tail": _env(
+            "METRAN_TPU_SERVE_REFIT_TAIL", int, SERVE_REFIT_TAIL
+        ),
+        "refit_holdout": _env(
+            "METRAN_TPU_SERVE_REFIT_HOLDOUT", int, SERVE_REFIT_HOLDOUT
+        ),
+        "refit_min_tail": _env(
+            "METRAN_TPU_SERVE_REFIT_MIN_TAIL", int, SERVE_REFIT_MIN_TAIL
+        ),
+        "refit_max_batch": _env(
+            "METRAN_TPU_SERVE_REFIT_MAX_BATCH", int,
+            SERVE_REFIT_MAX_BATCH,
+        ),
+        "refit_maxiter": _env(
+            "METRAN_TPU_SERVE_REFIT_MAXITER", int, SERVE_REFIT_MAXITER
+        ),
+        "refit_margin": _env(
+            "METRAN_TPU_SERVE_REFIT_MARGIN", float, SERVE_REFIT_MARGIN
+        ),
+        "refit_staleness_obs": _env(
+            "METRAN_TPU_SERVE_REFIT_STALENESS_OBS", int,
+            SERVE_REFIT_STALENESS_OBS,
+        ),
+        "refit_staleness_age_s": _env(
+            "METRAN_TPU_SERVE_REFIT_STALENESS_AGE_S", float,
+            SERVE_REFIT_STALENESS_AGE_S,
+        ),
+        "refit_cooldown_s": _env(
+            "METRAN_TPU_SERVE_REFIT_COOLDOWN_S", float,
+            SERVE_REFIT_COOLDOWN_S,
+        ),
+        "refit_deadline_s": _env(
+            "METRAN_TPU_SERVE_REFIT_DEADLINE_S", float,
+            SERVE_REFIT_DEADLINE_S,
         ),
     }
 

@@ -19,7 +19,7 @@ is handed the model, reads its parameter table, minimizes
 from __future__ import annotations
 
 from logging import getLogger
-from typing import Callable, Optional, Tuple
+from typing import Callable, NamedTuple, Optional, Tuple
 
 import numpy as np
 from pandas import DataFrame
@@ -645,6 +645,86 @@ def run_lbfgs(objective, theta0, maxiter: int = 200,
         otu.tree_get(state, "count"),
         nfev,
         converged,
+    )
+
+
+class BatchedLbfgsFit(NamedTuple):
+    """Result of :func:`batched_lbfgs` (host arrays, leading B).
+
+    ``converged`` is the gradient-norm verdict only (finite value AND
+    ``gnorm < tol``); callers with an external acceptance test — the
+    refit worker's held-out champion/challenger comparison — treat it
+    as telemetry, not a gate.  ``value0`` is the objective at the
+    start point, so a run that *worsened* (line-search failure creep)
+    is diagnosable without re-evaluating.
+    """
+
+    theta: np.ndarray
+    value: np.ndarray
+    value0: np.ndarray
+    iterations: np.ndarray
+    gnorm: np.ndarray
+    converged: np.ndarray
+
+
+def batched_lbfgs(objective, theta0, data=(), maxiter: int = 60,
+                  tol: Optional[float] = None,
+                  max_linesearch_steps: int = 16) -> BatchedLbfgsFit:
+    """Solve B independent problems with one vmapped L-BFGS dispatch.
+
+    The generic single-round batch driver over the shared
+    :func:`lbfgs_advance` core: ``objective(theta_i, *data_i) ->
+    scalar`` is vmapped over the leading axis of ``theta0`` and every
+    leaf of ``data``, each lane running the same optax zoom-linesearch
+    L-BFGS as :func:`run_lbfgs` (:func:`lbfgs_trace_ctx` dtype
+    discipline) to convergence or ``maxiter`` in ONE jitted device
+    execution — batches are expected small, so chunking/host
+    checkpointing would cost more than it saves.  The serving stack's
+    background refit builds its own runner on the same core because it
+    adds a trust-region/restart schedule around each lane
+    (:func:`metran_tpu.parallel.fleet.refit_fleet`); use this driver
+    when a plain warm-started descent is enough.  A lane whose
+    objective diverges simply reports a non-finite ``value`` (and
+    ``converged=False``); it cannot poison its batch mates.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+    import optax.tree_utils as otu
+
+    theta0 = jnp.asarray(theta0)
+    if tol is None:
+        tol = default_gtol(theta0.dtype)
+    opt = optax.lbfgs(linesearch=zoom_linesearch(max_linesearch_steps))
+
+    def lane(theta, *di):
+        def obj(th):
+            return objective(th, *di)
+
+        value0 = obj(theta)
+        state = opt.init(theta)
+        theta, state, _nfev = lbfgs_advance(
+            obj, opt, theta, state, tol, maxiter, maxiter
+        )
+        value = otu.tree_get(state, "value")
+        count = otu.tree_get(state, "count")
+        gnorm = tree_norm(otu.tree_get(state, "grad"))
+        return theta, value, value0, count, gnorm
+
+    with lbfgs_trace_ctx(theta0.dtype):
+        theta, value, value0, count, gnorm = jax.jit(jax.vmap(lane))(
+            theta0, *data
+        )
+    theta = np.asarray(theta)
+    value = np.asarray(value, float)
+    gnorm = np.asarray(gnorm, float)
+    return BatchedLbfgsFit(
+        theta=theta,
+        value=value,
+        value0=np.asarray(value0, float),
+        iterations=np.asarray(count, np.int64),
+        gnorm=gnorm,
+        converged=np.isfinite(value) & (gnorm < float(tol)),
     )
 
 

@@ -68,6 +68,11 @@ EVENT_KINDS = (
     "snapshot_publish",
     "steady_freeze",
     "steady_thaw",
+    "degraded",
+    "refit_scheduled",
+    "refit_promoted",
+    "refit_rejected",
+    "refit_failed",
 )
 
 

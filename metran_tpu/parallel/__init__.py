@@ -27,10 +27,13 @@ from .fleet import (
     ALPHA_PMIN,
     Fleet,
     FleetFit,
+    anchored_fleet_deviance,
+    anchored_fleet_posteriors,
     autocorr_init_params,
     default_init_params,
     fit_fleet,
     multistart_fit_fleet,
+    refit_fleet,
     fleet_decompose,
     fleet_deviance,
     fleet_forecast,
@@ -60,6 +63,8 @@ __all__ = [
     "BATCH_AXIS",
     "Fleet",
     "FleetFit",
+    "anchored_fleet_deviance",
+    "anchored_fleet_posteriors",
     "autocorr_init_params",
     "batch_sharding",
     "default_init_params",
@@ -76,6 +81,7 @@ __all__ = [
     "make_mesh",
     "make_train_step",
     "pack_fleet",
+    "refit_fleet",
     "pad_to_multiple",
     "replicated",
     "SweepResult",

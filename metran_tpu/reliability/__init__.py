@@ -30,8 +30,8 @@ linear algebra.
 """
 
 from .faultinject import FaultInjector, SensorFault, SimulatedCrash
-from .health import HealthMonitor
-from .scenarios import run_sensor_fault_scenario
+from .health import HealthMonitor, RefitCandidate
+from .scenarios import run_drift_recovery_scenario, run_sensor_fault_scenario
 from .policy import (
     BreakerBoard,
     ChainedRequestError,
@@ -52,11 +52,13 @@ __all__ = [
     "DeadlineExceededError",
     "FaultInjector",
     "HealthMonitor",
+    "RefitCandidate",
     "ReliabilityPolicy",
     "RetryPolicy",
     "SensorFault",
     "SimulatedCrash",
     "StateIntegrityError",
     "is_retryable",
+    "run_drift_recovery_scenario",
     "run_sensor_fault_scenario",
 ]

@@ -41,6 +41,18 @@ leaves its temp file behind exactly like a killed writer would, which
 is what the crash-recovery sweep (``io.sweep_stale_tmps``) exists to
 clean up.
 
+Named fault points currently compiled into the stack: ``serve.dispatch``
+(whole-batch dispatch failures / wedged workers), ``serve.state.load``
+(state-file reads), ``serve.update.new_obs`` (the data-corruption hook
+on raw update payloads), ``io.atomic_savez.rename`` (the atomic-write
+commit step), and the continuous-adaptation pair ``serve.refit.fit``
+(the background batch fit — inject errors/delays to prove a failed or
+slow refit leaves serving untouched) and ``serve.refit.promote``
+(inside the promotion's update-lock region, BEFORE any mutation — a
+:class:`SimulatedCrash` here, or at ``io.atomic_savez.rename`` during
+the promotion's write-through, proves hot-swap crash consistency:
+recovery lands on exactly the old or exactly the new parameters).
+
 The active injector is process-global (not thread-local) on purpose:
 the serving stack hops threads (caller -> batcher worker -> dispatch),
 and a fault armed by a test must fire on whichever thread executes the

@@ -17,10 +17,31 @@ events, and batcher liveness.
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
-from typing import Deque, Dict, List, Optional, Tuple
+from typing import Deque, Dict, List, NamedTuple, Optional, Tuple
 
-__all__ = ["HealthMonitor"]
+__all__ = ["HealthMonitor", "RefitCandidate"]
+
+
+class RefitCandidate(NamedTuple):
+    """One entry of :meth:`HealthMonitor.refit_candidates`.
+
+    ``score`` is the ranking key: how far past its threshold the
+    model's worst signal sits (1.0 = exactly at threshold), so a
+    sensor rejecting 3x the degraded rate outranks a model that just
+    crossed its staleness budget.  ``reasons`` names every signal that
+    fired (``"gate"``, ``"stale_obs"``, ``"stale_age"``); the raw
+    evidence rides alongside so the refit worker can log an
+    attributable decision.
+    """
+
+    model_id: str
+    score: float
+    reasons: Tuple[str, ...]
+    rejection_rate: float
+    obs_since_fit: int
+    age_s: float
 
 
 class HealthMonitor:
@@ -46,16 +67,26 @@ class HealthMonitor:
 
     def __init__(self, window: int = 512, max_error_rate: float = 0.5,
                  gate_window: int = 128,
-                 max_rejection_rate: float = 0.1):
+                 max_rejection_rate: float = 0.1,
+                 clock=time.monotonic):
         self.window = int(window)
         self.max_error_rate = float(max_error_rate)
         self.gate_window = int(gate_window)
         self.max_rejection_rate = float(max_rejection_rate)
+        self._clock = clock
         self._outcomes: Deque[bool] = deque(maxlen=self.window)
         # model_id -> recent (observed, rejected) pairs, one per update
         self._gate: Dict[str, Deque[Tuple[int, int]]] = {}
         self._lock = threading.Lock()
         self._seen = 0
+        # -- refit bookkeeping (see refit_candidates) -------------------
+        # model_id -> (mark instant, t_seen at mark): the staleness
+        # baseline, stamped by note_fit (a promotion) or implicitly by
+        # the first note_progress (staleness accrues from first sight)
+        self._fit_marks: Dict[str, Tuple[float, int]] = {}
+        self._fit_progress: Dict[str, int] = {}  # newest observed t_seen
+        self._refitting: set = set()  # models with a refit in flight
+        self._refit_cooldown: Dict[str, float] = {}  # until-instant
 
     def record(self, ok: bool) -> None:
         with self._lock:
@@ -173,6 +204,131 @@ class HealthMonitor:
             }
             for mid, obs, rej in items
         }
+
+    # -- refit candidate queue (degradation + staleness, merged) --------
+    def note_fit(self, model_id: str, t_seen: int) -> None:
+        """Stamp ``model_id``'s staleness baseline: it was (re)fit now,
+        at ``t_seen`` assimilated steps.  The refit worker calls this
+        after every promotion; staleness signals in
+        :meth:`refit_candidates` measure from the newest stamp."""
+        with self._lock:
+            self._fit_marks[model_id] = (float(self._clock()), int(t_seen))
+            self._fit_progress[model_id] = int(t_seen)
+
+    def note_progress(self, model_id: str, t_seen: int) -> None:
+        """Record the model's current ``t_seen`` (monotonic max).  A
+        model never stamped by :meth:`note_fit` gets an implicit
+        baseline at its FIRST observed ``t_seen`` — staleness then
+        accrues from first sight, never from the absolute stream
+        origin (which would flag every long-lived model instantly)."""
+        t_seen = int(t_seen)
+        with self._lock:
+            if model_id not in self._fit_marks:
+                self._fit_marks[model_id] = (float(self._clock()), t_seen)
+            prev = self._fit_progress.get(model_id, 0)
+            if t_seen > prev:
+                self._fit_progress[model_id] = t_seen
+
+    def begin_refit(self, model_id: str) -> bool:
+        """Claim ``model_id`` for a refit; False when one is already in
+        flight (the hysteresis half that stops double-scheduling)."""
+        with self._lock:
+            if model_id in self._refitting:
+                return False
+            self._refitting.add(model_id)
+            return True
+
+    def end_refit(self, model_id: str, cooldown_s: float = 0.0) -> None:
+        """Release a :meth:`begin_refit` claim; ``cooldown_s`` keeps the
+        model out of :meth:`refit_candidates` for that long — whatever
+        the outcome, so a rejected challenger cannot thrash the fit
+        lanes every scan while its degradation signal persists."""
+        with self._lock:
+            self._refitting.discard(model_id)
+            if cooldown_s > 0.0:
+                self._refit_cooldown[model_id] = (
+                    float(self._clock()) + float(cooldown_s)
+                )
+
+    def reset_gate(self, model_id: str) -> None:
+        """Drop the model's gate-rejection window (a promotion installs
+        new dynamics; verdicts booked against the old parameters must
+        not re-flag the fresh model as degraded)."""
+        with self._lock:
+            self._gate.pop(model_id, None)
+
+    def refitting(self) -> List[str]:
+        """Models currently claimed by :meth:`begin_refit` (sorted)."""
+        with self._lock:
+            return sorted(self._refitting)
+
+    def refit_candidates(
+        self,
+        staleness_obs: int = 0,
+        staleness_age_s: float = 0.0,
+        limit: Optional[int] = None,
+    ) -> List[RefitCandidate]:
+        """One ranked queue merging every refit trigger (module doc).
+
+        Signals, each scored as ``observed / threshold`` (>= 1.0 fires):
+
+        - **gate degradation** — the model's windowed observation-
+          rejection rate exceeds ``max_rejection_rate`` (the same test
+          as :meth:`degraded_models`, strict >);
+        - **observation staleness** — ``staleness_obs`` or more steps
+          assimilated since the last :meth:`note_fit` stamp (0 = off);
+        - **age staleness** — ``staleness_age_s`` or more seconds since
+          that stamp (0 = off).
+
+        Models mid-refit (:meth:`begin_refit`) or inside a
+        post-refit cooldown (:meth:`end_refit`) are excluded —
+        the hysteresis that keeps one degraded model from being
+        re-enqueued every scan while its (windowed) signal persists.
+        Ranked worst-first by the max signal ratio, ties by id.
+        """
+        now = float(self._clock())
+        with self._lock:
+            gate_items = {
+                mid: (sum(o for o, _ in dq), sum(r for _, r in dq))
+                for mid, dq in self._gate.items()
+            }
+            marks = dict(self._fit_marks)
+            progress = dict(self._fit_progress)
+            skip = set(self._refitting)
+            skip.update(
+                mid for mid, until in self._refit_cooldown.items()
+                if until > now
+            )
+        out: List[RefitCandidate] = []
+        for mid in sorted(set(gate_items) | set(marks)):
+            if mid in skip:
+                continue
+            obs, rej = gate_items.get(mid, (0, 0))
+            rate = rej / obs if obs else 0.0
+            mark = marks.get(mid)
+            age_s = now - mark[0] if mark is not None else 0.0
+            since = (
+                progress.get(mid, mark[1]) - mark[1]
+                if mark is not None else 0
+            )
+            reasons, score = [], 0.0
+            if obs and rate > self.max_rejection_rate:
+                reasons.append("gate")
+                score = max(score, rate / self.max_rejection_rate)
+            if staleness_obs > 0 and since >= staleness_obs:
+                reasons.append("stale_obs")
+                score = max(score, since / staleness_obs)
+            if staleness_age_s > 0 and age_s >= staleness_age_s:
+                reasons.append("stale_age")
+                score = max(score, age_s / staleness_age_s)
+            if reasons:
+                out.append(RefitCandidate(
+                    model_id=mid, score=float(score),
+                    reasons=tuple(reasons), rejection_rate=float(rate),
+                    obs_since_fit=int(since), age_s=float(age_s),
+                ))
+        out.sort(key=lambda c: (-c.score, c.model_id))
+        return out[:limit] if limit is not None else out
 
     def bind_metrics(self, registry, prefix: str = "metran_serve") -> None:
         """Publish this monitor into a :class:`~metran_tpu.obs.

@@ -38,7 +38,11 @@ import numpy as np
 from . import faultinject
 from .faultinject import SensorFault
 
-__all__ = ["run_sensor_fault_scenario", "simulate_dfm_panel"]
+__all__ = [
+    "run_drift_recovery_scenario",
+    "run_sensor_fault_scenario",
+    "simulate_dfm_panel",
+]
 
 
 def simulate_dfm_panel(ss, t_steps: int, rng, missing_p: float = 0.0):
@@ -85,6 +89,190 @@ def _stream_rmse(service, model_id, y_stream, x_truth, slot_index):
         errs.append(state.mean - x_truth[t][slot_index])
     errs = np.asarray(errs)
     return float(np.sqrt(np.mean(errs**2)))
+
+
+def _stream_phase(service, model_id, y_rows):
+    """Stream rows one update per row, scoring nothing (phase driver
+    for the recovery scenario; failed updates leave a servable
+    posterior exactly like :func:`_stream_rmse`)."""
+    for t in range(y_rows.shape[0]):
+        try:
+            service.update(model_id, y_rows[t][None, :])
+        except Exception:
+            pass
+
+
+def run_drift_recovery_scenario(
+    n_series: int = 6,
+    n_factors: int = 1,
+    t_hist: int = 200,
+    n_fault: int = 40,
+    n_tail: int = 80,
+    n_eval: int = 60,
+    seed: int = 0,
+    drift_per_step: float = 1.0,
+    alpha_factor: float = 8.0,
+    policy: str = "reject",
+    nsigma: float = 4.0,
+    min_seen: int = 32,
+    engine: str = "sqrt",
+    tail: int = 96,
+    holdout: int = 24,
+    maxiter: int = 40,
+) -> dict:
+    """End-to-end self-healing acceptance: drift fault → degraded →
+    background refit → promotion → recovered accuracy.
+
+    The setting the refit loop exists for: a model whose AR
+    time-scales are STALE — here, inflated by ``alpha_factor``, the
+    signature a drifting-calibration episode leaves in parameters fit
+    over it (a spurious trend reads as extra persistence) — serves a
+    drift-corrupted stream.  Timeline, one model, gate armed:
+
+    1. **fault phase** (``n_fault`` steps): a
+       :class:`SensorFault("drift")` ramps every series; the gate
+       rejects, the :class:`~metran_tpu.reliability.HealthMonitor`
+       rejection-rate window flags the model degraded.
+    2. **tail phase** (``n_tail`` steps): the sensor is fixed (the
+       fault rule's ``times`` budget ends it); clean rows refill the
+       refit worker's observation tail (fault-phase rows ride along
+       gate-masked).
+    3. ``RefitWorker.run_once()``: the degraded model is selected,
+       re-fit on its tail (warm-started from the stale alphas), the
+       challenger wins the held-out shadow comparison and hot-swaps.
+    4. **eval phase** (``n_eval`` steps, clean): posterior-mean RMSE
+       vs the known truth, compared against (a) a no-refit control —
+       same stale model, same corrupted stream, no worker — and (b)
+       the clean reference — true parameters, never-corrupted stream.
+
+    The acceptance bar (tests/test_refit.py, ``bench.py --phase
+    refit``): ``rmse_refit <= 2 * rmse_clean``, with the event trail
+    ``degraded`` → ``refit_scheduled`` → ``refit_promoted``
+    reconstructable from the service's :class:`~metran_tpu.obs.
+    EventLog`.  Returns the three RMSEs, their ratios, the worker
+    report, and the model's event-kind sequence.
+    """
+    from ..ops import dfm_statespace, kalman_filter, sqrt_kalman_filter
+    from ..serve import (
+        GateSpec,
+        MetranService,
+        ModelRegistry,
+        PosteriorState,
+        RefitSpec,
+        RefitWorker,
+    )
+    from ..serve.engine import state_slot_index
+
+    rng = np.random.default_rng(seed)
+    loadings = rng.uniform(0.4, 0.7, (n_series, n_factors))
+    loadings /= np.sqrt(n_factors)
+    alpha_sdf = rng.uniform(5.0, 40.0, n_series)
+    alpha_cdf = rng.uniform(10.0, 60.0, n_factors)
+    ss_true = dfm_statespace(alpha_sdf, alpha_cdf, loadings, 1.0)
+    t_total = t_hist + n_fault + n_tail + n_eval
+    xs, y_all, _ = simulate_dfm_panel(ss_true, t_total, rng)
+    y_hist = y_all[:t_hist]
+    mask_hist = np.ones(y_hist.shape, bool)
+    slot = state_slot_index(n_series, n_factors, n_series)
+    sqrt_engine = engine in ("sqrt", "sqrt_parallel")
+
+    def make_state(model_id, a_sdf, a_cdf):
+        ss = dfm_statespace(a_sdf, a_cdf, loadings, 1.0)
+        if sqrt_engine:
+            filt = sqrt_kalman_filter(ss, y_hist, mask_hist)
+            chol0 = np.asarray(filt.chol_f[-1])
+            cov0 = chol0 @ chol0.T
+        else:
+            filt = kalman_filter(ss, y_hist, mask_hist, engine=engine)
+            chol0, cov0 = None, np.asarray(filt.cov_f[-1])
+        return PosteriorState(
+            model_id=model_id, version=0, t_seen=t_hist,
+            mean=np.asarray(filt.mean_f[-1]), cov=cov0,
+            params=np.concatenate([a_sdf, a_cdf]),
+            loadings=loadings, dt=1.0,
+            scaler_mean=np.zeros(n_series),
+            scaler_std=np.ones(n_series),
+            names=tuple(f"s{j}" for j in range(n_series)),
+            chol=chol0,
+        )
+
+    y_fault = y_all[t_hist:t_hist + n_fault]
+    y_tail = y_all[t_hist + n_fault:t_hist + n_fault + n_tail]
+    y_eval = y_all[t_hist + n_fault + n_tail:]
+    x_eval = xs[t_hist + n_fault + n_tail:]
+    gate = GateSpec(policy=policy, nsigma=nsigma, min_seen=min_seen)
+    spec = RefitSpec(
+        tail=tail, holdout=holdout, min_tail=holdout + 8,
+        maxiter=maxiter, margin=0.0, cooldown_s=0.0,
+        deadline_s=600.0,
+    )
+
+    def run(stale: bool, corrupted: bool, refit: bool):
+        mid = "drift-recovery"
+        factor = alpha_factor if stale else 1.0
+        reg = ModelRegistry(root=None, engine=engine)
+        reg.put(
+            make_state(mid, alpha_sdf * factor, alpha_cdf * factor),
+            persist=False,
+        )
+        svc = MetranService(
+            reg, flush_deadline=None, persist_updates=False, gate=gate,
+        )
+        worker = RefitWorker(svc, spec) if refit else None
+        out = {}
+        try:
+            if corrupted:
+                with faultinject.active() as inj:
+                    inj.add(
+                        "serve.update.new_obs", match=mid,
+                        times=n_fault,
+                        corrupt=SensorFault(
+                            "drift", series=None,
+                            magnitude=drift_per_step,
+                        ),
+                    )
+                    _stream_phase(svc, mid, y_fault)
+            else:
+                _stream_phase(svc, mid, y_fault)
+            out["degraded_after_fault"] = svc.monitor.degraded_models()
+            _stream_phase(svc, mid, y_tail)
+            if worker is not None:
+                out["report"] = worker.run_once()
+            out["rmse"] = _stream_rmse(svc, mid, y_eval, x_eval, slot)
+            out["params"] = np.asarray(reg.get(mid).params)
+            out["events"] = [
+                e["kind"] for e in svc.events.for_model(mid)
+            ] if svc.events is not None else []
+            return out
+        finally:
+            if worker is not None:
+                worker.close()
+            svc.close()
+
+    clean = run(stale=False, corrupted=False, refit=False)
+    norefit = run(stale=True, corrupted=True, refit=False)
+    refit = run(stale=True, corrupted=True, refit=True)
+
+    rmse_clean = clean["rmse"]
+    report = refit.get("report", {})
+    return {
+        "n_fault": n_fault, "n_tail": n_tail, "n_eval": n_eval,
+        "alpha_factor": alpha_factor, "engine": engine,
+        "rmse_clean": rmse_clean,
+        "rmse_norefit": norefit["rmse"],
+        "rmse_refit": refit["rmse"],
+        "refit_vs_clean": refit["rmse"] / max(rmse_clean, 1e-12),
+        "norefit_vs_clean": norefit["rmse"] / max(rmse_clean, 1e-12),
+        "degraded_after_fault": refit["degraded_after_fault"],
+        "promoted": list(report.get("promoted", [])),
+        "report": report,
+        "events": refit["events"],
+        "params_true": np.concatenate([alpha_sdf, alpha_cdf]),
+        "params_stale": np.concatenate(
+            [alpha_sdf, alpha_cdf]
+        ) * alpha_factor,
+        "params_refit": refit["params"],
+    }
 
 
 def run_sensor_fault_scenario(

@@ -20,6 +20,11 @@ refilters history:
   materialized forecast read path — commit-time precomputed horizon
   moments served lock-free from immutable versioned snapshots
   (``METRAN_TPU_SERVE_READPATH``);
+- :mod:`~metran_tpu.serve.refit` — :class:`RefitWorker`: continuous
+  adaptation — degraded/stale models re-fit in the background on
+  retained observation tails through the fleet-fitting machinery,
+  champion/challenger shadow comparison, crash-safe hot-swap
+  (``METRAN_TPU_SERVE_REFIT``);
 - :mod:`~metran_tpu.serve.service` — :class:`MetranService`, the
   in-process ``update``/``forecast`` API with latency and occupancy
   telemetry, hard request deadlines, per-model circuit breakers, and
@@ -54,6 +59,7 @@ from .readpath import (
     SnapshotStore,
     parse_horizons,
 )
+from .refit import ObservationTail, RefitSpec, RefitWorker, TailSnapshot
 from .registry import CompiledFnCache, ModelRegistry
 from .service import ArenaUpdateAck, Forecast, MetranService, ServeMetrics
 from .smoothing import FixedLagTracker, SmoothedWindow
@@ -81,7 +87,10 @@ __all__ = [
     "MicroBatcher",
     "ModelMeta",
     "ModelRegistry",
+    "ObservationTail",
     "PosteriorState",
+    "RefitSpec",
+    "RefitWorker",
     "ServeMetrics",
     "SmoothedWindow",
     "SnapshotEntry",
@@ -89,6 +98,7 @@ __all__ = [
     "StateArena",
     "StateIntegrityError",
     "SteadySpec",
+    "TailSnapshot",
     "forecast_bucket",
     "make_arena_forecast_fn",
     "make_arena_steady_update_fn",

@@ -1,0 +1,876 @@
+"""Self-healing serving: background refit, shadow promotion, hot-swap.
+
+Serving assimilates observations forever but never re-learns
+parameters: a model whose AR time-scales drifted keeps serving stale
+dynamics for life, even though the observation gate's rejection-rate
+window (:class:`~metran_tpu.reliability.HealthMonitor`) already
+*detects* the degradation.  This module closes the detect → refit →
+promote loop — and with it finally joins the repo's two halves, the
+fleet-fitting stack (``parallel.fleet``/``models.solver``) and the
+serving stack, into one system:
+
+1. **Candidate selection** — :meth:`HealthMonitor.refit_candidates`
+   merges gate degradation and staleness/age into one ranked queue
+   with hysteresis (a model mid-refit or in post-refit cooldown is
+   never re-enqueued every scan).
+2. **Observation tails** — the serving dispatch paths feed every
+   committed update's standardized rows into an
+   :class:`ObservationTail`: a rolling anchor posterior plus the rows
+   since, per model — the recent history a refit needs without the
+   O(T) past.  Rows the observation gate acted on are stored masked
+   (the served filter did not assimilate them as given, and a refit
+   must not re-learn from readings the gate already called corrupt).
+3. **Background fit** — candidates are grouped by shape and batch-fit
+   OFF the serving thread through the fleet machinery
+   (:func:`~metran_tpu.parallel.fleet.refit_fleet`: anchored
+   square-root deviance, vmapped L-BFGS, warm-started from the
+   champion's parameters).  Fault point ``serve.refit.fit``.
+4. **Champion/challenger shadow comparison** — the tail's last
+   ``holdout`` rows are withheld from the fit; both parameter sets are
+   filtered over the fit portion from the SAME anchor and scored by
+   held-out one-step predictive deviance on the SAME holdout.  Only a
+   challenger that wins (by at least ``margin``) promotes; a worse,
+   diverged, or timed-out challenger is rejected and serving stays
+   bit-identically untouched — rejection is the safe default.
+5. **Crash-safe hot-swap** — promotion happens under the service's
+   update lock (no dispatch round can interleave), bumps the version
+   by one through ``registry.put`` (so every invariant built on the
+   commit path fires: snapshot-store invalidation via ``on_commit``,
+   arena row re-pack resetting steady leaves and frozen gains,
+   dict-mode steady thaw, fixed-lag tracker restart), and persists
+   through the atomic-npz + CRC state format — a crash anywhere
+   (fault point ``serve.refit.promote``,
+   :class:`~metran_tpu.reliability.SimulatedCrash`) recovers to
+   exactly the old or exactly the new parameters, never a torn mix.
+
+Ships OFF (``METRAN_TPU_SERVE_REFIT``); the knobs are the
+``METRAN_TPU_SERVE_REFIT_*`` family (:func:`metran_tpu.config.
+serve_defaults`).  See docs/concepts.md "Continuous adaptation" and
+``bench.py --phase refit`` for the measured cost story.  Background
+parameter adaptation under model misspecification is the setting of
+arXiv 2311.10580; the fast anchored refits lean on the closed-form
+filter gradients the sqrt engines keep exact (arXiv 2303.16846).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+import weakref
+from logging import getLogger
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from ..reliability.faultinject import fire
+from .smoothing import _anchor_factor
+
+logger = getLogger(__name__)
+
+__all__ = ["ObservationTail", "RefitSpec", "RefitWorker", "TailSnapshot"]
+
+
+class RefitSpec(NamedTuple):
+    """Continuous-adaptation policy (``METRAN_TPU_SERVE_REFIT_*``).
+
+    ``enabled`` arms the background worker inside
+    :class:`~metran_tpu.serve.MetranService`; everything below governs
+    one refit cycle.  ``tail`` bounds per-model memory (rows retained);
+    ``holdout`` rows are withheld from every fit for the shadow
+    comparison; ``margin`` is the held-out-deviance improvement a
+    challenger must show to promote (0.0 = any strict improvement);
+    ``staleness_obs``/``staleness_age_s`` arm the time-based refit
+    triggers next to gate degradation (0 = degradation-only);
+    ``cooldown_s`` is the re-enqueue hysteresis after any outcome;
+    ``deadline_s`` bounds one cycle's fit wall time — an overrun
+    rejects (the champion keeps serving) instead of promoting late.
+    """
+
+    enabled: bool = False
+    interval_s: float = 30.0
+    tail: int = 256
+    holdout: int = 32
+    min_tail: int = 64
+    max_batch: int = 32
+    maxiter: int = 40
+    margin: float = 0.0
+    staleness_obs: int = 0
+    staleness_age_s: float = 0.0
+    cooldown_s: float = 60.0
+    deadline_s: float = 120.0
+
+    @classmethod
+    def from_defaults(cls) -> "RefitSpec":
+        """Spec from :func:`metran_tpu.config.serve_defaults`
+        (env-overridable, shipped disabled)."""
+        from ..config import serve_defaults
+
+        d = serve_defaults()
+        return cls(
+            enabled=bool(d["refit"]),
+            interval_s=float(d["refit_interval_s"]),
+            tail=int(d["refit_tail"]),
+            holdout=int(d["refit_holdout"]),
+            min_tail=int(d["refit_min_tail"]),
+            max_batch=int(d["refit_max_batch"]),
+            maxiter=int(d["refit_maxiter"]),
+            margin=float(d["refit_margin"]),
+            staleness_obs=int(d["refit_staleness_obs"]),
+            staleness_age_s=float(d["refit_staleness_age_s"]),
+            cooldown_s=float(d["refit_cooldown_s"]),
+            deadline_s=float(d["refit_deadline_s"]),
+        ).validate()
+
+    def validate(self) -> "RefitSpec":
+        if self.tail < 2:
+            raise ValueError(f"refit tail must be >= 2, got {self.tail}")
+        if not 1 <= self.holdout < self.tail:
+            raise ValueError(
+                f"refit holdout must be in [1, tail), got {self.holdout}"
+            )
+        if self.min_tail <= self.holdout:
+            raise ValueError(
+                "refit min_tail must exceed holdout (a candidate needs "
+                f"fit rows), got min_tail={self.min_tail} "
+                f"holdout={self.holdout}"
+            )
+        if self.min_tail > self.tail:
+            # a tail can never hold more than `tail` rows, so this
+            # spec would skip EVERY candidate as short_tail forever —
+            # the feature armed, paid for, and silently inert
+            raise ValueError(
+                f"refit min_tail ({self.min_tail}) exceeds the tail "
+                f"capacity ({self.tail}); no candidate could ever "
+                "qualify"
+            )
+        if self.interval_s <= 0.0:
+            raise ValueError(
+                "refit interval_s must be > 0 (the background loop "
+                f"would busy-spin), got {self.interval_s}"
+            )
+        if self.deadline_s <= 0.0:
+            raise ValueError(
+                "refit deadline_s must be > 0 (every cycle would pay "
+                "full fit compute and reject 'timeout' forever), got "
+                f"{self.deadline_s}"
+            )
+        if self.cooldown_s < 0.0:
+            raise ValueError(
+                f"refit cooldown_s must be >= 0, got {self.cooldown_s}"
+            )
+        if self.max_batch < 1 or self.maxiter < 1:
+            raise ValueError("refit max_batch and maxiter must be >= 1")
+        return self
+
+
+class TailSnapshot(NamedTuple):
+    """One model's retained history, frozen for a refit cycle.
+
+    ``y``/``mask`` are the (R, n_series) standardized rows since the
+    anchor (gate-acted cells already masked); ``anchor_*`` the
+    posterior the tail filters from; ``params`` the champion alphas at
+    the tail's lineage start.  ``lineage`` identifies the tracking
+    epoch (bumped on every restart — first touch, external hot-swap,
+    rejected update, promotion); ``version`` is the serving version of
+    the last commit the tail recorded.  The promotion path re-checks
+    all three under the update lock: same lineage (the anchor may have
+    ADVANCED — that replay is lineage-preserving — but must not have
+    restarted), ``version`` equal to the committed state's, and
+    ``anchor_t_seen + R`` equal to the serving ``t_seen``.
+    """
+
+    model_id: str
+    params: np.ndarray
+    loadings: np.ndarray
+    dt: float
+    anchor_mean: np.ndarray
+    anchor_chol: np.ndarray
+    anchor_t_seen: int
+    y: np.ndarray
+    mask: np.ndarray
+    lineage: int
+    version: Optional[int]
+
+    @property
+    def rows(self) -> int:
+        return int(self.y.shape[0])
+
+
+class _TailTrack:
+    """One model's rolling tail (guarded by the tail lock)."""
+
+    __slots__ = (
+        "params", "loadings", "dt", "anchor_mean", "anchor_chol",
+        "anchor_t_seen", "rows", "lineage", "version",
+    )
+
+    _lineage_counter = itertools.count(1)
+
+    def __init__(self, state):
+        self.params = np.asarray(state.params, float)
+        self.loadings = np.asarray(state.loadings, float)
+        self.dt = float(state.dt)
+        self.anchor_mean = np.asarray(state.mean, float)
+        self.anchor_chol = _anchor_factor(state)
+        self.anchor_t_seen = int(state.t_seen)
+        #: buffered (y_std (n,), mask (n,)) rows SINCE the anchor
+        self.rows: List[Tuple[np.ndarray, np.ndarray]] = []
+        #: tracking epoch — survives anchor advances, not restarts
+        self.lineage = next(_TailTrack._lineage_counter)
+        #: serving version of the last recorded commit
+        self.version: Optional[int] = int(state.version)
+
+    def statespace(self):
+        from ..ops import dfm_statespace
+
+        n = self.loadings.shape[0]
+        return dfm_statespace(
+            self.params[:n], self.params[n:], self.loadings, self.dt
+        )
+
+
+class ObservationTail:
+    """Per-model rolling anchors + retained observation windows.
+
+    The refit counterpart of :class:`~metran_tpu.serve.smoothing.
+    FixedLagTracker`, with three deliberate differences: rows the
+    observation gate acted on are buffered **masked** instead of
+    restarting the window (a degraded model — the main refit customer
+    — would otherwise never accumulate a tail); the anchor replay
+    uses the champion parameters captured at the tail's lineage start,
+    keeping anchor and rows one consistent refit problem; and the
+    anchor advance is **amortized off the serving path** — rows buffer
+    up to ``2 * capacity``, one bulk ``capacity``-row replay kernel
+    fires per ``capacity`` commits (a stable compile shape), and
+    :meth:`snapshot` settles any remainder with fixed-shape
+    single-row replays once per refit cycle.  A per-commit replay (the
+    fixed-lag tracker's strategy, one kernel launch per model per
+    commit) measured ~35% foreground overhead on the batched update
+    path; the amortized scheme is one launch per model per
+    ``capacity`` commits.  Thread-safe; fed by the serving dispatch
+    paths via ``MetranService._observe_smoother`` whenever a worker is
+    attached.
+    """
+
+    def __init__(self, capacity: int):
+        if int(capacity) < 2:
+            raise ValueError(
+                f"tail capacity must be >= 2, got {capacity}"
+            )
+        self.capacity = int(capacity)
+        self._lock = threading.RLock()
+        self._tracks: Dict[str, _TailTrack] = {}
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._tracks)
+
+    def tracked(self) -> List[str]:
+        with self._lock:
+            return sorted(self._tracks)
+
+    def t_seen(self, model_id: str) -> Optional[int]:
+        """The tracked stream position (``None`` when untracked)."""
+        with self._lock:
+            tr = self._tracks.get(model_id)
+            if tr is None:
+                return None
+            return tr.anchor_t_seen + len(tr.rows)
+
+    def forget(self, model_id: str) -> None:
+        with self._lock:
+            self._tracks.pop(model_id, None)
+
+    def restart(self, model_id: str, state) -> None:
+        """(Re)start the model's tail from ``state`` (rows empty) —
+        called after a promotion so the new lineage measures the new
+        parameters, never rows the old ones assimilated."""
+        with self._lock:
+            self._tracks[model_id] = _TailTrack(state)
+
+    def observe(self, model_id: str, y_std, mask, t_seen_after: int,
+                post_state_fn, verdicts=None,
+                version: Optional[int] = None) -> None:
+        """Feed one committed update's ``k`` standardized rows.
+
+        Same lineage contract as the fixed-lag tracker, plus a version
+        check: a discontinuity in the stream position (first touch, a
+        rejected update) OR in the serving version (``version`` not
+        exactly one past the last recorded commit — the signature of
+        an external ``registry.put`` hot-swap, even one that preserves
+        ``t_seen``) restarts tracking from ``post_state_fn()``.  With
+        gate ``verdicts`` given, acted-on cells (any non-zero verdict)
+        are stored masked — see the class docstring.  Never raises:
+        tail maintenance must not fail a caller whose update
+        committed.
+        """
+        y_std = np.atleast_2d(np.asarray(y_std, float))
+        mask = np.atleast_2d(np.asarray(mask, bool))
+        if verdicts is not None:
+            mask = mask & (np.atleast_2d(np.asarray(verdicts)) == 0)
+        k = y_std.shape[0]
+        with self._lock:
+            tr = self._tracks.get(model_id)
+            if (
+                tr is None
+                or tr.anchor_t_seen + len(tr.rows) + k
+                != int(t_seen_after)
+                or (
+                    version is not None
+                    and tr.version is not None
+                    and int(version) != tr.version + 1
+                )
+            ):
+                try:
+                    self._tracks[model_id] = _TailTrack(post_state_fn())
+                except Exception:  # pragma: no cover - tracking only
+                    self._tracks.pop(model_id, None)
+                return
+            if version is not None:
+                tr.version = int(version)
+            elif tr.version is not None:
+                tr.version += 1
+            for i in range(k):
+                # copies, not views: the dispatch paths hand in slices
+                # of whole (G, k, n_pad) batch buffers, and a retained
+                # view would pin every such buffer for up to
+                # 2*capacity commits
+                tr.rows.append((y_std[i].copy(), mask[i].copy()))
+            while len(tr.rows) >= 2 * self.capacity:
+                # bulk advance: replay exactly `capacity` rows per
+                # kernel (stable compile shape), amortized to one
+                # launch per model per `capacity` commits — a while,
+                # not an if, so a single oversized commit (bulk
+                # backfill with k > capacity) cannot grow the buffer
+                # past 2*capacity either
+                self._replay(tr, self.capacity)
+
+    def _replay(self, tr: _TailTrack, count: int) -> None:
+        """Fold the oldest ``count`` rows into the anchor posterior
+        (one :func:`~metran_tpu.ops.sqrt_filter_append` call)."""
+        from ..ops import sqrt_filter_append
+
+        y = np.stack([r[0] for r in tr.rows[:count]])
+        m = np.stack([r[1] for r in tr.rows[:count]])
+        mean, chol, _, _ = sqrt_filter_append(
+            tr.statespace(), tr.anchor_mean, tr.anchor_chol, y, m
+        )
+        tr.anchor_mean = np.asarray(mean)
+        tr.anchor_chol = np.asarray(chol)
+        tr.anchor_t_seen += count
+        del tr.rows[:count]
+
+    def _settle(self, tr: _TailTrack) -> None:
+        """Advance the anchor until ``rows <= capacity``, one row per
+        kernel call: the per-call shape is fixed at (1, n), so however
+        ragged the excess, the jit cache holds ONE replay executable
+        per model shape (a single variable-length call would compile a
+        fresh program per distinct excess)."""
+        while len(tr.rows) > self.capacity:
+            self._replay(tr, 1)
+
+    def snapshot(self, model_id: str) -> Optional[TailSnapshot]:
+        """A consistent copy of the model's tail, at most ``capacity``
+        rows with the anchor settled to the window start (``None``
+        when untracked or empty)."""
+        with self._lock:
+            tr = self._tracks.get(model_id)
+            if tr is None or not tr.rows:
+                return None
+            self._settle(tr)
+            return TailSnapshot(
+                model_id=model_id,
+                params=tr.params.copy(),
+                loadings=tr.loadings.copy(),
+                dt=tr.dt,
+                anchor_mean=tr.anchor_mean.copy(),
+                anchor_chol=tr.anchor_chol.copy(),
+                anchor_t_seen=tr.anchor_t_seen,
+                y=np.stack([r[0] for r in tr.rows]),
+                mask=np.stack([r[1] for r in tr.rows]),
+                lineage=tr.lineage,
+                version=tr.version,
+            )
+
+
+class RefitWorker:
+    """The background refit/promotion loop over one
+    :class:`~metran_tpu.serve.MetranService` (module docstring).
+
+    Construction attaches the worker to the service (tail recording
+    arms on the dispatch paths, metrics/gauges bind into the service's
+    registry); :meth:`start` runs :meth:`run_once` every
+    ``spec.interval_s`` on a daemon thread, and tests/benches call
+    :meth:`run_once` synchronously for determinism.  ``close()``
+    detaches cleanly — the service's own ``close()`` does it for a
+    worker the service constructed (``MetranService(refit=...)``).
+    """
+
+    def __init__(self, service, spec: Optional[RefitSpec] = None):
+        self.service = service
+        self.spec = (
+            spec.validate() if spec is not None
+            else RefitSpec.from_defaults()
+        )
+        self.tail = ObservationTail(self.spec.tail)
+        self.monitor = service.monitor
+        self.events = service.events
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # one cycle at a time: the interval thread and a synchronous
+        # run_once (tests, operator poke) must not fit concurrently
+        self._cycle_lock = threading.Lock()
+        self._in_flight: set = set()
+        self._degraded_seen: set = set()
+        self._queue_depth = 0
+        self.counts: Dict[str, int] = {}
+        self.swap_latencies: List[float] = []  # bounded, newest last
+        self._counter = None
+        # attach FIRST: a second worker on a served service must be
+        # rejected before any side effect — binding gauges first would
+        # let the refused construction steal the live worker's
+        # callbacks (registry.gauge re-points on re-registration)
+        service._attach_refit(self)
+        metrics = getattr(service.obs, "metrics", None)
+        if metrics is not None:
+            self._counter = metrics.counter(
+                "metran_serve_refit_total",
+                "background refit outcomes by kind (scheduled/"
+                "promoted/rejected/failed)",
+                label_names=("outcome",),
+            )
+            # weakref callbacks: the registry's gauge references must
+            # neither keep a closed worker (and its buffered tails)
+            # alive nor report its stale values — a collected worker
+            # scrapes as 0
+            ref = weakref.ref(self)
+            metrics.gauge(
+                "metran_serve_refit_in_flight",
+                "models currently being refit by the background worker",
+                callback=lambda: float(
+                    len(w._in_flight) if (w := ref()) is not None else 0
+                ),
+            )
+            metrics.gauge(
+                "metran_serve_refit_queue_depth",
+                "refit candidates at the last worker scan",
+                callback=lambda: float(
+                    w._queue_depth if (w := ref()) is not None else 0
+                ),
+            )
+
+    # -- lifecycle -------------------------------------------------------
+    @property
+    def alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self) -> None:
+        """Start the interval loop (idempotent).  Refuses while a
+        previous loop thread is still winding down a cycle (a cleared
+        stop flag would un-stop it — two loops would then race one
+        worker's state)."""
+        if self._thread is not None and self._thread.is_alive():
+            if self._stop.is_set():
+                raise RuntimeError(
+                    "refit worker is still stopping (a cycle is mid-"
+                    "fit); wait for stop() to complete before restart"
+                )
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="metran-refit", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.spec.interval_s):
+            try:
+                self.run_once()
+            except Exception:
+                # a cycle failure degrades adaptation, never serving;
+                # SimulatedCrash (BaseException) deliberately escapes
+                # and kills the thread like the process death it models
+                logger.exception("background refit cycle failed")
+
+    def request_stop(self) -> None:
+        """Signal the loop to exit WITHOUT waiting — the non-blocking
+        half of :meth:`stop`.  From this instant no promotion can
+        land (the promote path rejects with reason ``shutdown``
+        inside the update lock); ``MetranService.close`` calls this
+        on a caller-attached worker it does not own."""
+        self._stop.set()
+
+    def stop(self) -> None:
+        """Signal the loop to exit and wait briefly.  A cycle mid-fit
+        can outlive the join timeout (a compiled fit is not
+        interruptible) — it is left to finish as a zombie that CANNOT
+        mutate serving: once the stop flag is set, its promotion path
+        rejects with reason ``shutdown`` before touching the registry.
+        The thread handle is kept while it lives, so ``alive`` stays
+        truthful and ``start()`` cannot spawn a second loop."""
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=5.0)
+            if not thread.is_alive():
+                self._thread = None
+
+    def close(self) -> None:
+        self.stop()
+        self.service._detach_refit(self)
+
+    # -- bookkeeping -----------------------------------------------------
+    def _book(self, outcome: str, model_id: Optional[str] = None,
+              **detail) -> None:
+        self.counts[outcome] = self.counts.get(outcome, 0) + 1
+        if self._counter is not None:
+            self._counter.inc(outcome=outcome)
+        if self.events is not None:
+            self.events.emit(
+                f"refit_{outcome}", model_id=model_id,
+                fault_point=f"serve.refit.{outcome}", **detail,
+            )
+
+    def stats(self) -> dict:
+        """Lifetime outcome counts + the current queue/in-flight view
+        (the ``health()`` section's source)."""
+        lat = self.swap_latencies
+        return {
+            "alive": self.alive,
+            "queue_depth": self._queue_depth,
+            "in_flight": len(self._in_flight),
+            "tracked_tails": len(self.tail),
+            "swap_latency_p50_ms": (
+                round(1e3 * float(np.median(lat)), 3) if lat else 0.0
+            ),
+            **{k: self.counts.get(k, 0)
+               for k in ("scheduled", "promoted", "rejected", "failed")},
+        }
+
+    # -- the cycle -------------------------------------------------------
+    def scan(self) -> list:
+        """Refresh staleness progress from the tails, emit ``degraded``
+        events for new gate-degraded entrants, and return the ranked
+        candidate queue."""
+        for mid in self.tail.tracked():
+            t = self.tail.t_seen(mid)
+            if t is not None:
+                self.monitor.note_progress(mid, t)
+        cands = self.monitor.refit_candidates(
+            staleness_obs=self.spec.staleness_obs,
+            staleness_age_s=self.spec.staleness_age_s,
+        )
+        # episode tracking uses the monitor's RAW degraded set, not
+        # the hysteresis-filtered candidate queue: a model parked in
+        # the refit cooldown drops out of the queue while its gate
+        # signal persists, and re-keying on the queue would re-emit
+        # one spurious `degraded` per rejected-refit round.  The set
+        # only clears on genuine recovery (the window decays, or a
+        # promotion resets the gate), which is exactly when the next
+        # entry IS a new episode.
+        gate_degraded = set(self.monitor.degraded_models())
+        for mid in sorted(gate_degraded - self._degraded_seen):
+            if self.events is not None:
+                self.events.emit(
+                    "degraded", model_id=mid,
+                    fault_point="serve.refit.scan",
+                    rejection_rate=self.monitor.rejection_rate(mid),
+                )
+        self._degraded_seen = gate_degraded
+        self._queue_depth = len(cands)
+        return cands
+
+    def run_once(self) -> dict:
+        """One full cycle: scan → batch fit → shadow compare →
+        promote/reject.  Returns a report dict; safe to call while the
+        interval thread runs (cycles serialize)."""
+        with self._cycle_lock:
+            return self._cycle()
+
+    def _cycle(self) -> dict:
+        spec = self.spec
+        report: dict = {
+            "candidates": 0, "scheduled": [], "promoted": [],
+            "rejected": {}, "failed": {}, "skipped": {},
+        }
+        cands = self.scan()
+        report["candidates"] = len(cands)
+        batch = []
+        for c in cands:
+            if len(batch) >= spec.max_batch:
+                break
+            snap = self.tail.snapshot(c.model_id)
+            if snap is None or snap.rows < spec.min_tail:
+                report["skipped"][c.model_id] = "short_tail"
+                continue
+            if not self.monitor.begin_refit(c.model_id):
+                continue
+            batch.append((c, snap))
+        if not batch:
+            return report
+        done: set = set()
+        try:
+            for c, snap in batch:
+                self._in_flight.add(c.model_id)
+                report["scheduled"].append(c.model_id)
+                self._book(
+                    "scheduled", c.model_id, score=c.score,
+                    reasons=",".join(c.reasons),
+                    rejection_rate=c.rejection_rate,
+                    obs_since_fit=c.obs_since_fit,
+                )
+            groups: Dict[tuple, list] = {}
+            for item in batch:
+                snap = item[1]
+                key = (
+                    snap.rows, snap.loadings.shape[0],
+                    snap.loadings.shape[1], snap.anchor_mean.shape[0],
+                )
+                groups.setdefault(key, []).append(item)
+            # ONE fit budget for the whole cycle, shared across shape
+            # groups — deadline_s is documented per cycle, and a
+            # per-group clock would let an N-group cycle promote N x
+            # later than the budget the knob exists to bound
+            fit_deadline = time.monotonic() + spec.deadline_s
+            for items in groups.values():
+                if self._stop.is_set():
+                    break  # shutting down: leave remaining groups
+                self._refit_group(items, report, done, fit_deadline)
+        finally:
+            for c, _ in batch:
+                self._in_flight.discard(c.model_id)
+                if c.model_id not in done:
+                    # a crash signal mid-group: release the claim with
+                    # the usual hysteresis so the next scan can retry
+                    self.monitor.end_refit(c.model_id, spec.cooldown_s)
+        return report
+
+    def _refit_group(self, items, report, done: set,
+                     fit_deadline: float) -> None:
+        """Fit + score + decide one homogeneous shape group.
+
+        ``fit_deadline`` is the CYCLE's shared budget instant
+        (``spec.deadline_s`` past the cycle's fit start): a group
+        reached after it rejects without fitting, and a group whose
+        fit finishes past it rejects every challenger — promoting late
+        is exactly the staleness the budget exists to bound."""
+        from ..parallel.fleet import (
+            anchored_fleet_posteriors,
+            refit_fleet,
+        )
+
+        spec = self.spec
+        ids = [snap.model_id for _, snap in items]
+        snaps = [snap for _, snap in items]
+        if time.monotonic() > fit_deadline:
+            for _, snap in items:
+                self._reject(
+                    snap.model_id, report, "timeout",
+                    deadline_s=spec.deadline_s, fitted=False,
+                )
+                self.monitor.end_refit(snap.model_id, spec.cooldown_s)
+                done.add(snap.model_id)
+            return
+        rows = snaps[0].rows
+        hold = min(spec.holdout, rows // 2)
+        fit_n = rows - hold
+        y = np.stack([s.y for s in snaps])
+        m = np.stack([s.mask for s in snaps])
+        lds = np.stack([s.loadings for s in snaps])
+        dts = np.asarray([s.dt for s in snaps])
+        am = np.stack([s.anchor_mean for s in snaps])
+        ac = np.stack([s.anchor_chol for s in snaps])
+        p0 = np.stack([s.params for s in snaps])
+        t0 = time.monotonic()
+        try:
+            fire("serve.refit.fit", ",".join(ids))
+            fit = refit_fleet(
+                y[:, :fit_n], m[:, :fit_n], lds, dts, am, ac, p0,
+                maxiter=spec.maxiter,
+            )
+            # both parameter sets filter the SAME fit rows from the
+            # SAME anchor, then score one-step predictions on the SAME
+            # held-out rows their fits never saw — the only difference
+            # entering the comparison is the parameters themselves
+            mean_c, chol_c, _ = anchored_fleet_posteriors(
+                p0, y[:, :fit_n], m[:, :fit_n], lds, dts, am, ac
+            )
+            mean_n, chol_n, _ = anchored_fleet_posteriors(
+                fit.theta, y[:, :fit_n], m[:, :fit_n], lds, dts, am, ac
+            )
+            _, _, dev_c = anchored_fleet_posteriors(
+                p0, y[:, fit_n:], m[:, fit_n:], lds, dts, mean_c, chol_c
+            )
+            _, _, dev_n = anchored_fleet_posteriors(
+                fit.theta, y[:, fit_n:], m[:, fit_n:], lds, dts,
+                mean_n, chol_n,
+            )
+        except Exception as exc:  # noqa: BLE001 - per-group isolation
+            logger.exception("refit fit failed for group %s", ids)
+            for c, snap in items:
+                report["failed"][snap.model_id] = repr(exc)
+                self._book(
+                    "failed", snap.model_id, error=repr(exc)
+                )
+                self.monitor.end_refit(snap.model_id, spec.cooldown_s)
+                done.add(snap.model_id)
+            return
+        elapsed = time.monotonic() - t0
+        timed_out = time.monotonic() > fit_deadline
+        for i, (c, snap) in enumerate(items):
+            mid = snap.model_id
+            try:
+                if timed_out:
+                    self._reject(
+                        mid, report, "timeout", elapsed_s=elapsed,
+                        deadline_s=spec.deadline_s,
+                    )
+                elif not (
+                    np.isfinite(dev_n[i])
+                    and np.all(np.isfinite(fit.theta[i]))
+                ):
+                    self._reject(mid, report, "diverged")
+                elif not dev_n[i] < dev_c[i] - spec.margin:
+                    self._reject(
+                        mid, report, "worse",
+                        dev_champion=float(dev_c[i]),
+                        dev_challenger=float(dev_n[i]),
+                        margin=spec.margin,
+                    )
+                else:
+                    self._promote(
+                        mid, snap, fit.theta[i], float(dev_c[i]),
+                        float(dev_n[i]), report,
+                    )
+            except Exception as exc:  # noqa: BLE001 - per-model
+                logger.exception("refit decision failed for %r", mid)
+                report["failed"][mid] = repr(exc)
+                self._book("failed", mid, error=repr(exc))
+            finally:
+                self.monitor.end_refit(mid, spec.cooldown_s)
+                done.add(mid)
+
+    def _reject(self, model_id: str, report, reason: str,
+                **detail) -> None:
+        report["rejected"][model_id] = reason
+        self._book("rejected", model_id, reason=reason, **detail)
+
+    def _promote(self, model_id: str, snap: TailSnapshot, new_params,
+                 dev_champion: float, dev_challenger: float,
+                 report) -> None:
+        """Hot-swap the challenger in, under the service update lock.
+
+        The lineage is re-checked against a FRESH tail snapshot inside
+        the lock: rows that streamed in while the fit ran are included
+        in the refreshed posterior (the tail kept buffering), and any
+        discontinuity — eviction, external put, tail restart — rejects
+        as ``stale`` instead of promoting a posterior that no longer
+        matches the serving stream.  Fault point
+        ``serve.refit.promote`` fires inside the lock, before any
+        mutation, so an injected crash proves the old state survives
+        untouched; a crash after ``registry.put``'s in-memory commit
+        leaves the new state serving (and the atomic-npz write-through
+        leaves disk wholly old or wholly new) — never a torn mix.
+        """
+        from ..ops import dfm_statespace, sqrt_filter_append
+
+        svc = self.service
+        new_params = np.asarray(new_params, float)
+        t0 = time.perf_counter()
+        with svc._update_lock:
+            fire("serve.refit.promote", model_id)
+            if self._stop.is_set():
+                # the service is shutting down: a promotion landing
+                # after close()'s drain would mutate a registry the
+                # service no longer serves — reject, never race
+                self._reject(model_id, report, "shutdown")
+                return
+            try:
+                cur = svc.registry.get(model_id)
+            except Exception:
+                self._reject(model_id, report, "missing")
+                return
+            snap2 = self.tail.snapshot(model_id)
+            # lineage check, NOT anchor equality: rows that streamed
+            # in while the fit ran may have ADVANCED the anchor (a
+            # lineage-preserving replay — same epoch, same champion
+            # params), and a busy model at tail capacity advances it
+            # every cycle; what must reject is a RESTART (external
+            # hot-swap — caught by the version discontinuity even at
+            # unchanged t_seen — eviction, rejected update) or a
+            # version the tail never recorded
+            if (
+                snap2 is None
+                or snap2.lineage != snap.lineage
+                or (
+                    snap2.version is not None
+                    and cur.version != snap2.version
+                )
+                or cur.t_seen != snap2.anchor_t_seen + snap2.rows
+            ):
+                self._reject(model_id, report, "stale")
+                return
+            n = cur.n_series
+            ss = dfm_statespace(
+                new_params[:n], new_params[n:],
+                np.asarray(cur.loadings, float), float(cur.dt),
+            )
+            mean, chol, _, _ = sqrt_filter_append(
+                ss, snap2.anchor_mean, snap2.anchor_chol,
+                snap2.y, snap2.mask,
+            )
+            mean = np.asarray(mean, cur.dtype)
+            chol = np.asarray(chol, cur.dtype)
+            if not (np.isfinite(mean).all() and np.isfinite(chol).all()):
+                self._reject(model_id, report, "diverged")
+                return
+            new_state = cur._replace(
+                version=cur.version + 1,
+                params=new_params.astype(
+                    np.asarray(cur.params).dtype, copy=False
+                ),
+                mean=mean,
+                cov=chol @ chol.T,
+                chol=chol,
+            )
+            try:
+                svc.registry.put(
+                    new_state, persist=svc.persist_updates
+                )
+            except Exception:
+                # the in-memory commit in put() precedes the disk
+                # write-through: the promotion IS applied; durability
+                # degraded exactly like an update's persist failure
+                svc.metrics.errors.increment("persist_failures")
+                if self.events is not None:
+                    self.events.emit(
+                        "persist_failure", model_id=model_id,
+                        fault_point="registry.put",
+                        version=new_state.version,
+                    )
+                logger.exception(
+                    "promotion write-through failed for model %r "
+                    "(serving the new parameters from memory)",
+                    model_id,
+                )
+            # registry.put already re-packed an arena row (steady
+            # leaves reset) and invalidated read-path snapshots via
+            # on_commit; the two host-side caches keyed on the OLD
+            # posterior lineage restart here
+            svc._thaw_dict(model_id, "refit_promoted")
+            if svc.smoother is not None:
+                svc.smoother.forget(model_id)
+            self.tail.restart(model_id, new_state)
+        swap_s = time.perf_counter() - t0
+        self.swap_latencies.append(swap_s)
+        del self.swap_latencies[:-256]
+        self.monitor.note_fit(model_id, new_state.t_seen)
+        self.monitor.reset_gate(model_id)
+        self._degraded_seen.discard(model_id)
+        report["promoted"].append(model_id)
+        self._book(
+            "promoted", model_id, version=new_state.version,
+            dev_champion=dev_champion, dev_challenger=dev_challenger,
+            swap_s=round(swap_s, 6),
+        )

@@ -78,6 +78,7 @@ from .batching import MicroBatcher
 from .engine import GateSpec, SteadySpec
 from .readpath import ForecastSnapshot, SnapshotEntry, SnapshotStore, \
     parse_horizons
+from .refit import RefitSpec, RefitWorker
 from .registry import ModelRegistry
 from .smoothing import FixedLagTracker, SmoothedWindow
 from .state import PosteriorState
@@ -433,6 +434,17 @@ class MetranService:
         moments at O(L) cost — never an O(T) refilter — from a
         per-model rolling anchor maintained on the update path
         (:mod:`metran_tpu.serve.smoothing`).
+    refit : continuous-adaptation policy
+        (:class:`~metran_tpu.serve.refit.RefitSpec`; default from
+        ``serve_defaults()`` — ``METRAN_TPU_SERVE_REFIT_*``, shipped
+        off).  Enabled, the service owns a background
+        :class:`~metran_tpu.serve.refit.RefitWorker`: observation
+        tails are retained per model, degraded/stale models are
+        re-fit off the serving thread through the fleet lanes
+        machinery, challengers are shadow-compared on held-out
+        one-step deviance, and winners hot-swap through
+        ``registry.put`` under the update lock — see docs/concepts.md
+        "Continuous adaptation".
     """
 
     def __init__(
@@ -448,6 +460,7 @@ class MetranService:
         horizons=None,
         steady: Optional[SteadySpec] = None,
         fixed_lag: Optional[int] = None,
+        refit: Optional[RefitSpec] = None,
     ):
         from ..config import serve_defaults
 
@@ -606,6 +619,41 @@ class MetranService:
                 "steady-state gain (the bounded-cost hot path)",
                 callback=lambda: float(self._steady_count()),
             )
+        # continuous adaptation (serve.refit): a worker attaches via
+        # _attach_refit (arming tail recording on the dispatch paths);
+        # the service owns — and closes — one it constructed itself
+        self._refit_tail = None
+        self._refit_worker: Optional[RefitWorker] = None
+        self._owns_refit = False
+        refit_spec = (
+            refit.validate() if refit is not None
+            else RefitSpec.from_defaults()
+        )
+        if refit_spec.enabled:
+            worker = RefitWorker(self, refit_spec)
+            self._owns_refit = True
+            worker.start()
+
+    def _attach_refit(self, worker: RefitWorker) -> None:
+        """Install ``worker`` as this service's refit loop (called by
+        :class:`~metran_tpu.serve.refit.RefitWorker` construction).
+        Tail recording on the update dispatch paths arms here — per
+        committed update it costs two row appends while a worker is
+        attached and one ``None`` check otherwise."""
+        if self._refit_worker is not None and (
+            self._refit_worker is not worker
+        ):
+            raise RuntimeError(
+                "service already has a refit worker attached"
+            )
+        self._refit_worker = worker
+        self._refit_tail = worker.tail
+
+    def _detach_refit(self, worker: RefitWorker) -> None:
+        """Undo :meth:`_attach_refit` (idempotent)."""
+        if self._refit_worker is worker:
+            self._refit_worker = None
+            self._refit_tail = None
 
     def _ready(self) -> float:
         """The orchestrator bit as a float (callback-gauge friendly)."""
@@ -731,13 +779,30 @@ class MetranService:
 
     def _observe_smoother(self, model_id: str, y_std, mask,
                           t_seen_after: int, post_state_fn,
-                          verdicts=None) -> None:
-        """Feed one committed update into the fixed-lag tracker
-        (no-op when the feature is off; never raises).  ``verdicts``
-        is the model's gate-verdict slice when the gate is armed: a
-        commit the gate acted on restarts the window from the served
-        posterior instead of buffering rows the served filter did not
-        assimilate as given."""
+                          verdicts=None, version=None) -> None:
+        """Feed one committed update into the post-commit observers:
+        the fixed-lag tracker and, with a refit worker attached, the
+        refit observation tail (each a no-op when off; never raises).
+        ``verdicts`` is the model's gate-verdict slice when the gate
+        is armed: a commit the gate acted on restarts the smoothing
+        window from the served posterior (the served filter did not
+        assimilate those rows as given), while the refit tail keeps
+        buffering with the acted-on cells masked — a degraded model
+        must still accumulate the history its refit needs.
+        ``version`` is the commit's serving version; the tail uses it
+        to detect an intervening external hot-swap even at unchanged
+        ``t_seen``."""
+        tail = self._refit_tail
+        if tail is not None:
+            try:
+                tail.observe(
+                    model_id, y_std, mask, t_seen_after, post_state_fn,
+                    verdicts=verdicts, version=version,
+                )
+            except Exception:  # pragma: no cover - tracking only
+                logger.exception(
+                    "refit tail tracking failed for model %r", model_id
+                )
         if self.smoother is None:
             return
         clean = verdicts is None or not np.any(verdicts)
@@ -1693,6 +1758,7 @@ class MetranService:
                         verdicts=(
                             verdicts[gi, :, :n_i] if gated else None
                         ),
+                        version=int(versions[gi]),
                     )
                     if empty[gi] and self.events is not None:
                         self.events.emit(
@@ -1890,10 +1956,27 @@ class MetranService:
                 "lag": self.smoother.lag,
                 "tracked": len(self.smoother),
             }} if self.smoother is not None else {}),
+            **({"refit": self._refit_worker.stats()}
+               if self._refit_worker is not None else {}),
         })
         return snap
 
     def close(self) -> None:
+        # the refit worker stops FIRST: a promotion must never race
+        # the drain below or land after the batcher refuses traffic.
+        # A caller-attached worker is the caller's to close(), but its
+        # stop flag is set HERE regardless — once this service drains,
+        # any still-running cycle's promotion path must reject
+        # (reason "shutdown") rather than commit into a closed service
+        worker = self._refit_worker
+        if worker is not None:
+            try:
+                if self._owns_refit:
+                    worker.close()
+                else:
+                    worker.request_stop()
+            except Exception:  # pragma: no cover - shutdown only
+                logger.exception("refit worker close failed")
         # batcher.close() drains to empty — including deferred chained
         # updates that only enqueue from done-callbacks mid-drain —
         # before it starts refusing submissions
@@ -2417,6 +2500,7 @@ class MetranService:
                         verdict_t[i, :, : st.n_series]
                         if gated else None
                     ),
+                    version=new_state.version,
                 )
                 if rp is not None and info.hvars is not None:
                     # its OWN guard, like the exact path's: the
@@ -2720,6 +2804,7 @@ class MetranService:
                 verdicts=(
                     verdict_t[i, :, : st.n_series] if gated else None
                 ),
+                version=new_state.version,
             )
             if steady_on and st.model_id not in self._steady_info:
                 # freeze detection: converged factor + fully-observed
@@ -3261,6 +3346,7 @@ class MetranService:
                         verdicts[i, :, : meta.n_series]
                         if gated else None
                     ),
+                    version=int(versions[i]),
                 )
                 if not m[i].any():
                     self.metrics.data_quality.increment("empty_updates")

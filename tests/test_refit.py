@@ -1,0 +1,636 @@
+"""Self-healing serving (`metran_tpu.serve.refit`).
+
+Pins the continuous-adaptation contracts:
+
+1. candidate selection merges gate degradation and staleness into one
+   ranked, hysteresis-guarded queue (`HealthMonitor.refit_candidates`);
+2. the observation tail keeps a consistent anchored lineage — rows the
+   gate acted on buffered masked, discontinuities restarting tracking;
+3. `refit_fleet` recovers stale AR time-scales from a posterior-seeded
+   tail, and the challenger beats the champion on held-out deviance;
+4. **rejection is the safe default**: a worse / failed / timed-out
+   challenger leaves the serving posterior, read-path snapshots and
+   steady state bit-identically untouched;
+5. promotion composes with every serving invariant: snapshots
+   invalidated, frozen gains thawed, the fixed-lag window restarted,
+   concurrent updates neither lost nor reordered across the swap;
+6. a crash injected mid-promotion recovers to exactly the old or
+   exactly the new parameters — never a torn mix;
+7. end to end: drift fault → degraded → background refit → promotion →
+   forecast RMSE within 2x of the clean stream.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from metran_tpu.ops import dfm_statespace, sqrt_kalman_filter
+from metran_tpu.reliability import faultinject
+from metran_tpu.reliability.faultinject import SimulatedCrash
+from metran_tpu.reliability.health import HealthMonitor, RefitCandidate
+from metran_tpu.reliability.scenarios import (
+    run_drift_recovery_scenario,
+    simulate_dfm_panel,
+)
+from metran_tpu.serve import (
+    GateSpec,
+    MetranService,
+    ModelRegistry,
+    ObservationTail,
+    PosteriorState,
+    RefitSpec,
+    RefitWorker,
+    SteadySpec,
+)
+
+pytestmark = pytest.mark.refit
+
+N, K, T_HIST = 4, 1, 150
+TAIL, HOLDOUT, MIN_TAIL = 40, 10, 20
+#: one shared spec shape across the module so every worker reuses one
+#: compiled refit runner (tail rows pinned at capacity by streaming
+#: >= TAIL rows before any cycle)
+SPEC = RefitSpec(
+    tail=TAIL, holdout=HOLDOUT, min_tail=MIN_TAIL, maxiter=15,
+    cooldown_s=0.0, deadline_s=600.0, staleness_obs=1,
+)
+
+
+def _make_model(seed=0, alpha_factor=6.0, n=N, k=K, t_hist=T_HIST):
+    """A true DFM, a stale serving state (alphas scaled by
+    ``alpha_factor``), and a clean future stream simulated from the
+    true dynamics."""
+    rng = np.random.default_rng(seed)
+    loadings = rng.uniform(0.4, 0.7, (n, k)) / np.sqrt(k)
+    alpha_sdf = rng.uniform(5.0, 40.0, n)
+    alpha_cdf = rng.uniform(10.0, 60.0, k)
+    true_params = np.concatenate([alpha_sdf, alpha_cdf])
+    ss_true = dfm_statespace(alpha_sdf, alpha_cdf, loadings, 1.0)
+    xs, y_all, _ = simulate_dfm_panel(ss_true, t_hist + 200, rng)
+    stale = true_params * alpha_factor
+    ss_stale = dfm_statespace(stale[:n], stale[n:], loadings, 1.0)
+    mask = np.ones((t_hist, n), bool)
+    filt = sqrt_kalman_filter(ss_stale, y_all[:t_hist], mask)
+    chol0 = np.asarray(filt.chol_f[-1])
+    state = PosteriorState(
+        model_id="m0", version=0, t_seen=t_hist,
+        mean=np.asarray(filt.mean_f[-1]), cov=chol0 @ chol0.T,
+        params=stale, loadings=loadings, dt=1.0,
+        scaler_mean=np.zeros(n), scaler_std=np.ones(n),
+        names=tuple(f"s{j}" for j in range(n)), chol=chol0,
+    )
+    return state, true_params, y_all[t_hist:], xs[t_hist:]
+
+
+def _make_service(state, root=None, **kw):
+    reg = ModelRegistry(root=root, engine="sqrt")
+    reg.put(state, persist=root is not None)
+    svc = MetranService(
+        reg, flush_deadline=None,
+        persist_updates=root is not None, **kw,
+    )
+    return svc, reg
+
+
+def _stream(svc, mid, rows):
+    for t in range(rows.shape[0]):
+        svc.update(mid, rows[t][None, :])
+
+
+def _state_fingerprint(state):
+    return (
+        state.version, state.t_seen,
+        np.asarray(state.params).tobytes(),
+        np.asarray(state.mean).tobytes(),
+        np.asarray(state.cov).tobytes(),
+    )
+
+
+# ----------------------------------------------------------------------
+# 1. candidate queue: merge, ranking, hysteresis
+# ----------------------------------------------------------------------
+def test_refit_candidates_merge_and_hysteresis():
+    clock = [0.0]
+    mon = HealthMonitor(max_rejection_rate=0.1, clock=lambda: clock[0])
+    # gate degradation: m_gate rejects 30% of its observations
+    for _ in range(10):
+        mon.record_gate("m_gate", 10, 3)
+        mon.record_gate("m_ok", 10, 0)
+    # staleness: m_stale assimilated 500 steps since its fit mark
+    mon.note_fit("m_stale", 1000)
+    mon.note_progress("m_stale", 1500)
+    # implicit baseline: first sight is NOT stale however large t_seen
+    mon.note_progress("m_new", 10**6)
+
+    cands = mon.refit_candidates(staleness_obs=100)
+    by_id = {c.model_id: c for c in cands}
+    assert set(by_id) == {"m_gate", "m_stale"}
+    assert isinstance(cands[0], RefitCandidate)
+    # gate ratio 3.0 outranks staleness ratio 5.0? no: max ratio wins
+    assert by_id["m_gate"].reasons == ("gate",)
+    assert by_id["m_stale"].reasons == ("stale_obs",)
+    assert by_id["m_stale"].obs_since_fit == 500
+    assert cands[0].model_id == "m_stale"  # 5.0 > 3.0
+    assert by_id["m_gate"].rejection_rate == pytest.approx(0.3)
+
+    # hysteresis: a claimed model leaves the queue...
+    assert mon.begin_refit("m_gate")
+    assert not mon.begin_refit("m_gate")  # double-claim refused
+    assert "m_gate" not in {
+        c.model_id for c in mon.refit_candidates(staleness_obs=100)
+    }
+    # ...and stays out through the cooldown after release
+    mon.end_refit("m_gate", cooldown_s=30.0)
+    assert "m_gate" not in {
+        c.model_id for c in mon.refit_candidates(staleness_obs=100)
+    }
+    clock[0] = 31.0
+    assert "m_gate" in {
+        c.model_id for c in mon.refit_candidates(staleness_obs=100)
+    }
+    # a promotion resets both signals
+    mon.note_fit("m_stale", 1500)
+    mon.reset_gate("m_gate")
+    assert mon.refit_candidates(staleness_obs=100) == []
+    # age staleness fires on the clock alone — but only for models
+    # with a baseline stamp (m_gate never got one: no mark, no age)
+    clock[0] = 1031.0
+    age = mon.refit_candidates(staleness_age_s=500.0)
+    assert {c.model_id for c in age} == {"m_stale", "m_new"}
+    assert all("stale_age" in c.reasons for c in age)
+
+
+# ----------------------------------------------------------------------
+# 2. observation tail: lineage, masking, capacity
+# ----------------------------------------------------------------------
+def test_observation_tail_lineage_and_masking(rng):
+    state, _, y_future, _ = _make_model(seed=1)
+    tail = ObservationTail(capacity=8)
+    mid = state.model_id
+    t0 = state.t_seen
+
+    tail.observe(mid, y_future[0][None], np.ones((1, N), bool),
+                 t0 + 1, lambda: state._replace(t_seen=t0 + 1))
+    # first touch restarts AFTER the commit: anchor at t0+1, no rows
+    assert tail.t_seen(mid) == t0 + 1
+    assert tail.snapshot(mid) is None
+    for i in range(1, 6):
+        tail.observe(mid, y_future[i][None], np.ones((1, N), bool),
+                     t0 + 1 + i, lambda: None)
+    snap = tail.snapshot(mid)
+    assert snap.rows == 5 and snap.anchor_t_seen == t0 + 1
+    np.testing.assert_array_equal(snap.y, y_future[1:6])
+
+    # gate verdicts mask acted-on cells without breaking the lineage
+    verd = np.zeros((1, N), np.int8)
+    verd[0, 2] = 1
+    tail.observe(mid, y_future[6][None], np.ones((1, N), bool),
+                 t0 + 7, lambda: None, verdicts=verd)
+    snap = tail.snapshot(mid)
+    assert snap.rows == 6
+    assert not snap.mask[-1, 2] and snap.mask[-1, [0, 1, 3]].all()
+
+    # a gap (rejected update upstream) restarts from the fresh state
+    tail.observe(mid, y_future[9][None], np.ones((1, N), bool),
+                 t0 + 99, lambda: state._replace(t_seen=t0 + 99))
+    assert tail.t_seen(mid) == t0 + 99
+    assert tail.snapshot(mid) is None
+
+    # capacity: the anchor advances by replaying evicted rows
+    for i in range(12):
+        tail.observe(mid, y_future[10 + i][None],
+                     np.ones((1, N), bool), t0 + 100 + i, lambda: None)
+    snap = tail.snapshot(mid)
+    assert snap.rows == 8  # capacity
+    assert snap.anchor_t_seen == t0 + 99 + 4  # 12 - 8 replayed
+    assert tail.t_seen(mid) == t0 + 111
+    assert np.isfinite(snap.anchor_mean).all()
+    assert np.isfinite(snap.anchor_chol).all()
+
+
+# ----------------------------------------------------------------------
+# 3. the fit itself: solver + fleet entry point
+# ----------------------------------------------------------------------
+def test_batched_lbfgs_solves_independent_quadratics():
+    import jax.numpy as jnp
+
+    from metran_tpu.models.solver import batched_lbfgs
+
+    centers = np.array([[1.0, -2.0], [3.0, 0.5], [-4.0, 4.0]])
+
+    def objective(theta, c):
+        return jnp.sum((theta - c) ** 2)
+
+    fit = batched_lbfgs(
+        objective, np.zeros_like(centers), (jnp.asarray(centers),),
+        maxiter=50,
+    )
+    np.testing.assert_allclose(fit.theta, centers, atol=1e-8)
+    assert fit.converged.all()
+    np.testing.assert_allclose(fit.value, 0.0, atol=1e-12)
+    assert (fit.value0 > 1.0).all()
+
+
+def test_refit_fleet_recovers_stale_params():
+    from metran_tpu.parallel import (
+        anchored_fleet_posteriors,
+        refit_fleet,
+    )
+
+    state, true_params, y_future, _ = _make_model(seed=2)
+    n = N
+    rows = y_future[:TAIL]
+    mask = np.ones(rows.shape, bool)
+    args = (
+        rows[None], mask[None], state.loadings[None], np.ones(1),
+        np.asarray(state.mean)[None], np.asarray(state.chol)[None],
+    )
+    fit = refit_fleet(*args, state.params[None], maxiter=15)
+    assert np.isfinite(fit.value[0])
+    # the anchored deviance improved and the alphas moved toward truth
+    assert fit.value[0] < fit.value0[0]
+    err_before = np.abs(np.log(state.params) - np.log(true_params))
+    err_after = np.abs(np.log(fit.theta[0]) - np.log(true_params))
+    assert err_after.mean() < err_before.mean()
+    # and the challenger wins the same-tail deviance comparison
+    _, _, dev_c = anchored_fleet_posteriors(state.params[None], *args)
+    _, _, dev_n = anchored_fleet_posteriors(fit.theta, *args)
+    assert dev_n[0] < dev_c[0]
+
+
+# ----------------------------------------------------------------------
+# 4. rejection is the safe default (bit-identical serving state)
+# ----------------------------------------------------------------------
+@pytest.mark.faults
+def test_rejection_leaves_serving_bit_identical():
+    state, _, y_future, _ = _make_model(seed=3)
+    svc, reg = _make_service(state, readpath=True, horizons="1-5")
+    mid = state.model_id
+    worker = RefitWorker(svc, SPEC)
+    try:
+        svc.monitor.note_fit(mid, state.t_seen)
+        _stream(svc, mid, y_future[:TAIL + 4])
+        entry_before = svc.readpath.read(mid, 3)
+        assert entry_before is not None
+        before_state = reg.get(mid)
+        before = _state_fingerprint(before_state)
+
+        # (a) worse challenger: an infinite margin rejects any fit
+        worker.spec = worker.spec._replace(margin=float("inf"))
+        report = worker.run_once()
+        assert report["rejected"] == {mid: "worse"}
+        assert reg.get(mid) is before_state  # no put() happened at all
+        assert _state_fingerprint(reg.get(mid)) == before
+        assert svc.readpath.read(mid, 3) is entry_before
+
+        # (b) fit blows up: injected failure leaves serving untouched
+        worker.spec = worker.spec._replace(margin=0.0)
+        with faultinject.active() as inj:
+            inj.add("serve.refit.fit", error=RuntimeError("boom"))
+            report = worker.run_once()
+        assert mid in report["failed"]
+        assert reg.get(mid) is before_state
+        assert _state_fingerprint(reg.get(mid)) == before
+
+        # (c) timeout: the deadline overruns reject, never promote late
+        worker.spec = worker.spec._replace(deadline_s=0.0)
+        report = worker.run_once()
+        assert report["rejected"] == {mid: "timeout"}
+        assert reg.get(mid) is before_state
+        assert _state_fingerprint(reg.get(mid)) == before
+        assert svc.readpath.read(mid, 3) is entry_before
+
+        counts = worker.counts
+        assert counts.get("promoted", 0) == 0
+        assert counts["scheduled"] == 3
+        kinds = [e["kind"] for e in svc.events.for_model(mid)]
+        assert kinds.count("refit_rejected") == 2
+        assert kinds.count("refit_failed") == 1
+    finally:
+        worker.close()
+        svc.close()
+
+
+# ----------------------------------------------------------------------
+# 5. promotion composes with snapshots, steady rows, fixed-lag windows
+# ----------------------------------------------------------------------
+def test_promotion_invalidates_caches_and_restarts_windows():
+    state, _, y_future, _ = _make_model(seed=4)
+    svc, reg = _make_service(
+        state, readpath=True, horizons="1-5",
+        steady=SteadySpec(tol=1e6, min_seen=1), fixed_lag=6,
+    )
+    mid = state.model_id
+    worker = RefitWorker(svc, SPEC._replace(margin=-1e30))
+    try:
+        svc.monitor.note_fit(mid, state.t_seen)
+        _stream(svc, mid, y_future[:TAIL + 4])
+        # the huge tol froze the model onto the steady path...
+        assert svc._steady_count() == 1
+        assert svc.smoother.tracking(mid)
+        assert svc.readpath.read(mid, 3) is not None
+        v0 = reg.get(mid).version
+        old_params = np.asarray(reg.get(mid).params).copy()
+
+        report = worker.run_once()
+        assert report["promoted"] == [mid]
+        new_state = reg.get(mid)
+        assert new_state.version == v0 + 1
+        assert not np.array_equal(new_state.params, old_params)
+        # snapshot store invalidated by the on_commit feed
+        assert svc.readpath.read(mid, 3) is None
+        # frozen gain thawed (a stale gain must not serve new dynamics)
+        assert svc._steady_count() == 0
+        kinds = [e["kind"] for e in svc.events.for_model(mid)]
+        assert "steady_thaw" in kinds and "refit_promoted" in kinds
+        # fixed-lag window restarted — the old rows were assimilated
+        # by the replaced posterior lineage
+        assert not svc.smoother.tracking(mid)
+        # the gate/staleness signals reset: no immediate re-enqueue
+        assert svc.monitor.refit_candidates(staleness_obs=1) == []
+
+        # serving continues seamlessly on the promoted state
+        res = svc.update(mid, y_future[TAIL + 4][None, :])
+        assert res.version == v0 + 2
+        fc = svc.forecast(mid, 3)
+        assert np.isfinite(fc.means).all()
+        assert fc.version == v0 + 2
+        # outcome counter family reached the metrics registry
+        prom = svc.obs.metrics.render_prometheus()
+        assert 'metran_serve_refit_total{outcome="promoted"}' in prom
+        assert "metran_serve_refit_in_flight" in prom
+        assert "metran_serve_refit_queue_depth" in prom
+    finally:
+        worker.close()
+        svc.close()
+
+
+# ----------------------------------------------------------------------
+# 6. concurrent updates across a swap: none lost, none reordered
+# ----------------------------------------------------------------------
+def test_update_during_swap_ordering():
+    state, _, y_future, _ = _make_model(seed=5)
+    svc, reg = _make_service(state)
+    mid = state.model_id
+    worker = RefitWorker(svc, SPEC._replace(margin=-1e30))
+    errors = []
+    try:
+        svc.monitor.note_fit(mid, state.t_seen)
+        _stream(svc, mid, y_future[:TAIL])
+        t0 = reg.get(mid).t_seen
+        v0 = reg.get(mid).version
+        n_updates = 24
+        start = threading.Barrier(2)
+
+        def writer():
+            start.wait()
+            for i in range(n_updates):
+                try:
+                    svc.update(mid, y_future[TAIL + i][None, :])
+                except Exception as exc:  # pragma: no cover
+                    errors.append(exc)
+
+        def promoter():
+            start.wait()
+            for _ in range(3):
+                try:
+                    worker.run_once()
+                except Exception as exc:  # pragma: no cover
+                    errors.append(exc)
+
+        threads = [
+            threading.Thread(target=writer),
+            threading.Thread(target=promoter),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert not errors
+        promoted = worker.counts.get("promoted", 0)
+        final = reg.get(mid)
+        # every update assimilated exactly once, in order, across
+        # however many swaps landed; each swap bumped the version once
+        assert final.t_seen == t0 + n_updates
+        assert final.version == v0 + n_updates + promoted
+        assert promoted >= 1
+        assert np.isfinite(final.mean).all()
+    finally:
+        worker.close()
+        svc.close()
+
+
+# ----------------------------------------------------------------------
+# 7. crash-safe hot-swap: exactly old or exactly new, never torn
+# ----------------------------------------------------------------------
+@pytest.mark.faults
+@pytest.mark.parametrize(
+    "crash_point", ["serve.refit.promote", "io.atomic_savez.rename"]
+)
+def test_crash_mid_promote_recovers_old_or_new(tmp_path, crash_point):
+    state, _, y_future, _ = _make_model(seed=6)
+    svc, reg = _make_service(state, root=tmp_path)
+    mid = state.model_id
+    worker = RefitWorker(svc, SPEC._replace(margin=-1e30))
+    try:
+        svc.monitor.note_fit(mid, state.t_seen)
+        _stream(svc, mid, y_future[:TAIL])
+        pre_crash = reg.get(mid)
+        old_params = np.asarray(pre_crash.params).copy()
+        with faultinject.active() as inj:
+            inj.add(crash_point, error=SimulatedCrash,
+                    match=mid if crash_point.startswith("io.") else None)
+            with pytest.raises(SimulatedCrash):
+                worker.run_once()
+    finally:
+        worker.close()
+        svc.close()
+    # "restart": a fresh registry recovers from disk alone.  The
+    # atomic-npz + CRC format guarantees the file is wholly old or
+    # wholly new — and with the crash before/at the write-through
+    # commit point, old in both variants.
+    reg2 = ModelRegistry(root=tmp_path, engine="sqrt")
+    recovered = reg2.get(mid)
+    new_params = np.asarray(recovered.params)
+    is_old = np.array_equal(new_params, old_params)
+    is_new = (
+        recovered.version == pre_crash.version + 1
+        and not np.array_equal(new_params, old_params)
+    )
+    assert is_old or is_new
+    assert is_old  # both crash points precede the durable commit
+    assert recovered.version == pre_crash.version
+    np.testing.assert_array_equal(recovered.mean, pre_crash.mean)
+    assert reg2.integrity_stats.get("quarantined", 0) == 0
+
+
+@pytest.mark.faults
+def test_clean_promotion_persists_new_params(tmp_path):
+    state, _, y_future, _ = _make_model(seed=7)
+    svc, reg = _make_service(state, root=tmp_path)
+    mid = state.model_id
+    worker = RefitWorker(svc, SPEC._replace(margin=-1e30))
+    try:
+        svc.monitor.note_fit(mid, state.t_seen)
+        _stream(svc, mid, y_future[:TAIL])
+        old_params = np.asarray(reg.get(mid).params).copy()
+        report = worker.run_once()
+        assert report["promoted"] == [mid]
+        promoted = reg.get(mid)
+    finally:
+        worker.close()
+        svc.close()
+    reg2 = ModelRegistry(root=tmp_path, engine="sqrt")
+    recovered = reg2.get(mid)
+    assert recovered.version == promoted.version
+    np.testing.assert_array_equal(recovered.params, promoted.params)
+    assert not np.array_equal(recovered.params, old_params)
+    np.testing.assert_array_equal(recovered.mean, promoted.mean)
+
+
+# ----------------------------------------------------------------------
+# 7b. promotion lineage: tolerant of anchor advance, strict on swaps
+# ----------------------------------------------------------------------
+def test_promotion_tolerates_lineage_preserving_anchor_advance():
+    """Rows streaming in DURING the fit advance the tail anchor (a
+    lineage-preserving replay); the promotion must still land — a busy
+    model at tail capacity would otherwise reject 'stale' on every
+    cycle and never self-heal."""
+    state, _, y_future, _ = _make_model(seed=10)
+    svc, reg = _make_service(state)
+    mid = state.model_id
+    worker = RefitWorker(svc, SPEC)
+    try:
+        _stream(svc, mid, y_future[:TAIL + 2])
+        snap = worker.tail.snapshot(mid)  # the fit's view
+        # traffic continues while the "fit" runs: enough rows to force
+        # a bulk anchor advance (2x capacity triggers the replay)
+        _stream(svc, mid, y_future[TAIL + 2:3 * TAIL])
+        snap2 = worker.tail.snapshot(mid)
+        assert snap2.anchor_t_seen > snap.anchor_t_seen  # advanced
+        assert snap2.lineage == snap.lineage  # same epoch
+        v0 = reg.get(mid).version
+        report = {"promoted": [], "rejected": {}, "failed": {}}
+        worker._promote(
+            mid, snap, np.asarray(state.params) * 0.9, 1.0, 0.0, report
+        )
+        assert report["promoted"] == [mid]
+        assert reg.get(mid).version == v0 + 1
+    finally:
+        worker.close()
+        svc.close()
+
+
+def test_external_same_tseen_swap_restarts_tail_and_rejects():
+    """An external registry.put that PRESERVES t_seen (operator
+    restore at the same stream position) must still break the tail
+    lineage — the version discontinuity catches it — and a promotion
+    fit against the old lineage must reject as stale rather than
+    clobber the operator's parameters."""
+    state, _, y_future, _ = _make_model(seed=11)
+    svc, reg = _make_service(state)
+    mid = state.model_id
+    worker = RefitWorker(svc, SPEC)
+    try:
+        _stream(svc, mid, y_future[:TAIL])
+        snap = worker.tail.snapshot(mid)
+        cur = reg.get(mid)
+        operator_params = np.asarray(cur.params) * 0.5
+        reg.put(cur._replace(
+            version=cur.version + 7, params=operator_params
+        ), persist=False)
+        # the next commit reveals the version jump -> lineage restart
+        svc.update(mid, y_future[TAIL][None, :])
+        snap2 = worker.tail.snapshot(mid)
+        assert snap2 is None or snap2.lineage != snap.lineage
+        report = {"promoted": [], "rejected": {}, "failed": {}}
+        worker._promote(
+            mid, snap, np.asarray(state.params) * 0.9, 1.0, 0.0, report
+        )
+        assert report["rejected"] == {mid: "stale"}
+        np.testing.assert_array_equal(
+            reg.get(mid).params, operator_params
+        )
+    finally:
+        worker.close()
+        svc.close()
+
+
+def test_stopped_worker_cannot_promote():
+    """A zombie cycle finishing after stop() must reject instead of
+    mutating a registry the service no longer serves (the close()
+    drain-race guard)."""
+    state, _, y_future, _ = _make_model(seed=12)
+    svc, reg = _make_service(state)
+    mid = state.model_id
+    worker = RefitWorker(svc, SPEC)
+    try:
+        _stream(svc, mid, y_future[:TAIL])
+        snap = worker.tail.snapshot(mid)
+        before = reg.get(mid)
+        worker._stop.set()
+        report = {"promoted": [], "rejected": {}, "failed": {}}
+        worker._promote(
+            mid, snap, np.asarray(state.params) * 0.9, 1.0, 0.0, report
+        )
+        assert report["rejected"] == {mid: "shutdown"}
+        assert reg.get(mid) is before
+    finally:
+        worker.close()
+        svc.close()
+
+
+# ----------------------------------------------------------------------
+# 8. service-owned worker lifecycle
+# ----------------------------------------------------------------------
+def test_service_owns_refit_worker_lifecycle():
+    state, _, y_future, _ = _make_model(seed=8)
+    reg = ModelRegistry(root=None, engine="sqrt")
+    reg.put(state, persist=False)
+    svc = MetranService(
+        reg, flush_deadline=None, persist_updates=False,
+        refit=SPEC._replace(enabled=True, interval_s=3600.0),
+    )
+    try:
+        worker = svc._refit_worker
+        assert worker is not None and worker.alive
+        # tail recording armed on the dispatch path
+        svc.update(state.model_id, y_future[0][None, :])
+        assert worker.tail.t_seen(state.model_id) == state.t_seen + 1
+        assert "refit" in svc.health()
+    finally:
+        svc.close()
+    assert not worker.alive
+    assert svc._refit_worker is None
+
+
+# ----------------------------------------------------------------------
+# 9. end to end: drift fault -> degraded -> refit -> recovered
+# ----------------------------------------------------------------------
+@pytest.mark.faults
+def test_drift_recovery_scenario():
+    out = run_drift_recovery_scenario(seed=0)
+    mid = "drift-recovery"
+    # the fault was detected...
+    assert out["degraded_after_fault"] == [mid]
+    # ...the refit promoted a challenger...
+    assert out["promoted"] == [mid]
+    # ...accuracy recovered to within 2x of the clean stream, and
+    # beat the no-refit control serving the same corrupted stream
+    assert out["refit_vs_clean"] <= 2.0, out
+    assert out["rmse_refit"] < out["rmse_norefit"], out
+    # the full story reconstructs from the event log alone, in order
+    kinds = [
+        k for k in out["events"]
+        if k in ("degraded", "refit_scheduled", "refit_promoted")
+    ]
+    assert kinds == ["degraded", "refit_scheduled", "refit_promoted"]
+    # the promoted parameters moved toward the truth
+    err_stale = np.abs(
+        np.log(out["params_stale"]) - np.log(out["params_true"])
+    ).mean()
+    err_refit = np.abs(
+        np.log(out["params_refit"]) - np.log(out["params_true"])
+    ).mean()
+    assert err_refit < err_stale

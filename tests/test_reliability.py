@@ -1197,3 +1197,66 @@ def test_jaxsolve_divergence_names_offending_parameters(series_list):
     for name in mt.parameters.index[mt.parameters.vary.astype(bool)]:
         assert str(name) in msg
     assert "pmin" in msg  # actionable guidance, not just a stack trace
+
+
+# ----------------------------------------------------------------------
+# 6. continuous-adaptation chaos: the refit loop under injected faults
+# ----------------------------------------------------------------------
+def test_refit_chaos_faults_never_touch_serving(rng, tmp_path):
+    """Chaos pass over the self-healing loop's named fault points
+    (`serve.refit.fit`, `serve.refit.promote`): a refit cycle hit by
+    an injected fit error, a wedged fit (delay), and a SimulatedCrash
+    mid-promotion must leave the served posterior bit-identical and
+    the on-disk state loadable as exactly the old parameters — the
+    crash-consistency claim, injected rather than asserted."""
+    from metran_tpu.serve import RefitSpec, RefitWorker
+
+    st, ss, y, mask = _make_state(
+        rng, model_id="chaos0", n=3, k=1, t=60, engine="sqrt"
+    )
+    reg = ModelRegistry(root=tmp_path, engine="sqrt")
+    reg.put(st)
+    svc = MetranService(reg, flush_deadline=None)
+    worker = RefitWorker(svc, RefitSpec(
+        tail=24, holdout=6, min_tail=12, maxiter=5,
+        cooldown_s=0.0, deadline_s=600.0, staleness_obs=1,
+        margin=-1e30,  # absent faults, every cycle would promote
+    ))
+    try:
+        svc.monitor.note_fit("chaos0", st.t_seen)
+        for t in range(26):
+            svc.update("chaos0", rng.normal(size=(1, 3)))
+        before = reg.get("chaos0")
+        v0 = before.version
+
+        with faultinject.active() as inj:
+            # a failing fit and a wedged (slow) fit: both book
+            # refit_failed / reject and leave serving untouched
+            inj.add("serve.refit.fit", error=RuntimeError, times=1)
+            report = worker.run_once()
+            assert "chaos0" in report["failed"]
+            assert reg.get("chaos0") is before
+
+            inj.add("serve.refit.fit", delay_s=0.05, times=1)
+            worker.spec = worker.spec._replace(deadline_s=0.01)
+            report = worker.run_once()
+            assert report["rejected"] == {"chaos0": "timeout"}
+            assert reg.get("chaos0") is before
+            worker.spec = worker.spec._replace(deadline_s=600.0)
+
+            # SimulatedCrash mid-promotion: BaseException escapes the
+            # worker (the process is "gone"), nothing was swapped
+            inj.add("serve.refit.promote", error=SimulatedCrash)
+            with pytest.raises(SimulatedCrash):
+                worker.run_once()
+        assert reg.get("chaos0") is before
+        assert reg.get("chaos0").version == v0
+    finally:
+        worker.close()
+        svc.close()
+    # a fresh process recovers the exact pre-crash state from disk
+    reg2 = ModelRegistry(root=tmp_path, engine="sqrt")
+    recovered = reg2.get("chaos0")
+    assert recovered.version == v0
+    np.testing.assert_array_equal(recovered.params, before.params)
+    np.testing.assert_array_equal(recovered.mean, before.mean)

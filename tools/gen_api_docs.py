@@ -50,6 +50,7 @@ MODULES = [
     "metran_tpu.serve.registry",
     "metran_tpu.serve.batching",
     "metran_tpu.serve.readpath",
+    "metran_tpu.serve.refit",
     "metran_tpu.serve.service",
     "metran_tpu.serve.smoothing",
     "metran_tpu.reliability.policy",
