@@ -417,6 +417,21 @@ def run_device_bench(out_path: str, budget_s: float,
         np.asarray(fit.params)
         return fit
 
+    import resource as _resource
+
+    def _rss_mb() -> float:
+        return _resource.getrusage(_resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+    def _resolve_grad(which: str) -> str:
+        # "lanes" rides the sequential-engine resolution (fit_fleet's
+        # _lanes_score rule)
+        from metran_tpu.ops import resolve_grad_engine
+
+        return resolve_grad_engine(
+            None, "sequential" if which == "lanes" else which
+        )
+
+    rss_before_fit = _rss_mb()
     t0 = time.perf_counter()
     fit = timed_fit()
     fit_compile_s = time.perf_counter() - t0
@@ -450,9 +465,19 @@ def run_device_bench(out_path: str, budget_s: float,
         "stalled_frac": round(float(np.mean(np.asarray(fit.stalled))), 3),
         "deviance_model0": float(np.asarray(fit.deviance)[0]),
         "batch": batch,
+        # the lanes fit differentiates through its analytical score by
+        # default; recorded so rounds are comparable if the knob flips
+        "grad_engine": _resolve_grad("lanes"),
+        # host-process peak RSS across the fit phase (monotone counter:
+        # the delta is the fit's incremental demand over the stages
+        # before it — forward/backward buffers included on the CPU
+        # backend, compile workspace included on first run)
+        "peak_rss_mb": round(_rss_mb(), 1),
+        "rss_delta_mb": round(_rss_mb() - rss_before_fit, 1),
     }
     progress("fit_done", **{k: out["fit"][k] for k in
-                            ("run_s", "fits_per_s", "lbfgs_iters_mean")})
+                            ("run_s", "fits_per_s", "lbfgs_iters_mean",
+                             "rss_delta_mb")})
     write_partial(out_path, out)
 
     # ---- single-model fit latency -------------------------------------
@@ -2579,10 +2604,18 @@ def run_refit_bench(out_path: str, budget_s: float) -> dict:
         RefitWorker,
     )
 
+    from metran_tpu.ops import resolve_grad_engine
+
     deadline = time.monotonic() + budget_s
     out = {
         "platform": jax.default_backend(),
         "cpus": os.cpu_count(),
+        # which gradient engine the anchored batch fits differentiate
+        # with this round (the adjoint by default since ISSUE 10 —
+        # models/s here is comparable against the PR 9 autodiff
+        # baseline in earlier round JSONs; the anchored objective has
+        # no f32 carve-out, see parallel/fleet.py::refit_fleet)
+        "grad_engine": resolve_grad_engine(None, "sqrt"),
         "refit": {}, "swap": {}, "foreground": {},
     }
 
@@ -2803,6 +2836,312 @@ def run_refit_bench(out_path: str, budget_s: float) -> dict:
 
 
 # ----------------------------------------------------------------------
+# phase: gradient engines (closed-form adjoint vs autodiff)
+# ----------------------------------------------------------------------
+def run_grad_bench(out_path: str, budget_s: float) -> dict:
+    """Gradient-engine cost story (`ops/adjoint.py`, ISSUE 10).
+
+    Three measured claims:
+
+    1. **backward speed** — at the standard workload (T=5000 flagship
+       shape, f64 — the CPU fit/refit regime where ``auto`` resolves
+       to the adjoint), paired interleaved value-and-grad laps per
+       engine: ``backward_s = value_and_grad_s - forward_s``, ratio =
+       autodiff backward / adjoint backward, acceptance bar >= 2x;
+    2. **backward memory flat in T** — subprocess peak-RSS deltas of
+       one value-and-grad at T = 1e2/1e4/1e5 per gradient engine
+       (``--phase grad-mem`` children; tracemalloc + jax device
+       memory stats recorded when available — on the CPU backend the
+       buffers are native, so peak RSS is the honest instrument);
+    3. **anchored refit speed** — `refit_fleet` wall per batch under
+       each engine (the background-refit path's models/s).
+    """
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", JAX_CACHE + "-cpu")
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    from metran_tpu.ops import deviance, dfm_statespace
+
+    n, k_fct, t_steps = N_SERIES, N_FACTORS, T_STEPS
+    pairs = 5
+    mem_ts = (100, 10_000, 100_000)
+    if os.environ.get("METRAN_TPU_BENCH_SMALL"):
+        t_steps, pairs, mem_ts = 500, 2, (100, 2_000)
+    deadline = time.monotonic() + budget_s
+    out = {
+        "platform": jax.default_backend(),
+        "cpus": os.cpu_count(),
+        "dtype": "float64",
+        "n_series": n, "n_factors": k_fct, "t_steps": t_steps,
+        "pairs": pairs,
+        "engines": {}, "anchored": {}, "memory": {},
+    }
+
+    rng = np.random.default_rng(0)
+    loadings = rng.uniform(0.4, 0.8, (n, k_fct))
+    mask = rng.uniform(size=(t_steps, n)) > MISSING
+    mask[0] = False
+    y = np.where(mask, rng.normal(size=(t_steps, n)), 0.0)
+    alpha = jnp.asarray(np.full(n + k_fct, 10.0))
+
+    def dev(a, engine, grad):
+        ss = dfm_statespace(a[:n], a[n:], jnp.asarray(loadings), 1.0)
+        return deviance(
+            ss, jnp.asarray(y), jnp.asarray(mask), warmup=1,
+            engine=engine, grad=grad,
+        )
+
+    def lap(fn):
+        t0 = time.perf_counter()
+        r = fn(alpha)
+        (r[0] if isinstance(r, tuple) else r).block_until_ready()
+        return time.perf_counter() - t0
+
+    for engine in ("sqrt", "joint"):
+        fwd = jax.jit(lambda a, e=engine: dev(a, e, "autodiff"))
+        vg = {
+            mode: jax.jit(jax.value_and_grad(
+                lambda a, e=engine, m=mode: dev(a, e, m)
+            ))
+            for mode in ("adjoint", "autodiff")
+        }
+        lap(fwd)  # warm (compile)
+        for f in vg.values():
+            lap(f)
+        fwd_s = float(np.median([lap(fwd) for _ in range(3)]))
+        # paired interleaved laps, alternating AB/BA order so drift
+        # and contention hit both engines of each pair equally
+        times = {"adjoint": [], "autodiff": []}
+        for i in range(pairs):
+            order = (
+                ("adjoint", "autodiff") if i % 2 == 0
+                else ("autodiff", "adjoint")
+            )
+            for mode in order:
+                times[mode].append(lap(vg[mode]))
+        vg_adj = float(np.median(times["adjoint"]))
+        vg_auto = float(np.median(times["autodiff"]))
+        bwd_adj = max(vg_adj - fwd_s, 1e-9)
+        bwd_auto = max(vg_auto - fwd_s, 1e-9)
+        out["engines"][engine] = {
+            "forward_s": round(fwd_s, 5),
+            "value_and_grad_s_adjoint": round(vg_adj, 5),
+            "value_and_grad_s_autodiff": round(vg_auto, 5),
+            "backward_s_adjoint": round(bwd_adj, 5),
+            "backward_s_autodiff": round(bwd_auto, 5),
+            "backward_speedup": round(bwd_auto / bwd_adj, 3),
+            "value_and_grad_speedup": round(vg_auto / vg_adj, 3),
+        }
+        progress("grad_engine_timed", engine=engine,
+                 **out["engines"][engine])
+        write_partial(out_path, out)
+        if time.monotonic() > deadline:
+            out["truncated"] = "budget"
+            write_partial(out_path, out)
+            return out
+    head = out["engines"].get("sqrt") or {}
+    out["backward_speedup"] = head.get("backward_speedup", 0.0)
+    out["bar"] = 2.0
+    out["meets_bar"] = bool(out["backward_speedup"] >= 2.0)
+
+    # flat-in-T backward memory: one subprocess per point so peak RSS
+    # is a clean per-measurement instrument (RSS peaks are monotone
+    # within a process).  Runs BEFORE the anchored section — memory is
+    # the acceptance-critical claim, the refit A/B the bonus
+    for t_mem in mem_ts:
+        for grad in ("adjoint", "autodiff"):
+            if time.monotonic() > deadline:
+                out["memory"]["truncated"] = "budget"
+                write_partial(out_path, out)
+                return out
+            mem_path = os.path.join(
+                CACHE_DIR, f"bench_grad_mem_{t_mem}_{grad}.json"
+            )
+            if os.path.exists(mem_path):
+                os.remove(mem_path)
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = env.get("JAX_PLATFORMS") or "cpu"
+            proc = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__),
+                 "--phase", "grad-mem", "--out", mem_path,
+                 "--grad-t", str(t_mem), "--grad-mode", grad],
+                stdout=subprocess.DEVNULL, env=env,
+            )
+            ok = _wait(
+                proc, min(240.0, max(deadline - time.monotonic(), 30.0)),
+                f"grad_mem_{t_mem}_{grad}",
+            )
+            rec = _read_json(mem_path) or {
+                "error": "no output" if ok else "child failed/timeout"
+            }
+            out["memory"].setdefault(str(t_mem), {})[grad] = rec
+            progress("grad_mem_point", t=t_mem, grad=grad, **{
+                k: rec.get(k) for k in
+                ("rss_delta_mb", "backward_s") if k in rec
+            })
+            write_partial(out_path, out)
+    # headline comparison at the largest T (growth ratios degenerate
+    # when the smaller points sit below RSS resolution — the adjoint's
+    # deltas at T <= 1e4 measure 0 MB where the autodiff tape already
+    # takes hundreds)
+    try:
+        t_hi = str(mem_ts[-1])
+        peak_adj = out["memory"][t_hi]["adjoint"]["rss_delta_mb"]
+        peak_auto = out["memory"][t_hi]["autodiff"]["rss_delta_mb"]
+        out["memory"]["peak_mb_adjoint"] = peak_adj
+        out["memory"]["peak_mb_autodiff"] = peak_auto
+        out["memory"]["autodiff_vs_adjoint_peak"] = round(
+            peak_auto / max(peak_adj, 1.0), 2
+        )
+        out["memory"]["max_t"] = int(mem_ts[-1])
+        progress(
+            "grad_mem_peak", t=int(mem_ts[-1]),
+            adjoint_mb=peak_adj, autodiff_mb=peak_auto,
+            ratio=out["memory"]["autodiff_vs_adjoint_peak"],
+        )
+    except (KeyError, TypeError):
+        pass
+    write_partial(out_path, out)
+
+    # anchored refit objective: the background-refit fit path per
+    # engine, at the refit bench's own scale (run_refit_bench: small
+    # series counts and short tails — the full flagship shape costs
+    # minutes per compile+run on a 1-core host and belongs to the
+    # engines section above, which already measured it)
+    if time.monotonic() > deadline:
+        out["truncated"] = "budget"
+        write_partial(out_path, out)
+        return out
+    try:
+        from metran_tpu.parallel.fleet import refit_fleet
+
+        b, tail, n_r = 8, 96, 6
+        if os.environ.get("METRAN_TPU_BENCH_SMALL"):
+            b, tail = 4, 48
+        s_dim = n_r + k_fct
+        lds = rng.uniform(0.4, 0.7, (b, n_r, k_fct))
+        ym = rng.normal(size=(b, tail, n_r))
+        mm = rng.uniform(size=(b, tail, n_r)) > MISSING
+        m0 = np.zeros((b, s_dim))
+        c0 = np.tile(np.eye(s_dim)[None], (b, 1, 1))
+        p0 = np.full((b, n_r + k_fct), 10.0)
+
+        def refit_wall(grad):
+            t0 = time.perf_counter()
+            refit_fleet(
+                np.where(mm, ym, 0.0), mm, lds, np.ones(b), m0, c0,
+                p0, maxiter=8, grad_engine=grad,
+            )
+            return time.perf_counter() - t0
+
+        walls = {}
+        for grad in ("adjoint", "autodiff"):
+            refit_wall(grad)  # warm (compile)
+            walls[grad] = refit_wall(grad)
+            if time.monotonic() > deadline:
+                break
+        if len(walls) == 2:
+            out["anchored"] = {
+                "batch": b, "tail_rows": tail, "n_series": n_r,
+                "maxiter": 8,
+                "models_per_s_adjoint": round(b / walls["adjoint"], 2),
+                "models_per_s_autodiff": round(
+                    b / walls["autodiff"], 2
+                ),
+                "refit_speedup": round(
+                    walls["autodiff"] / walls["adjoint"], 3
+                ),
+            }
+            progress("grad_anchored", **out["anchored"])
+        else:
+            out["anchored"] = {"truncated": "budget"}
+    except Exception as e:  # budget/oom must not sink the phase
+        out["anchored"] = {"error": str(e)[-200:]}
+    write_partial(out_path, out)
+    return out
+
+
+def run_grad_mem(out_path: str, t_steps: int, grad_mode: str) -> dict:
+    """One backward-memory point (child of ``--phase grad``): peak RSS
+    delta of one jitted value-and-grad at ``t_steps``, measured against
+    a baseline taken after a tiny same-structure run has paid the
+    import/compiler footprint.  tracemalloc only sees Python-side
+    allocations (jax CPU buffers are native) and device memory stats
+    are unavailable on CPU — both are still recorded, with RSS as the
+    honest headline instrument."""
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", JAX_CACHE + "-cpu")
+    import resource
+    import tracemalloc
+
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    from metran_tpu.ops import deviance, dfm_statespace
+
+    n, k_fct = N_SERIES, N_FACTORS
+    rng = np.random.default_rng(0)
+    loadings = rng.uniform(0.4, 0.8, (n, k_fct))
+    alpha = jnp.asarray(np.full(n + k_fct, 10.0))
+
+    def make_vg(t):
+        mask = rng.uniform(size=(t, n)) > MISSING
+        y = jnp.asarray(np.where(mask, rng.normal(size=(t, n)), 0.0))
+        mask = jnp.asarray(mask)
+
+        def f(a):
+            ss = dfm_statespace(
+                a[:n], a[n:], jnp.asarray(loadings), 1.0
+            )
+            return deviance(
+                ss, y, mask, warmup=1, engine="sqrt", grad=grad_mode
+            )
+
+        return jax.jit(jax.value_and_grad(f))
+
+    def rss_kb() -> int:
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+
+    # tiny twin first: imports, compiler machinery, executable caches
+    tiny = make_vg(64)
+    v, g = tiny(alpha)
+    g.block_until_ready()
+    base_kb = rss_kb()
+
+    vg = make_vg(int(t_steps))
+    tracemalloc.start()
+    v, g = vg(alpha)  # compile + first run (allocates the real buffers)
+    g.block_until_ready()
+    t0 = time.perf_counter()
+    v, g = vg(alpha)
+    g.block_until_ready()
+    bwd_plus_fwd_s = time.perf_counter() - t0
+    _, py_peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    peak_kb = rss_kb()
+    stats = jax.local_devices()[0].memory_stats()
+    out = {
+        "t_steps": int(t_steps),
+        "grad": grad_mode,
+        "engine": "sqrt",
+        "rss_base_mb": round(base_kb / 1024.0, 1),
+        "rss_peak_mb": round(peak_kb / 1024.0, 1),
+        "rss_delta_mb": round((peak_kb - base_kb) / 1024.0, 1),
+        "tracemalloc_peak_mb": round(py_peak / 1e6, 2),
+        "device_memory_stats": (
+            {k: int(v) for k, v in stats.items()
+             if isinstance(v, (int, float))} if stats else None
+        ),
+        "value_and_grad_s": round(bwd_plus_fwd_s, 4),
+    }
+    write_partial(out_path, out)
+    return out
+
+
+# ----------------------------------------------------------------------
 # orchestrator
 # ----------------------------------------------------------------------
 def _read_json(path: str):
@@ -2811,6 +3150,15 @@ def _read_json(path: str):
             return json.load(fh)
     except Exception:
         return None
+
+
+def _dig(d, *keys):
+    """Nested ``dict.get`` chain; None at the first miss."""
+    for k in keys:
+        if not isinstance(d, dict):
+            return None
+        d = d.get(k)
+    return d
 
 
 def _spawn(phase: str, out_path: str, budget: float, extra_env=None):
@@ -2882,13 +3230,18 @@ def _wait(proc, timeout: float, label: str) -> bool:
 
 
 def _wait_device(proc, out_path: str, deadline: float,
-                 init_timeout: float, poll_s: float = 5.0) -> bool:
+                 init_timeout: float, poll_s: float = 5.0) -> str:
     """Wait for the device child, killing it EARLY if device init never
     completes — or if init succeeds but the executed-matmul probe never
     lands (the round-4 r4d wedge: instant jax.devices(), first dispatch
     hung >900 s) — so the retry/CPU fallback gets real budget.  The
     child being killed here is already hung mid-dispatch; the kill does
-    not make the pool state worse (the dispatch is lost either way)."""
+    not make the pool state worse (the dispatch is lost either way).
+
+    Returns ``"ok"`` on a clean exit, else a human-readable failure
+    reason — the round artifact records WHY a TPU attempt produced
+    nothing instead of an information-free ``{"error": "no output"}``.
+    """
     exec_timeout = float(
         os.environ.get("METRAN_TPU_BENCH_EXEC_TIMEOUT_S", "90")
     )
@@ -2897,7 +3250,12 @@ def _wait_device(proc, out_path: str, deadline: float,
     while True:
         try:
             proc.wait(timeout=poll_s)
-            return proc.returncode == 0
+            if proc.returncode == 0:
+                return "ok"
+            return (
+                f"device child exited rc={proc.returncode} "
+                "(crash/uncaught error before writing a fit result)"
+            )
         except subprocess.TimeoutExpired:
             pass
         now = time.monotonic()
@@ -2910,18 +3268,29 @@ def _wait_device(proc, out_path: str, deadline: float,
             progress("device_init_timeout", timeout_s=round(init_timeout, 0))
             proc.kill()
             proc.wait()
-            return False
+            return (
+                f"device init did not complete within {init_timeout:.0f}s "
+                "(wedged tunnel: jax backend never came up)"
+            )
         if (initialized and not executed
                 and now > init_seen_at + exec_timeout):
             progress("device_exec_timeout", timeout_s=round(exec_timeout, 0))
             proc.kill()
             proc.wait()
-            return False
+            return (
+                "device initialized but the executed-matmul probe never "
+                f"landed within {exec_timeout:.0f}s (wedged tunnel: "
+                "first dispatch hung)"
+            )
         if now > deadline:
             progress("device_timeout")
             proc.kill()
             proc.wait()
-            return False
+            return (
+                "device-phase budget exhausted before a fit result "
+                f"(killed at deadline; last stage: "
+                f"{'executed probe' if executed else 'initialized' if initialized else 'pre-init'})"
+            )
 
 
 def main() -> None:
@@ -2931,7 +3300,59 @@ def main() -> None:
     final = {"metric": METRIC, "value": 0.0, "unit": "fits/s/chip",
              "vs_baseline": 0.0}
 
+    def _phase_summary(detail: dict) -> dict:
+        """Small per-phase headline extract for the final stdout line
+        (the full detail goes to the artifact file)."""
+        g = lambda d, *ks: _dig(d, *ks)  # noqa: E731
+        s = {
+            "cpu_fit_s": g(detail, "cpu_baseline", "fit_s"),
+            "serve_arena_speedup": g(
+                detail, "serve", "arena_vs_dict", "arena_speedup"
+            ),
+            "serve_load_reads_per_s": g(
+                detail, "serve_load", "cached", "achieved_read_rps"
+            ),
+            "serve_faults_degraded_qps": g(
+                detail, "serve_faults", "poisoned_slot", "degraded_qps"
+            ),
+            "steady_speedup": g(
+                detail, "steady", "steady", "throughput_ratio"
+            ),
+            "refit_models_per_s": g(
+                detail, "refit", "refit", "models_per_s"
+            ),
+            "grad_backward_speedup": g(
+                detail, "grad", "backward_speedup"
+            ),
+            "grad_mem_peak_mb_adjoint": g(
+                detail, "grad", "memory", "peak_mb_adjoint"
+            ),
+            "grad_mem_peak_mb_autodiff": g(
+                detail, "grad", "memory", "peak_mb_autodiff"
+            ),
+        }
+        return {k: v for k, v in s.items() if v is not None}
+
     def emit_and_exit(code: int = 0):
+        # the harness captures stdout and parses the final line as
+        # JSON; rounds 4-5 embedded the ever-growing multi-phase
+        # detail inline and the capture recorded "parsed": null.  Keep
+        # the LAST stdout line small and self-contained (metric +
+        # per-phase headline summary) and persist the full detail to a
+        # committed artifact the line points at.
+        detail = final.pop("detail", None)
+        if detail is not None:
+            rel = os.path.join("bench_artifacts",
+                               "BENCH_detail_latest.json")
+            path = os.path.join(REPO, rel)
+            try:
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                with open(path, "w") as fh:
+                    json.dump({**final, "detail": detail}, fh, indent=1)
+                final["detail_file"] = rel
+            except Exception as e:  # the summary line must still emit
+                final["detail_file_error"] = str(e)[-120:]
+            final["summary"] = _phase_summary(detail)
         print(json.dumps(final), flush=True)
         sys.exit(code)
 
@@ -2993,10 +3414,12 @@ def main() -> None:
     init_timeout = float(
         os.environ.get("METRAN_TPU_BENCH_INIT_TIMEOUT_S", "300")
     )
-    _wait_device(
+    dev_reason = _wait_device(
         dev_proc, dev_path, time.monotonic() + device_budget, init_timeout
     )
     device = _read_json(dev_path) or {}
+    if dev_reason != "ok" and "fit" not in device:
+        device.setdefault("failure_reason", dev_reason)
 
     if "fit" not in device and budget - elapsed() > 420:
         # a wedged tunnel sometimes clears after the dead client is
@@ -3017,11 +3440,13 @@ def main() -> None:
         # counts as healthy (init alone can succeed on a wedged tunnel);
         # an exec-hung first attempt gets the short window too.
         first_executed = "device_exec_probe_s" in first_attempt
-        _wait_device(
+        dev_reason = _wait_device(
             dev_proc, dev_path, time.monotonic() + retry_budget,
             init_timeout if first_executed else min(init_timeout, 120.0),
         )
         device = _read_json(dev_path) or {}
+        if dev_reason != "ok" and "fit" not in device:
+            device.setdefault("failure_reason", dev_reason)
         if first_attempt:
             device["first_attempt"] = first_attempt
 
@@ -3037,7 +3462,13 @@ def main() -> None:
         _wait(fb_proc, fb_budget, "device_cpu")
         fallback = _read_json(fb_path) or {}
         if "fit" in fallback or "forward" in fallback:
-            fallback["tpu_attempt"] = device or {"error": "no output"}
+            # record the ACTUAL failure reason, never a bare "no
+            # output": the staged partials + _wait_device verdicts say
+            # how far the attempt got and what killed it
+            fallback["tpu_attempt"] = device or {
+                "error": dev_reason if dev_reason != "ok" else
+                "device child exited cleanly but wrote no result JSON",
+            }
             fallback["last_known_good_tpu"] = _last_known_good_tpu()
             device = fallback
 
@@ -3105,6 +3536,20 @@ def main() -> None:
         _wait(rf_proc, rf_budget + 15.0, "refit")
         refit = _read_json(rf_path) or {}
 
+    # gradient-engine scenario (ISSUE 10's measurement story): adjoint
+    # vs autodiff backward wall time at the standard workload, the
+    # flat-in-T backward-memory curve, and the anchored refit
+    # objective's fit speed per engine — CPU-pinned like the others
+    grad = {}
+    if budget - elapsed() > 120:
+        gr_path = os.path.join(CACHE_DIR, "bench_grad.json")
+        if os.path.exists(gr_path):
+            os.remove(gr_path)
+        gr_budget = max(min(240.0, budget - elapsed() - 60.0), 60.0)
+        gr_proc = _spawn("grad", gr_path, gr_budget, cpu_env)
+        _wait(gr_proc, gr_budget + 15.0, "grad")
+        grad = _read_json(gr_path) or {}
+
     # solo (uncontended) sharding-overhead stage: runs after every other
     # child has exited so its ratio is clean (VERDICT r3 item 8)
     if budget - elapsed() > 90:
@@ -3124,6 +3569,7 @@ def main() -> None:
               "serve_faults": serve_faults,
               "steady": steady,
               "refit": refit,
+              "grad": grad,
               "workload": {"n_series": N_SERIES, "n_factors": N_FACTORS,
                            "t_steps": T_STEPS, "missing": MISSING,
                            "maxiter": MAXITER, "tol": TOL}}
@@ -3153,9 +3599,19 @@ if __name__ == "__main__":
                                  "mesh", "mesh-solo", "serve",
                                  "serve-load", "serve-faults", "sqrt",
                                  "obs", "robust-obs", "steady",
-                                 "refit"])
+                                 "refit", "grad", "grad-mem"])
     parser.add_argument("--out", default=None)
     parser.add_argument("--budget", type=float, default=900.0)
+    parser.add_argument(
+        "--grad-t", type=int, default=10_000,
+        help="grad-mem: timestep count of the one measured "
+             "value-and-grad",
+    )
+    parser.add_argument(
+        "--grad-mode", default="adjoint",
+        choices=["adjoint", "autodiff"],
+        help="grad-mem: gradient engine of the measured backward pass",
+    )
     parser.add_argument(
         "--rps", type=float, default=None,
         help="serve-load: total open-loop arrival rate of the "
@@ -3338,6 +3794,35 @@ if __name__ == "__main__":
                 "unit": "models/s", "vs_baseline": 0.0,
                 "detail": rf_out,
             }), flush=True)
+    elif args.phase == "grad":
+        out_path = args.out or os.path.join(CACHE_DIR, "bench_grad.json")
+        os.makedirs(CACHE_DIR, exist_ok=True)
+        g_out = run_grad_bench(out_path, args.budget)
+        if args.out is None:
+            # standalone run: emit the BENCH_r* result-line schema with
+            # the backward-speedup headline (acceptance bar: adjoint
+            # backward >= 2x the autodiff-through-scan backward at the
+            # standard T=5000 workload) next to the flat-in-T memory
+            # growth factors
+            mem = g_out.get("memory") or {}
+            print(json.dumps({
+                "metric": (
+                    "adjoint-vs-autodiff backward speedup (sqrt "
+                    f"engine, T={g_out.get('t_steps')}; peak backward "
+                    f"memory at T={mem.get('max_t')}: adjoint "
+                    f"{mem.get('peak_mb_adjoint')} MB vs autodiff "
+                    f"{mem.get('peak_mb_autodiff')} MB)"
+                ),
+                "value": g_out.get("backward_speedup", 0.0),
+                "unit": "x", "vs_baseline": 0.0,
+                "detail": g_out,
+            }), flush=True)
+    elif args.phase == "grad-mem":
+        out_path = args.out or os.path.join(
+            CACHE_DIR, "bench_grad_mem.json"
+        )
+        os.makedirs(CACHE_DIR, exist_ok=True)
+        run_grad_mem(out_path, args.grad_t, args.grad_mode)
     elif args.phase == "device":
         run_device_bench(args.out, args.budget)
     else:  # device-cpu fallback

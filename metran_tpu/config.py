@@ -112,6 +112,45 @@ def shard_map_compat(f, mesh, in_specs, out_specs, check_vma: bool = False):
 
 
 # ----------------------------------------------------------------------
+# gradient engine (fit paths)
+# ----------------------------------------------------------------------
+# How fits differentiate the filter deviance (docs/concepts.md
+# "Gradient engine"):
+#
+# - "adjoint": the closed-form Kalman-score VJP (ops/adjoint.py for the
+#   batch-leading sequential/joint/sqrt engines, the lanes kernel's
+#   analytical score for layout="lanes") — one cheap covariance-form
+#   reverse sweep, no autodiff through QR/Cholesky, near-flat backward
+#   memory in T;
+# - "autodiff": reverse-mode autodiff through the filter scan (the only
+#   mode that produces gradients w.r.t. loadings/observations);
+# - "auto" (default): adjoint wherever it is defined, autodiff for the
+#   associative-scan engines.
+GRAD_ENGINE = "auto"
+GRAD_ENGINES = ("auto", "adjoint", "autodiff")
+
+
+def grad_engine(value=None) -> str:
+    """Validated gradient-engine mode (``METRAN_TPU_GRAD_ENGINE``).
+
+    ``value`` overrides the environment when given.  Unknown values
+    RAISE — a typo'd engine name must not silently fall back to a
+    different gradient path (the two differ in cost, memory and
+    differentiable inputs).
+    """
+    if value is None:
+        value = os.environ.get("METRAN_TPU_GRAD_ENGINE") or GRAD_ENGINE
+    v = str(value).strip().lower()
+    if v not in GRAD_ENGINES:
+        raise ValueError(
+            f"unknown gradient engine {value!r} (from "
+            "METRAN_TPU_GRAD_ENGINE or an explicit grad_engine "
+            f"argument); expected one of {GRAD_ENGINES}"
+        )
+    return v
+
+
+# ----------------------------------------------------------------------
 # serving defaults (metran_tpu.serve)
 # ----------------------------------------------------------------------
 SERVE_FLUSH_DEADLINE_S = 0.005  # micro-batch coalescing window

@@ -39,15 +39,17 @@ _ENGINE_ALIASES = {
 }
 
 
-@functools.partial(jax.jit, static_argnames=("warmup", "engine"))
-def _dfm_deviance(p, y, mask, loadings, dt, warmup, engine):
+@functools.partial(jax.jit, static_argnames=("warmup", "engine", "grad"))
+def _dfm_deviance(p, y, mask, loadings, dt, warmup, engine,
+                  grad="autodiff"):
     n_series = loadings.shape[0]
     ss = dfm_statespace(p[:n_series], p[n_series:], loadings, dt)
-    return deviance(ss, y, mask, warmup=warmup, engine=engine)
+    return deviance(ss, y, mask, warmup=warmup, engine=engine, grad=grad)
 
 
 _dfm_deviance_vg = jax.jit(
-    jax.value_and_grad(_dfm_deviance), static_argnames=("warmup", "engine")
+    jax.value_and_grad(_dfm_deviance),
+    static_argnames=("warmup", "engine", "grad"),
 )
 
 
@@ -395,11 +397,23 @@ class Metran:
             self._engine = _ENGINE_ALIASES[engine]
         self.kf = KalmanRunner(self._active_panel(), engine=self._engine)
 
-    def _deviance_jax(self, p_table):
+    def _resolved_grad(self, grad=None) -> str:
+        """The gradient engine this model's fits differentiate with
+        (``METRAN_TPU_GRAD_ENGINE`` unless overridden; see
+        :func:`metran_tpu.ops.resolve_grad_engine`)."""
+        from ..config import default_dtype
+        from ..ops import resolve_grad_engine
+
+        return resolve_grad_engine(grad, self._engine, default_dtype())
+
+    def _deviance_jax(self, p_table, grad=None):
         """Deviance of the *table-order* parameter vector (the order the
         solvers optimize in) as a traced JAX value.  The reorder to the
         canonical [sdf..., cdf...] layout happens inside the trace, so
-        autodiff gradients/Hessians come back in table order."""
+        gradients/Hessians come back in table order.  ``grad`` selects
+        the gradient engine (``None`` = configured default); Hessian
+        consumers pass ``"autodiff"`` — the closed-form adjoint is
+        reverse-mode-only."""
         idx = jnp.asarray(self._canonical_idx)
         return _dfm_deviance(
             jnp.take(jnp.asarray(p_table), idx),
@@ -409,6 +423,7 @@ class Metran:
             self._dt,
             self.settings["warmup"],
             self._engine,
+            self._resolved_grad(grad),
         )
 
     def _deviance_value_and_grad(self, p_table):
@@ -423,6 +438,7 @@ class Metran:
             self._dt,
             self.settings["warmup"],
             self._engine,
+            self._resolved_grad(),
         )
         return value, jnp.zeros_like(grad).at[idx].set(grad)
 

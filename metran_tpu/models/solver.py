@@ -122,7 +122,10 @@ class BaseSolver:
             import jax.numpy as jnp
 
             full = jnp.asarray(self.initial).at[np.flatnonzero(self.vary)].set(xv)
-            return self.mt._deviance_jax(full)
+            # grad="autodiff" pinned: jax.hessian forward-differentiates
+            # the gradient, which a custom_vjp (the closed-form adjoint
+            # gradient engine) does not admit
+            return self.mt._deviance_jax(full, grad="autodiff")
 
         hessian = np.asarray(jax.hessian(dev_vary)(np.asarray(x, float)))
         cov = np.linalg.pinv(hessian)
@@ -285,6 +288,7 @@ class JaxSolve(BaseSolver):
             theta, value, _iters, nfev, converged = run_lbfgs(
                 objective, theta0, maxiter=maxiter, tol=tol,
                 raise_on_divergence=True, telemetry=self.telemetry,
+                grad_engine=self.mt._resolved_grad(),
             )
         except SolverDivergenceError as exc:
             # name the offending parameters (data units, table order)
@@ -498,14 +502,25 @@ def default_ftol(dtype) -> float:
 
 def run_lbfgs(objective, theta0, maxiter: int = 200,
               tol: Optional[float] = None, ftol: Optional[float] = None,
-              raise_on_divergence: bool = False, telemetry=None):
+              raise_on_divergence: bool = False, telemetry=None,
+              grad_engine: Optional[str] = None):
     """Chunked optax L-BFGS loop with dtype-aware stopping.
 
     ``telemetry`` (a :class:`metran_tpu.obs.FitTelemetry`) records the
     run's trajectory at zero device cost — one checkpoint per host-side
-    convergence check (deviance, gradient norm, nfev), the precise stop
-    reason, line-search stall counts and any divergence diagnosis —
-    surfaced by ``Metran.fit_report()``.
+    convergence check (deviance, gradient norm, nfev, the chunk's wall
+    time — backward passes included, so per-iteration cost is
+    diagnosable per engine), the precise stop reason, line-search stall
+    counts and any divergence diagnosis — surfaced by
+    ``Metran.fit_report()``.
+
+    ``grad_engine`` is the resolved gradient engine the objective
+    differentiates with (``"adjoint"``/``"autodiff"``) — recorded into
+    the telemetry so a fit report states WHICH backward pass its
+    timings describe; it does not alter the objective (deviance-based
+    objectives resolve the engine themselves, see
+    :func:`metran_tpu.ops.deviance`).  Validated eagerly: unknown
+    values raise.
 
     Returns ``(theta, value, n_iters, nfev, converged)`` where ``nfev``
     counts true objective evaluations (scipy-comparable).  ``converged``
@@ -537,6 +552,10 @@ def run_lbfgs(objective, theta0, maxiter: int = 200,
     import optax
     import optax.tree_utils as otu
 
+    if grad_engine is not None:
+        from ..config import grad_engine as _validate_grad
+
+        grad_engine = _validate_grad(grad_engine)
     theta0 = jnp.asarray(theta0)
     if tol is None:
         tol = default_gtol(theta0.dtype)
@@ -558,6 +577,7 @@ def run_lbfgs(objective, theta0, maxiter: int = 200,
         value0 = float(objective(theta0))
         if telemetry is not None:
             telemetry.record_start(value0)
+            telemetry.record_grad_engine(grad_engine)
         if not _np.isfinite(value0):
             if telemetry is not None:
                 telemetry.record_stop(
@@ -581,16 +601,24 @@ def run_lbfgs(objective, theta0, maxiter: int = 200,
         prev_value = None
         converged = False
         reason = "maxiter"
+        import time as _time
+
         while True:
+            _t0 = _time.perf_counter()
             theta, state, nfev = advance(theta, state, nfev)
             value = float(otu.tree_get(state, "value"))
             count = int(otu.tree_get(state, "count"))
             gnorm = float(tree_norm(otu.tree_get(state, "grad")))
+            # value/count/gnorm are host reads of the finished dispatch,
+            # so the elapsed time covers the chunk's real device work
+            # (forward + backward passes), not just its submission
+            _wall = _time.perf_counter() - _t0
             if telemetry is not None:
-                # one record per device chunk — the deviance curve and
-                # gradient-norm trail, at host-checkpoint granularity
+                # one record per device chunk — the deviance curve,
+                # gradient-norm trail and chunk wall time, at
+                # host-checkpoint granularity
                 telemetry.record_checkpoint(count, value, gnorm,
-                                            int(nfev))
+                                            int(nfev), wall_s=_wall)
             if not _np.isfinite(value):
                 reason = "diverged"
                 if telemetry is not None:
@@ -669,7 +697,8 @@ class BatchedLbfgsFit(NamedTuple):
 
 def batched_lbfgs(objective, theta0, data=(), maxiter: int = 60,
                   tol: Optional[float] = None,
-                  max_linesearch_steps: int = 16) -> BatchedLbfgsFit:
+                  max_linesearch_steps: int = 16,
+                  grad_engine: Optional[str] = None) -> BatchedLbfgsFit:
     """Solve B independent problems with one vmapped L-BFGS dispatch.
 
     The generic single-round batch driver over the shared
@@ -686,12 +715,22 @@ def batched_lbfgs(objective, theta0, data=(), maxiter: int = 60,
     when a plain warm-started descent is enough.  A lane whose
     objective diverges simply reports a non-finite ``value`` (and
     ``converged=False``); it cannot poison its batch mates.
+
+    ``grad_engine`` is validated eagerly (unknown values raise) but
+    does not rewrite a generic ``objective`` — deviance-based
+    objectives resolve the configured gradient engine themselves
+    (:func:`metran_tpu.ops.deviance`); pass it to make a driver
+    call's intent explicit and typo-proof.
     """
     import jax
     import jax.numpy as jnp
     import optax
     import optax.tree_utils as otu
 
+    if grad_engine is not None:
+        from ..config import grad_engine as _validate_grad
+
+        _validate_grad(grad_engine)
     theta0 = jnp.asarray(theta0)
     if tol is None:
         tol = default_gtol(theta0.dtype)

@@ -42,8 +42,14 @@ class FitTelemetry:
     """One optimization run's recorded trajectory (see module docstring).
 
     ``checkpoints`` holds one record per host-side convergence check —
-    ``{"iters", "value", "grad_norm", "nfev"}`` — chunk-granular, so a
-    200-iteration fit carries ~10 records, not 200.
+    ``{"iters", "value", "grad_norm", "nfev"[, "wall_s"]}`` —
+    chunk-granular, so a 200-iteration fit carries ~10 records, not
+    200.  ``grad_engine`` names the gradient engine the run
+    differentiated with (``"adjoint"`` = the closed-form Kalman-score
+    VJP, ``"autodiff"`` = reverse-mode through the filter scan), so the
+    per-iteration wall times — forward + backward fused inside each
+    device chunk — are attributable to the backward pass that actually
+    ran.
     """
 
     checkpoints: List[Dict] = field(default_factory=list)
@@ -55,29 +61,39 @@ class FitTelemetry:
     linesearch_stalls: int = 0
     value0: Optional[float] = None
     value: Optional[float] = None
+    grad_engine: Optional[str] = None
 
     def record_start(self, value0: float) -> None:
         self.value0 = float(value0)
 
+    def record_grad_engine(self, engine: Optional[str]) -> None:
+        """Name the gradient engine this run differentiates with."""
+        self.grad_engine = None if engine is None else str(engine)
+
     def record_checkpoint(self, iters: int, value: float,
-                          grad_norm: float, nfev: int) -> None:
+                          grad_norm: float, nfev: int,
+                          wall_s: Optional[float] = None) -> None:
         """One host-side convergence check (between device chunks).
 
         A checkpoint whose value failed to improve on its predecessor
         counts as a **line-search stall** — the signature of zoom
         line-search failure fallbacks creeping along a flat or
-        degenerate objective.
+        degenerate objective.  ``wall_s`` is the chunk's host-measured
+        wall time (device forward + backward work included).
         """
         if self.checkpoints and not (
             float(value) < self.checkpoints[-1]["value"]
         ):
             self.linesearch_stalls += 1
-        self.checkpoints.append({
+        rec = {
             "iters": int(iters),
             "value": float(value),
             "grad_norm": float(grad_norm),
             "nfev": int(nfev),
-        })
+        }
+        if wall_s is not None:
+            rec["wall_s"] = round(float(wall_s), 6)
+        self.checkpoints.append(rec)
         self.n_iters = int(iters)
         self.nfev = int(nfev)
         self.value = float(value)
@@ -104,6 +120,27 @@ class FitTelemetry:
             return None
         return self.value0 - self.value
 
+    def iteration_wall_s(self) -> Optional[float]:
+        """Mean wall seconds per L-BFGS iteration over the timed
+        chunks (None when no chunk carried a wall time).
+
+        The FIRST timed chunk is excluded whenever a later one exists:
+        it carries the jit trace+compile of the optimizer program
+        (typically dwarfing steady-state chunk time), which would
+        systematically inflate a per-engine backward-cost comparison.
+        Single-chunk fits have nothing else to report, so their
+        (compile-inclusive) number is returned as-is — callers reading
+        it for engine attribution should prefer multi-chunk runs.
+        """
+        timed = [c for c in self.checkpoints if "wall_s" in c]
+        if not timed or self.n_iters <= 0:
+            return None
+        if len(timed) >= 2:
+            iters = timed[-1]["iters"] - timed[0]["iters"]
+            if iters > 0:
+                return sum(c["wall_s"] for c in timed[1:]) / iters
+        return timed[0]["wall_s"] / max(timed[0]["iters"], 1)
+
     def snapshot(self) -> Dict:
         """JSON-ready dict (bench/report consumption)."""
         return {
@@ -115,6 +152,8 @@ class FitTelemetry:
             "linesearch_stalls": self.linesearch_stalls,
             "value0": self.value0,
             "value": self.value,
+            "grad_engine": self.grad_engine,
+            "iteration_wall_s": self.iteration_wall_s(),
             "checkpoints": [dict(c) for c in self.checkpoints],
         }
 
@@ -133,6 +172,11 @@ class FitTelemetry:
             f"nfev={self.nfev}",
             f"|grad|={grad}",
         ]
+        if self.grad_engine:
+            parts.insert(0, f"grad_engine={self.grad_engine}")
+        it_wall = self.iteration_wall_s()
+        if it_wall is not None:
+            parts.append(f"s/iter={it_wall:.3g}")
         if imp is not None:
             parts.append(f"ddev={imp:.6g}")
         if self.linesearch_stalls:

@@ -1658,9 +1658,6 @@ def _deviance_terms_remat(ss, y, mask, engine, remat_seg):
     return sigma.reshape(-1)[:t_steps], detf.reshape(-1)[:t_steps]
 
 
-@functools.partial(
-    jax.jit, static_argnames=("engine", "warmup", "remat_seg")
-)
 def deviance(
     ss: StateSpace,
     y: jnp.ndarray,
@@ -1668,6 +1665,7 @@ def deviance(
     warmup: int = 1,
     engine: str = "sequential",
     remat_seg: Optional[int] = None,
+    grad: Optional[str] = None,
 ) -> jnp.ndarray:
     """-2 log-likelihood (the quantity the reference minimizes).
 
@@ -1676,10 +1674,57 @@ def deviance(
     O(seg n^2) at the cost of one extra forward recompute in the
     backward pass; results are identical to the plain scan.
 
+    ``grad`` selects how this value differentiates (docs/concepts.md
+    "Gradient engine"): ``"adjoint"`` attaches the closed-form
+    Kalman-score VJP (:mod:`metran_tpu.ops.adjoint` — one cheap
+    covariance-form reverse sweep, no autodiff through QR/Cholesky,
+    cotangents for the transition parameters only), ``"autodiff"``
+    keeps reverse-mode autodiff through the scan (required for
+    gradients w.r.t. loadings/observations, and for anything that
+    forward-differentiates the result — ``jax.hessian`` included),
+    ``"auto"`` picks the adjoint where it is defined.  ``None``
+    (default) reads the configured mode
+    (:func:`metran_tpu.config.grad_engine`, env
+    ``METRAN_TPU_GRAD_ENGINE``) at trace time.  The VALUE is
+    bit-identical across modes; only the gradient path changes (in
+    adjoint mode ``remat_seg`` maps onto the backward segment length).
+
     A non-finite result is mapped to ``+inf`` in every engine (see
     :func:`_finite_or_inf`): optimizers see a rejectable step, never a
     NaN-poisoned state.
     """
+    from .adjoint import resolve_grad_engine
+
+    mode = resolve_grad_engine(grad, engine, dtype=ss.q.dtype)
+    if mode == "adjoint":
+        _check_diagonal_q(ss.q)
+    return _deviance_impl(
+        ss, y, mask, warmup=warmup, engine=engine, remat_seg=remat_seg,
+        grad=mode,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("engine", "warmup", "remat_seg", "grad")
+)
+def _deviance_impl(
+    ss: StateSpace,
+    y: jnp.ndarray,
+    mask: jnp.ndarray,
+    warmup: int = 1,
+    engine: str = "sequential",
+    remat_seg: Optional[int] = None,
+    grad: str = "autodiff",
+) -> jnp.ndarray:
+    if grad == "adjoint":
+        from .adjoint import DEFAULT_SEG, adjoint_deviance_terms
+
+        sigma, detf = adjoint_deviance_terms(
+            ss, y, mask, engine=engine, seg=remat_seg or DEFAULT_SEG
+        )
+        return _finite_or_inf(
+            deviance_terms(sigma, detf, mask, warmup=warmup)
+        )
     if engine in ("parallel", "sqrt_parallel"):
         if remat_seg:
             raise ValueError(
@@ -1709,10 +1754,12 @@ def deviance(
     )
 
 
-def log_likelihood(ss, y, mask, warmup: int = 1, engine: str = "sequential"):
+def log_likelihood(ss, y, mask, warmup: int = 1, engine: str = "sequential",
+                   grad: Optional[str] = None):
     """Actual log-likelihood ``-deviance / 2`` (``-inf`` when the filter
     path is non-finite — the rejectable-step guard of :func:`deviance`)."""
-    return -0.5 * deviance(ss, y, mask, warmup=warmup, engine=engine)
+    return -0.5 * deviance(ss, y, mask, warmup=warmup, engine=engine,
+                           grad=grad)
 
 
 class SmootherResult(NamedTuple):

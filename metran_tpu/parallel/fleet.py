@@ -172,12 +172,13 @@ def pack_fleet(
 
 
 def _model_deviance(p, y, mask, loadings, dt, warmup, engine,
-                    remat_seg=None):
+                    remat_seg=None, grad=None):
     """Deviance of one fleet member; p = [alpha_sdf (N), alpha_cdf (K)]."""
     n = loadings.shape[0]
     ss = dfm_statespace(p[:n], p[n:], loadings, dt)
     return _deviance(
-        ss, y, mask, warmup=warmup, engine=engine, remat_seg=remat_seg
+        ss, y, mask, warmup=warmup, engine=engine, remat_seg=remat_seg,
+        grad=grad,
     )
 
 
@@ -206,8 +207,22 @@ def _lanes_args(params, fleet):
     )
 
 
+def _lanes_score(grad) -> str:
+    """Map a gradient-engine request onto the lanes kernel's ``score``
+    (its analytical (phi, q) adjoint IS the closed-form gradient engine
+    for the lane layout; ``auto`` resolves to it)."""
+    from ..ops.adjoint import resolve_grad_engine
+
+    return (
+        "autodiff"
+        if resolve_grad_engine(grad, "sequential") == "autodiff"
+        else "adjoint"
+    )
+
+
 @functools.partial(
-    jax.jit, static_argnames=("warmup", "engine", "layout", "remat_seg")
+    jax.jit,
+    static_argnames=("warmup", "engine", "layout", "remat_seg", "grad"),
 )
 def fleet_deviance(
     params: jnp.ndarray,
@@ -216,12 +231,16 @@ def fleet_deviance(
     engine: str = "joint",
     layout: str = "batch",
     remat_seg: Optional[int] = None,
+    grad: Optional[str] = None,
 ) -> jnp.ndarray:
     """(B,) deviance of every fleet member at ``params`` (B, N+K).
 
     ``layout="lanes"`` evaluates the hand-written lane-layout kernel
     (:func:`metran_tpu.ops.lanes.lanes_dfm_deviance`, sequential-
-    processing semantics — ``engine`` is ignored there).
+    processing semantics — ``engine`` is ignored there).  ``grad``
+    selects the gradient engine when this value is differentiated
+    (see :func:`metran_tpu.ops.deviance`; ``None`` reads the
+    configured default at trace time).
     """
     if layout == "lanes":
         from ..ops.lanes import lanes_dfm_deviance
@@ -229,10 +248,10 @@ def fleet_deviance(
         alpha_t, y_l, mask_l, loadings_l, dt_l = _lanes_args(params, fleet)
         return lanes_dfm_deviance(
             alpha_t, loadings_l, dt_l, y_l, mask_l,
-            warmup=warmup, remat_seg=remat_seg,
+            warmup=warmup, remat_seg=remat_seg, score=_lanes_score(grad),
         )
     fun = lambda p, y, m, ld, dt: _model_deviance(  # noqa: E731
-        p, y, m, ld, dt, warmup, engine, remat_seg
+        p, y, m, ld, dt, warmup, engine, remat_seg, grad
     )
     return jax.vmap(fun)(
         params, fleet.y, fleet.mask, fleet.loadings, fleet.dt
@@ -240,7 +259,8 @@ def fleet_deviance(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("warmup", "engine", "layout", "remat_seg")
+    jax.jit,
+    static_argnames=("warmup", "engine", "layout", "remat_seg", "grad"),
 )
 def fleet_value_and_grad(
     params,
@@ -249,21 +269,26 @@ def fleet_value_and_grad(
     engine: str = "joint",
     layout: str = "batch",
     remat_seg: Optional[int] = None,
+    grad: Optional[str] = None,
 ):
-    """Per-model (deviance, gradient) — exact autodiff, fully batched.
+    """Per-model (deviance, gradient) — exact gradients, fully batched.
 
     ``layout="lanes"`` uses one forward + one backward pass of the
     lane-layout kernel: deviances are separable across the fleet, so the
     vjp against a ones-vector yields every model's exact gradient.
+    ``grad`` selects the gradient engine (closed-form adjoint vs
+    autodiff through the scan — :func:`metran_tpu.ops.deviance`);
+    ``None`` reads the configured default.
     """
     if layout == "lanes":
         from ..ops.lanes import lanes_dfm_deviance
 
+        score = _lanes_score(grad)
         alpha_t, y_l, mask_l, loadings_l, dt_l = _lanes_args(params, fleet)
         val, vjp = jax.vjp(
             lambda a: lanes_dfm_deviance(
                 a, loadings_l, dt_l, y_l, mask_l,
-                warmup=warmup, remat_seg=remat_seg,
+                warmup=warmup, remat_seg=remat_seg, score=score,
             ),
             alpha_t,
         )
@@ -271,7 +296,7 @@ def fleet_value_and_grad(
         return val, grad_t.T
     vg = jax.value_and_grad(_model_deviance)
     fun = lambda p, y, m, ld, dt: vg(  # noqa: E731
-        p, y, m, ld, dt, warmup, engine, remat_seg
+        p, y, m, ld, dt, warmup, engine, remat_seg, grad
     )
     return jax.vmap(fun)(
         params, fleet.y, fleet.mask, fleet.loadings, fleet.dt
@@ -427,7 +452,7 @@ def _alpha_to_theta(p, cap):
 
 def _solve_chunk(theta, state, frozen, y, mask, loadings, dt, warmup,
                  engine, tol, chunk, maxiter, opt, theta_cap,
-                 remat_seg=None):
+                 remat_seg=None, grad=None):
     """Advance one model's L-BFGS by up to ``chunk`` iterations.
 
     Chunking keeps each device execution short and bounded (long single
@@ -441,7 +466,7 @@ def _solve_chunk(theta, state, frozen, y, mask, loadings, dt, warmup,
     def objective(th):
         p = _theta_to_alpha(th, theta_cap)
         return _model_deviance(
-            p, y, mask, loadings, dt, warmup, engine, remat_seg
+            p, y, mask, loadings, dt, warmup, engine, remat_seg, grad
         )
 
     theta, state, _nfev = lbfgs_advance(
@@ -466,7 +491,8 @@ def _chunk_outputs(theta, state, tol, theta_cap):
 
 @functools.lru_cache(maxsize=32)
 def _make_chunk_runner(warmup, engine, tol, chunk, maxiter,
-                       max_linesearch_steps, theta_cap, remat_seg=None):
+                       max_linesearch_steps, theta_cap, remat_seg=None,
+                       grad=None):
     """Build (opt, vmapped chunk advance, vmapped outputs).
 
     Cached on its (hashable) configuration so repeated ``fit_fleet`` calls
@@ -484,7 +510,7 @@ def _make_chunk_runner(warmup, engine, tol, chunk, maxiter,
     def advance(theta, state, frozen, y, mask, loadings, dt):
         return _solve_chunk(
             theta, state, frozen, y, mask, loadings, dt, warmup, engine,
-            tol, chunk, maxiter, opt, theta_cap, remat_seg,
+            tol, chunk, maxiter, opt, theta_cap, remat_seg, grad,
         )
 
     def outputs(theta, state):
@@ -500,7 +526,7 @@ def _make_chunk_runner(warmup, engine, tol, chunk, maxiter,
 @functools.lru_cache(maxsize=32)
 def _make_lanes_runner(warmup, tol, chunk, maxiter, ls_steps,
                        history, theta_cap, remat_seg, stall_tol=None,
-                       stall_rtol=0.0):
+                       stall_rtol=0.0, score="adjoint"):
     """Build (init, run_chunk) for the lane-layout batched L-BFGS.
 
     The objective is the hand-written lane-layout Kalman deviance
@@ -519,7 +545,7 @@ def _make_lanes_runner(warmup, tol, chunk, maxiter, ls_steps,
         alpha = _theta_to_alpha(theta, theta_cap)
         return lanes_dfm_deviance(
             alpha, loadings, dt, y, mask,
-            warmup=warmup, remat_seg=remat_seg,
+            warmup=warmup, remat_seg=remat_seg, score=score,
         )
 
     def vg_fn(theta, y, mask, loadings, dt):
@@ -560,7 +586,8 @@ LANE_MIN_BATCH = 8  # on TPU, pad tinier lane fleets up to this width
 def _fit_fleet_lanes(fleet, p0, warmup, maxiter, tol, mesh,
                      chunk, max_linesearch_steps, alpha_max, stall_tol,
                      checkpoint, remat_seg, history=8, max_chunks=None,
-                     compact_min=COMPACT_MIN, stall_rtol=0.0):
+                     compact_min=COMPACT_MIN, stall_rtol=0.0,
+                     score="adjoint"):
     """Lane-layout fleet fit driver (see ``fit_fleet(layout="lanes")``)."""
     from . import lanes_lbfgs
 
@@ -568,7 +595,7 @@ def _fit_fleet_lanes(fleet, p0, warmup, maxiter, tol, mesh,
     ls_steps = lanes_lbfgs.default_ls_steps(min(max_linesearch_steps, 6))
     init, run_chunk = _make_lanes_runner(
         warmup, tol, chunk, maxiter, ls_steps, history,
-        theta_cap, remat_seg, stall_tol, stall_rtol,
+        theta_cap, remat_seg, stall_tol, stall_rtol, score,
     )
     # two-phase schedule: after the first full chunk, advance in short
     # tail dispatches so the run ends within ~tail iterations of the
@@ -582,7 +609,7 @@ def _fit_fleet_lanes(fleet, p0, warmup, maxiter, tol, mesh,
     _, run_tail = (
         (None, run_chunk) if tail == chunk else _make_lanes_runner(
             warmup, tol, tail, maxiter, ls_steps, history,
-            theta_cap, remat_seg, stall_tol, stall_rtol,
+            theta_cap, remat_seg, stall_tol, stall_rtol, score,
         )
     )
     theta0 = _alpha_to_theta(jnp.asarray(p0), theta_cap)
@@ -604,7 +631,7 @@ def _fit_fleet_lanes(fleet, p0, warmup, maxiter, tol, mesh,
         ckpt_meta = dict(
             maxiter=maxiter, chunk=chunk, tol=tol, engine="sequential",
             warmup=warmup, theta_cap=theta_cap, stall_tol=stall_tol,
-            stall_rtol=stall_rtol,
+            stall_rtol=stall_rtol, grad=score,
             ls_steps=list(ls_steps), history=history, layout="lanes",
             remat_seg=remat_seg,
             data=_fleet_fingerprint(
@@ -822,6 +849,7 @@ def fit_fleet(
     max_chunks: Optional[int] = None,
     compact_min: int = COMPACT_MIN,
     lane_min_batch: Optional[int] = None,
+    grad_engine: Optional[str] = None,
 ) -> FleetFit:
     """Fit every model in the fleet by on-device L-BFGS.
 
@@ -913,6 +941,20 @@ def fit_fleet(
         Values below ``LANE_MIN_BATCH`` (8) are for testing: they let
         the tail compact into the degenerate-width programs the
         ``lane_min_batch`` pad exists to avoid.
+    grad_engine : how the optimizer differentiates the deviance
+        (``"auto"``/``"adjoint"``/``"autodiff"``; default ``None``
+        reads ``METRAN_TPU_GRAD_ENGINE`` —
+        :func:`metran_tpu.config.grad_engine`, unknown values raise).
+        ``"adjoint"`` is the closed-form Kalman-score VJP — the lanes
+        kernel's analytical score for ``layout="lanes"``, the
+        batch-leading :mod:`metran_tpu.ops.adjoint` VJP for
+        ``layout="batch"`` — with no autodiff through QR/Cholesky and
+        near-flat backward memory in T; deviance VALUES are
+        bit-identical across engines, and gradients agree to
+        float-rounding (tests/test_adjoint.py), so optima match while
+        iterate trajectories may differ at the resolution floor.
+        Recorded in checkpoint metadata: a checkpoint written under a
+        different gradient engine is invalidated rather than resumed.
     lane_min_batch : (``layout="lanes"``, no mesh) smallest lane width
         the fit will run at; smaller fleets are padded by cyclic
         replication and every result field sliced back, so the pad is
@@ -961,6 +1003,12 @@ def fit_fleet(
 
     if layout not in ("batch", "lanes"):
         raise ValueError(f"unknown layout {layout!r}")
+    from ..ops.adjoint import resolve_grad_engine
+
+    grad = resolve_grad_engine(
+        grad_engine, "sequential" if layout == "lanes" else engine,
+        dtype=fleet.y.dtype,
+    )
     if layout == "lanes":
         if use_shard_map:
             logger.warning(
@@ -996,7 +1044,7 @@ def fit_fleet(
             fleet, p0, warmup, maxiter, tol, mesh, chunk,
             max_linesearch_steps, alpha_max, stall_tol, checkpoint,
             remat_seg, max_chunks=max_chunks, compact_min=compact_min,
-            stall_rtol=stall_rtol,
+            stall_rtol=stall_rtol, score=grad,
         )
         if pad_lanes:
             fit = FleetFit(
@@ -1005,7 +1053,7 @@ def fit_fleet(
         return fit
     opt, advance, outputs = _make_chunk_runner(
         warmup, engine, tol, chunk, maxiter, max_linesearch_steps,
-        theta_cap, remat_seg,
+        theta_cap, remat_seg, grad,
     )
     theta = _alpha_to_theta(jnp.asarray(p0), theta_cap)
     data_args = (fleet.y, fleet.mask, fleet.loadings, fleet.dt)
@@ -1067,7 +1115,7 @@ def fit_fleet(
         ckpt_meta = dict(
             maxiter=maxiter, chunk=chunk, tol=tol, engine=engine,
             warmup=warmup, theta_cap=theta_cap, stall_tol=stall_tol,
-            stall_rtol=stall_rtol,
+            stall_rtol=stall_rtol, grad=grad,
             max_linesearch_steps=max_linesearch_steps,
             layout="batch", remat_seg=remat_seg,
             data=_fleet_fingerprint(
@@ -1657,8 +1705,12 @@ def _make_stderr_runner(warmup, engine, remat_seg):
 
     def one_chunk(p, y, mask, loadings, dt):
         def dev(pi, yi, mi, ldi, dti):
+            # grad="autodiff" pinned: jax.hessian forward-differentiates
+            # the gradient, and a custom_vjp function admits no jvp —
+            # the closed-form adjoint is reverse-mode-only by design
             return _model_deviance(
-                pi, yi, mi, ldi, dti, warmup, engine, remat_seg
+                pi, yi, mi, ldi, dti, warmup, engine, remat_seg,
+                "autodiff",
             )
 
         hess = jax.vmap(jax.hessian(dev))(p, y, mask, loadings, dt)
@@ -1815,6 +1867,25 @@ def _anchored_lane(p, y_i, m_i, ld, dt_i, m0, c0):
     return mean, chol, jnp.sum(sigma) + jnp.sum(detf)
 
 
+def _anchored_adjoint_lane(p, y_i, m_i, ld, dt_i, m0, c0):
+    """ONE member's anchored tail deviance with the closed-form VJP.
+
+    The adjoint twin of :func:`_anchored_lane`'s deviance output:
+    values are bit-identical (the custom-vjp primal runs the same
+    square-root scan — the champion/challenger contract requires the
+    objective and the scorer to be bit-consistent,
+    tests/test_adjoint.py pins it); differentiation runs the
+    closed-form covariance-form sweep from the anchor instead of
+    autodiff through the QR updates
+    (:func:`metran_tpu.ops.anchored_adjoint_deviance`).
+    """
+    from ..ops import anchored_adjoint_deviance
+
+    n = ld.shape[0]
+    ss = dfm_statespace(p[:n], p[n:], ld, dt_i)
+    return anchored_adjoint_deviance(ss, m0, c0, y_i, m_i)
+
+
 def anchored_fleet_deviance(
     params: jnp.ndarray,
     y: jnp.ndarray,
@@ -1823,6 +1894,7 @@ def anchored_fleet_deviance(
     dt: jnp.ndarray,
     anchor_mean: jnp.ndarray,
     anchor_chol: jnp.ndarray,
+    grad: Optional[str] = None,
 ) -> jnp.ndarray:
     """(B,) tail deviance of every member, filter seeded per member
     from its anchor posterior ``N(mean, chol chol')`` instead of the
@@ -1833,12 +1905,26 @@ def anchored_fleet_deviance(
     ``n_obs log 2π`` constants are dropped: they depend only on the
     mask, so both the argmin and any same-data champion/challenger
     comparison are unchanged.
+
+    ``grad`` selects the gradient engine (``None`` reads the
+    configured default): ``"adjoint"`` attaches the closed-form
+    anchored VJP — values stay bit-identical, the anchor and data get
+    exactly-zero cotangents (fixed inputs of the refit objective).
     """
-    return jax.vmap(_anchored_lane)(
+    from ..ops.adjoint import resolve_grad_engine
+
+    # engine-only resolution (no f32-sqrt carve-out): see refit_fleet —
+    # the anchored objective keeps the adjoint at f32 by design
+    lane = (
+        _anchored_adjoint_lane
+        if resolve_grad_engine(grad, "sqrt") == "adjoint"
+        else lambda *a: _anchored_lane(*a)[2]
+    )
+    return jax.vmap(lane)(
         jnp.asarray(params), jnp.asarray(y), jnp.asarray(mask),
         jnp.asarray(loadings), jnp.asarray(dt),
         jnp.asarray(anchor_mean), jnp.asarray(anchor_chol),
-    )[2]
+    )
 
 
 @jax.jit
@@ -1875,7 +1961,7 @@ def anchored_fleet_posteriors(
 
 @functools.lru_cache(maxsize=16)
 def _make_refit_runner(maxiter, tol, ls_steps, theta_cap, max_step,
-                       restarts):
+                       restarts, grad="autodiff"):
     """The jitted vmapped refit lane: ``restarts`` trust-region
     rounds of L-BFGS per model, re-centered between rounds (see
     :func:`refit_fleet`).  Cached per configuration so every refit
@@ -1892,7 +1978,7 @@ def _make_refit_runner(maxiter, tol, ls_steps, theta_cap, max_step,
             p = _theta_to_alpha(th, theta_cap)
             return anchored_fleet_deviance(
                 p[None], y_i[None], m_i[None], ld[None], dt_i[None],
-                m0[None], c0[None],
+                m0[None], c0[None], grad=grad,
             )[0]
 
         value0 = obj_at(th0)
@@ -1938,6 +2024,7 @@ def refit_fleet(
     alpha_max: float = ALPHA_MAX,
     max_step: float = 3.0,
     restarts: int = 3,
+    grad_engine: Optional[str] = None,
 ):
     """Batch-refit one homogeneous group of models on their retained
     tails, warm-started from their serving parameters.
@@ -1975,6 +2062,14 @@ def refit_fleet(
     — so the composite is a damped, restartable descent that cannot
     leave the region its tail can resolve.
 
+    ``grad_engine`` selects how the anchored objective differentiates
+    (``None`` reads ``METRAN_TPU_GRAD_ENGINE``): the default
+    closed-form adjoint replaces autodiff through the per-step QR
+    updates with one covariance-form reverse sweep from the anchor
+    (:func:`metran_tpu.ops.anchored_adjoint_deviance`) — objective
+    values, and hence the champion/challenger scoring contract, are
+    bit-identical either way.
+
     Returns a :class:`~metran_tpu.models.solver.BatchedLbfgsFit` with
     ``theta`` already mapped back to alphas.  A lane that diverges
     reports a non-finite value and its input parameters — never a
@@ -1986,6 +2081,7 @@ def refit_fleet(
         default_gtol,
         lbfgs_trace_ctx,
     )
+    from ..ops.adjoint import resolve_grad_engine
 
     if not np.isfinite(alpha_max) or alpha_max <= ALPHA_PMIN:
         raise ValueError(
@@ -2001,9 +2097,16 @@ def refit_fleet(
         tol = default_gtol(y.dtype)
     theta_cap = float(np.log(alpha_max))
     theta0 = _alpha_to_theta(jnp.asarray(p0, y.dtype), theta_cap)
+    # no dtype carve-out here (unlike the full-history sqrt deviance):
+    # the anchored objective is a trust-region-bounded warm-started
+    # correction whose f32 gradient noise sits inside the optimizer's
+    # own f32 resolution floor, and the refit worker's promotion gate
+    # (held-out deviance on bit-identical values) rejects any
+    # regression — so f32 refit keeps the adjoint's speed
     runner = _make_refit_runner(
         int(maxiter), float(tol), int(max_linesearch_steps),
         theta_cap, float(max_step), int(restarts),
+        resolve_grad_engine(grad_engine, "sqrt"),
     )
     with lbfgs_trace_ctx(y.dtype):
         theta, value, value0, iters, gnorm = runner(
@@ -2037,21 +2140,26 @@ def make_train_step(
     optimizer,
     warmup: int = 1,
     engine: str = "joint",
+    grad_engine: Optional[str] = None,
 ):
     """Build a jittable fleet training step for first-order optimizers.
 
     One step computes every model's deviance and exact gradient (vmapped
-    masked Kalman filter under autodiff), applies the optax update in
-    log-parameter space, and reports the fleet-mean deviance.  jit it with
-    sharded ``params``/``fleet`` to scale over a mesh: models are
-    independent, so the only cross-device traffic is the scalar mean.
+    masked Kalman filter under the configured gradient engine —
+    ``grad_engine``, default the ``METRAN_TPU_GRAD_ENGINE`` mode),
+    applies the optax update in log-parameter space, and reports the
+    fleet-mean deviance.  jit it with sharded ``params``/``fleet`` to
+    scale over a mesh: models are independent, so the only cross-device
+    traffic is the scalar mean.
     """
     import optax
 
     def train_step(theta, opt_state, fleet):
         def loss(th):
             p = ALPHA_PMIN + jnp.exp(th)
-            dev = fleet_deviance(p, fleet, warmup=warmup, engine=engine)
+            dev = fleet_deviance(
+                p, fleet, warmup=warmup, engine=engine, grad=grad_engine
+            )
             return jnp.mean(dev)
 
         value, grad = jax.value_and_grad(loss)(theta)

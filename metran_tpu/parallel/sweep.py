@@ -137,6 +137,7 @@ def sweep_fit(
     checkpoint_dir: Optional[str] = None,
     on_batch: Optional[Callable[[int, dict], None]] = None,
     verify_restore: bool = False,
+    grad_engine: Optional[str] = None,
     **fit_kw,
 ) -> SweepResult:
     """Fit every batch in ``batches`` and concatenate the results.
@@ -181,8 +182,19 @@ def sweep_fit(
         fitted THIS run (checkpoint-restored batches do not fire it —
         their work happened in the run that saved them); ``record``
         holds host arrays for the :class:`FleetFit` fields.
+    grad_engine : gradient engine for every batch's fit
+        (``"auto"``/``"adjoint"``/``"autodiff"``; ``None`` reads
+        ``METRAN_TPU_GRAD_ENGINE``).  Validated ONCE here — a typo'd
+        value fails the sweep up front, and the mode is pinned so a
+        mid-sweep environment change cannot make later batches
+        optimize under a different gradient path than restored ones;
+        the dtype-aware ``auto`` resolution happens per batch inside
+        :func:`fit_fleet` (it needs the fleet's dtype).
     **fit_kw : forwarded to :func:`fit_fleet` (layout, chunk, tol, ...).
     """
+    from ..config import grad_engine as _grad_engine
+
+    fit_kw["grad_engine"] = _grad_engine(grad_engine)
     if isinstance(p0, str):
         if p0 != "autocorr":
             raise ValueError(f"unknown p0 mode {p0!r}")

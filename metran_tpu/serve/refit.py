@@ -99,6 +99,13 @@ class RefitSpec(NamedTuple):
     staleness_age_s: float = 0.0
     cooldown_s: float = 60.0
     deadline_s: float = 120.0
+    # gradient engine for the anchored batch fit
+    # ("auto"/"adjoint"/"autodiff"; None reads METRAN_TPU_GRAD_ENGINE —
+    # the closed-form anchored VJP by default, see
+    # metran_tpu.ops.anchored_adjoint_deviance).  Objective VALUES are
+    # bit-identical across engines, so the champion/challenger
+    # comparison is unaffected; only fit cost changes.
+    grad_engine: Optional[str] = None
 
     @classmethod
     def from_defaults(cls) -> "RefitSpec":
@@ -161,6 +168,12 @@ class RefitSpec(NamedTuple):
             )
         if self.max_batch < 1 or self.maxiter < 1:
             raise ValueError("refit max_batch and maxiter must be >= 1")
+        if self.grad_engine is not None:
+            from ..config import grad_engine as _validate_grad
+
+            # raises on unknown values: a typo'd engine must not
+            # silently fit every cycle under a different gradient path
+            _validate_grad(self.grad_engine)
         return self
 
 
@@ -687,7 +700,7 @@ class RefitWorker:
             fire("serve.refit.fit", ",".join(ids))
             fit = refit_fleet(
                 y[:, :fit_n], m[:, :fit_n], lds, dts, am, ac, p0,
-                maxiter=spec.maxiter,
+                maxiter=spec.maxiter, grad_engine=spec.grad_engine,
             )
             # both parameter sets filter the SAME fit rows from the
             # SAME anchor, then score one-step predictions on the SAME

@@ -33,11 +33,11 @@ def _supervise(bench, out, deadline_s, init_timeout):
     proc = subprocess.Popen(["sleep", "300"])
     try:
         t0 = time.monotonic()
-        ok = bench._wait_device(
+        verdict = bench._wait_device(
             proc, str(out), time.monotonic() + deadline_s,
             init_timeout=init_timeout, poll_s=0.2,
         )
-        return ok, time.monotonic() - t0, proc.returncode
+        return verdict, time.monotonic() - t0, proc.returncode
     finally:
         if proc.poll() is None:
             proc.kill()
@@ -50,8 +50,12 @@ def test_exec_probe_timeout_kills_initialized_but_hung_child(
     monkeypatch.setenv("METRAN_TPU_BENCH_EXEC_TIMEOUT_S", "0.5")
     out = tmp_path / "dev.json"
     out.write_text(json.dumps({"device_init_s": 0.1}))  # no exec probe
-    ok, elapsed, rc = _supervise(bench, out, deadline_s=60, init_timeout=30)
-    assert ok is False
+    verdict, elapsed, rc = _supervise(
+        bench, out, deadline_s=60, init_timeout=30
+    )
+    # the verdict names WHAT failed (lands in the round artifact's
+    # tpu_attempt instead of an information-free "no output")
+    assert verdict != "ok" and "probe" in verdict
     # killed at the exec deadline (~0.5 s + poll rounds), not at 60 s
     assert elapsed < 10
     assert rc != 0
@@ -65,8 +69,10 @@ def test_exec_probe_present_runs_to_normal_deadline(
     out.write_text(
         json.dumps({"device_init_s": 0.1, "device_exec_probe_s": 0.4})
     )
-    ok, elapsed, rc = _supervise(bench, out, deadline_s=3, init_timeout=30)
-    assert ok is False
+    verdict, elapsed, rc = _supervise(
+        bench, out, deadline_s=3, init_timeout=30
+    )
+    assert verdict != "ok" and "budget" in verdict
     # the tight exec timeout must NOT fire once the probe marker exists:
     # the child lives until the overall 3 s deadline, not ~0.5 s
     assert elapsed >= 2.5
@@ -78,14 +84,16 @@ def test_healthy_child_exit_is_success(bench, tmp_path):
         json.dumps({"device_init_s": 0.1, "device_exec_probe_s": 0.4})
     )
     proc = subprocess.Popen(["sleep", "0.5"])
-    ok = bench._wait_device(
+    verdict = bench._wait_device(
         proc, str(out), time.monotonic() + 30, init_timeout=30, poll_s=0.2
     )
-    assert ok is True
+    assert verdict == "ok"
 
 
 def test_init_timeout_still_fires_without_any_markers(bench, tmp_path):
     out = tmp_path / "dev.json"  # never written: init never completed
-    ok, elapsed, rc = _supervise(bench, out, deadline_s=60, init_timeout=0.5)
-    assert ok is False
+    verdict, elapsed, rc = _supervise(
+        bench, out, deadline_s=60, init_timeout=0.5
+    )
+    assert verdict != "ok" and "init" in verdict
     assert elapsed < 10

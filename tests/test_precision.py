@@ -296,6 +296,15 @@ def check_f32_fleet_fit(engines=("joint",)):
         )
         if engine != "joint":
             kwargs["engine"] = engine
+        if engine == "sqrt":
+            # pin ONE gradient engine for both dtypes: under the auto
+            # rule the f32 sqrt deviance keeps autodiff while f64 uses
+            # the closed-form adjoint (ops/adjoint.py), and two
+            # mid-trajectory runs descending under DIFFERENT gradient
+            # paths legitimately sit >1e-3 apart at maxiter — the
+            # property pinned here is f32-tracks-f64, not
+            # engine-vs-engine
+            kwargs["grad_engine"] = "autodiff"
         fit64 = fit_fleet(fleet_of(jnp.float64, t), tol=1e-6, **kwargs)
         fit32 = fit_fleet(fleet_of(jnp.float32, t), tol=0.05, **kwargs)
         d64 = float(np.asarray(fit64.deviance)[0])

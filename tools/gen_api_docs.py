@@ -36,6 +36,7 @@ MODULES = [
     "metran_tpu.models.kalman_runner",
     "metran_tpu.ops.statespace",
     "metran_tpu.ops.forecast",
+    "metran_tpu.ops.adjoint",
     "metran_tpu.ops.kalman",
     "metran_tpu.ops.pkalman",
     "metran_tpu.ops.lanes",
