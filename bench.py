@@ -2838,6 +2838,190 @@ def run_refit_bench(out_path: str, budget_s: float) -> dict:
 # ----------------------------------------------------------------------
 # phase: gradient engines (closed-form adjoint vs autodiff)
 # ----------------------------------------------------------------------
+def run_detect_bench(out_path: str, budget_s: float) -> dict:
+    """Online monitoring scenario: armed-detector overhead + quality.
+
+    Two acceptance claims (docs/concepts.md "Online monitoring",
+    ISSUE 11):
+
+    1. the ARMED streaming detector (CUSUM + autocorrelation drift +
+       anomaly flags fused into the update kernels) costs < 3% update
+       throughput on the ARENA BULK path versus the same service with
+       detection off — paired interleaved laps, ratio of medians (the
+       PR 5 gate-overhead methodology).  Default thresholds on clean
+       data, so the measured cost is RUNNING the detector, not alarms
+       changing the workload;
+    2. detection quality at those defaults: delay-vs-magnitude curves
+       for drift and unit-error sensor faults plus the measured
+       clean-stream false-alarm rate (the
+       ``reliability.scenarios.run_detection_delay_scenario``
+       harness — the same numbers the ``-m faults`` tests assert).
+    """
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", JAX_CACHE + "-cpu")
+    import jax
+    import jax.numpy as jnp
+
+    from metran_tpu.obs import Observability
+    from metran_tpu.ops import dfm_statespace, kalman_filter
+    from metran_tpu.reliability.scenarios import (
+        run_detection_delay_scenario,
+    )
+    from metran_tpu.serve import (
+        DetectSpec, GateSpec, MetranService, ModelRegistry,
+        PosteriorState,
+    )
+
+    deadline = time.monotonic() + budget_s
+    n_models, n, k_fct, t_hist = 256, 8, 1, 200
+    rounds = 24
+    if os.environ.get("METRAN_TPU_BENCH_SMALL"):
+        n_models, t_hist, rounds = 32, 60, 6
+    out = {
+        "platform": jax.default_backend(),
+        "n_models": n_models, "n_series": n, "n_factors": k_fct,
+    }
+
+    rng = np.random.default_rng(29)
+    alpha_sdf = rng.uniform(5.0, 40.0, (n_models, n))
+    alpha_cdf = rng.uniform(10.0, 60.0, (n_models, k_fct))
+    loadings = rng.uniform(0.3, 0.8, (n_models, n, k_fct)) / np.sqrt(k_fct)
+    y = rng.normal(size=(n_models, t_hist, n))
+    mask = np.ones(y.shape, bool)
+
+    def one(a_s, a_c, ld, yy, mm):
+        ss = dfm_statespace(a_s, a_c, ld, 1.0)
+        res = kalman_filter(ss, yy, mm, engine="joint", store=False)
+        return res.mean_f, res.cov_f
+
+    means, covs = jax.jit(jax.vmap(one))(
+        jnp.asarray(alpha_sdf), jnp.asarray(alpha_cdf),
+        jnp.asarray(loadings), jnp.asarray(y), jnp.asarray(mask),
+    )
+    means, covs = np.asarray(means), np.asarray(covs)
+    states = [
+        PosteriorState(
+            model_id=f"m{i}", version=0, t_seen=t_hist,
+            mean=means[i], cov=covs[i],
+            params=np.concatenate([alpha_sdf[i], alpha_cdf[i]]),
+            loadings=loadings[i], dt=1.0,
+            scaler_mean=np.zeros(n), scaler_std=np.ones(n),
+            names=tuple(f"s{j}" for j in range(n)),
+        )
+        for i in range(n_models)
+    ]
+    ids = [st.model_id for st in states]
+
+    # three services isolate what the 3% bar is about.  Arming detect
+    # on an ungated registry ALSO switches it to the z-score-emitting
+    # gated kernel form (the documented shift, same as arming the
+    # gate) — on this CPU host the sequential form is actually FASTER
+    # than the joint kernel at these widths, so the raw on-vs-off
+    # delta conflates the two effects.  The bar therefore applies to
+    # detect+gate vs gate-only (identical gated core on both sides —
+    # the measured cost IS the fused recursions + mirror refresh),
+    # with the deployment-facing on-vs-off delta reported next to it.
+    # Wide-open thresholds (the PR 5 gate methodology): the synthetic
+    # ticks are not model-consistent, and alarms changing the host
+    # workload is not what a clean-stream hot path pays.
+    inert_gate = GateSpec(policy="reject", nsigma=1e3, min_seen=1)
+    inert_detect = DetectSpec(
+        enabled=True, min_seen=1, nsigma=1e3, cusum_h=1e9,
+        lb_thresh=1e9,
+    )
+
+    def make_service(gate=None, detect=None):
+        reg = ModelRegistry(
+            root=None, arena=True, arena_rows=n_models, arena_mesh=0,
+        )
+        for st in states:
+            reg.put(st, persist=False)
+        return MetranService(
+            reg, flush_deadline=None, max_batch=4 * n_models,
+            persist_updates=False,
+            observability=Observability.disabled(),
+            gate=gate, detect=detect,
+        )
+
+    services = {
+        "off": make_service(),
+        "gate": make_service(gate=inert_gate),
+        "both": make_service(gate=inert_gate, detect=inert_detect),
+        "on": make_service(detect=inert_detect),
+    }
+    obs_rows = rng.normal(size=(rounds + 2, n_models, 1, n)) * 0.2
+
+    def tick(svc, t) -> float:
+        t0 = time.perf_counter()
+        svc.update_batch(ids, obs_rows[t])
+        return time.perf_counter() - t0
+
+    for svc in services.values():  # compile + warm (excluded)
+        tick(svc, 0)
+        tick(svc, 1)
+    names = list(services)
+    ratios = {"detector": [], "vs_off": []}
+    for r in range(rounds):
+        if time.monotonic() > deadline - 45:
+            break
+        order = names if r % 2 == 0 else names[::-1]
+        lap = {m: tick(services[m], r + 2) for m in order}
+        ratios["detector"].append(lap["both"] / lap["gate"])
+        ratios["vs_off"].append(lap["on"] / lap["off"])
+    alarms = services["both"].health().get("detect", {})
+    for svc in services.values():
+        svc.close()
+
+    def pct(rs):  # qps overhead = 1 - 1/r for a paired lap-time ratio
+        r = float(np.median(rs)) if rs else 1.0
+        return round(100.0 * (1.0 - 1.0 / r), 2)
+
+    out["overhead"] = {
+        "batch": n_models,
+        "laps": len(ratios["detector"]),
+        # the bar: fused recursions + mirror refresh, same kernel form
+        "update_qps_pct": pct(ratios["detector"]),
+        "bar_pct": 3.0,
+        # the deployment delta (includes the joint->sequential kernel
+        # shift an ungated registry pays when arming detection)
+        "on_vs_off_qps_pct": pct(ratios["vs_off"]),
+        # honesty check: nonzero alarm counts would mean the numbers
+        # above include alarm booking, not just the recursions
+        "alarms_during_laps": {
+            k: alarms.get(k, 0)
+            for k in ("anomaly", "changepoint_cusum", "changepoint_lb")
+        },
+    }
+    progress(
+        "detect_overhead", pct=out["overhead"]["update_qps_pct"],
+        on_vs_off_pct=out["overhead"]["on_vs_off_qps_pct"],
+        laps=len(ratios["detector"]),
+    )
+    write_partial(out_path, out)
+
+    # -- detection quality at the same default thresholds --------------
+    out["scenarios"] = {}
+    n_steps, n_clean = 60, 1200
+    if os.environ.get("METRAN_TPU_BENCH_SMALL"):
+        n_steps, n_clean = 30, 300
+    for mode, mags in (("drift", (0.5, 1.0, 2.0)),
+                       ("unit", (2.0, 5.0, 10.0))):
+        if time.monotonic() > deadline - 30:
+            out["truncated"] = "budget"
+            write_partial(out_path, out)
+            break
+        res = run_detection_delay_scenario(
+            mode, magnitudes=mags, n_steps=n_steps, n_clean=n_clean,
+        )
+        out["scenarios"][mode] = res
+        progress(
+            f"detect_{mode}",
+            delays=[c["delay_steps"] for c in res["curve"]],
+            fa_per_10k=round(res["false_alarms_per_10k"], 2),
+        )
+        write_partial(out_path, out)
+    return out
+
+
 def run_grad_bench(out_path: str, budget_s: float) -> dict:
     """Gradient-engine cost story (`ops/adjoint.py`, ISSUE 10).
 
@@ -3321,6 +3505,9 @@ def main() -> None:
             "refit_models_per_s": g(
                 detail, "refit", "refit", "models_per_s"
             ),
+            "detect_overhead_pct": g(
+                detail, "detect", "overhead", "update_qps_pct"
+            ),
             "grad_backward_speedup": g(
                 detail, "grad", "backward_speedup"
             ),
@@ -3355,6 +3542,22 @@ def main() -> None:
             final["summary"] = _phase_summary(detail)
         print(json.dumps(final), flush=True)
         sys.exit(code)
+
+    if os.environ.get("METRAN_TPU_BENCH_DRY_RUN"):
+        # the bench-capture regression guard (tests/test_bench_capture
+        # .py) drives the REAL final-line emitter — detail-file write,
+        # per-phase summary extraction, the one compact stdout JSON —
+        # without spawning any phase child.  PR 10 fixed the emitter
+        # after rounds r01-r05 all recorded "parsed": null (the
+        # ever-growing detail printed inline); this hook is what keeps
+        # that contract pinned by a tier-1 test.  An optional
+        # ..._DRY_RUN_DETAIL path injects a synthetic detail dict so
+        # the test can assert the summary extraction itself.
+        detail_src = os.environ.get("METRAN_TPU_BENCH_DRY_RUN_DETAIL")
+        final["detail"] = (
+            _read_json(detail_src) if detail_src else None
+        ) or {"dry_run": True}
+        emit_and_exit(0)
 
     def on_alarm(signum, frame):
         final.setdefault("detail", {})["error"] = (
@@ -3536,6 +3739,20 @@ def main() -> None:
         _wait(rf_proc, rf_budget + 15.0, "refit")
         refit = _read_json(rf_path) or {}
 
+    # online-monitoring scenario (ISSUE 11's measurement story):
+    # armed-detector overhead on the arena bulk path (paired
+    # interleaved, 3% bar) + detection-delay curves at a measured
+    # clean-stream false-alarm rate — CPU-pinned like the others
+    detect = {}
+    if budget - elapsed() > 120:
+        dt_path = os.path.join(CACHE_DIR, "bench_detect.json")
+        if os.path.exists(dt_path):
+            os.remove(dt_path)
+        dt_budget = max(min(180.0, budget - elapsed() - 60.0), 60.0)
+        dt_proc = _spawn("detect", dt_path, dt_budget, cpu_env)
+        _wait(dt_proc, dt_budget + 15.0, "detect")
+        detect = _read_json(dt_path) or {}
+
     # gradient-engine scenario (ISSUE 10's measurement story): adjoint
     # vs autodiff backward wall time at the standard workload, the
     # flat-in-T backward-memory curve, and the anchored refit
@@ -3569,6 +3786,7 @@ def main() -> None:
               "serve_faults": serve_faults,
               "steady": steady,
               "refit": refit,
+              "detect": detect,
               "grad": grad,
               "workload": {"n_series": N_SERIES, "n_factors": N_FACTORS,
                            "t_steps": T_STEPS, "missing": MISSING,
@@ -3599,7 +3817,8 @@ if __name__ == "__main__":
                                  "mesh", "mesh-solo", "serve",
                                  "serve-load", "serve-faults", "sqrt",
                                  "obs", "robust-obs", "steady",
-                                 "refit", "grad", "grad-mem"])
+                                 "refit", "detect", "grad",
+                                 "grad-mem"])
     parser.add_argument("--out", default=None)
     parser.add_argument("--budget", type=float, default=900.0)
     parser.add_argument(
@@ -3793,6 +4012,26 @@ if __name__ == "__main__":
                 "value": rf.get("models_per_s", 0.0),
                 "unit": "models/s", "vs_baseline": 0.0,
                 "detail": rf_out,
+            }), flush=True)
+    elif args.phase == "detect":
+        out_path = args.out or os.path.join(CACHE_DIR, "bench_detect.json")
+        os.makedirs(CACHE_DIR, exist_ok=True)
+        dt_out = run_detect_bench(out_path, args.budget)
+        if args.out is None:
+            # standalone run: emit the BENCH_r* result-line schema with
+            # the armed-detector overhead headline (acceptance bar:
+            # < 3% on the arena bulk update path, paired interleaved)
+            ov = dt_out.get("overhead") or {}
+            print(json.dumps({
+                "metric": (
+                    "armed-detector update-throughput overhead on the "
+                    f"arena bulk path (batch {ov.get('batch')}, "
+                    f"{ov.get('laps')} paired laps; bar "
+                    f"{ov.get('bar_pct')}%)"
+                ),
+                "value": ov.get("update_qps_pct", 0.0),
+                "unit": "%", "vs_baseline": 0.0,
+                "detail": dt_out,
             }), flush=True)
     elif args.phase == "grad":
         out_path = args.out or os.path.join(CACHE_DIR, "bench_grad.json")

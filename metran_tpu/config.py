@@ -204,6 +204,27 @@ SERVE_STEADY_MIN_SEEN = 256  # assimilated-steps floor before freezing
 # in grid steps; 0 disables tracking (the rolling anchor costs one
 # O(k) replay kernel per commit once armed).
 SERVE_FIXED_LAG = 0
+# online monitoring: streaming anomaly / changepoint / autocorrelation
+# -drift detection fused into the update kernels, with alerting and
+# changepoint-triggered refits (docs/concepts.md "Online monitoring").
+# Ships OFF: arming it selects the gated (z-score-emitting) kernel
+# variants and adds per-slot detector state, and the thresholds are a
+# per-deployment calibration (false-alarm rate vs detection delay).
+SERVE_DETECT = 0  # 1 = arm streaming detection + alerting
+SERVE_DETECT_CUSUM_K = 0.5  # CUSUM reference value (innovation sigmas;
+#                             tuned for shifts of ~2k sigmas)
+SERVE_DETECT_CUSUM_H = 12.0  # CUSUM alarm threshold (delay ~ h/(d-k)
+#                              steps for a d-sigma shift; false-alarm
+#                              ARL grows exponentially in h)
+SERVE_DETECT_LB_WINDOW = 64  # effective window of the autocorrelation
+#                              -drift recursion (must exceed the lag, 1)
+SERVE_DETECT_LB_THRESH = 25.0  # autocorrelation-drift alarm bar on the
+#                                chi-square(1) statistic (25 = 5 sigma)
+SERVE_DETECT_NSIGMA = 5.0  # per-observation anomaly bar (z^2 > nsigma^2)
+SERVE_DETECT_MIN_SEEN = 64  # disarm models below this t_seen (cold
+#                             filters' innovations are over-dispersed)
+SERVE_DETECT_ALERT_COOLDOWN_S = 60.0  # alert raise/clear hysteresis
+#                                       window (seconds)
 # continuous adaptation: background refit + champion/challenger
 # promotion (docs/concepts.md "Continuous adaptation").  Ships OFF:
 # arming it spends fit compute on serving hosts and lets the service
@@ -323,6 +344,37 @@ def serve_defaults() -> dict:
         ),
         "fixed_lag": _env(
             "METRAN_TPU_SERVE_FIXED_LAG", int, SERVE_FIXED_LAG
+        ),
+        "detect": _env(
+            "METRAN_TPU_SERVE_DETECT", int, SERVE_DETECT
+        ),
+        "detect_cusum_k": _env(
+            "METRAN_TPU_SERVE_DETECT_CUSUM_K", float,
+            SERVE_DETECT_CUSUM_K,
+        ),
+        "detect_cusum_h": _env(
+            "METRAN_TPU_SERVE_DETECT_CUSUM_H", float,
+            SERVE_DETECT_CUSUM_H,
+        ),
+        "detect_lb_window": _env(
+            "METRAN_TPU_SERVE_DETECT_LB_WINDOW", int,
+            SERVE_DETECT_LB_WINDOW,
+        ),
+        "detect_lb_thresh": _env(
+            "METRAN_TPU_SERVE_DETECT_LB_THRESH", float,
+            SERVE_DETECT_LB_THRESH,
+        ),
+        "detect_nsigma": _env(
+            "METRAN_TPU_SERVE_DETECT_NSIGMA", float,
+            SERVE_DETECT_NSIGMA,
+        ),
+        "detect_min_seen": _env(
+            "METRAN_TPU_SERVE_DETECT_MIN_SEEN", int,
+            SERVE_DETECT_MIN_SEEN,
+        ),
+        "detect_alert_cooldown_s": _env(
+            "METRAN_TPU_SERVE_DETECT_ALERT_COOLDOWN_S", float,
+            SERVE_DETECT_ALERT_COOLDOWN_S,
         ),
         "refit": _env(
             "METRAN_TPU_SERVE_REFIT", int, SERVE_REFIT

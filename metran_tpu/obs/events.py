@@ -68,6 +68,10 @@ EVENT_KINDS = (
     "snapshot_publish",
     "steady_freeze",
     "steady_thaw",
+    "anomaly",
+    "changepoint",
+    "alert_raised",
+    "alert_cleared",
     "degraded",
     "refit_scheduled",
     "refit_promoted",

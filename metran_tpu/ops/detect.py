@@ -1,0 +1,218 @@
+"""Streaming anomaly & changepoint detection over normalized innovations.
+
+The gated serving kernels (:func:`metran_tpu.ops.gated_filter_append`
+and friends) already emit each observed slot's signed normalized
+innovation ``z = v / sqrt(f)`` — standard normal, serially independent
+under a well-specified model.  This module turns that stream into the
+three online detection statistics the monitoring product serves
+(docs/concepts.md "Online monitoring"), as O(1)-state recursions cheap
+enough to fuse into the update dispatch itself:
+
+- **anomaly**: a single observation with ``z^2 > nsigma^2`` — the
+  chi-square(1) outlier test, same null as the observation gate but
+  bookable independently of any gate policy (including gate off);
+- **changepoint (two-sided CUSUM)**: per-slot Page recursions
+  ``C+ <- max(0, C+ + z - k)`` and ``C- <- max(0, C- - z - k)``
+  alarming at ``C > h`` — the classical sequential test for a
+  sustained mean shift of the innovations, which is exactly what a
+  level/datum shift, a persistent drift, or stale dynamics leave
+  behind after the filter stops tracking.  The tripped accumulator
+  resets on alarm (one alarm per detected episode, re-armed);
+- **autocorrelation drift (windowed Ljung-Box-style)**: an
+  exponentially-windowed lag-1 portmanteau statistic
+  ``Q = n_eff * rho_1^2`` with ``rho_1 = S_zz / S_z2`` maintained by
+  forgetting-factor recursions (``lambda = 1 - 1/window``).  Under
+  whiteness ``Q ~ chi-square(1)``; serial structure — the signature of
+  *misspecified dynamics* rather than bad readings, the thing the
+  offline Ljung-Box diagnostic (:mod:`metran_tpu.diagnostics`) tests
+  after the fact — pushes it up.  Alarms need the window at least
+  half full (``n_eff >= window/2``), so a cold recursion cannot alarm
+  on two lucky draws.
+
+Everything here is pure JAX, jit/vmap-friendly, and branch-free per
+slot: the serving engine (:mod:`metran_tpu.serve.engine`) appends one
+:func:`detect_append` pass to its fused update kernels so an arena
+bulk tick pays **zero extra kernel launches** for detection, and the
+(``DETECT_STATE_ROWS``, N) carried state becomes one more
+:class:`~metran_tpu.serve.state.StateArena` leaf.
+
+State layout (:data:`DETECT_STATE_ROWS` = 6 rows, one column per
+observation slot): ``[C+, C-, z_prev, S_zz, S_z2, n_eff]``.  A fresh
+model starts at :func:`detect_init` (all zeros); unobserved slots and
+disarmed models carry every row through unchanged, so missing data
+never decays or corrupts the statistics.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "DETECT_STATE_ROWS",
+    "detect_append",
+    "detect_init",
+    "detect_stats",
+]
+
+#: rows of the carried per-slot detector state:
+#: ``[cusum_pos, cusum_neg, z_prev, s_zz, s_z2, n_eff]``.
+DETECT_STATE_ROWS = 6
+
+
+def detect_init(n_obs: int, dtype=None) -> jnp.ndarray:
+    """A fresh (:data:`DETECT_STATE_ROWS`, ``n_obs``) detector state
+    (all zeros — no evidence, no window).  ``dtype`` defaults to the
+    active precision policy (:func:`metran_tpu.config.default_dtype`)."""
+    if dtype is None:
+        from ..config import default_dtype
+
+        dtype = default_dtype()
+    return jnp.zeros((DETECT_STATE_ROWS, int(n_obs)), dtype)
+
+
+def detect_stats(state: jnp.ndarray) -> jnp.ndarray:
+    """The display/alarm statistics of a detector state.
+
+    Returns a (3, N) array ``[cusum_pos, cusum_neg, lb_q]`` (batched
+    over any leading axes): the two CUSUM accumulators verbatim plus
+    the current Ljung-Box-style drift statistic
+    ``Q = n_eff * (S_zz / S_z2)^2`` (0 while the window is empty) —
+    what the serving layer's host mirrors and ``service.anomalies()``
+    report per slot.
+    """
+    state = jnp.asarray(state)
+    szz = state[..., 3, :]
+    sz2 = state[..., 4, :]
+    nef = state[..., 5, :]
+    tiny = jnp.asarray(jnp.finfo(state.dtype).tiny, state.dtype)
+    rho = szz / jnp.maximum(sz2, tiny)
+    return jnp.stack(
+        [state[..., 0, :], state[..., 1, :], nef * rho * rho], axis=-2
+    )
+
+
+def _lb_q(szz, sz2, nef, tiny):
+    rho = szz / jnp.maximum(sz2, tiny)
+    return nef * rho * rho
+
+
+def _detect_scan(state, zs, mask, armed, *, cusum_k, cusum_h,
+                 lb_window, lb_thresh, nsigma):
+    """The raw recursion (traceable; see :func:`detect_append`)."""
+    dtype = state.dtype
+    zs = jnp.atleast_2d(jnp.asarray(zs, dtype))
+    mask = jnp.atleast_2d(jnp.asarray(mask, bool))
+    k = jnp.asarray(cusum_k, dtype)
+    h = jnp.asarray(cusum_h, dtype)
+    lam = jnp.asarray(1.0 - 1.0 / float(lb_window), dtype)
+    warm = jnp.asarray(0.5 * float(lb_window), dtype)
+    q_bar = jnp.asarray(lb_thresh, dtype)
+    a_bar = jnp.asarray(float(nsigma) ** 2, dtype)
+    tiny = jnp.asarray(jnp.finfo(dtype).tiny, dtype)
+    zero = jnp.zeros((), dtype)
+
+    def step(carry, xs):
+        cpos, cneg, prev, szz, sz2, nef = carry
+        z_raw, m_t = xs
+        # disarmed models and unobserved slots carry state unchanged;
+        # NaN z-scores (the gated kernels' unobserved marker) are
+        # excluded the same way, so a padded or missing slot can never
+        # poison an accumulator
+        obs = m_t & armed & jnp.isfinite(z_raw)
+        z = jnp.where(obs, z_raw, zero)
+        anom = obs & (z * z > a_bar)
+        # two-sided CUSUM, reset-on-alarm (one alarm per episode)
+        cpos_n = jnp.where(obs, jnp.maximum(cpos + z - k, 0.0), cpos)
+        cneg_n = jnp.where(obs, jnp.maximum(cneg - z - k, 0.0), cneg)
+        cp_hit = obs & ((cpos_n > h) | (cneg_n > h))
+        cpos_n = jnp.where(cp_hit, 0.0, cpos_n)
+        cneg_n = jnp.where(cp_hit, 0.0, cneg_n)
+        # exponentially-windowed lag-1 autocorrelation (LB-style);
+        # alarms are RISING EDGES of the over-threshold condition so a
+        # persistent excursion books one episode, not one per step
+        was = (nef >= warm) & (_lb_q(szz, sz2, nef, tiny) > q_bar)
+        szz_n = jnp.where(obs, lam * szz + z * prev, szz)
+        sz2_n = jnp.where(obs, lam * sz2 + z * z, sz2)
+        nef_n = jnp.where(obs, lam * nef + 1.0, nef)
+        prev_n = jnp.where(obs, z, prev)
+        now = (nef_n >= warm) & (
+            _lb_q(szz_n, sz2_n, nef_n, tiny) > q_bar
+        )
+        lb_hit = obs & now & ~was
+        counts_t = jnp.stack([
+            anom.astype(jnp.int32),
+            cp_hit.astype(jnp.int32),
+            lb_hit.astype(jnp.int32),
+        ])
+        return (cpos_n, cneg_n, prev_n, szz_n, sz2_n, nef_n), counts_t
+
+    carry0 = tuple(state[i] for i in range(DETECT_STATE_ROWS))
+    carry, counts = lax.scan(step, carry0, (zs, mask))
+    return jnp.stack(carry), counts.sum(axis=0)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "cusum_k", "cusum_h", "lb_window", "lb_thresh", "nsigma",
+    ),
+)
+def detect_append(
+    state: jnp.ndarray,
+    zs: jnp.ndarray,
+    mask: jnp.ndarray,
+    armed=True,
+    *,
+    cusum_k: float = 0.5,
+    cusum_h: float = 12.0,
+    lb_window: int = 64,
+    lb_thresh: float = 25.0,
+    nsigma: float = 5.0,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Advance one model's detector state over ``k`` appended steps.
+
+    Parameters
+    ----------
+    state : (:data:`DETECT_STATE_ROWS`, N) carried accumulators (see
+        module docstring; start from :func:`detect_init`).
+    zs : (k, N) signed normalized innovations — the gated serving
+        kernels' z-score output (NaN where unobserved).
+    mask : (k, N) observed flags (real, non-missing slots).
+    armed : scalar bool (traced — per-model under ``vmap``): a cold
+        model's innovations are over-dispersed until the filter
+        forgets its ``N(0, I)`` init, so the serving layer disarms
+        models below ``DetectSpec.min_seen`` exactly like the
+        observation gate; disarmed steps carry the state unchanged.
+    cusum_k, cusum_h : CUSUM reference value and alarm threshold (in
+        innovation sigmas).  ``k`` is the half-shift the test is tuned
+        for; ``h`` trades detection delay (~``h / (shift - k)`` steps)
+        against the false-alarm rate (Siegmund: ARL grows
+        exponentially in ``h``).
+    lb_window : effective window of the autocorrelation recursion
+        (forgetting factor ``1 - 1/window``); must exceed the lag (1).
+    lb_thresh : alarm threshold on ``Q`` (chi-square(1) under
+        whiteness; the default 25 is a 5-sigma bar).
+    nsigma : per-observation anomaly threshold (``z^2 > nsigma^2``).
+
+    Returns
+    -------
+    state' : the advanced (6, N) accumulators.
+    counts : (3, N) int32 — per-slot ``[anomalies, cusum_alarms,
+        lb_alarms]`` booked across the ``k`` steps (alarm = episode:
+        CUSUM resets on alarm, LB counts threshold rising edges).
+
+    The thresholds are static (compile-time) like the gate's
+    ``policy``/``nsigma`` — they join the serving registry's compile
+    keys; ``armed`` and the state are traced.
+    """
+    return _detect_scan(
+        jnp.asarray(state), zs, mask, jnp.asarray(armed, bool),
+        cusum_k=float(cusum_k), cusum_h=float(cusum_h),
+        lb_window=int(lb_window), lb_thresh=float(lb_thresh),
+        nsigma=float(nsigma),
+    )

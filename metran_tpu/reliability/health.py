@@ -68,15 +68,22 @@ class HealthMonitor:
     def __init__(self, window: int = 512, max_error_rate: float = 0.5,
                  gate_window: int = 128,
                  max_rejection_rate: float = 0.1,
+                 changepoint_ttl_s: float = 900.0,
                  clock=time.monotonic):
         self.window = int(window)
         self.max_error_rate = float(max_error_rate)
         self.gate_window = int(gate_window)
         self.max_rejection_rate = float(max_rejection_rate)
+        self.changepoint_ttl_s = float(changepoint_ttl_s)
         self._clock = clock
         self._outcomes: Deque[bool] = deque(maxlen=self.window)
         # model_id -> recent (observed, rejected) pairs, one per update
         self._gate: Dict[str, Deque[Tuple[int, int]]] = {}
+        # model_id -> instant of the newest detected changepoint (the
+        # streaming detector's structural-break flag — see
+        # refit_candidates; consumed when a refit claims the model,
+        # expired after changepoint_ttl_s)
+        self._changepoints: Dict[str, float] = {}
         self._lock = threading.Lock()
         self._seen = 0
         # -- refit bookkeeping (see refit_candidates) -------------------
@@ -205,6 +212,39 @@ class HealthMonitor:
             for mid, obs, rej in items
         }
 
+    # -- changepoint flags (streaming detection -> refit trigger) -------
+    def record_changepoint(self, model_id: str) -> None:
+        """Flag a detected structural break for ``model_id`` (the
+        serving layer's streaming CUSUM / autocorrelation-drift
+        detectors, :mod:`metran_tpu.ops.detect`).  The flag makes the
+        model a :meth:`refit_candidates` entry with reason
+        ``"changepoint"`` — a detected break *schedules a refit*
+        instead of merely degrading health — and carries its own
+        hysteresis, distinct from gate-rejection degradation: it is
+        CONSUMED when a refit claims the model (:meth:`begin_refit`)
+        or a promotion lands (:meth:`note_fit`), and expires after
+        ``changepoint_ttl_s`` so a stale break cannot trigger a refit
+        long after the stream moved on."""
+        with self._lock:
+            self._changepoints[model_id] = float(self._clock())
+
+    def changepoint_models(self) -> List[str]:
+        """Models with an unexpired, unconsumed changepoint flag."""
+        now = float(self._clock())
+        with self._lock:
+            self._prune_changepoints(now)
+            return sorted(self._changepoints)
+
+    def _prune_changepoints(self, now: float) -> None:
+        """Drop expired flags (callers hold the lock)."""
+        if self.changepoint_ttl_s <= 0.0:
+            return
+        for mid in [
+            m for m, ts in self._changepoints.items()
+            if now - ts > self.changepoint_ttl_s
+        ]:
+            del self._changepoints[mid]
+
     # -- refit candidate queue (degradation + staleness, merged) --------
     def note_fit(self, model_id: str, t_seen: int) -> None:
         """Stamp ``model_id``'s staleness baseline: it was (re)fit now,
@@ -214,6 +254,8 @@ class HealthMonitor:
         with self._lock:
             self._fit_marks[model_id] = (float(self._clock()), int(t_seen))
             self._fit_progress[model_id] = int(t_seen)
+            # a promotion resolves the break the flag reported
+            self._changepoints.pop(model_id, None)
 
     def note_progress(self, model_id: str, t_seen: int) -> None:
         """Record the model's current ``t_seen`` (monotonic max).  A
@@ -231,11 +273,16 @@ class HealthMonitor:
 
     def begin_refit(self, model_id: str) -> bool:
         """Claim ``model_id`` for a refit; False when one is already in
-        flight (the hysteresis half that stops double-scheduling)."""
+        flight (the hysteresis half that stops double-scheduling).  A
+        successful claim CONSUMES the model's changepoint flag — the
+        break triggered its refit; only a new detection re-arms it
+        (the changepoint trigger's own hysteresis, on top of the
+        post-outcome cooldown)."""
         with self._lock:
             if model_id in self._refitting:
                 return False
             self._refitting.add(model_id)
+            self._changepoints.pop(model_id, None)
             return True
 
     def end_refit(self, model_id: str, cooldown_s: float = 0.0) -> None:
@@ -275,6 +322,12 @@ class HealthMonitor:
         - **gate degradation** — the model's windowed observation-
           rejection rate exceeds ``max_rejection_rate`` (the same test
           as :meth:`degraded_models`, strict >);
+        - **changepoint** — the streaming detector flagged a
+          structural break (:meth:`record_changepoint`), unexpired and
+          unconsumed.  A sequential test that fired already paid its
+          false-alarm budget, so the flag scores a flat 2.0 — above a
+          barely-crossed threshold, below a sensor rejecting several
+          times the degraded rate;
         - **observation staleness** — ``staleness_obs`` or more steps
           assimilated since the last :meth:`note_fit` stamp (0 = off);
         - **age staleness** — ``staleness_age_s`` or more seconds since
@@ -294,13 +347,15 @@ class HealthMonitor:
             }
             marks = dict(self._fit_marks)
             progress = dict(self._fit_progress)
+            self._prune_changepoints(now)
+            breaks = set(self._changepoints)
             skip = set(self._refitting)
             skip.update(
                 mid for mid, until in self._refit_cooldown.items()
                 if until > now
             )
         out: List[RefitCandidate] = []
-        for mid in sorted(set(gate_items) | set(marks)):
+        for mid in sorted(set(gate_items) | set(marks) | breaks):
             if mid in skip:
                 continue
             obs, rej = gate_items.get(mid, (0, 0))
@@ -315,6 +370,9 @@ class HealthMonitor:
             if obs and rate > self.max_rejection_rate:
                 reasons.append("gate")
                 score = max(score, rate / self.max_rejection_rate)
+            if mid in breaks:
+                reasons.append("changepoint")
+                score = max(score, 2.0)
             if staleness_obs > 0 and since >= staleness_obs:
                 reasons.append("stale_obs")
                 score = max(score, since / staleness_obs)
@@ -358,6 +416,8 @@ class HealthMonitor:
             n = len(self._outcomes)
             errors = n - sum(self._outcomes)
             seen = self._seen
+            self._prune_changepoints(float(self._clock()))
+            changepoints = sorted(self._changepoints)
             gate_items = [
                 (mid, sum(o for o, _ in dq), sum(r for _, r in dq))
                 for mid, dq in self._gate.items()
@@ -376,6 +436,7 @@ class HealthMonitor:
                 ),
                 "max_rejection_rate": self.max_rejection_rate,
             },
+            "changepoints_pending": changepoints,
         }
         if extra:
             snap.update(extra)

@@ -39,6 +39,8 @@ from . import faultinject
 from .faultinject import SensorFault
 
 __all__ = [
+    "run_changepoint_scenario",
+    "run_detection_delay_scenario",
     "run_drift_recovery_scenario",
     "run_sensor_fault_scenario",
     "simulate_dfm_panel",
@@ -271,6 +273,312 @@ def run_drift_recovery_scenario(
         "params_stale": np.concatenate(
             [alpha_sdf, alpha_cdf]
         ) * alpha_factor,
+        "params_refit": refit["params"],
+    }
+
+
+def run_detection_delay_scenario(
+    mode: str,
+    magnitudes=(2.0, 4.0, 8.0),
+    n_series: int = 6,
+    n_factors: int = 1,
+    t_hist: int = 300,
+    n_steps: int = 80,
+    n_clean: int = 1000,
+    seed: int = 0,
+    series: int = 0,
+    probability=None,
+    engine: str = "sqrt",
+    detect=None,
+) -> dict:
+    """Detection delay vs fault magnitude, at a measured false-alarm
+    rate on clean streams (docs/concepts.md "Online monitoring").
+
+    One detection-armed :class:`~metran_tpu.serve.MetranService`
+    hosts a CLEAN control model plus one model per fault magnitude
+    (identical states — one compiled kernel set, one compile).  The
+    control streams ``n_clean`` uncorrupted rows and every raw alarm
+    it books is a false alarm (reported per 10k steps next to the
+    raised-alert count — the operator-facing unit).  Each fault model
+    then streams ``n_steps`` rows corrupted by a fresh
+    :class:`SensorFault` of the given ``mode``/magnitude from step 0;
+    its detection **delay** is the stream position of its first
+    ``anomaly``/``changepoint`` event minus the onset (``None`` when
+    the episode was never detected — expected for magnitudes inside
+    the null).  The ``faults``-marked tier-1 tests assert the curve's
+    shape (monotone-ish delay, detection of the strong drift and
+    unit-error episodes) and the clean false-alarm bar (<= 1 per 10k
+    steps at default thresholds).
+
+    ``detect`` is a :class:`~metran_tpu.serve.DetectSpec` (default:
+    the shipped thresholds with ``min_seen=1`` — the state is warm at
+    ``t_hist`` steps).  Per-mode magnitude semantics follow
+    :func:`run_sensor_fault_scenario` (drift: units/step, unit: the
+    scale factor, spike/stuck: data units).
+    """
+    from ..ops import dfm_statespace, sqrt_kalman_filter
+    from ..serve import (
+        DetectSpec,
+        GateSpec,
+        MetranService,
+        ModelRegistry,
+        PosteriorState,
+    )
+
+    if detect is None:
+        detect = DetectSpec(enabled=True, min_seen=1)
+    rng = np.random.default_rng(seed)
+    loadings = rng.uniform(0.4, 0.7, (n_series, n_factors))
+    loadings /= np.sqrt(n_factors)
+    alpha_sdf = rng.uniform(5.0, 40.0, n_series)
+    alpha_cdf = rng.uniform(10.0, 60.0, n_factors)
+    ss = dfm_statespace(alpha_sdf, alpha_cdf, loadings, 1.0)
+    t_total = t_hist + max(n_steps, n_clean)
+    _, y_all, _ = simulate_dfm_panel(ss, t_total, rng)
+    y_hist = y_all[:t_hist]
+    filt = sqrt_kalman_filter(ss, y_hist, np.ones(y_hist.shape, bool))
+    chol0 = np.asarray(filt.chol_f[-1])
+
+    def make_state(model_id):
+        return PosteriorState(
+            model_id=model_id, version=0, t_seen=t_hist,
+            mean=np.asarray(filt.mean_f[-1]), cov=chol0 @ chol0.T,
+            params=np.concatenate([alpha_sdf, alpha_cdf]),
+            loadings=loadings, dt=1.0,
+            scaler_mean=np.zeros(n_series),
+            scaler_std=np.ones(n_series),
+            names=tuple(f"s{j}" for j in range(n_series)),
+            chol=chol0,
+        )
+
+    reg = ModelRegistry(root=None, engine=engine)
+    fault_ids = [f"{mode}-{mag:g}" for mag in magnitudes]
+    for mid in ["clean"] + fault_ids:
+        reg.put(make_state(mid), persist=False)
+    svc = MetranService(
+        reg, flush_deadline=None, persist_updates=False,
+        gate=GateSpec(policy="off"), detect=detect,
+    )
+    try:
+        y_clean = y_all[t_hist:t_hist + n_clean]
+        _stream_phase(svc, "clean", y_clean)
+        clean = svc.anomalies("clean").get("clean", {})
+        clean_alarms = (
+            clean.get("anomalies", 0) + clean.get("cusum_alarms", 0)
+            + clean.get("lb_alarms", 0)
+        )
+        clean_alerts = len(svc.alerts("clean", active_only=False))
+        curve = []
+        y_fault = y_all[t_hist:t_hist + n_steps]
+        for mid, mag in zip(fault_ids, magnitudes):
+            with faultinject.active() as inj:
+                inj.add(
+                    "serve.update.new_obs", match=mid,
+                    probability=probability, seed=seed + 1,
+                    corrupt=SensorFault(
+                        mode, series=series, magnitude=mag,
+                        factor=mag,
+                        value=mag if mode == "stuck" else None,
+                    ),
+                )
+                _stream_phase(svc, mid, y_fault)
+            first = None
+            signal = None
+            for e in svc.events.for_model(mid):
+                if e["kind"] in ("anomaly", "changepoint"):
+                    first = int(e["detail"]["t_seen"]) - t_hist
+                    signal = (
+                        e["kind"] if e["kind"] == "anomaly"
+                        else ("cusum" if e["detail"].get("cusum")
+                              else "lb_drift")
+                    )
+                    break
+            curve.append({
+                "magnitude": float(mag),
+                "detected": first is not None,
+                "delay_steps": first,
+                "signal": signal,
+            })
+        return {
+            "mode": mode,
+            "engine": engine,
+            "n_steps": n_steps,
+            "clean_steps": int(n_clean),
+            "clean_alarms": int(clean_alarms),
+            "clean_alerts": int(clean_alerts),
+            "false_alarms_per_10k": (
+                1e4 * clean_alarms / max(n_clean, 1)
+            ),
+            "curve": curve,
+            "detect": detect._asdict(),
+        }
+    finally:
+        svc.close()
+
+
+def run_changepoint_scenario(
+    n_series: int = 6,
+    n_factors: int = 1,
+    t_hist: int = 200,
+    n_fault: int = 40,
+    n_tail: int = 80,
+    n_eval: int = 60,
+    seed: int = 0,
+    drift_per_step: float = 1.0,
+    alpha_factor: float = 8.0,
+    policy: str = "reject",
+    nsigma: float = 4.0,
+    min_seen: int = 32,
+    engine: str = "sqrt",
+    tail: int = 96,
+    holdout: int = 24,
+    maxiter: int = 40,
+    detect=None,
+) -> dict:
+    """End-to-end changepoint-triggered self-healing:
+    detect → alert → refit → promote (docs/concepts.md "Online
+    monitoring").
+
+    The :func:`run_drift_recovery_scenario` setting — a STALE model
+    (alphas inflated by ``alpha_factor``) serving a drift-corrupted
+    stream — with the streaming detector armed on top of the gate.
+    The drifting episode leaves exactly the signature the CUSUM tests
+    for (persistent same-sign innovations once the gate stops the
+    state from tracking), so the timeline now reads: ``degraded``
+    (gate-rejection window) AND ``changepoint`` (CUSUM) →
+    ``alert_raised`` → the changepoint flag makes the model a ranked
+    :meth:`~metran_tpu.reliability.HealthMonitor.refit_candidates`
+    entry → ``refit_scheduled`` (reasons include ``changepoint``) →
+    ``refit_promoted`` — all reconstructible from the
+    :class:`~metran_tpu.obs.EventLog` alone, which the tier-1
+    acceptance test asserts.  A no-refit control run (same stale
+    model, same corrupted stream, no worker) anchors the recovered
+    accuracy: ``rmse_refit`` must beat ``rmse_norefit``.
+    """
+    from ..ops import dfm_statespace, kalman_filter, sqrt_kalman_filter
+    from ..serve import (
+        DetectSpec,
+        GateSpec,
+        MetranService,
+        ModelRegistry,
+        PosteriorState,
+        RefitSpec,
+        RefitWorker,
+    )
+    from ..serve.engine import state_slot_index
+
+    if detect is None:
+        detect = DetectSpec(
+            enabled=True, min_seen=1, alert_cooldown_s=5.0
+        )
+    rng = np.random.default_rng(seed)
+    loadings = rng.uniform(0.4, 0.7, (n_series, n_factors))
+    loadings /= np.sqrt(n_factors)
+    alpha_sdf = rng.uniform(5.0, 40.0, n_series)
+    alpha_cdf = rng.uniform(10.0, 60.0, n_factors)
+    ss_true = dfm_statespace(alpha_sdf, alpha_cdf, loadings, 1.0)
+    t_total = t_hist + n_fault + n_tail + n_eval
+    xs, y_all, _ = simulate_dfm_panel(ss_true, t_total, rng)
+    y_hist = y_all[:t_hist]
+    mask_hist = np.ones(y_hist.shape, bool)
+    slot = state_slot_index(n_series, n_factors, n_series)
+    sqrt_engine = engine in ("sqrt", "sqrt_parallel")
+
+    def make_state(model_id, a_sdf, a_cdf):
+        ss = dfm_statespace(a_sdf, a_cdf, loadings, 1.0)
+        if sqrt_engine:
+            filt = sqrt_kalman_filter(ss, y_hist, mask_hist)
+            chol0 = np.asarray(filt.chol_f[-1])
+            cov0 = chol0 @ chol0.T
+        else:
+            filt = kalman_filter(ss, y_hist, mask_hist, engine=engine)
+            chol0, cov0 = None, np.asarray(filt.cov_f[-1])
+        return PosteriorState(
+            model_id=model_id, version=0, t_seen=t_hist,
+            mean=np.asarray(filt.mean_f[-1]), cov=cov0,
+            params=np.concatenate([a_sdf, a_cdf]),
+            loadings=loadings, dt=1.0,
+            scaler_mean=np.zeros(n_series),
+            scaler_std=np.ones(n_series),
+            names=tuple(f"s{j}" for j in range(n_series)),
+            chol=chol0,
+        )
+
+    y_fault = y_all[t_hist:t_hist + n_fault]
+    y_tail = y_all[t_hist + n_fault:t_hist + n_fault + n_tail]
+    y_eval = y_all[t_hist + n_fault + n_tail:]
+    x_eval = xs[t_hist + n_fault + n_tail:]
+    gate = GateSpec(policy=policy, nsigma=nsigma, min_seen=min_seen)
+    spec = RefitSpec(
+        tail=tail, holdout=holdout, min_tail=holdout + 8,
+        maxiter=maxiter, margin=0.0, cooldown_s=0.0,
+        deadline_s=600.0,
+    )
+
+    def run(refit: bool) -> dict:
+        mid = "changepoint-recovery"
+        reg = ModelRegistry(root=None, engine=engine)
+        reg.put(
+            make_state(
+                mid, alpha_sdf * alpha_factor, alpha_cdf * alpha_factor
+            ),
+            persist=False,
+        )
+        svc = MetranService(
+            reg, flush_deadline=None, persist_updates=False,
+            gate=gate, detect=detect,
+        )
+        worker = RefitWorker(svc, spec) if refit else None
+        out = {}
+        try:
+            with faultinject.active() as inj:
+                inj.add(
+                    "serve.update.new_obs", match=mid, times=n_fault,
+                    corrupt=SensorFault(
+                        "drift", series=None, magnitude=drift_per_step,
+                    ),
+                )
+                _stream_phase(svc, mid, y_fault)
+            out["changepoints_pending"] = (
+                svc.monitor.changepoint_models()
+            )
+            out["alerts"] = svc.alerts(mid, active_only=False)
+            out["anomalies"] = svc.anomalies(mid).get(mid, {})
+            out["candidates"] = [
+                (c.model_id, c.reasons, c.score)
+                for c in svc.monitor.refit_candidates()
+            ]
+            _stream_phase(svc, mid, y_tail)
+            if worker is not None:
+                out["report"] = worker.run_once()
+            out["rmse"] = _stream_rmse(svc, mid, y_eval, x_eval, slot)
+            out["params"] = np.asarray(reg.get(mid).params)
+            out["events"] = [
+                e["kind"] for e in svc.events.for_model(mid)
+            ] if svc.events is not None else []
+            return out
+        finally:
+            if worker is not None:
+                worker.close()
+            svc.close()
+
+    norefit = run(refit=False)
+    refit = run(refit=True)
+    report = refit.get("report", {})
+    return {
+        "n_fault": n_fault, "n_tail": n_tail, "n_eval": n_eval,
+        "alpha_factor": alpha_factor, "engine": engine,
+        "rmse_norefit": norefit["rmse"],
+        "rmse_refit": refit["rmse"],
+        "refit_vs_norefit": refit["rmse"] / max(norefit["rmse"], 1e-12),
+        "changepoints_pending": refit["changepoints_pending"],
+        "alerts": refit["alerts"],
+        "anomalies": refit["anomalies"],
+        "candidates": refit["candidates"],
+        "promoted": list(report.get("promoted", [])),
+        "report": report,
+        "events": refit["events"],
+        "params_true": np.concatenate([alpha_sdf, alpha_cdf]),
         "params_refit": refit["params"],
     }
 

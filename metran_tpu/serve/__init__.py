@@ -25,6 +25,11 @@ refilters history:
   retained observation tails through the fleet-fitting machinery,
   champion/challenger shadow comparison, crash-safe hot-swap
   (``METRAN_TPU_SERVE_REFIT``);
+- :mod:`~metran_tpu.serve.monitoring` — :class:`AlertBoard` /
+  :class:`DetectorMirror`: the online monitoring product's host
+  halves — alert raise/clear hysteresis and per-model detection
+  mirrors over the fused streaming detectors
+  (``METRAN_TPU_SERVE_DETECT``, :mod:`metran_tpu.ops.detect`);
 - :mod:`~metran_tpu.serve.service` — :class:`MetranService`, the
   in-process ``update``/``forecast`` API with latency and occupancy
   telemetry, hard request deadlines, per-model circuit breakers, and
@@ -42,6 +47,7 @@ from ..reliability.policy import (
 )
 from .batching import MicroBatcher
 from .engine import (
+    DetectSpec,
     GateSpec,
     SteadySpec,
     forecast_bucket,
@@ -53,6 +59,7 @@ from .engine import (
     stack_bucket,
     update_bucket,
 )
+from .monitoring import Alert, AlertBoard, DetectorMirror
 from .readpath import (
     ForecastSnapshot,
     SnapshotEntry,
@@ -61,7 +68,13 @@ from .readpath import (
 )
 from .refit import ObservationTail, RefitSpec, RefitWorker, TailSnapshot
 from .registry import CompiledFnCache, ModelRegistry
-from .service import ArenaUpdateAck, Forecast, MetranService, ServeMetrics
+from .service import (
+    ArenaUpdateAck,
+    Decomposition,
+    Forecast,
+    MetranService,
+    ServeMetrics,
+)
 from .smoothing import FixedLagTracker, SmoothedWindow
 from .state import (
     ArenaLostError,
@@ -73,12 +86,17 @@ from .state import (
 )
 
 __all__ = [
+    "Alert",
+    "AlertBoard",
     "ArenaLostError",
     "ArenaUpdateAck",
     "ChainedRequestError",
     "CircuitOpenError",
     "CompiledFnCache",
     "DeadlineExceededError",
+    "Decomposition",
+    "DetectSpec",
+    "DetectorMirror",
     "FixedLagTracker",
     "Forecast",
     "ForecastSnapshot",

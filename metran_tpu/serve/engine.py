@@ -37,6 +37,7 @@ from ..ops import (
     steady_converged,
     steady_filter_append,
 )
+from ..ops.detect import detect_append, detect_stats
 from ..ops.statespace import StateSpace, dfm_statespace
 
 
@@ -142,6 +143,111 @@ class SteadySpec(NamedTuple):
                 f"steady tol must be >= 0 (0 disables), got {self.tol!r}"
             )
         return self
+
+
+class DetectSpec(NamedTuple):
+    """Streaming-detection policy for the serving update path.
+
+    Armed (``enabled=True``), every update dispatch additionally runs
+    the :mod:`metran_tpu.ops.detect` recursions over the kernel's
+    normalized innovations — per-slot **anomaly** flags
+    (``z^2 > nsigma^2``), two-sided **CUSUM** changepoint accumulators
+    (``cusum_k``/``cusum_h``) and the exponentially-windowed
+    **Ljung-Box-style autocorrelation-drift** statistic
+    (``lb_window``/``lb_thresh``) — fused into the same kernel launch
+    (the detector state is one more carried leaf; no second dispatch).
+    The service books the outcomes (``metran_serve_detect_total``
+    counters, ``anomaly``/``changepoint`` events), raises alerts with
+    ``alert_cooldown_s`` raise/clear hysteresis, and feeds changepoint
+    flags into :meth:`~metran_tpu.reliability.HealthMonitor.
+    refit_candidates` so a detected structural break *schedules a
+    refit* instead of merely degrading health (docs/concepts.md
+    "Online monitoring").
+
+    ``min_seen`` disarms detection for cold models exactly like the
+    observation gate's floor (evaluated from ``t_seen`` per dispatch —
+    traced, never a recompile).  The thresholds are XLA-static and
+    join the kernel compile keys.  With detection **disabled** the
+    serving kernels are bit-identical to today's (the detect factories
+    are never taken); with it **enabled** an ungated registry serves
+    through the z-score-emitting gated kernel variants with the gate
+    permanently disarmed — posteriors bit-identical on square-root
+    engines, float-tolerance on ``joint`` (the same documented shift
+    as arming the gate).
+
+    Defaults from :func:`metran_tpu.config.serve_defaults`
+    (``METRAN_TPU_SERVE_DETECT{,_CUSUM_K,_CUSUM_H,_LB_WINDOW,
+    _LB_THRESH,_NSIGMA,_MIN_SEEN,_ALERT_COOLDOWN_S}``); shipped off.
+    """
+
+    enabled: bool = False
+    cusum_k: float = 0.5
+    cusum_h: float = 12.0
+    lb_window: int = 64
+    lb_thresh: float = 25.0
+    nsigma: float = 5.0
+    min_seen: int = 64
+    alert_cooldown_s: float = 60.0
+
+    @classmethod
+    def from_defaults(cls) -> "DetectSpec":
+        from ..config import serve_defaults
+
+        d = serve_defaults()
+        return cls(
+            enabled=bool(d["detect"]),
+            cusum_k=float(d["detect_cusum_k"]),
+            cusum_h=float(d["detect_cusum_h"]),
+            lb_window=int(d["detect_lb_window"]),
+            lb_thresh=float(d["detect_lb_thresh"]),
+            nsigma=float(d["detect_nsigma"]),
+            min_seen=int(d["detect_min_seen"]),
+            alert_cooldown_s=float(d["detect_alert_cooldown_s"]),
+        ).validate()
+
+    def validate(self) -> "DetectSpec":
+        """Reject inert or broken combinations — an armed detector
+        that could never alarm (or that would alarm on everything) is
+        paid for and silently useless."""
+        if not self.enabled:
+            return self
+        if self.min_seen < 0:
+            raise ValueError(
+                f"detect min_seen must be >= 0, got {self.min_seen}"
+            )
+        if self.lb_window <= 1:
+            # the recursion tests lag-1 autocorrelation: a window at
+            # or below the lag holds no pair to correlate
+            raise ValueError(
+                "detect lb_window must exceed the autocorrelation "
+                f"lag (1), got {self.lb_window}"
+            )
+        if self.alert_cooldown_s < 0.0:
+            raise ValueError(
+                "detect alert_cooldown_s must be >= 0, got "
+                f"{self.alert_cooldown_s}"
+            )
+        if self.cusum_k < 0.0 or not self.cusum_h > 0.0:
+            raise ValueError(
+                "detect cusum_k must be >= 0 and cusum_h > 0, got "
+                f"k={self.cusum_k} h={self.cusum_h}"
+            )
+        if not self.lb_thresh > 0.0 or not self.nsigma > 0.0:
+            raise ValueError(
+                "detect lb_thresh and nsigma must be > 0, got "
+                f"lb_thresh={self.lb_thresh} nsigma={self.nsigma}"
+            )
+        return self
+
+    @property
+    def kernel_params(self) -> dict:
+        """The static threshold half, as :func:`metran_tpu.ops.
+        detect_append` keyword arguments (and compile-key material)."""
+        return dict(
+            cusum_k=float(self.cusum_k), cusum_h=float(self.cusum_h),
+            lb_window=int(self.lb_window),
+            lb_thresh=float(self.lb_thresh), nsigma=float(self.nsigma),
+        )
 
 
 class BucketBatch(NamedTuple):
@@ -377,7 +483,8 @@ def _horizon_pass(ss, mean_t, fac_t, horizons: Tuple[int, ...],
 
 
 def make_update_fn(engine: str = "joint", gate: Optional[GateSpec] = None,
-                   horizons: Optional[Tuple[int, ...]] = None):
+                   horizons: Optional[Tuple[int, ...]] = None,
+                   detect: Optional[DetectSpec] = None):
     """A fresh jitted batched incremental-update kernel.
 
     ``fn(ss, mean, cov, y_new, mask_new) -> (mean_T, cov_T, sigma,
@@ -410,9 +517,24 @@ def make_update_fn(engine: str = "joint", gate: Optional[GateSpec] = None,
     standardized units) appended after every other output — the
     commit-time precompute, one extra closed-form pass amortized
     across the batch, no second kernel launch.
+
+    With an **enabled** ``detect`` (:class:`DetectSpec`), the kernel
+    additionally advances the streaming detection recursions
+    (:func:`metran_tpu.ops.detect_append`) over the per-slot z-scores
+    in the SAME launch: it takes two more trailing arguments —
+    ``det_state`` ((B, 6, N) carried accumulators) and ``det_armed``
+    ((B,) bool, the host's ``t_seen >= detect.min_seen`` verdict) —
+    and appends ``(det_state', det_counts, det_stats)`` ((B, 6, N),
+    (B, 3, N) int32, (B, 3, N)) as its last outputs.  An ungated
+    registry arming detection serves through the gated kernel variant
+    with the gate permanently disarmed — real z-scores, posteriors
+    bit-identical to the plain kernel (the PR 5 no-trip contract).
     """
     sqrt_engine = engine in ("sqrt", "sqrt_parallel")
     gated = gate is not None and gate.enabled
+    det_on = detect is not None and detect.enabled
+    if det_on:
+        detect.validate()
     if gated:
         gate.validate()
         policy, nsigma = gate.policy, float(gate.nsigma)
@@ -434,6 +556,29 @@ def make_update_fn(engine: str = "joint", gate: Optional[GateSpec] = None,
                         nsigma=nsigma,
                     )
                 )(ss, mean, cov, y_new, mask_new, armed)
+    elif det_on and sqrt_engine:
+        # detection needs z-scores: the gated kernel with the gate
+        # permanently DISARMED — no slot can ever trip, and a
+        # non-tripping slot computes the exact same floating-point
+        # operations as the plain kernel (tests/test_gating.py), so
+        # the posterior stays bit-identical while the z-scores come
+        # out for free
+        def core(ss, mean, chol, y_new, mask_new):
+            return jax.vmap(
+                lambda s, m, c, y, k: gated_sqrt_filter_append(
+                    s, m, c, y, k, armed=False, policy="reject",
+                    nsigma=4.0,
+                )
+            )(ss, mean, chol, y_new, mask_new)
+    elif det_on:
+
+        def core(ss, mean, cov, y_new, mask_new):
+            return jax.vmap(
+                lambda s, m, c, y, k: gated_filter_append(
+                    s, m, c, y, k, armed=False, policy="reject",
+                    nsigma=4.0,
+                )
+            )(ss, mean, cov, y_new, mask_new)
     elif sqrt_engine:
 
         def core(ss, mean, chol, y_new, mask_new):
@@ -448,6 +593,29 @@ def make_update_fn(engine: str = "joint", gate: Optional[GateSpec] = None,
                     s, m, c, y, k, engine=engine
                 )
             )(ss, mean, cov, y_new, mask_new)
+
+    if det_on:
+        hz = tuple(int(h) for h in horizons) if horizons else ()
+        dpar = detect.kernel_params
+
+        def fused(ss, mean, fac, y_new, mask_new, *extra):
+            *gate_extra, det_state, det_armed = extra
+            out = core(ss, mean, fac, y_new, mask_new, *gate_extra)
+            # the core is a z-score-emitting variant either way; the
+            # detect-only path strips zs/verdicts back off the public
+            # outputs (the service books no gate verdicts then)
+            res = out if gated else out[:4]
+            if hz:
+                fm, fv = _horizon_pass(
+                    ss, out[0], out[1], hz, sqrt_engine
+                )
+                res = res + (fm, fv)
+            det_new, det_counts = jax.vmap(
+                lambda st, z, m, a: detect_append(st, z, m, a, **dpar)
+            )(det_state, out[4], mask_new, det_armed)
+            return res + (det_new, det_counts, detect_stats(det_new))
+
+        return _annotated(jax.jit(fused), UPDATE_ANNOTATION)
 
     if horizons:
         hz = tuple(int(h) for h in horizons)
@@ -484,7 +652,8 @@ def _steady_horizon_means(ss, mean_t, horizons: Tuple[int, ...]):
 
 def make_steady_update_fn(gate: Optional[GateSpec] = None,
                           horizons: Optional[Tuple[int, ...]] = None,
-                          sequential_gate: bool = False):
+                          sequential_gate: bool = False,
+                          detect: Optional[DetectSpec] = None):
     """A fresh jitted batched **steady** (frozen-gain) update kernel.
 
     ``fn(ss, mean, kgain, fdiag, real, y_new, mask_new[, armed]) ->
@@ -507,8 +676,19 @@ def make_steady_update_fn(gate: Optional[GateSpec] = None,
     With ``horizons`` the kernel appends the MEAN half of the fused
     commit-time forecast pass (:func:`_steady_horizon_means`); the
     variance half is a frozen constant the caller caches.
+
+    With an enabled ``detect`` the signature becomes
+    ``fn(ss, mean, kgain, fdiag, real, y_new, mask_new, armed,
+    det_state, det_armed)`` (``armed`` always present — zeros when the
+    gate is off) and ``(det_state', det_counts, det_stats)`` ride as
+    the last outputs; a BROKE row's detector state carries unchanged
+    (its result is discarded and the rows replay through the exact
+    kernel, which accumulates them exactly once).
     """
     gated = gate is not None and gate.enabled
+    det_on = detect is not None and detect.enabled
+    if det_on:
+        detect.validate()
     if gated:
         gate.validate()
         policy, nsigma = gate.policy, float(gate.nsigma)
@@ -530,20 +710,32 @@ def make_steady_update_fn(gate: Optional[GateSpec] = None,
             res = res + (zs, verdicts)
         if hz:
             res = res + (_steady_horizon_means(ss, mean_t, hz),)
-        return res
+        return res, zs, broke
 
-    if gated:
+    if det_on:
+        dpar = detect.kernel_params
+
+        def fn(ss, mean, kgain, fdiag, real, y_new, mask_new, armed,
+               det_state, det_armed):
+            res, zs, broke = core(ss, mean, kgain, fdiag, real,
+                                  y_new, mask_new, armed)
+            det_new, det_counts = jax.vmap(
+                lambda st, z, m, a: detect_append(st, z, m, a, **dpar)
+            )(det_state, zs, mask_new, det_armed & ~broke)
+            return res + (det_new, det_counts, detect_stats(det_new))
+
+    elif gated:
 
         def fn(ss, mean, kgain, fdiag, real, y_new, mask_new, armed):
             return core(ss, mean, kgain, fdiag, real, y_new,
-                        mask_new, armed)
+                        mask_new, armed)[0]
 
     else:
 
         def fn(ss, mean, kgain, fdiag, real, y_new, mask_new):
             armed = jnp.zeros(mean.shape[0], bool)
             return core(ss, mean, kgain, fdiag, real, y_new,
-                        mask_new, armed)
+                        mask_new, armed)[0]
 
     return _annotated(jax.jit(fn), UPDATE_ANNOTATION)
 
@@ -628,6 +820,7 @@ def make_arena_update_fn(
     validate: bool = True,
     horizons: Optional[Tuple[int, ...]] = None,
     steady_tol: float = 0.0,
+    detect: Optional[DetectSpec] = None,
 ):
     """A fresh jitted **arena** assimilation kernel (in-place).
 
@@ -669,13 +862,35 @@ def make_arena_update_fn(
     append.  The service ANDs in its host-side conditions (``t_seen``
     floor, no gate verdicts) before freezing the row's gain
     (docs/concepts.md "Bounded-cost serving").
+
+    With an enabled ``detect`` (:class:`DetectSpec`) the kernel has
+    ONE fixed signature — ``fn(dynamic, static, det, rows, y, mask,
+    min_seen, real, det_min_seen)`` with the (B, 6, N) detector leaf
+    donated alongside the dynamic leaves — and appends ``(det_counts,
+    det_stats)`` ((G, 3, N) each) after every other output, with the
+    new detector leaf returned second (``(dynamic', det', ok, ...)``;
+    :meth:`StateArena.apply_det` swaps both).  Per-row ``det_armed``
+    comes from the resident ``t_seen`` against the traced
+    ``det_min_seen`` (warming never recompiles); a row the integrity
+    gate REJECTS carries its detector state bit-identically unchanged
+    and books zero counts — observations that were never assimilated
+    are never detected on either.
     """
     sqrt_engine = engine in ("sqrt", "sqrt_parallel")
     gated = gate is not None and gate.enabled
+    det_on = detect is not None and detect.enabled
+    if det_on:
+        detect.validate()
+    # detection needs per-slot z-scores: an ungated registry arming it
+    # runs the gated kernel variant with the gate permanently disarmed
+    # (bit-identical posteriors — no slot can trip at armed=False)
+    run_gated = gated or det_on
     hz = tuple(int(h) for h in horizons) if horizons else ()
     if gated:
         gate.validate()
         policy, nsigma = gate.policy, float(gate.nsigma)
+    elif det_on:
+        policy, nsigma = "reject", 4.0
 
     def _body(dyn, static, rows, y, mask, armed, real=None):
         mean_a, fac_a, t_a, v_a = dyn
@@ -689,7 +904,7 @@ def make_arena_update_fn(
         mean_g = mean_a[rows]
         fac_g = fac_a[rows]
         extra = ()
-        if gated:
+        if run_gated:
             if sqrt_engine:
                 mean_n, fac_n, sigma, detf, zs, verdicts = jax.vmap(
                     lambda s, m, c, yy, kk, a: gated_sqrt_filter_append(
@@ -745,6 +960,39 @@ def make_arena_update_fn(
             ),)
         return (new_dyn, ok, sigma, detf) + extra
 
+    if det_on:
+        dpar = detect.kernel_params
+
+        @functools.partial(jax.jit, donate_argnums=(0, 2))
+        def fn(dyn, static, det_a, rows, y, mask, min_seen, real,
+               det_min_seen):
+            armed = (
+                dyn[2][rows] >= min_seen if gated
+                else jnp.zeros(rows.shape, bool)
+            )
+            det_armed = dyn[2][rows] >= det_min_seen
+            out = _body(dyn, static, rows, y, mask, armed,
+                        real if steady_tol > 0.0 else None)
+            new_dyn, rest = out[0], out[1:]
+            # rest = (ok, sigma, detf, zs, verdicts[, fm, fv][, conv])
+            ok, zs = rest[0], rest[3]
+            det_g = det_a[rows]
+            det_n, det_counts = jax.vmap(
+                lambda st, z, m, a: detect_append(st, z, m, a, **dpar)
+            )(det_g, zs, mask, det_armed)
+            # per-slot isolation extends to the detector: a rejected
+            # row's state writes back unchanged, its counts zero out
+            det_w = jnp.where(ok[:, None, None], det_n, det_g)
+            det_counts = jnp.where(ok[:, None, None], det_counts, 0)
+            new_det = det_a.at[rows].set(det_w)
+            if not gated:
+                rest = rest[:3] + rest[5:]
+            return (new_dyn, new_det) + rest + (
+                det_counts, detect_stats(det_w)
+            )
+
+        return _annotated(fn, UPDATE_ANNOTATION)
+
     # the convergence detector needs the (G, N) real-slot mask (host
     # series counts — padded Z rows cannot mark padding), so arming
     # steady_tol appends one trailing argument to the signature
@@ -781,6 +1029,7 @@ def make_arena_steady_update_fn(
     gate: Optional[GateSpec] = None,
     horizons: Optional[Tuple[int, ...]] = None,
     sequential_gate: bool = False,
+    detect: Optional[DetectSpec] = None,
 ):
     """A fresh jitted **arena steady** (frozen-gain) update kernel.
 
@@ -806,8 +1055,21 @@ def make_arena_steady_update_fn(
     the O(k·S³) QR.  With ``horizons`` the MEAN half of the fused
     forecast pass rides along (:func:`_steady_horizon_means`); the
     variance half is the frozen constant cached at freeze time.
+
+    With an enabled ``detect`` the signature is ``fn(dynamic, static,
+    steady_leaves, det, rows, real, y, mask, min_seen, det_min_seen)``
+    (the detector leaf donated fourth; :meth:`StateArena.
+    apply_steady_det`), with ``(det_counts, det_stats)`` appended last
+    and the new detector leaf second — the steady twin of
+    :func:`make_arena_update_fn`'s detect contract.  A row that was
+    NOT applied (not frozen, or time-invariance broke) carries its
+    detector state unchanged: those rows replay through the exact
+    kernel in the same service call, which accumulates them once.
     """
     gated = gate is not None and gate.enabled
+    det_on = detect is not None and detect.enabled
+    if det_on:
+        detect.validate()
     if gated:
         gate.validate()
         policy, nsigma = gate.policy, float(gate.nsigma)
@@ -847,16 +1109,46 @@ def make_arena_steady_update_fn(
             extra = (zs, verdicts)
         if hz:
             extra = extra + (_steady_horizon_means(ss, mean_w, hz),)
-        return (new_dyn, applied, sigma, detf) + extra
+        return (new_dyn, applied, sigma, detf) + extra, zs
 
-    if gated:
+    if det_on:
+        dpar = detect.kernel_params
+
+        @functools.partial(jax.jit, donate_argnums=(0, 3))
+        def fn(dyn, static, steady_leaves, det_a, rows, real, y, mask,
+               min_seen, det_min_seen):
+            armed = (
+                dyn[2][rows] >= min_seen if gated
+                else jnp.zeros(rows.shape, bool)
+            )
+            det_armed = dyn[2][rows] >= det_min_seen
+            out, zs = _body(dyn, static, steady_leaves, rows, real, y,
+                            mask, armed)
+            new_dyn, rest = out[0], out[1:]
+            applied = rest[0]
+            det_g = det_a[rows]
+            det_n, det_counts = jax.vmap(
+                lambda st, z, m, a: detect_append(st, z, m, a, **dpar)
+            )(det_g, zs, mask, det_armed)
+            # an unapplied row replays through the exact kernel, which
+            # accumulates its observations exactly once — carry here
+            det_w = jnp.where(applied[:, None, None], det_n, det_g)
+            det_counts = jnp.where(
+                applied[:, None, None], det_counts, 0
+            )
+            new_det = det_a.at[rows].set(det_w)
+            return (new_dyn, new_det) + rest + (
+                det_counts, detect_stats(det_w)
+            )
+
+    elif gated:
 
         @functools.partial(jax.jit, donate_argnums=(0,))
         def fn(dyn, static, steady_leaves, rows, real, y, mask,
                min_seen):
             armed = dyn[2][rows] >= min_seen
             return _body(dyn, static, steady_leaves, rows, real, y,
-                         mask, armed)
+                         mask, armed)[0]
 
     else:
 
@@ -864,7 +1156,7 @@ def make_arena_steady_update_fn(
         def fn(dyn, static, steady_leaves, rows, real, y, mask):
             armed = jnp.zeros(rows.shape, bool)
             return _body(dyn, static, steady_leaves, rows, real, y,
-                         mask, armed)
+                         mask, armed)[0]
 
     return _annotated(fn, UPDATE_ANNOTATION)
 
@@ -924,6 +1216,7 @@ def forecast_bucket(ss, mean, cov, steps: int):
 
 __all__ = [
     "BucketBatch",
+    "DetectSpec",
     "FORECAST_ANNOTATION",
     "GateSpec",
     "SteadySpec",

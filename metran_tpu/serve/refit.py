@@ -874,6 +874,13 @@ class RefitWorker:
             svc._thaw_dict(model_id, "refit_promoted")
             if svc.smoother is not None:
                 svc.smoother.forget(model_id)
+            if svc.detector is not None:
+                # evidence and alerts accumulated against the replaced
+                # parameters must not page or re-trigger on the new
+                # ones (the arena leaf/dict state already reset via
+                # registry.put's re-pack / version discontinuity)
+                svc.detector.forget(model_id)
+                svc.alert_board.forget(model_id)
             self.tail.restart(model_id, new_state)
         swap_s = time.perf_counter() - t0
         self.swap_latencies.append(swap_s)

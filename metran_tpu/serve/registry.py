@@ -34,6 +34,8 @@ from logging import getLogger
 from pathlib import Path
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
+import numpy as np
+
 from ..io import sweep_stale_tmps
 from ..parallel.mesh import pad_to_multiple
 from ..reliability.policy import StateIntegrityError
@@ -961,8 +963,21 @@ class ModelRegistry:
         # is [sdf * n_pad | cdf...], so n_state_pad >= n_pad always
         return (n_pad, pad_to_multiple(n_pad + state.n_factors, m))
 
+    @staticmethod
+    def _detect_key(detect) -> tuple:
+        """The compile-key suffix of an enabled detect spec (its
+        static threshold half — the traced ``min_seen``/state never
+        recompile), or ``()``."""
+        if detect is None or not getattr(detect, "enabled", False):
+            return ()
+        return (
+            "det", float(detect.cusum_k), float(detect.cusum_h),
+            int(detect.lb_window), float(detect.lb_thresh),
+            float(detect.nsigma),
+        )
+
     def update_fn(self, bucket: ShapeBucket, k: int, gate=None,
-                  horizons=None):
+                  horizons=None, detect=None):
         """Compiled assimilation kernel for ``k`` appended steps.
 
         ``gate`` (an enabled :class:`~metran_tpu.serve.engine.
@@ -972,7 +987,10 @@ class ModelRegistry:
         never recompile (that knob is the kernel's traced ``armed``
         argument).  A non-empty ``horizons`` tuple selects the fused
         commit-time forecast variant (``serve.readpath``) — the
-        horizon set is XLA-static, so it joins the key too."""
+        horizon set is XLA-static, so it joins the key too.  An
+        enabled ``detect`` (:class:`~metran_tpu.serve.engine.
+        DetectSpec`) selects the fused streaming-detection variant;
+        its static thresholds join the key the same way."""
         from .engine import make_update_fn
 
         key = ("update", bucket, int(k), self.engine)
@@ -981,9 +999,11 @@ class ModelRegistry:
         if horizons:
             horizons = tuple(int(h) for h in horizons)
             key = key + ("hz", horizons)
+        key = key + self._detect_key(detect)
         return self._compiled.get_or_create(
             key, lambda: make_update_fn(
-                engine=self.engine, gate=gate, horizons=horizons
+                engine=self.engine, gate=gate, horizons=horizons,
+                detect=detect,
             ),
         )
 
@@ -998,7 +1018,7 @@ class ModelRegistry:
 
     def arena_update_fn(self, bucket: ShapeBucket, k: int, gate=None,
                         validate: bool = True, horizons=None,
-                        steady_tol: float = 0.0):
+                        steady_tol: float = 0.0, detect=None):
         """Compiled arena assimilation kernel (donating, in-place) for
         ``k`` appended steps — same compile-key discipline as
         :meth:`update_fn` plus the ``validate`` bit (the on-device
@@ -1017,16 +1037,18 @@ class ModelRegistry:
             key = key + ("hz", horizons)
         if steady_tol > 0.0:
             key = key + ("conv", float(steady_tol))
+        key = key + self._detect_key(detect)
         return self._compiled.get_or_create(
             key,
             lambda: make_arena_update_fn(
                 engine=self.engine, gate=gate, validate=validate,
                 horizons=horizons, steady_tol=float(steady_tol),
+                detect=detect,
             ),
         )
 
     def steady_update_fn(self, bucket: ShapeBucket, k: int, gate=None,
-                         horizons=None):
+                         horizons=None, detect=None):
         """Compiled **steady** (frozen-gain, mean-only) update kernel
         for ``k`` appended steps — the dict-registry bounded-cost hot
         path (:func:`~metran_tpu.serve.engine.make_steady_update_fn`).
@@ -1050,15 +1072,17 @@ class ModelRegistry:
         if horizons:
             horizons = tuple(int(h) for h in horizons)
             key = key + ("hz", horizons)
+        key = key + self._detect_key(detect)
         return self._compiled.get_or_create(
             key,
             lambda: make_steady_update_fn(
-                gate=gate, horizons=horizons, sequential_gate=seq
+                gate=gate, horizons=horizons, sequential_gate=seq,
+                detect=detect,
             ),
         )
 
     def arena_steady_update_fn(self, bucket: ShapeBucket, k: int,
-                               gate=None, horizons=None):
+                               gate=None, horizons=None, detect=None):
         """Compiled **arena steady** update kernel (donating, mean-only
         scatter) — :func:`~metran_tpu.serve.engine.
         make_arena_steady_update_fn` under the same LRU and gate-form
@@ -1077,12 +1101,47 @@ class ModelRegistry:
         if horizons:
             horizons = tuple(int(h) for h in horizons)
             key = key + ("hz", horizons)
+        key = key + self._detect_key(detect)
         return self._compiled.get_or_create(
             key,
             lambda: make_arena_steady_update_fn(
-                gate=gate, horizons=horizons, sequential_gate=seq
+                gate=gate, horizons=horizons, sequential_gate=seq,
+                detect=detect,
             ),
         )
+
+    def arena_detect_stats(self, model_id: Optional[str] = None):
+        """Live per-slot detection statistics of resident models:
+        ``{model_id: (stats (3, n), n_series, version, t_seen)}`` with
+        ``stats`` rows ``[cusum_pos, cusum_neg, lb_q]``, computed from
+        one bulk read of each arena's detector leaf per query.  The
+        query path pays the device read so the bulk update path never
+        pays a per-dispatch stats transfer (the <3% overhead bar);
+        ``StateArena.det_stats_host`` keeps the last-alarm view."""
+        from ..ops.detect import detect_stats
+
+        out = {}
+        with self._arena_lock:
+            by_bucket: Dict[ShapeBucket, list] = {}
+            for mid, (bucket, row) in self._row_map.items():
+                if model_id is not None and mid != model_id:
+                    continue
+                arena = self._arenas.get(bucket)
+                if arena is None or arena.lost:
+                    continue
+                by_bucket.setdefault(bucket, []).append((mid, row))
+            for bucket, entries in by_bucket.items():
+                arena = self._arenas[bucket]
+                det = arena.read_det_rows([r for _, r in entries])
+                stats = np.asarray(detect_stats(det))
+                for (mid, row), st in zip(entries, stats):
+                    n = int(arena.n_series_host[row])
+                    out[mid] = (
+                        st[:, :n].copy(), n,
+                        int(arena.version_host[row]),
+                        int(arena.t_seen_host[row]),
+                    )
+        return out
 
     def steady_rows_count(self) -> int:
         """Frozen (steady) rows across every arena — the

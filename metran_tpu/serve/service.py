@@ -74,8 +74,10 @@ from ..reliability.policy import (
     is_retryable,
 )
 from ..utils.profiling import EventCounters, LatencyRecorder, OccupancyCounter
+from ..ops.detect import DETECT_STATE_ROWS
 from .batching import MicroBatcher
-from .engine import GateSpec, SteadySpec
+from .engine import DetectSpec, GateSpec, SteadySpec
+from .monitoring import AlertBoard, DetectorMirror
 from .readpath import ForecastSnapshot, SnapshotEntry, SnapshotStore, \
     parse_horizons
 from .refit import RefitSpec, RefitWorker
@@ -241,6 +243,40 @@ class Forecast(NamedTuple):
     version: int
 
 
+class Decomposition(NamedTuple):
+    """Counterfactual split of a model's recent smoothed heads into
+    specific vs common-factor contributions, data units
+    (:meth:`MetranService.decompose`).
+
+    Per window step and series,
+    ``total = offset + sdf + sum_k cdf[k]`` exactly: ``total`` is the
+    fixed-lag smoothed observation-space mean (what
+    :meth:`MetranService.smoothed` serves), ``sdf`` the series' own
+    AR(1) (specific dynamic factor) contribution, ``cdf[k]`` the
+    loading-weighted contribution of common factor ``k``, and
+    ``offset`` the static per-series standardization mean (the datum —
+    it moves with neither).  The ``delta_*`` fields split the window's
+    **movement** (``x[-1] - x[0]``) the same way — the online answer
+    to "how much of this head drop is the regional common factor?".
+
+    ``total``/``sdf`` are (lag, n_series); ``cdf`` is (n_factors, lag,
+    n_series); ``delta_total``/``delta_sdf`` (n_series,); ``delta_cdf``
+    (n_factors, n_series); ``t_end`` the grid index of the last
+    smoothed step; ``lag`` the realized window length.
+    """
+
+    total: np.ndarray
+    sdf: np.ndarray
+    cdf: np.ndarray
+    offset: np.ndarray
+    delta_total: np.ndarray
+    delta_sdf: np.ndarray
+    delta_cdf: np.ndarray
+    names: Tuple[str, ...]
+    t_end: int
+    lag: int
+
+
 class ArenaUpdateAck(NamedTuple):
     """What an **arena-path** update resolves to.
 
@@ -298,6 +334,12 @@ class ServeMetrics:
     steady_transitions: EventCounters = field(
         default_factory=EventCounters
     )
+    #: streaming-detection outcomes by kind (``anomaly`` — a single
+    #: observation past the outlier bar; ``changepoint_cusum`` /
+    #: ``changepoint_lb`` — CUSUM / autocorrelation-drift alarm
+    #: episodes; ``alert_raised`` / ``alert_cleared`` — alert
+    #: lifecycle transitions)
+    detect_total: EventCounters = field(default_factory=EventCounters)
     #: gate-score histogram (squared normalized innovation per observed
     #: slot); only present on registry-backed instances
     gate_scores: Optional[object] = None
@@ -347,6 +389,13 @@ class ServeMetrics:
                 name="metran_serve_steady_transitions_total",
                 help="steady-state serving transitions by kind "
                      "(freeze, thaw)",
+            ),
+            detect_total=EventCounters(
+                registry=registry,
+                name="metran_serve_detect_total",
+                help="streaming-detection outcomes by kind (anomaly, "
+                     "changepoint_cusum, changepoint_lb, alert_raised, "
+                     "alert_cleared)",
             ),
             gate_scores=registry.histogram(
                 "metran_serve_gate_score",
@@ -445,6 +494,21 @@ class MetranService:
         one-step deviance, and winners hot-swap through
         ``registry.put`` under the update lock — see docs/concepts.md
         "Continuous adaptation".
+    detect : online monitoring policy
+        (:class:`~metran_tpu.serve.engine.DetectSpec`; default from
+        ``serve_defaults()`` — ``METRAN_TPU_SERVE_DETECT*``, shipped
+        off).  Enabled, every update dispatch also advances streaming
+        per-slot **anomaly**, **CUSUM changepoint** and
+        **autocorrelation-drift** statistics over the kernel's
+        normalized innovations — fused into the same launch, detector
+        state carried as one more arena leaf / host mirror.  Outcomes
+        are booked (``metran_serve_detect_total`` counters,
+        ``anomaly``/``changepoint`` events), :meth:`alerts` serves the
+        raise/clear alert lifecycle, :meth:`anomalies` the per-model
+        statistics, :meth:`decompose` the online counterfactual
+        sdf/cdf split, and a detected changepoint feeds
+        ``HealthMonitor.refit_candidates`` so a structural break
+        schedules a refit.  See docs/concepts.md "Online monitoring".
     """
 
     def __init__(
@@ -461,6 +525,7 @@ class MetranService:
         steady: Optional[SteadySpec] = None,
         fixed_lag: Optional[int] = None,
         refit: Optional[RefitSpec] = None,
+        detect: Optional[DetectSpec] = None,
     ):
         from ..config import serve_defaults
 
@@ -523,6 +588,23 @@ class MetranService:
         self.smoother = (
             FixedLagTracker(fixed_lag) if fixed_lag > 0 else None
         )
+        # online monitoring (serve.monitoring + ops.detect): streaming
+        # anomaly/changepoint/autocorrelation-drift detection fused
+        # into the update kernels, alerting with raise/clear
+        # hysteresis, changepoint-triggered refits; shipped off
+        self.detect = (
+            detect.validate() if detect is not None
+            else DetectSpec.from_defaults()
+        )
+        self.detector: Optional[DetectorMirror] = None
+        self.alert_board: Optional[AlertBoard] = None
+        if self.detect.enabled:
+            self.detector = DetectorMirror()
+            self.alert_board = AlertBoard(
+                cooldown_s=self.detect.alert_cooldown_s,
+                events=self.events,
+                counter=self.metrics.detect_total,
+            )
         # materialized forecast read path (serve.readpath): commit-time
         # snapshots served lock-free, version-checked against every
         # registry commit; a miss/stale read falls through to the
@@ -619,6 +701,14 @@ class MetranService:
                 "steady-state gain (the bounded-cost hot path)",
                 callback=lambda: float(self._steady_count()),
             )
+            if self.alert_board is not None:
+                board = self.alert_board
+                m.gauge(
+                    "metran_serve_alerts_active",
+                    "currently-active detection alerts "
+                    "(raise/clear hysteresis applied at read time)",
+                    callback=lambda: float(board.active_count()),
+                )
         # continuous adaptation (serve.refit): a worker attaches via
         # _attach_refit (arming tail recording on the dispatch paths);
         # the service owns — and closes — one it constructed itself
@@ -815,6 +905,199 @@ class MetranService:
             logger.exception(
                 "fixed-lag tracking failed for model %r", model_id
             )
+
+    # ------------------------------------------------------------------
+    # online monitoring (serve.monitoring + ops.detect)
+    # ------------------------------------------------------------------
+    def _book_detect(self, model_id: str, counts, stats, version: int,
+                     t_seen: int, names, n_series: int, state=None,
+                     request_id=None, reset_on_gap: bool = True) -> None:
+        """Book one committed slot's detection outcome: mirror update,
+        counters, ``anomaly``/``changepoint`` events, the health
+        monitor's changepoint flag, and the alert board.
+
+        ``counts``/``stats`` are the model's real-series slices
+        ((3, n) each); ``state`` is the advanced (6, n) accumulator on
+        dict registries (arena registries keep it in the device leaf).
+        Never raises past its caller's guard — the update is already
+        applied, and monitoring must not relabel it."""
+        per_kind = np.asarray(counts).sum(axis=1)
+        n_an, n_cp, n_lb = (int(x) for x in per_kind)
+        flagged = np.flatnonzero(np.asarray(counts).sum(axis=0) > 0)
+        slots = tuple(names[int(j)] for j in flagged)
+        self.detector.commit(
+            model_id, version, t_seen, n_series, stats, per_kind,
+            state=state, slots=slots, reset_on_gap=reset_on_gap,
+        )
+        if not (n_an or n_cp or n_lb):
+            return
+        booked = self.metrics.detect_total
+        if n_an:
+            booked.increment("anomaly", n_an)
+        if n_cp:
+            booked.increment("changepoint_cusum", n_cp)
+        if n_lb:
+            booked.increment("changepoint_lb", n_lb)
+        if n_an:
+            if self.events is not None:
+                self.events.emit(
+                    "anomaly", model_id=model_id,
+                    request_id=request_id,
+                    fault_point="serve.detect", count=n_an,
+                    slots=list(slots), t_seen=int(t_seen),
+                )
+            self.alert_board.note(model_id, "anomaly", n_an, slots)
+        if n_cp or n_lb:
+            if self.events is not None:
+                self.events.emit(
+                    "changepoint", model_id=model_id,
+                    request_id=request_id,
+                    fault_point="serve.detect", cusum=n_cp,
+                    lb_drift=n_lb, slots=list(slots),
+                    t_seen=int(t_seen),
+                )
+            # a detected structural break SCHEDULES a refit (its own
+            # trigger next to gate degradation/staleness) — see
+            # HealthMonitor.refit_candidates
+            self.monitor.record_changepoint(model_id)
+            self.alert_board.note(
+                model_id, "changepoint", n_cp + n_lb, slots
+            )
+
+    def _book_detect_rows(self, ids, rows_arr, ok, versions, t_seens,
+                          counts, stat_parts, arena) -> None:
+        """Arena-bulk detection booking — reached only when a
+        dispatch actually ALARMED: the per-branch device-side stats
+        are materialized here (never on the clean hot path), the
+        alarming rows' stats land in the arena's last-alarm host
+        mirror, and only alarming rows pay per-model booking."""
+        stats = np.zeros((len(ids), counts.shape[1], counts.shape[2]))
+        for pos, dev_stats in stat_parts:
+            stats[pos] = np.asarray(dev_stats)[: len(pos)]
+        counts_sum = counts.sum(axis=(1, 2))
+        alarming = np.flatnonzero((counts_sum > 0) & ok)
+        with arena.lock:
+            arena.det_stats_host[rows_arr[alarming]] = stats[alarming]
+        for gi in alarming:
+            n_i = int(arena.n_series_host[rows_arr[gi]])
+            try:
+                self._book_detect(
+                    ids[gi], counts[gi][:, :n_i],
+                    stats[gi][:, :n_i], int(versions[gi]),
+                    int(t_seens[gi]),
+                    self.registry.meta(ids[gi]).names, n_i,
+                    reset_on_gap=False,
+                )
+            except Exception:  # pragma: no cover - monitoring only
+                logger.exception(
+                    "detection booking failed for model %r", ids[gi]
+                )
+
+    def anomalies(self, model_id: Optional[str] = None) -> dict:
+        """Per-model streaming-detection snapshot (requires
+        ``MetranService(detect=DetectSpec(enabled=True))`` /
+        ``METRAN_TPU_SERVE_DETECT=1``).
+
+        Returns ``{model_id: {...}}`` with, per model: the live
+        per-slot CUSUM accumulators (``cusum_pos``/``cusum_neg``) and
+        autocorrelation-drift statistic (``lb_q``) — read from host
+        mirrors, never the device — plus cumulative ``anomalies`` /
+        ``cusum_alarms`` / ``lb_alarms`` counts, the stream position
+        of the last alarm, and the flagged slot tally.  On an arena
+        registry the per-slot statistics come from the arena's host
+        mirror (refreshed every dispatch); evicting a model resets its
+        accumulators like any row re-pack.
+        """
+        if not self.detect.enabled:
+            raise ValueError(
+                "streaming detection is disabled; construct the "
+                "service with detect=DetectSpec(enabled=True) or set "
+                "METRAN_TPU_SERVE_DETECT=1"
+            )
+        if model_id is not None:
+            self.registry.meta(model_id)  # unknown ids raise KeyError
+        snap = self.detector.snapshot(model_id)
+        if self.registry.arena_enabled:
+            live = self.registry.arena_detect_stats(model_id)
+            for mid, (stats, n, version, t_seen) in live.items():
+                entry = snap.get(mid)
+                if entry is None:
+                    entry = snap[mid] = {
+                        "anomalies": 0, "cusum_alarms": 0,
+                        "lb_alarms": 0, "last_alarm_t_seen": None,
+                        "slots_flagged": {},
+                    }
+                entry.update(
+                    version=version, t_seen=t_seen,
+                    cusum_pos=stats[0].tolist(),
+                    cusum_neg=stats[1].tolist(),
+                    lb_q=stats[2].tolist(),
+                )
+        return snap
+
+    def alerts(self, model_id: Optional[str] = None,
+               active_only: bool = True) -> list:
+        """Alert records, newest raise first (see
+        :class:`~metran_tpu.serve.monitoring.AlertBoard`): one record
+        per detection episode with raise/clear hysteresis applied —
+        what a pager integration consumes.  Requires detection to be
+        armed, like :meth:`anomalies`."""
+        if not self.detect.enabled:
+            raise ValueError(
+                "streaming detection is disabled; construct the "
+                "service with detect=DetectSpec(enabled=True) or set "
+                "METRAN_TPU_SERVE_DETECT=1"
+            )
+        return self.alert_board.alerts(model_id, active_only=active_only)
+
+    def decompose(self, model_id: str,
+                  lag: Optional[int] = None) -> Decomposition:
+        """Online counterfactual query: split the model's recent
+        smoothed head movement into its specific (sdf) vs
+        loading-weighted common-factor (cdf) contributions — "how much
+        of this drop is the regional factor?" — served from the
+        fixed-lag smoothed states at O(L) cost (requires
+        ``MetranService(fixed_lag=L)``, like :meth:`smoothed`).
+
+        The split is the source paper's decomposition
+        (:func:`metran_tpu.ops.decompose_states`) evaluated on the
+        smoothed recent window instead of the offline full history;
+        on the overlap window the two agree exactly (the fixed-lag
+        window is bit-identical (f64) to the full smoother's last L
+        steps — tests pin ``<= 1e-8``).  Data units; see
+        :class:`Decomposition` for the exact identity.
+        """
+        from ..ops import decompose_states, dfm_statespace
+
+        win = self.smoothed(model_id, lag)
+        meta = self.registry.meta(model_id)
+        n = meta.n_series
+        params = np.asarray(meta.params, float)
+        ss = dfm_statespace(
+            params[:n], params[n:],
+            np.asarray(meta.loadings, float), float(meta.dt),
+        )
+        sdf_s, cdf_s = decompose_states(ss.z, win.state_means, n)
+        std = np.asarray(meta.scaler_std, float)
+        sdf = np.asarray(sdf_s) * std
+        cdf = np.asarray(cdf_s) * std
+        total = np.asarray(win.means)
+        delta = (
+            lambda x: x[..., -1, :] - x[..., 0, :]
+            if x.shape[-2] > 1 else np.zeros(x.shape[:-2] + x.shape[-1:])
+        )
+        return Decomposition(
+            total=total,
+            sdf=sdf,
+            cdf=cdf,
+            offset=np.asarray(meta.scaler_mean, float),
+            delta_total=delta(total),
+            delta_sdf=delta(sdf),
+            delta_cdf=delta(cdf),
+            names=win.names,
+            t_end=win.t_end,
+            lag=win.lag,
+        )
 
     # ------------------------------------------------------------------
     # public API
@@ -1956,6 +2239,14 @@ class MetranService:
                 "lag": self.smoother.lag,
                 "tracked": len(self.smoother),
             }} if self.smoother is not None else {}),
+            **({"detect": {
+                "tracked": len(self.detector),
+                "alerts": self.alert_board.stats(),
+                "changepoints_pending": (
+                    self.monitor.changepoint_models()
+                ),
+                **self.metrics.detect_total.snapshot(),
+            }} if self.detect.enabled else {}),
             **({"refit": self._refit_worker.stats()}
                if self._refit_worker is not None else {}),
         })
@@ -2410,19 +2701,46 @@ class MetranService:
             np.arange(n_pad)[None, :]
             < np.array([st.n_series for st in kstates])[:, None]
         )
+        det = self.detect if self.detect.enabled else None
         fn = self.registry.steady_update_fn(
             bucket, k, gate=gate if gated else None,
             horizons=self.horizons if rp is not None else None,
+            detect=det,
         )
         tracer = self.tracer
         t_eng0 = tracer.clock() if tracer is not None else None
-        if gated:
-            armed = np.array(
+        armed = (
+            np.array(
                 [st.t_seen >= gate.min_seen for st in kstates], bool
+            ) if gated else None
+        )
+        if det is not None:
+            # detect signature always carries the gate-armed flags
+            # (zeros when the gate is off) + the detector state
+            outs = fn(
+                batch.ss, batch.mean, kg, fd, real, y, m,
+                armed if gated else np.zeros(len(kstates), bool),
+                self.detector.stack(
+                    [st.model_id for st in kstates],
+                    [st.version for st in kstates],
+                    n_pad, DETECT_STATE_ROWS, kstates[0].dtype,
+                ),
+                np.array(
+                    [st.t_seen >= det.min_seen for st in kstates],
+                    bool,
+                ),
             )
+        elif gated:
             outs = fn(batch.ss, batch.mean, kg, fd, real, y, m, armed)
         else:
             outs = fn(batch.ss, batch.mean, kg, fd, real, y, m)
+        det_new = det_counts = det_stats = None
+        if det is not None:
+            det_new, det_counts, det_stats = (
+                np.asarray(outs[-3]), np.asarray(outs[-2]),
+                np.asarray(outs[-1]),
+            )
+            outs = outs[:-3]
         fm_t = z_t = verdict_t = None
         if rp is not None:
             fm_t, outs = np.asarray(outs[-1]), outs[:-1]
@@ -2502,6 +2820,24 @@ class MetranService:
                     ),
                     version=new_state.version,
                 )
+                if det is not None:
+                    try:
+                        n = st.n_series
+                        self._book_detect(
+                            st.model_id, det_counts[i][:, :n],
+                            det_stats[i][:, :n], new_state.version,
+                            new_state.t_seen, st.names, n,
+                            state=det_new[i][:, :n],
+                            request_id=(
+                                trace_ctx.trace_id
+                                if trace_ctx is not None else None
+                            ),
+                        )
+                    except Exception:  # pragma: no cover - monitoring
+                        logger.exception(
+                            "detection booking failed for model %r",
+                            st.model_id,
+                        )
                 if rp is not None and info.hvars is not None:
                     # its OWN guard, like the exact path's: the
                     # update IS applied — a cache-build hiccup must
@@ -2575,14 +2911,31 @@ class MetranService:
         # pass (serve.readpath): the kernel appends (B, H, N) forecast
         # moments of the NEW posteriors — same dispatch, no second
         # launch
+        det = self.detect if self.detect.enabled else None
         fn = self.registry.update_fn(
             bucket, k, gate=gate if gated else None,
             horizons=self.horizons if rp is not None else None,
+            detect=det,
         )
         tracer = self.tracer
         t_eng0 = tracer.clock() if tracer is not None else None
         chol_t = cov_t = z_t = verdict_t = None
         fac_b = batch.chol if sqrt_engine else batch.cov
+        det_args = ()
+        if det is not None:
+            # the carried detector accumulators ride the dispatch (the
+            # dict-registry twin of the arena's detector leaf), zeroed
+            # for first-touch models and on version discontinuities
+            det_args = (
+                self.detector.stack(
+                    [st.model_id for st in states],
+                    [st.version for st in states],
+                    n_pad, DETECT_STATE_ROWS, states[0].dtype,
+                ),
+                np.array(
+                    [st.t_seen >= det.min_seen for st in states], bool
+                ),
+            )
         if gated:
             # the gate disarms per model below min_seen assimilated
             # steps (a cold filter's innovations are over-dispersed
@@ -2592,9 +2945,17 @@ class MetranService:
             armed = np.array(
                 [st.t_seen >= gate.min_seen for st in states], bool
             )
-            outs = fn(batch.ss, batch.mean, fac_b, y, m, armed)
+            outs = fn(batch.ss, batch.mean, fac_b, y, m, armed,
+                      *det_args)
         else:
-            outs = fn(batch.ss, batch.mean, fac_b, y, m)
+            outs = fn(batch.ss, batch.mean, fac_b, y, m, *det_args)
+        det_new = det_counts = det_stats = None
+        if det is not None:
+            det_new, det_counts, det_stats = (
+                np.asarray(outs[-3]), np.asarray(outs[-2]),
+                np.asarray(outs[-1]),
+            )
+            outs = outs[:-3]
         fm_t = fv_t = None
         if rp is not None:
             fm_t, fv_t = np.asarray(outs[-2]), np.asarray(outs[-1])
@@ -2806,6 +3167,26 @@ class MetranService:
                 ),
                 version=new_state.version,
             )
+            if det is not None:
+                # its OWN guard: the update is applied, and a
+                # monitoring hiccup must never relabel it failed
+                try:
+                    n = st.n_series
+                    self._book_detect(
+                        st.model_id, det_counts[i][:, :n],
+                        det_stats[i][:, :n], new_state.version,
+                        new_state.t_seen, st.names, n,
+                        state=det_new[i][:, :n],
+                        request_id=(
+                            trace_ctx.trace_id
+                            if trace_ctx is not None else None
+                        ),
+                    )
+                except Exception:  # pragma: no cover - monitoring
+                    logger.exception(
+                        "detection booking failed for model %r",
+                        st.model_id,
+                    )
             if steady_on and st.model_id not in self._steady_info:
                 # freeze detection: converged factor + fully-observed
                 # append + warm enough + no gate verdicts.  Its OWN
@@ -2991,6 +3372,7 @@ class MetranService:
         gated = gate.enabled
         validate = self.reliability.validate_updates
         rp = self.readpath
+        det = self.detect if self.detect.enabled else None
         steady = self.steady if self.steady.enabled else None
         g = len(rows_arr)
         n_pad = bucket[0]
@@ -3002,6 +3384,14 @@ class MetranService:
         n_hz = len(self.horizons) if rp is not None else 0
         fm = np.zeros((g, n_hz, n_pad)) if rp is not None else None
         fv = np.zeros((g, n_hz, n_pad)) if rp is not None else None
+        det_counts = (
+            np.zeros((g, 3, n_pad), np.int64) if det is not None
+            else None
+        )
+        # stats stay DEVICE-side per branch until an alarm actually
+        # needs them: a per-dispatch (G, 3, N) transfer + mirror write
+        # measurably ate into the <3% overhead bar on clean streams
+        det_stat_parts: list = []
         sel = np.zeros(g, bool)
         if steady is not None:
             sel = arena.steady_host[rows_arr].copy()
@@ -3022,6 +3412,7 @@ class MetranService:
             fn = self.registry.arena_steady_update_fn(
                 bucket, k, gate=gate if gated else None,
                 horizons=self.horizons if rp is not None else None,
+                detect=det,
             )
             rows_p, (real_p, y_p, m_p) = self._pad_dispatch(
                 rows_s, arena.scratch_row,
@@ -3029,7 +3420,13 @@ class MetranService:
             )
             fm_s = None
             with arena.lock:
-                if gated:
+                if det is not None:
+                    outs = arena.apply_steady_det(
+                        fn, rows_p, real_p, y_p, m_p,
+                        np.int32(gate.min_seen if gated else 0),
+                        np.int32(det.min_seen),
+                    )
+                elif gated:
                     outs = arena.apply_steady(
                         fn, rows_p, real_p, y_p, m_p,
                         np.int32(gate.min_seen),
@@ -3038,10 +3435,17 @@ class MetranService:
                     outs = arena.apply_steady(
                         fn, rows_p, real_p, y_p, m_p
                     )
+                if det is not None:
+                    outs, dc_s, dst_s = (
+                        outs[:-2], np.asarray(outs[-2]), outs[-1]
+                    )
                 if rp is not None:
                     outs, fm_s = outs[:-1], np.asarray(outs[-1])
                 applied = np.asarray(outs[0])[: len(s_pos)]
                 vers, ts = arena.commit_rows(rows_s, applied, k)
+            if det is not None:
+                det_counts[s_pos] = dc_s[: len(s_pos)]
+                det_stat_parts.append((s_pos, dst_s))
             ok[s_pos] = applied
             versions[s_pos] = vers
             t_seens[s_pos] = ts
@@ -3078,6 +3482,7 @@ class MetranService:
                 validate=validate,
                 horizons=self.horizons if rp is not None else None,
                 steady_tol=steady.tol if steady is not None else 0.0,
+                detect=det,
             )
             rows_p, (real_p, y_p, m_p) = self._pad_dispatch(
                 rows_e, arena.scratch_row,
@@ -3085,7 +3490,16 @@ class MetranService:
             )
             conv = None
             with arena.lock:
-                if gated and steady is not None:
+                if det is not None:
+                    # the detect kernel has ONE signature (engine.py):
+                    # gate/steady args always present, unused halves
+                    # traced out by XLA
+                    outs = arena.apply_det(
+                        fn, rows_p, y_p, m_p,
+                        np.int32(gate.min_seen if gated else 0),
+                        real_p, np.int32(det.min_seen),
+                    )
+                elif gated and steady is not None:
                     outs = arena.apply(
                         fn, rows_p, y_p, m_p,
                         np.int32(gate.min_seen), real_p,
@@ -3098,6 +3512,10 @@ class MetranService:
                     outs = arena.apply(fn, rows_p, y_p, m_p, real_p)
                 else:
                     outs = arena.apply(fn, rows_p, y_p, m_p)
+                if det is not None:
+                    outs, dc_e, dst_e = (
+                        outs[:-2], np.asarray(outs[-2]), outs[-1]
+                    )
                 if steady is not None:
                     outs, conv = (
                         outs[:-1], np.asarray(outs[-1])[: len(e_pos)]
@@ -3109,6 +3527,9 @@ class MetranService:
                     )
                 ok_e = np.asarray(outs[0])[: len(e_pos)]
                 vers, ts = arena.commit_rows(rows_e, ok_e, k)
+            if det is not None:
+                det_counts[e_pos] = dc_e[: len(e_pos)]
+                det_stat_parts.append((e_pos, dst_e))
             ok[e_pos] = ok_e
             versions[e_pos] = vers
             t_seens[e_pos] = ts
@@ -3148,6 +3569,13 @@ class MetranService:
             # scaler mirrors in place
             self._publish_arena_snapshot(
                 bucket, arena, rows_arr, versions, fm, fv, ids, names
+            )
+        if det is not None and det_counts.any():
+            # only dispatches that actually ALARMED pay any further
+            # host work (stats materialization, mirror, events)
+            self._book_detect_rows(
+                ids, rows_arr, ok, versions, t_seens, det_counts,
+                det_stat_parts, arena,
             )
         return ok, versions, t_seens, zs, verdicts
 
@@ -3370,4 +3798,10 @@ class MetranService:
         return results
 
 
-__all__ = ["ArenaUpdateAck", "Forecast", "MetranService", "ServeMetrics"]
+__all__ = [
+    "ArenaUpdateAck",
+    "Decomposition",
+    "Forecast",
+    "MetranService",
+    "ServeMetrics",
+]

@@ -653,6 +653,28 @@ class StateArena:
         #: host mirror of the device steady flags — the dispatch-time
         #: row partition reads this, never the device
         self.steady_host = np.zeros(capacity, bool)
+        # --- streaming-detection leaf (docs/concepts.md "Online
+        # monitoring"): each row's per-slot detector accumulators
+        # ([C+, C-, z_prev, S_zz, S_z2, n_eff] — ops/detect.py),
+        # advanced in place by the fused detect update kernels
+        # (donated alongside the dynamic leaves, `apply_det`) and
+        # RESET by every (re)pack/clear like the steady leaves: a
+        # registry.put that replaced the posterior must never leave
+        # stale evidence accumulating against the new parameters.
+        # Zeros are the valid fresh state, so the leaf is inert when
+        # detection is off.
+        from ..ops.detect import DETECT_STATE_ROWS
+
+        self._det = _place(
+            np.zeros((capacity, DETECT_STATE_ROWS, n_pad), dt)
+        )
+        #: host mirror of each row's detection display statistics
+        #: ([C+, C-, LB-Q] per slot, `ops.detect.detect_stats`) at its
+        #: LAST ALARM — refreshed only by alarming dispatches (a
+        #: per-dispatch refresh measurably ate into the <3% overhead
+        #: bar); `registry.arena_detect_stats` serves LIVE values with
+        #: one read of the detector leaf per query instead
+        self.det_stats_host = np.zeros((capacity, 3, n_pad))
 
     # -- row bookkeeping ------------------------------------------------
     @property
@@ -730,6 +752,61 @@ class StateArena:
                 self._lost = True
                 raise
             return out[1:]
+
+    def apply_det(self, fn, *args):
+        """Run a donating **detect** update kernel ``fn(dynamic,
+        static, det, *args)`` (:func:`~metran_tpu.serve.engine.
+        make_arena_update_fn` with detection armed): the detector leaf
+        is donated alongside the dynamic leaves and both reference
+        swaps happen before the lock releases — the same donation
+        contract as :meth:`apply`, extended to the second donated
+        output."""
+        with self.lock:
+            self._check()
+            try:
+                out = fn(
+                    self._dynamic(), self._static(), self._det, *args
+                )
+                (self._mean, self._fac, self._t_seen, self._version) = out[0]
+                self._det = out[1]
+            except BaseException:
+                self._lost = True
+                raise
+            return out[2:]
+
+    def apply_steady_det(self, fn, *args):
+        """Run the donating **steady detect** kernel ``fn(dynamic,
+        static, steady_leaves, det, *args)`` — :meth:`apply_steady`
+        with the donated detector leaf threaded in like
+        :meth:`apply_det`."""
+        with self.lock:
+            self._check()
+            try:
+                out = fn(
+                    self._dynamic(), self._static(),
+                    self._steady_leaves(), self._det, *args,
+                )
+                (self._mean, self._fac, self._t_seen, self._version) = out[0]
+                self._det = out[1]
+            except BaseException:
+                self._lost = True
+                raise
+            return out[2:]
+
+    def read_det_row(self, row: int) -> np.ndarray:
+        """One row's detector accumulators back on the host ((6, N))."""
+        with self.lock:
+            self._check()
+            return np.asarray(self._det[row])
+
+    def read_det_rows(self, rows) -> np.ndarray:
+        """Bulk device→host gather of several rows' detector
+        accumulators ((R, 6, N), one transfer) — the
+        ``service.anomalies()`` query path."""
+        rows = np.asarray(rows, np.int64)
+        with self.lock:
+            self._check()
+            return np.asarray(self._det[rows])
 
     def query(self, fn, *args):
         """Run a read-only kernel ``fn(mean, fac, static, *args)``
@@ -835,6 +912,8 @@ class StateArena:
             np.asarray([state.dt], self.dtype),
         )
         n_pad, s_pad = self.bucket
+        from ..ops.detect import DETECT_STATE_ROWS
+
         vals = (
             mean, fac,
             np.int32(state.t_seen), np.int32(state.version),
@@ -844,12 +923,16 @@ class StateArena:
             # leave a stale frozen gain serving the new parameters
             False, np.zeros((s_pad, n_pad), self.dtype),
             np.ones(n_pad, self.dtype),
+            # ... and RESETS the detector accumulators: evidence
+            # gathered against the replaced posterior must not carry
+            np.zeros((DETECT_STATE_ROWS, n_pad), self.dtype),
         )
         with self.lock:
             self._check()
             if _ARENA_WRITE is None:
                 _ARENA_WRITE = _arena_write_fn()
-            leaves = self._dynamic() + self._static() + self._steady_leaves()
+            leaves = (self._dynamic() + self._static()
+                      + self._steady_leaves() + (self._det,))
             try:
                 new = _ARENA_WRITE(leaves, np.int32(row), vals)
             except BaseException:
@@ -857,8 +940,10 @@ class StateArena:
                 raise
             (self._mean, self._fac, self._t_seen, self._version) = new[:4]
             (self._phi, self._q, self._z, self._r) = new[4:8]
-            (self._steady, self._kgain, self._fdiag) = new[8:]
+            (self._steady, self._kgain, self._fdiag) = new[8:11]
+            self._det = new[11]
             self.steady_host[row] = False
+            self.det_stats_host[row] = 0.0
             self.t_seen_host[row] = int(state.t_seen)
             self.version_host[row] = int(state.version)
             self.dirty[row] = False
@@ -939,6 +1024,8 @@ class StateArena:
     def clear_row(self, row: int) -> None:
         """Reset ``row`` to the padded-slot identity values and return
         it to the free list (eviction's last step)."""
+        from ..ops.detect import DETECT_STATE_ROWS
+
         global _ARENA_WRITE
         n_pad, s_pad = self.bucket
         dt = self.dtype
@@ -948,12 +1035,14 @@ class StateArena:
             np.int32(0), np.int32(0),
             phi0, q0, z0, r0,
             False, np.zeros((s_pad, n_pad), dt), np.ones(n_pad, dt),
+            np.zeros((DETECT_STATE_ROWS, n_pad), dt),
         )
         with self.lock:
             self._check()
             if _ARENA_WRITE is None:
                 _ARENA_WRITE = _arena_write_fn()
-            leaves = self._dynamic() + self._static() + self._steady_leaves()
+            leaves = (self._dynamic() + self._static()
+                      + self._steady_leaves() + (self._det,))
             try:
                 new = _ARENA_WRITE(leaves, np.int32(row), vals)
             except BaseException:
@@ -961,8 +1050,10 @@ class StateArena:
                 raise
             (self._mean, self._fac, self._t_seen, self._version) = new[:4]
             (self._phi, self._q, self._z, self._r) = new[4:8]
-            (self._steady, self._kgain, self._fdiag) = new[8:]
+            (self._steady, self._kgain, self._fdiag) = new[8:11]
+            self._det = new[11]
             self.steady_host[row] = False
+            self.det_stats_host[row] = 0.0
             self.t_seen_host[row] = 0
             self.version_host[row] = 0
             self.dirty[row] = False

@@ -1,0 +1,106 @@
+"""Regression guard for bench.py's machine-readable final output.
+
+Rounds r01-r05 all recorded ``"parsed": null`` in their BENCH_r*.json
+captures because ``bench.py main()`` streamed the ever-growing
+multi-phase detail blob to stdout and the harness's final-line JSON
+parse choked on it.  PR 10 fixed the emitter (one compact final stdout
+line: headline metric + per-phase summary + a pointer to the detail
+artifact) — but nothing pinned it, so the next person to add a phase
+could silently regress the capture again.  These tests drive the REAL
+``main()`` emitter end to end: the ``METRAN_TPU_BENCH_DRY_RUN`` hook
+skips the phase children but runs the genuine final-line path —
+detail-file write, per-phase summary extraction, the single stdout
+JSON object the harness parses.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+BENCH = REPO / "bench.py"
+
+
+def _run_main(tmp_path, detail=None):
+    env = dict(
+        os.environ,
+        METRAN_TPU_BENCH_DRY_RUN="1",
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+    )
+    if detail is not None:
+        src = tmp_path / "detail.json"
+        src.write_text(json.dumps(detail))
+        env["METRAN_TPU_BENCH_DRY_RUN_DETAIL"] = str(src)
+    proc = subprocess.run(
+        [sys.executable, str(BENCH), "--phase", "main"],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd=str(tmp_path),  # cwd-independence of the artifact paths
+    )
+    assert proc.returncode in (0, 1), proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert lines, f"no stdout at all; stderr: {proc.stderr[-2000:]}"
+    return lines[-1]
+
+
+def test_main_final_stdout_line_is_compact_json(tmp_path):
+    """``main()``'s LAST stdout line must parse as one compact JSON
+    object carrying the harness schema — the exact operation the round
+    capture applies (take the final line, ``json.loads`` it)."""
+    line = _run_main(tmp_path)
+    final = json.loads(line)  # must not raise: the r01-r05 bug
+    # the harness schema: the headline metric triple ...
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in final, sorted(final)
+    # ... plus the PR 10 capture fix: per-phase summary inline and the
+    # full detail in a pointed-at artifact, NOT inline
+    assert isinstance(final.get("summary"), dict)
+    assert "detail" not in final, (
+        "the detail blob is back inline — this is exactly the "
+        "r01-r05 'parsed: null' regression"
+    )
+    assert len(line) < 20_000, "final line grew un-compact"
+    detail_file = final.get("detail_file")
+    assert detail_file, final
+    artifact = REPO / detail_file
+    assert artifact.exists()
+    with open(artifact) as fh:
+        payload = json.load(fh)
+    assert "detail" in payload
+
+
+def test_phase_summary_extracts_every_phase_headline(tmp_path):
+    """Injecting a real-shaped detail dict, the final line's summary
+    must surface one headline number per phase — a phase whose key
+    path drifts silently vanishes from every future round capture."""
+    detail = {
+        "cpu_baseline": {"fit_s": 17.2},
+        "serve": {"arena_vs_dict": {"arena_speedup": 8.0}},
+        "serve_load": {"cached": {"achieved_read_rps": 108000.0}},
+        "serve_faults": {"poisoned_slot": {"degraded_qps": 900.0}},
+        "steady": {"steady": {"throughput_ratio": 2.45}},
+        "refit": {"refit": {"models_per_s": 7.1}},
+        "detect": {"overhead": {"update_qps_pct": 1.2}},
+        "grad": {
+            "backward_speedup": 2.56,
+            "memory": {
+                "peak_mb_adjoint": 417.0,
+                "peak_mb_autodiff": 4876.0,
+            },
+        },
+    }
+    final = json.loads(_run_main(tmp_path, detail=detail))
+    assert final["summary"] == {
+        "cpu_fit_s": 17.2,
+        "serve_arena_speedup": 8.0,
+        "serve_load_reads_per_s": 108000.0,
+        "serve_faults_degraded_qps": 900.0,
+        "steady_speedup": 2.45,
+        "refit_models_per_s": 7.1,
+        "detect_overhead_pct": 1.2,
+        "grad_backward_speedup": 2.56,
+        "grad_mem_peak_mb_adjoint": 417.0,
+        "grad_mem_peak_mb_autodiff": 4876.0,
+    }

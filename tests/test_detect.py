@@ -1,0 +1,602 @@
+"""Online monitoring: streaming detection, alerting, changepoint-
+triggered refit, and counterfactual decomposition queries.
+
+Layers under test (docs/concepts.md "Online monitoring"):
+
+- the :mod:`metran_tpu.ops.detect` recursions themselves (false-alarm
+  rate on white noise, CUSUM delay monotone in the shift, LB-drift
+  firing on autocorrelation, disarmed/masked no-ops);
+- the serving fusion: detection-armed posteriors BIT-IDENTICAL to the
+  plain kernels on square-root engines, arena == dict detection
+  parity, detector-state round-trip through arena evict/spill/reload;
+- the product: anomaly/changepoint events, alert raise/clear
+  hysteresis, changepoint flags driving
+  :meth:`HealthMonitor.refit_candidates`, the end-to-end
+  detect→alert→refit→promote scenario (``faults``/``refit`` marked),
+  detection-delay-vs-magnitude curves at a bounded clean-stream
+  false-alarm rate, and ``service.decompose()`` matching the offline
+  full-history smoother decomposition at 1e-8.
+"""
+
+import numpy as np
+import pytest
+
+from metran_tpu.config import enable_x64
+
+enable_x64(True)
+
+from metran_tpu.ops import (  # noqa: E402
+    DETECT_STATE_ROWS,
+    decompose_states,
+    detect_append,
+    detect_init,
+    detect_stats,
+    dfm_statespace,
+    sqrt_kalman_filter,
+    sqrt_rts_smoother,
+)
+from metran_tpu.reliability.health import HealthMonitor  # noqa: E402
+from metran_tpu.serve import (  # noqa: E402
+    DetectSpec,
+    GateSpec,
+    MetranService,
+    ModelRegistry,
+    PosteriorState,
+)
+
+N, KF, T_HIST = 5, 1, 120
+
+
+def _fitted_state(seed=7, model_id="m0", n=N, kf=KF, t_hist=T_HIST,
+                  t_future=80):
+    """A warm serving state over MODEL-CONSISTENT data: history and
+    the continuation stream are simulated from the DFM itself, so the
+    serving innovations are genuinely N(0, 1) — clean continuation
+    rows must book nothing, and a +c spike is a c-sigma event.
+    Returns ``(state, y_hist, y_future)``."""
+    from metran_tpu.reliability.scenarios import simulate_dfm_panel
+
+    rng = np.random.default_rng(seed)
+    ld = rng.uniform(0.3, 0.7, (n, kf)) / np.sqrt(kf)
+    a_s = rng.uniform(5.0, 40.0, n)
+    a_c = rng.uniform(10.0, 60.0, kf)
+    ss = dfm_statespace(a_s, a_c, ld, 1.0)
+    _, y_all, _ = simulate_dfm_panel(ss, t_hist + t_future, rng)
+    y = y_all[:t_hist]
+    filt = sqrt_kalman_filter(ss, y, np.ones_like(y, bool))
+    chol0 = np.asarray(filt.chol_f[-1])
+    state = PosteriorState(
+        model_id=model_id, version=0, t_seen=t_hist,
+        mean=np.asarray(filt.mean_f[-1]), cov=chol0 @ chol0.T,
+        params=np.concatenate([a_s, a_c]), loadings=ld, dt=1.0,
+        scaler_mean=np.zeros(n), scaler_std=np.ones(n),
+        names=tuple(f"s{j}" for j in range(n)), chol=chol0,
+    )
+    return state, y, y_all[t_hist:]
+
+
+def _service(state, detect=None, arena=False, gate=None, **kw):
+    reg = ModelRegistry(
+        root=None, engine="sqrt", arena=arena,
+        arena_rows=kw.pop("arena_rows", 8),
+    )
+    reg.put(state, persist=False)
+    return MetranService(
+        reg, flush_deadline=None, persist_updates=False,
+        detect=detect, gate=gate or GateSpec(policy="off"), **kw
+    )
+
+
+# ----------------------------------------------------------------------
+# ops/detect.py recursions
+# ----------------------------------------------------------------------
+def test_clean_stream_books_no_alarms():
+    """White-noise z-scores at the default thresholds: ZERO alarm
+    episodes over 10k steps x 6 slots (the <= 1-per-10k-steps
+    acceptance bar with wide margin — the thresholds sit at 5 sigma)."""
+    rng = np.random.default_rng(0)
+    z = rng.normal(size=(10_000, 6))
+    state, counts = detect_append(
+        detect_init(6), z, np.ones_like(z, bool)
+    )
+    assert int(np.asarray(counts).sum()) == 0
+    stats = np.asarray(detect_stats(state))
+    assert np.all(np.isfinite(stats))
+
+
+def test_cusum_delay_monotone_in_shift():
+    """A sustained +delta-sigma shift trips the CUSUM with delay
+    decreasing in delta (~ h/(delta-k)); below the reference value k
+    it never trips."""
+    rng = np.random.default_rng(1)
+    base = rng.normal(size=(400, 1))
+
+    def first_alarm(delta):
+        z = base + delta
+        st = detect_init(1)
+        for t in range(base.shape[0]):
+            st, c = detect_append(st, z[t][None], np.ones((1, 1), bool))
+            if int(np.asarray(c)[1, 0]) > 0:
+                return t + 1
+        return None
+
+    d1, d2, d4 = first_alarm(1.0), first_alarm(2.0), first_alarm(4.0)
+    assert d4 is not None and d2 is not None and d1 is not None
+    assert d4 <= d2 <= d1
+    assert first_alarm(0.0) is None  # the null never trips
+
+
+def test_lb_drift_fires_on_autocorrelated_innovations():
+    """AR(1)-correlated z-scores (the stale-dynamics signature) trip
+    the autocorrelation-drift detector; the same marginals permuted
+    white do not."""
+    rng = np.random.default_rng(2)
+    e = rng.normal(size=(600, 2))
+    z = np.zeros_like(e)
+    for t in range(1, len(e)):
+        z[t] = 0.75 * z[t - 1] + np.sqrt(1 - 0.75**2) * e[t]
+    _, counts = detect_append(
+        detect_init(2), z, np.ones_like(z, bool)
+    )
+    assert int(np.asarray(counts)[2].sum()) > 0
+    shuffled = z[rng.permutation(len(z))]
+    _, counts_w = detect_append(
+        detect_init(2), shuffled, np.ones_like(z, bool)
+    )
+    assert int(np.asarray(counts_w)[2].sum()) == 0
+
+
+def test_disarmed_masked_and_nan_are_noops():
+    rng = np.random.default_rng(3)
+    z = rng.normal(size=(50, 3)) + 9.0  # wildly anomalous
+    st0 = detect_init(3)
+    st, counts = detect_append(st0, z, np.ones_like(z, bool),
+                               armed=False)
+    assert np.array_equal(np.asarray(st), np.asarray(st0))
+    assert int(np.asarray(counts).sum()) == 0
+    st, counts = detect_append(st0, z, np.zeros_like(z, bool))
+    assert np.array_equal(np.asarray(st), np.asarray(st0))
+    z_nan = np.full_like(z, np.nan)
+    st, counts = detect_append(st0, z_nan, np.ones_like(z, bool))
+    assert np.array_equal(np.asarray(st), np.asarray(st0))
+    assert int(np.asarray(counts).sum()) == 0
+
+
+def test_detect_stats_layout():
+    """stats = [C+, C-, Q] with Q = n_eff * (S_zz/S_z2)^2."""
+    state = np.zeros((DETECT_STATE_ROWS, 2))
+    state[0] = [1.5, 0.0]
+    state[1] = [0.0, 2.5]
+    state[3] = [0.3, -0.4]  # S_zz
+    state[4] = [1.0, 2.0]  # S_z2
+    state[5] = [10.0, 20.0]  # n_eff
+    stats = np.asarray(detect_stats(state))
+    np.testing.assert_allclose(stats[0], [1.5, 0.0])
+    np.testing.assert_allclose(stats[1], [0.0, 2.5])
+    np.testing.assert_allclose(
+        stats[2], [10.0 * 0.3**2, 20.0 * (-0.4 / 2.0) ** 2]
+    )
+
+
+# ----------------------------------------------------------------------
+# DetectSpec validation (config satellite)
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("bad", [
+    dict(min_seen=-1),
+    dict(lb_window=1),  # window <= lag
+    dict(lb_window=0),
+    dict(alert_cooldown_s=-0.5),
+    dict(cusum_h=0.0),
+    dict(cusum_k=-0.1),
+    dict(lb_thresh=0.0),
+    dict(nsigma=0.0),
+])
+def test_detect_spec_rejects_broken_combinations(bad):
+    with pytest.raises(ValueError):
+        DetectSpec(enabled=True, **bad).validate()
+    # disabled specs are inert and never rejected (nothing is armed)
+    DetectSpec(enabled=False, **bad).validate()
+
+
+def test_detect_spec_defaults_ship_off(monkeypatch):
+    monkeypatch.delenv("METRAN_TPU_SERVE_DETECT", raising=False)
+    assert not DetectSpec.from_defaults().enabled
+    monkeypatch.setenv("METRAN_TPU_SERVE_DETECT", "1")
+    monkeypatch.setenv("METRAN_TPU_SERVE_DETECT_CUSUM_H", "9.5")
+    monkeypatch.setenv("METRAN_TPU_SERVE_DETECT_LB_WINDOW", "32")
+    monkeypatch.setenv("METRAN_TPU_SERVE_DETECT_LB_THRESH", "16.0")
+    monkeypatch.setenv("METRAN_TPU_SERVE_DETECT_NSIGMA", "4.5")
+    spec = DetectSpec.from_defaults()
+    assert spec.enabled and spec.cusum_h == 9.5 and spec.lb_window == 32
+    assert spec.lb_thresh == 16.0 and spec.nsigma == 4.5
+
+
+def test_alert_board_raise_clear_and_flap_suppression():
+    """The alert lifecycle under an injectable clock: one page per
+    episode, clear after a quiet cooldown, and an episode flapping
+    back within one cooldown of its CLEAR reactivates silently
+    instead of paging again."""
+    from metran_tpu.serve import AlertBoard
+
+    t = [0.0]
+    board = AlertBoard(cooldown_s=10.0, clock=lambda: t[0])
+    assert board.note("w1", "changepoint", 1, ("s0",)) is not None
+    t[0] = 3.0  # alarms inside the episode absorb, never re-page
+    assert board.note("w1", "changepoint", 2, ("s1",)) is None
+    assert board.active_count() == 1
+    t[0] = 14.0  # quiet past the cooldown: clears
+    assert board.active_count() == 0
+    assert board.cleared_total == 1
+    t[0] = 20.0  # flap-back within one cooldown of the CLEAR:
+    assert board.note("w1", "changepoint", 1) is None  # no second page
+    assert board.suppressed_total == 1
+    assert board.active_count() == 1  # ... but it IS active again
+    t[0] = 45.0  # a genuinely new episode long after: pages again
+    assert board.active_count() == 0
+    assert board.note("w1", "changepoint", 1) is not None
+    assert board.raised_total == 2
+    # anomaly bar: a single outlier is an event, not a page; the
+    # second within one window raises with the accumulated count
+    assert board.note("w2", "anomaly", 1, ("s0",)) is None
+    raised = board.note("w2", "anomaly", 1, ("s1",))
+    assert raised is not None and raised.count == 2
+
+
+# ----------------------------------------------------------------------
+# serving fusion
+# ----------------------------------------------------------------------
+def test_detect_enabled_posterior_bit_identical_sqrt():
+    """Arming detection must not move the posterior by one ULP on a
+    square-root registry (the z-score-emitting kernel with the gate
+    disarmed computes the exact same FP ops)."""
+    state, _, y_future = _fitted_state()
+    obs = y_future[:20].copy()
+    obs[7, 2] = np.nan  # missing cells ride along
+    svc_off = _service(state)
+    svc_on = _service(
+        state, detect=DetectSpec(enabled=True, min_seen=1)
+    )
+    for t in range(len(obs)):
+        svc_off.update("m0", obs[t][None, :])
+        svc_on.update("m0", obs[t][None, :])
+    a, b = svc_off.registry.get("m0"), svc_on.registry.get("m0")
+    assert np.array_equal(a.mean, b.mean)
+    assert np.array_equal(a.chol, b.chol)
+    assert a.version == b.version
+    # ... while the armed service actually tracked statistics
+    snap = svc_on.anomalies()["m0"]
+    assert snap["t_seen"] == state.t_seen + len(obs)
+    svc_off.close()
+    svc_on.close()
+
+
+def test_anomaly_changepoint_events_counters_and_alert_lifecycle():
+    state, _, y_future = _fitted_state()
+    spec = DetectSpec(enabled=True, min_seen=1, alert_cooldown_s=30.0)
+    svc = _service(state, detect=spec)
+    clean = y_future[:30]
+    for t in range(len(clean)):
+        svc.update("m0", clean[t][None, :])
+    assert svc.alerts() == []  # clean stream: nothing raised
+    for t in range(5):  # a persistent +12-sigma offset on one slot
+        bad = y_future[30 + t].copy()
+        bad[1] += 12.0
+        svc.update("m0", bad[None, :])
+    counts = svc.metrics.detect_total.snapshot()
+    assert counts.get("anomaly", 0) >= 1
+    assert counts.get("changepoint_cusum", 0) >= 1
+    kinds = {e["kind"] for e in svc.events.for_model("m0")}
+    assert {"anomaly", "changepoint", "alert_raised"} <= kinds
+    active = svc.alerts()
+    assert active and active[0]["slots"] == ["s1"]
+    snap = svc.anomalies()["m0"]
+    assert snap["cusum_alarms"] >= 1
+    assert "s1" in snap["slots_flagged"]
+    assert svc.monitor.changepoint_models() == ["m0"]
+    assert svc.health()["detect"]["alerts"]["active"] >= 1
+    # raise/clear hysteresis: jump the board clock past the cooldown
+    # and the quiet alert clears (one alert per episode, then a page
+    # on the NEXT episode only)
+    board = svc.alert_board
+    base = board._clock()
+    board._clock = lambda: base + spec.alert_cooldown_s + 1.0
+    assert svc.alerts() == []
+    assert svc.metrics.detect_total.snapshot().get(
+        "alert_cleared", 0
+    ) >= 1
+    svc.close()
+
+
+def test_external_put_resets_dict_detector_state():
+    """A registry.put that replaces the posterior (hot-swap/restore)
+    must reset the accumulated evidence — stale CUSUM mass and a full
+    autocorrelation window against the old parameters cannot alarm
+    against the new ones."""
+    state, _, y_future = _fitted_state()
+    svc = _service(
+        state, detect=DetectSpec(enabled=True, min_seen=1)
+    )
+    for t in range(10):  # build up evidence (a mild persistent shift)
+        svc.update("m0", (y_future[t] + 1.0)[None, :])
+    entry = svc.detector._entries["m0"]
+    nef_before = float(entry.state[5].max())
+    assert nef_before > 5.0  # a ~10-step effective window accumulated
+    assert entry.version == 10
+    svc.registry.put(state, persist=False)  # operator restore
+    svc.update("m0", y_future[10][None, :])
+    after = svc.anomalies()["m0"]
+    entry = svc.detector._entries["m0"]
+    # restarted from zeros: the window holds exactly ONE observed step
+    assert float(entry.state[5].max()) == 1.0
+    assert after["version"] == 1
+    svc.close()
+
+
+def test_arena_matches_dict_detection():
+    """The arena's fused detect kernel and the dict path run the same
+    recursions over the same z-scores: identical alarm counts, equal
+    accumulator statistics (to reassociation dust — two distinct
+    compiled programs), bit-identical posteriors."""
+    state, _, y_future = _fitted_state()
+    spec = DetectSpec(enabled=True, min_seen=1)
+    svc_d = _service(state, detect=spec)
+    svc_a = _service(state, detect=spec, arena=True)
+    obs = y_future[:25].copy()
+    obs[10, 0] += 11.0  # one spiky episode
+    obs[11, 0] += 11.0
+    for t in range(len(obs)):
+        svc_d.update("m0", obs[t][None, :])
+        svc_a.update("m0", obs[t][None, :])
+    sd = svc_d.anomalies()["m0"]
+    sa = svc_a.anomalies()["m0"]
+    for key in ("anomalies", "cusum_alarms", "lb_alarms"):
+        assert sd[key] == sa[key], key
+    for key in ("cusum_pos", "cusum_neg", "lb_q"):
+        np.testing.assert_allclose(
+            sd[key], sa[key], rtol=0, atol=1e-12, err_msg=key,
+        )
+    # posteriors bit-identical across the two registries too
+    a, d = svc_a.registry.get("m0"), svc_d.registry.get("m0")
+    np.testing.assert_array_equal(a.mean, d.mean)
+    svc_d.close()
+    svc_a.close()
+
+
+def test_detector_state_through_arena_evict_spill_reload(tmp_path):
+    """The detector leaf rides the arena row lifecycle like the steady
+    leaves: spill (checkpoint) leaves it untouched, evict/reload
+    RESETS it (accumulators are serving-session state, not persisted),
+    the posterior round-trips bit-identically, and detection re-arms
+    cleanly afterward."""
+    state, _, y_future = _fitted_state()
+    reg = ModelRegistry(
+        root=tmp_path, engine="sqrt", arena=True, arena_rows=4,
+    )
+    reg.put(state, persist=True)
+    svc = MetranService(
+        reg, flush_deadline=None, persist_updates=True,
+        detect=DetectSpec(enabled=True, min_seen=1),
+    )
+    for t in range(12):  # a mild persistent shift accumulates evidence
+        svc.update("m0", (y_future[t] + 1.0)[None, :])
+    bucket, row = reg.ensure_resident("m0")
+    arena = reg.arena_of(bucket)
+    det_live = arena.read_det_row(row)
+    assert np.abs(det_live).max() > 0.0  # evidence accumulated
+    # spill (checkpoint, row stays resident): detector state untouched
+    assert reg.spill(dirty_only=True) >= 1
+    np.testing.assert_array_equal(arena.read_det_row(row), det_live)
+    st_before = reg.get("m0")
+    # evict + reload: posterior bit-identical, detector leaf reset
+    reg.evict("m0")
+    bucket2, row2 = reg.ensure_resident("m0")
+    st_after = reg.get("m0")
+    np.testing.assert_array_equal(st_before.mean, st_after.mean)
+    np.testing.assert_array_equal(st_before.chol, st_after.chol)
+    assert st_before.version == st_after.version
+    arena2 = reg.arena_of(bucket2)
+    assert np.abs(arena2.read_det_row(row2)).max() == 0.0
+    # ... and detection still works on the reloaded row
+    for t in range(4):
+        bad = y_future[12 + t].copy()
+        bad[3] += 12.0
+        svc.update("m0", bad[None, :])
+    assert svc.anomalies()["m0"]["cusum_alarms"] >= 1
+    svc.close()
+
+
+@pytest.mark.parametrize("arena", [False, True])
+def test_detect_rides_the_frozen_steady_path(arena):
+    """With steady-state serving armed, FROZEN rows' dispatches still
+    advance the detector (the steady kernels emit z-scores too), the
+    stream position stays consistent, and an episode is counted
+    exactly once — on dict and arena registries alike."""
+    from metran_tpu.serve import SteadySpec
+
+    state, _, y_future = _fitted_state(t_hist=300, t_future=80)
+    reg = ModelRegistry(
+        root=None, engine="sqrt", arena=arena, arena_rows=8,
+    )
+    reg.put(state, persist=False)
+    svc = MetranService(
+        reg, flush_deadline=None, persist_updates=False,
+        steady=SteadySpec(tol=1e-5, min_seen=10),
+        detect=DetectSpec(enabled=True, min_seen=1),
+    )
+    for t in range(20):
+        svc.update("m0", y_future[t][None, :])
+    assert svc._steady_count() == 1  # frozen: the mean-only hot path
+    bad = y_future[20].copy()
+    bad[2] += 12.0  # a 12-sigma spike THROUGH the frozen kernel
+    svc.update("m0", bad[None, :])
+    snap = svc.anomalies()["m0"]
+    assert snap["anomalies"] == 1  # once — never double-counted
+    assert snap["t_seen"] == state.t_seen + 21
+    assert "s2" in snap["slots_flagged"]
+    svc.close()
+
+
+# ----------------------------------------------------------------------
+# changepoint -> refit trigger
+# ----------------------------------------------------------------------
+def test_changepoint_flag_is_a_refit_candidate_on_its_own():
+    """A changepoint flag alone — no gate signal, no staleness — makes
+    the model a ranked refit candidate, consumed when a refit claims
+    it, expired after the TTL."""
+    t = [0.0]
+    mon = HealthMonitor(changepoint_ttl_s=100.0, clock=lambda: t[0])
+    mon.record_changepoint("w1")
+    cands = mon.refit_candidates()
+    assert [(c.model_id, c.reasons, c.score) for c in cands] == [
+        ("w1", ("changepoint",), 2.0)
+    ]
+    # begin_refit consumes the flag: the break triggered its refit
+    assert mon.begin_refit("w1")
+    mon.end_refit("w1", cooldown_s=0.0)
+    assert mon.refit_candidates() == []
+    # TTL: a stale break cannot trigger a refit long after the fact
+    mon.record_changepoint("w2")
+    t[0] = 101.0
+    assert mon.refit_candidates() == []
+    assert mon.changepoint_models() == []
+    # note_fit (promotion) also clears a pending flag
+    mon.record_changepoint("w3")
+    mon.note_fit("w3", t_seen=100)
+    assert mon.refit_candidates() == []
+
+
+@pytest.mark.faults
+@pytest.mark.refit
+def test_changepoint_scenario_detect_alert_refit_promote():
+    """End-to-end acceptance: a structural-break episode is detected,
+    alerts, schedules a refit via the changepoint flag, and the
+    promoted challenger beats the no-refit control — with the
+    degraded/changepoint/refit trail reconstructible from the
+    EventLog alone."""
+    from metran_tpu.reliability.scenarios import run_changepoint_scenario
+
+    res = run_changepoint_scenario(
+        n_fault=30, n_tail=70, n_eval=40, maxiter=30,
+    )
+    # detection fired during the fault phase and flagged the model
+    assert res["changepoints_pending"] == ["changepoint-recovery"]
+    assert any(a["kind"] == "changepoint" for a in res["alerts"])
+    assert res["anomalies"]["cusum_alarms"] >= 1
+    # the candidate carries the changepoint reason into scheduling
+    reasons = dict(
+        (mid, set(rs)) for mid, rs, _ in res["candidates"]
+    )
+    assert "changepoint" in reasons["changepoint-recovery"]
+    # the loop closed: scheduled -> promoted, accuracy recovered
+    assert res["promoted"] == ["changepoint-recovery"]
+    assert res["rmse_refit"] < res["rmse_norefit"]
+    # the whole trail, from the event log alone
+    kinds = set(res["events"])
+    assert {
+        "changepoint", "alert_raised", "degraded",
+        "refit_scheduled", "refit_promoted",
+    } <= kinds
+
+
+@pytest.mark.faults
+@pytest.mark.parametrize("mode,mags", [
+    ("spike", (4.0, 12.0)),
+    ("stuck", (4.0, 12.0)),
+    ("drift", (0.5, 2.0)),
+    ("unit", (3.0, 10.0)),
+])
+def test_detection_delay_curves(mode, mags):
+    """Delay-vs-magnitude curves per SensorFault mode at a bounded
+    false-positive rate on clean streams: the strong episode of every
+    mode is detected, delay never grows with magnitude, and the clean
+    control books <= 1 false alarm per 10k steps at the default
+    thresholds."""
+    from metran_tpu.reliability.scenarios import (
+        run_detection_delay_scenario,
+    )
+
+    res = run_detection_delay_scenario(
+        mode, magnitudes=mags, n_steps=60, n_clean=800,
+    )
+    assert res["false_alarms_per_10k"] <= 1.0
+    assert res["clean_alerts"] == 0
+    curve = res["curve"]
+    strong = curve[-1]
+    assert strong["detected"], (mode, curve)
+    delays = [
+        c["delay_steps"] for c in curve if c["delay_steps"] is not None
+    ]
+    assert delays == sorted(delays, reverse=True) or len(delays) < 2, (
+        mode, curve,
+    )
+
+
+# ----------------------------------------------------------------------
+# counterfactual decomposition queries
+# ----------------------------------------------------------------------
+def test_decompose_matches_offline_smoother_and_sums():
+    """service.decompose() off the fixed-lag smoothed states equals
+    the OFFLINE full-history smoother decomposition on the overlap
+    window at f64 (<= 1e-8), and the contributions satisfy the exact
+    identity total = offset + sdf + sum_k cdf_k."""
+    lag = 16
+    state, y_hist, y_future = _fitted_state(t_hist=60)
+    # data-unit scalers exercise the de-standardization path
+    scl_m = np.linspace(3.0, 5.0, N)
+    scl_s = np.linspace(0.5, 2.0, N)
+    state = state._replace(scaler_mean=scl_m, scaler_std=scl_s)
+    svc = _service(state, fixed_lag=lag)
+    y_new = y_future[:40]
+    for t in range(len(y_new)):
+        svc.update("m0", (y_new[t] * scl_s + scl_m)[None, :])
+    dec = svc.decompose("m0")
+    assert dec.lag == lag
+    assert dec.t_end == state.t_seen + len(y_new)
+    # identity: total(t) = offset + sdf(t) + sum_k cdf_k(t)
+    np.testing.assert_allclose(
+        dec.total, dec.offset + dec.sdf + dec.cdf.sum(axis=0),
+        rtol=0, atol=1e-10,
+    )
+    np.testing.assert_allclose(
+        dec.delta_total, dec.delta_sdf + dec.delta_cdf.sum(axis=0),
+        rtol=0, atol=1e-10,
+    )
+    # offline reference: full-history smoother over hist + streamed
+    # rows, decomposed over the last `lag` steps
+    n = state.n_series
+    params = np.asarray(state.params)
+    ss = dfm_statespace(
+        params[:n], params[n:], np.asarray(state.loadings), 1.0
+    )
+    y_full = np.concatenate([y_hist, y_new])
+    filt = sqrt_kalman_filter(
+        ss, y_full, np.ones(y_full.shape, bool), store=True
+    )
+    sm = sqrt_rts_smoother(ss, filt)
+    mean_s = np.asarray(sm.mean_s)[-lag:]
+    sdf_ref, cdf_ref = decompose_states(ss.z, mean_s, n)
+    np.testing.assert_allclose(
+        dec.sdf, np.asarray(sdf_ref) * scl_s, rtol=0, atol=1e-8,
+    )
+    np.testing.assert_allclose(
+        dec.cdf, np.asarray(cdf_ref) * scl_s, rtol=0, atol=1e-8,
+    )
+    svc.close()
+
+
+def test_decompose_requires_fixed_lag():
+    state, _, _ = _fitted_state()
+    svc = _service(state)
+    with pytest.raises(ValueError, match="fixed-lag"):
+        svc.decompose("m0")
+    svc.close()
+
+
+def test_monitoring_apis_require_detect():
+    state, _, _ = _fitted_state()
+    svc = _service(state)
+    with pytest.raises(ValueError, match="detection is disabled"):
+        svc.anomalies()
+    with pytest.raises(ValueError, match="detection is disabled"):
+        svc.alerts()
+    svc.close()

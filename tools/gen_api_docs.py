@@ -37,6 +37,7 @@ MODULES = [
     "metran_tpu.ops.statespace",
     "metran_tpu.ops.forecast",
     "metran_tpu.ops.adjoint",
+    "metran_tpu.ops.detect",
     "metran_tpu.ops.kalman",
     "metran_tpu.ops.pkalman",
     "metran_tpu.ops.lanes",
@@ -50,6 +51,7 @@ MODULES = [
     "metran_tpu.serve.engine",
     "metran_tpu.serve.registry",
     "metran_tpu.serve.batching",
+    "metran_tpu.serve.monitoring",
     "metran_tpu.serve.readpath",
     "metran_tpu.serve.refit",
     "metran_tpu.serve.service",
