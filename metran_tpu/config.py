@@ -250,6 +250,19 @@ OBS_TRACE = 0  # request-scoped span tracing (metrics/events stay on)
 OBS_TRACE_BUFFER = 4096  # finished spans kept in the tracer ring
 OBS_EVENT_BUFFER = 2048  # reliability events kept in the log ring
 OBS_EVENT_SINK = ""  # JSON-lines file sink path ("" = ring only)
+OBS_EVENT_SINK_MAX_MB = 0.0  # rotate the sink to a .1 suffix past this
+#                              size (0 = unbounded); only path-
+#                              constructed (owned) sinks rotate
+# capacity & cost plane (metran_tpu.obs.capacity; docs/concepts.md
+# "Capacity & cost").  ON by default whenever metrics are on — the
+# stage stamps are per-dispatch, measured <= 5% on the arena bulk path
+# and 0% on cached reads (bench.py --phase capacity).
+OBS_CAPACITY = 1  # 0 = no stage/SLO/cost instrumentation
+OBS_CAPACITY_SAMPLE = 1  # record every Nth dispatch (sampled-subset
+#                          mode for deployments where even the
+#                          per-dispatch stamps matter)
+OBS_SLO_MS = 50.0  # the serve-latency SLO the burn rate measures
+#                    against (p99 < OBS_SLO_MS, 1% violation budget)
 
 
 def _env(name, cast, default):
@@ -441,6 +454,19 @@ def obs_defaults() -> dict:
         ),
         "event_sink": os.environ.get(
             "METRAN_TPU_OBS_EVENT_SINK", OBS_EVENT_SINK
+        ),
+        "event_sink_max_mb": _env(
+            "METRAN_TPU_OBS_EVENT_SINK_MAX_MB", float,
+            OBS_EVENT_SINK_MAX_MB,
+        ),
+        "capacity": _env(
+            "METRAN_TPU_OBS_CAPACITY", int, OBS_CAPACITY
+        ),
+        "capacity_sample": _env(
+            "METRAN_TPU_OBS_CAPACITY_SAMPLE", int, OBS_CAPACITY_SAMPLE
+        ),
+        "slo_ms": _env(
+            "METRAN_TPU_OBS_SLO_MS", float, OBS_SLO_MS
         ),
     }
 

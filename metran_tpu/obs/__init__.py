@@ -38,6 +38,12 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Optional
 
+from .capacity import (
+    STAGES,
+    BurnRateMonitor,
+    CapacityTracker,
+    ModelCostLedger,
+)
 from .events import EVENT_KINDS, EventLog
 from .metrics import (
     DEFAULT_LATENCY_BUCKETS,
@@ -86,6 +92,7 @@ class Observability:
             events=EventLog(
                 maxlen=d["event_buffer"],
                 sink=d["event_sink"] or None,
+                max_sink_mb=d["event_sink_max_mb"] or None,
             ),
         )
 
@@ -103,6 +110,8 @@ class Observability:
 
 
 __all__ = [
+    "BurnRateMonitor",
+    "CapacityTracker",
     "Counter",
     "DEFAULT_LATENCY_BUCKETS",
     "DEFAULT_SIZE_BUCKETS",
@@ -114,8 +123,10 @@ __all__ = [
     "Histogram",
     "LatencyRecorder",
     "MetricsRegistry",
+    "ModelCostLedger",
     "Observability",
     "OccupancyCounter",
+    "STAGES",
     "Span",
     "SpanContext",
     "Tracer",

@@ -1,0 +1,607 @@
+"""Capacity & cost observability: where every millisecond — and every
+device-second — goes.
+
+PR 7's load bench showed the single-process ceiling ("one Python core
+does 75% of the work and reads queue behind writes"), but that number
+came from a one-off probe.  This module is the instrumentation the
+service *carries*, so the saturation story reads off live gauges —
+before and after the multi-process split is judged against it:
+
+- **Stage-latency decomposition** (:class:`CapacityTracker`): every
+  dispatched update/forecast/bulk-tick request decomposes into the
+  canonical :data:`STAGES` — queue wait, lock wait, host prep, device
+  time, publish — as a per-stage :class:`~metran_tpu.obs.metrics.
+  LatencyRecorder` family (``metran_serve_stage_<stage>_seconds``
+  histograms) with an invariant check that recorded stages sum to
+  >= 90% of end-to-end request wall (``coverage()``; the
+  ``metran_serve_stage_coverage_ratio`` gauge, validated by
+  ``bench.py --phase capacity``).
+- **Dispatch-thread utilization** (``utilization()``): the fraction of
+  recent wall time the dispatch thread spent inside dispatches — the
+  GIL-ceiling gauge.  Near 1.0 with queue/lock stages dominating IS
+  the ROADMAP item-1 saturation story, read from a scrape.
+- **SLO burn rate** (:class:`BurnRateMonitor`): rolling multi-window
+  (5m/1h by default, injectable clock) error-budget burn for the
+  p99 < 50 ms serve SLO, fed per request from the dispatch paths.
+- **Per-model cost accounting** (:class:`ModelCostLedger`):
+  update/read/gate/detect/refit counts and amortized device-seconds
+  per model, with ``top_models(by="device_s")`` for fleet triage.
+
+The per-(bucket, kernel-kind) **compile & device-time ledger** lives
+with the compiled-kernel cache it instruments
+(:class:`~metran_tpu.serve.registry.CompiledFnCache`); everything is
+assembled into one structured snapshot by
+:meth:`~metran_tpu.serve.MetranService.capacity_report` and rendered
+by ``tools/capacity_report.py``.
+
+Cost discipline (the bars ``bench.py --phase capacity`` enforces:
+<= 5% on the arena bulk update path, <= 1% on cached reads):
+
+- stage timing is a handful of ``time.monotonic()`` stamps per
+  *dispatch* (never per request) flushed in one bulk recorder call;
+- ``sample_every=N`` records only every Nth dispatch — the
+  sampled-subset mode for deployments where even the stamps matter
+  (the reported distributions and coverage then describe the sampled
+  subset; fractions stay unbiased);
+- the **cached read path is deliberately untouched**: a snapshot hit
+  is ~2 µs of host memory and the 1% bar leaves no room for even one
+  per-read dict operation, so cached reads appear only in the
+  store-level aggregate cache counters (``serve.readpath``), never in
+  the per-model ledger.  Documented in docs/concepts.md
+  ("Capacity & cost").
+
+The device stage is bracketed on the dispatch thread: the kernel-cache
+ledger calls ``jax.block_until_ready`` on the dispatch outputs (the
+serving paths materialize them immediately afterward anyway, so the
+block moves a wait it does not add), and the outer stamps therefore
+measure true kernel wall, not async-dispatch submission time.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from logging import getLogger
+from typing import Dict, List, Optional, Tuple
+
+from .metrics import LatencyRecorder, MetricsRegistry
+
+logger = getLogger(__name__)
+
+#: The canonical stage catalogue.  Every stage label the serving layer
+#: records (``CapacityTracker.observe_stage``) must be listed here AND
+#: documented in the stage table of docs/concepts.md ("Capacity &
+#: cost") — ``tools/check_metrics.py`` AST-scans both, the same drift
+#: gate the event-kind catalogue carries.  Order is the pipeline
+#: order; see the concepts table for exact boundaries.
+STAGES = (
+    "queue",      # submit/enqueue -> dispatch claim (incl. defer wait)
+    "lock",       # _update_lock + arena-lock acquisition waits
+    "host_prep",  # lookup/stacking/validation/standardization
+    "device",     # kernel dispatch -> outputs materialized on host
+    "publish",    # per-slot finalize: commit, snapshot, telemetry
+)
+
+#: default burn-rate windows (seconds) and their gauge labels
+DEFAULT_BURN_WINDOWS: Tuple[float, ...] = (300.0, 3600.0)
+
+#: default serve SLO (seconds) — the p99 < 50 ms bar the load bench
+#: measures against — and the violation budget the burn rate divides
+#: by (p99 < SLO == at most 1% of requests over it)
+DEFAULT_SLO_S = 0.050
+DEFAULT_SLO_BUDGET = 0.01
+
+
+def window_label(seconds: float) -> str:
+    """A compact metric-name-safe label for a burn window (300 ->
+    ``5m``, 3600 -> ``1h``, 90000 -> ``25h``)."""
+    s = int(seconds)
+    if s % 3600 == 0:
+        return f"{s // 3600}h"
+    if s % 60 == 0:
+        return f"{s // 60}m"
+    return f"{s}s"
+
+
+class BurnRateMonitor:
+    """Rolling multi-window SLO error-budget burn (thread-safe).
+
+    The SLO is stated as a latency bound plus a violation budget: with
+    ``slo_s=0.05`` and ``budget=0.01``, "p99 < 50 ms" — at most 1% of
+    requests may exceed 50 ms.  ``burn_rate(window)`` is the windowed
+    violation fraction divided by the budget: 1.0 means the budget is
+    being consumed exactly at its sustainable rate, >1 means it burns
+    faster (the standard multi-window burn-rate alerting quantity —
+    page on the short window, ticket on the long one).
+
+    Implementation: time-bucketed (``bucket_s``-wide) counters in a
+    bounded deque sized to the longest window — O(1) memory however
+    long the service lives, O(windows) per read.  ``clock`` is
+    injectable (monotonic seconds) so the burn-rate math is unit
+    -testable deterministically.
+    """
+
+    def __init__(self, slo_s: float = DEFAULT_SLO_S,
+                 budget: float = DEFAULT_SLO_BUDGET,
+                 windows: Tuple[float, ...] = DEFAULT_BURN_WINDOWS,
+                 bucket_s: float = 10.0, clock=time.monotonic):
+        if slo_s <= 0:
+            raise ValueError(f"slo_s must be > 0, got {slo_s}")
+        if not 0 < budget <= 1:
+            raise ValueError(f"budget must be in (0, 1], got {budget}")
+        if not windows:
+            raise ValueError("at least one burn window is required")
+        self.slo_s = float(slo_s)
+        self.budget = float(budget)
+        self.windows = tuple(sorted(float(w) for w in windows))
+        if self.windows[0] <= 0:
+            raise ValueError(f"windows must be > 0, got {windows}")
+        self.bucket_s = float(bucket_s)
+        self._clock = clock
+        # (bucket_index, total, violations); bounded to the longest
+        # window plus one partial bucket
+        n = int(self.windows[-1] / self.bucket_s) + 2
+        self._buckets: "deque[list]" = deque(maxlen=n)
+        self._lock = threading.Lock()
+        self.total = 0
+        self.violations = 0
+
+    def observe(self, latency_s: float, n: int = 1) -> None:
+        """Book ``n`` requests at ``latency_s`` seconds each."""
+        viol = n if latency_s > self.slo_s else 0
+        self._book(n, viol)
+
+    def observe_many(self, latencies) -> None:
+        """Book a batch of per-request latencies in one lock trip."""
+        total = 0
+        viol = 0
+        slo = self.slo_s
+        for v in latencies:
+            total += 1
+            if v > slo:
+                viol += 1
+        if total:
+            self._book(total, viol)
+
+    def _book(self, n: int, violations: int) -> None:
+        idx = int(self._clock() / self.bucket_s)
+        with self._lock:
+            if self._buckets and self._buckets[-1][0] == idx:
+                b = self._buckets[-1]
+                b[1] += n
+                b[2] += violations
+            else:
+                self._buckets.append([idx, n, violations])
+            self.total += n
+            self.violations += violations
+
+    def window_stats(self, window_s: float) -> Dict[str, float]:
+        """Requests/violations/fraction/burn over the trailing window."""
+        now_idx = self._clock() / self.bucket_s
+        min_idx = now_idx - float(window_s) / self.bucket_s
+        total = viol = 0
+        with self._lock:
+            for idx, n, v in self._buckets:
+                if idx >= min_idx:
+                    total += n
+                    viol += v
+        frac = viol / total if total else 0.0
+        return {
+            "window_s": float(window_s),
+            "requests": total,
+            "violations": viol,
+            "violation_fraction": frac,
+            "burn_rate": frac / self.budget,
+        }
+
+    def burn_rate(self, window_s: float) -> float:
+        """Windowed violation fraction over the budget (see class doc)."""
+        return self.window_stats(window_s)["burn_rate"]
+
+    def snapshot(self) -> dict:
+        """Every configured window's stats plus the SLO statement."""
+        return {
+            "slo_ms": self.slo_s * 1e3,
+            "budget": self.budget,
+            "requests_total": self.total,
+            "violations_total": self.violations,
+            "windows": {
+                window_label(w): self.window_stats(w)
+                for w in self.windows
+            },
+        }
+
+
+class ModelCostLedger:
+    """Per-model cost accounting: who is spending the fleet's capacity.
+
+    Tracks, per model id: ``updates`` / ``reads`` committed through
+    the dispatch paths, ``gate_flags`` (observations the gate acted
+    on), ``detect_alarms``, ``refits``, and amortized ``device_s`` —
+    each batched dispatch's measured device wall split evenly over its
+    riders (the honest per-model share of a shared execution).
+    Cached snapshot reads are deliberately NOT counted here (see the
+    module docstring's 1%-bar note); they appear in the aggregate
+    cache counters only.
+
+    Bounded: past ``max_models`` tracked ids the cheapest half (by
+    ``device_s``) is pruned and counted in ``pruned`` — fleet-scale
+    services keep their hottest models' accounting, which is what
+    ``top_models`` triage needs.
+    """
+
+    FIELDS = ("updates", "reads", "gate_flags", "detect_alarms",
+              "refits", "device_s")
+    _IDX = {f: i for i, f in enumerate(FIELDS)}
+    _DEV = FIELDS.index("device_s")
+
+    def __init__(self, max_models: int = 100_000):
+        self.max_models = int(max_models)
+        # entries are flat lists indexed by _IDX — the charge paths
+        # run per rider per dispatch, and list indexing beats a
+        # six-key dict measurably at fleet batch sizes
+        self._models: Dict[str, list] = {}
+        self._lock = threading.Lock()
+        self.pruned = 0
+
+    def _prune(self) -> None:
+        dev = self._DEV
+        keep = sorted(
+            self._models.items(), key=lambda kv: kv[1][dev],
+            reverse=True,
+        )[: self.max_models // 2]
+        self.pruned += len(self._models) - len(keep)
+        self._models = dict(keep)
+
+    def charge(self, model_id: str, field: str, n: int = 1,
+               device_s: float = 0.0) -> None:
+        idx = self._IDX[field]
+        with self._lock:
+            e = self._models.get(model_id)
+            if e is None:
+                if len(self._models) >= self.max_models:
+                    self._prune()  # before inserting: the new entry
+                    # (zero device_s) must survive its own charge
+                e = self._models[model_id] = [0, 0, 0, 0, 0, 0.0]
+            e[idx] += n
+            if device_s:
+                e[self._DEV] += device_s
+
+    def charge_many(self, model_ids, field: str,
+                    device_s_total: float = 0.0) -> None:
+        """One dispatch's outcome for all its riders: ``field`` += 1
+        each, the shared device wall split evenly."""
+        n = len(model_ids)
+        if not n:
+            return
+        idx = self._IDX[field]
+        dev = self._DEV
+        share = device_s_total / n
+        cap = self.max_models
+        with self._lock:
+            models = self._models
+            for mid in model_ids:
+                e = models.get(mid)
+                if e is None:
+                    if len(models) >= cap:
+                        self._prune()
+                        models = self._models
+                    e = models[mid] = [0, 0, 0, 0, 0, 0.0]
+                e[idx] += 1
+                e[dev] += share
+
+    def count_refit(self, model_id: str) -> None:
+        self.charge(model_id, "refits")
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._models)
+
+    def top_models(self, by: str = "device_s",
+                   limit: int = 10) -> List[dict]:
+        """The ``limit`` most expensive models by ``by`` (any of
+        :data:`FIELDS`), each as ``{"model_id": ..., **costs}``."""
+        if by not in self._IDX:
+            raise ValueError(
+                f"unknown cost field {by!r}; expected one of "
+                f"{self.FIELDS}"
+            )
+        idx = self._IDX[by]
+        with self._lock:
+            items = sorted(
+                self._models.items(), key=lambda kv: kv[1][idx],
+                reverse=True,
+            )[: int(limit)]
+        return [
+            {"model_id": mid,
+             **{f: (round(e[i], 6) if f == "device_s" else e[i])
+                for f, i in self._IDX.items()}}
+            for mid, e in items
+        ]
+
+    def snapshot(self, limit: Optional[int] = None) -> dict:
+        return {
+            "tracked_models": len(self),
+            "pruned": self.pruned,
+            "top_by_device_s": self.top_models(
+                "device_s", limit if limit is not None else 10
+            ),
+        }
+
+
+class _DispatchAcc:
+    """One sampled dispatch's stage accumulator (single-threaded —
+    dispatches run on one thread; no lock)."""
+
+    __slots__ = ("stages", "counts")
+
+    def __init__(self):
+        self.stages = dict.fromkeys(STAGES, 0.0)
+        self.counts = dict.fromkeys(STAGES, 0)
+
+
+class CapacityTracker:
+    """The service-side stage/utilization/SLO aggregator (module doc).
+
+    Usage, on a dispatch thread::
+
+        acc = tracker.begin_dispatch()        # None when sampled out
+        ...
+        tracker.observe_stage("lock", dt)     # no-op when not sampled
+        ...
+        tracker.end_dispatch(acc, waits, t_claim, t_end)
+
+    ``begin_dispatch`` parks the accumulator in a thread-local so the
+    helpers the dispatch body calls (`_run_update_dict`,
+    `_arena_dispatch_rows`, ...) record stages without signature
+    changes; dispatches run one-per-thread-at-a-time, so a begin that
+    finds a parked accumulator treats it as leaked by an exception
+    path and discards it (see :meth:`begin_dispatch`).
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 sample_every: int = 1,
+                 slo_s: float = DEFAULT_SLO_S,
+                 slo_budget: float = DEFAULT_SLO_BUDGET,
+                 burn_windows: Tuple[float, ...] = DEFAULT_BURN_WINDOWS,
+                 max_models: int = 100_000,
+                 clock=time.monotonic):
+        self.clock = clock
+        self.sample_every = max(1, int(sample_every))
+        self.slo = BurnRateMonitor(
+            slo_s=slo_s, budget=slo_budget, windows=burn_windows,
+            clock=clock,
+        )
+        self.costs = ModelCostLedger(max_models=max_models)
+        self.recorders: Dict[str, LatencyRecorder] = {
+            s: LatencyRecorder(
+                registry=registry,
+                name=f"metran_serve_stage_{s}_seconds",
+                help=f"per-dispatch {s} stage wall (seconds); see the "
+                     "stage table in docs/concepts.md (Capacity & cost)",
+            )
+            for s in STAGES
+        }
+        self._lock = threading.Lock()
+        self._totals = dict.fromkeys(STAGES, 0.0)
+        self._counts = dict.fromkeys(STAGES, 0)
+        self._wall_s = 0.0     # sum over requests of end-to-end wall
+        self._staged_s = 0.0   # sum over requests of attributed stages
+        self._requests = 0
+        self._dispatches = 0
+        self._sampled = 0
+        self._busy_s = 0.0     # dispatch-thread seconds inside dispatches
+        self._t0 = float(clock())
+        # (instant, cumulative busy) marks for windowed utilization
+        self._busy_marks: "deque[tuple]" = deque(maxlen=1024)
+        self._tls = threading.local()
+        if registry is not None:
+            registry.gauge(
+                "metran_serve_stage_coverage_ratio",
+                "recorded stages over end-to-end request wall (the "
+                "decomposition invariant; bar >= 0.9)",
+                callback=self.coverage,
+            )
+            registry.gauge(
+                "metran_serve_dispatch_utilization",
+                "fraction of recent wall time the dispatch thread "
+                "spent inside dispatches (the GIL-ceiling gauge)",
+                callback=self.utilization,
+            )
+            for w in self.slo.windows:
+                registry.gauge(
+                    f"metran_serve_slo_burn_rate_{window_label(w)}",
+                    f"error-budget burn rate over the trailing "
+                    f"{window_label(w)} (1.0 = budget consumed at "
+                    "exactly its sustainable rate)",
+                    callback=(lambda w=w: self.slo.burn_rate(w)),
+                )
+
+    # -- dispatch lifecycle ---------------------------------------------
+    def begin_dispatch(self) -> Optional[_DispatchAcc]:
+        """Start one dispatch's stage accounting, or ``None`` when this
+        dispatch is sampled out.
+
+        One dispatch runs per thread at a time, so an accumulator
+        still parked in the thread-local here was LEAKED by an
+        exception path (an injected whole-batch dispatch fault, a
+        crashed finalize) — it is discarded (its partial stats never
+        flush) rather than left to blind capacity accounting on this
+        thread forever."""
+        with self._lock:
+            self._dispatches += 1
+            sampled = (self._dispatches - 1) % self.sample_every == 0
+            if sampled:
+                self._sampled += 1
+        if not sampled:
+            self._tls.acc = None  # clear any leaked accumulator too
+            return None
+        acc = _DispatchAcc()
+        self._tls.acc = acc
+        return acc
+
+    def active(self) -> Optional[_DispatchAcc]:
+        """The dispatch accumulator parked on this thread, if any."""
+        return getattr(self._tls, "acc", None)
+
+    def device_charge(self, measured_s: float) -> float:
+        """Scale one SAMPLED dispatch's measured device wall to its
+        cost-ledger charge: under ``sample_every=N`` each sampled
+        dispatch stands for N dispatches, so the per-model amortized
+        device-seconds stay an unbiased estimate instead of an N-fold
+        undercount (the same convention the kernel ledger uses)."""
+        return measured_s * self.sample_every
+
+    def observe_stage(self, stage: str, seconds: float,
+                      n: int = 1) -> None:
+        """Accumulate ``seconds`` of ``stage`` into the active
+        dispatch (no-op off a sampled dispatch).  ``stage`` must be a
+        :data:`STAGES` member — call sites pass literals, which is
+        what the ``tools/check_metrics.py`` stage drift gate scans."""
+        acc = getattr(self._tls, "acc", None)
+        if acc is None:
+            return
+        acc.stages[stage] += seconds
+        acc.counts[stage] += n
+
+    def end_dispatch(self, acc: _DispatchAcc, waits, t_claim: float,
+                     t_end: float, latencies=None) -> None:
+        """Flush one sampled dispatch: per-stage histograms (one
+        sample per stage per dispatch; per-request samples for the
+        queue stage), the coverage sums, the busy-time marks, and the
+        SLO burn monitor.
+
+        ``waits`` are the riders' queue waits (enqueue -> claim,
+        seconds; an empty list books the dispatch as one queue-less
+        request — the bulk-tick form).  Per-request end-to-end wall is
+        ``wait_i + (t_end - t_claim)``: every rider experiences the
+        full shared dispatch, which is exactly what its future's
+        resolution latency shows."""
+        if self._tls.acc is acc:
+            self._tls.acc = None
+        q_list = list(waits) if waits else None
+        n_req = len(q_list) if q_list is not None else 1
+        q_sum = sum(q_list) if q_list is not None else 0.0
+        span = max(t_end - t_claim, 0.0)
+        staged_shared = sum(
+            acc.stages[s] for s in STAGES if s != "queue"
+        )
+        if q_list is not None:
+            self.recorders["queue"].record_many(q_list)
+        for s in STAGES:
+            if s != "queue" and acc.counts[s]:
+                self.recorders[s].record(acc.stages[s])
+        with self._lock:
+            self._totals["queue"] += q_sum
+            self._counts["queue"] += n_req
+            for s in STAGES:
+                if s != "queue" and acc.counts[s]:
+                    self._totals[s] += acc.stages[s]
+                    self._counts[s] += 1
+            self._wall_s += q_sum + n_req * span
+            self._staged_s += q_sum + n_req * min(staged_shared, span)
+            self._requests += n_req
+            self._busy_s += span
+            self._busy_marks.append((t_end, self._busy_s))
+        if latencies is not None:
+            # the caller already holds the riders' end-to-end
+            # latencies (the same values wait_i + span would rebuild)
+            self.slo.observe_many(latencies)
+        elif q_list is not None:
+            self.slo.observe_many([w + span for w in q_list])
+        else:
+            self.slo.observe(span)
+
+    # -- read -----------------------------------------------------------
+    def coverage(self) -> float:
+        """Attributed stage seconds over end-to-end request wall,
+        cumulative over the sampled dispatches (the >= 0.9 invariant
+        ``bench.py --phase capacity`` validates).  1.0 until the first
+        dispatch (nothing to decompose is vacuously covered)."""
+        with self._lock:
+            if self._wall_s <= 0.0:
+                return 1.0
+            return self._staged_s / self._wall_s
+
+    def utilization(self, window_s: float = 60.0) -> float:
+        """Fraction of the trailing ``window_s`` the dispatch thread
+        spent inside dispatches (sampled dispatches only — scale by
+        ``sample_every`` mentally when sampling; default 1 records
+        all).  Falls back to the lifetime average while the mark
+        window is still filling."""
+        now = float(self.clock())
+        with self._lock:
+            busy_now = self._busy_s
+            marks = self._busy_marks
+            anchor_t, anchor_busy = self._t0, 0.0
+            if (
+                marks
+                and len(marks) == marks.maxlen
+                and marks[0][0] >= now - window_s
+            ):
+                # the deque is full and even its OLDEST retained mark
+                # is inside the window (sustained high dispatch rate):
+                # anchor there — falling back to (_t0, 0) would read a
+                # long-lived service as idle at exactly the moment it
+                # saturates
+                anchor_t, anchor_busy = marks[0]
+            else:
+                for t, b in marks:
+                    if t >= now - window_s:
+                        break
+                    anchor_t, anchor_busy = t, b
+        elapsed = max(now - anchor_t, 1e-9)
+        return min(max((busy_now - anchor_busy) / elapsed, 0.0), 1.0)
+
+    def stage_report(self) -> dict:
+        """Per-stage totals/percentiles/shares (the report body)."""
+        with self._lock:
+            totals = dict(self._totals)
+            counts = dict(self._counts)
+        staged = sum(totals.values())
+        out = {}
+        for s in STAGES:
+            rec = self.recorders[s]
+            out[s] = {
+                "seconds_total": round(totals[s], 6),
+                "count": counts[s],
+                "p50_ms": round(rec.p50 * 1e3, 4),
+                "p99_ms": round(rec.p99 * 1e3, 4),
+                "share": round(totals[s] / staged, 4) if staged else 0.0,
+            }
+        return out
+
+    def report(self) -> dict:
+        """The tracker's half of ``service.capacity_report()``."""
+        with self._lock:
+            dispatches = self._dispatches
+            sampled = self._sampled
+            requests = self._requests
+            busy = self._busy_s
+            wall = self._wall_s
+        return {
+            "stages": self.stage_report(),
+            "coverage": round(self.coverage(), 4),
+            "dispatches": dispatches,
+            "sampled_dispatches": sampled,
+            "sample_every": self.sample_every,
+            "requests": requests,
+            "busy_s": round(busy, 4),
+            "request_wall_s": round(wall, 4),
+            "utilization_60s": round(self.utilization(60.0), 4),
+            "slo": self.slo.snapshot(),
+            "models": self.costs.snapshot(),
+        }
+
+
+__all__ = [
+    "BurnRateMonitor",
+    "CapacityTracker",
+    "DEFAULT_BURN_WINDOWS",
+    "DEFAULT_SLO_BUDGET",
+    "DEFAULT_SLO_S",
+    "ModelCostLedger",
+    "STAGES",
+    "window_label",
+]

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import io
 import json
+import os
 import threading
 import time
 from collections import deque
@@ -90,22 +91,38 @@ class EventLog:
         event is written as one JSON line and flushed.  ``None``
         disables the sink (ring buffer only).
     clock : epoch-seconds time source (injectable for tests).
+    max_sink_mb : bound the on-disk sink by size (``METRAN_TPU_OBS_
+        EVENT_SINK_MAX_MB``; ``None``/0 = unbounded, the historical
+        behavior).  A **path-constructed** sink reaching the bound is
+        rotated: the current file moves to ``<path>.1`` (replacing any
+        earlier rotation — at most two files ever exist, so a
+        long-lived service cannot fill the disk) and a fresh file is
+        opened at the path; the fd the log owned is closed, the new
+        one is owned — the close-semantics contract is unchanged.
+        Caller-provided file objects are never rotated (the log does
+        not know their path and does not own their lifecycle).
     """
 
     def __init__(self, maxlen: int = 2048, sink=None,
-                 clock=time.time):
+                 clock=time.time, max_sink_mb: Optional[float] = None):
         self._events: "deque[dict]" = deque(maxlen=int(maxlen))
         self._lock = threading.Lock()
         self._clock = clock
         self._counts: Dict[str, int] = {}
         self.dropped = 0  # events pushed out of the ring (lifetime)
+        self.rotations = 0  # sink files rotated to the .1 suffix
         self._sink = None
         self._owns_sink = False
+        self._sink_path: Optional[str] = None
+        self._max_sink_bytes = (
+            int(float(max_sink_mb) * 1024 * 1024) if max_sink_mb else 0
+        )
         if sink is not None:
             if isinstance(sink, (str, bytes)) or hasattr(sink, "__fspath__"):
                 try:
                     self._sink = open(sink, "a", encoding="utf-8")
                     self._owns_sink = True
+                    self._sink_path = os.fspath(sink)
                 except OSError:
                     # degrade-don't-fail, same contract as a write
                     # failure: an unwritable sink path must not stop
@@ -160,20 +177,63 @@ class EventLog:
                 sink.flush()
             except (OSError, ValueError, io.UnsupportedOperation):
                 # a full disk / closed file must degrade the sink, not
-                # the serving path that emitted the event
+                # the serving path that emitted the event.  A write
+                # that raced a size rotation (its fd closed under it)
+                # only loses its own line — the fresh sink stays up.
                 with self._lock:
-                    self._sink = None
-                    owns, self._owns_sink = self._owns_sink, False
+                    disabled = self._sink is sink
+                    if disabled:
+                        self._sink = None
+                        owns, self._owns_sink = self._owns_sink, False
+                    else:
+                        owns = False  # rotated away mid-write
                 if owns:
                     try:
                         sink.close()  # release the fd we opened
                     except (OSError, ValueError):
                         pass
-                logger.exception(
-                    "event-log sink failed; disabling the file sink "
-                    "(in-memory ring continues)"
-                )
+                if disabled:
+                    logger.exception(
+                        "event-log sink failed; disabling the file "
+                        "sink (in-memory ring continues)"
+                    )
+            else:
+                self._maybe_rotate(sink)
         return event
+
+    def _maybe_rotate(self, sink) -> None:
+        """Rotate an owned, path-constructed sink past the size bound
+        (see the constructor doc); no-op otherwise.  A rotation
+        failure degrades to ring-only like a write failure."""
+        if not self._max_sink_bytes:
+            return
+        try:
+            size = sink.tell()
+        except (OSError, ValueError):
+            return
+        if size < self._max_sink_bytes:
+            return
+        with self._lock:
+            if (
+                self._sink is not sink
+                or not self._owns_sink
+                or self._sink_path is None
+            ):
+                return  # caller-provided, already swapped, or closed
+            try:
+                sink.close()
+                os.replace(self._sink_path, self._sink_path + ".1")
+                self._sink = open(
+                    self._sink_path, "a", encoding="utf-8"
+                )
+                self.rotations += 1
+            except OSError:
+                self._sink = None
+                self._owns_sink = False
+                logger.exception(
+                    "event-log sink rotation failed; disabling the "
+                    "file sink (in-memory ring continues)"
+                )
 
     # -- read -----------------------------------------------------------
     def tail(self, n: int = 50) -> List[dict]:

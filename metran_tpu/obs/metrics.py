@@ -284,16 +284,43 @@ class Histogram(_Metric):
     def observe_many(self, values) -> None:
         """Bulk observe under ONE lock acquisition — the serving
         dispatch path books a whole batch's gate scores at once
-        instead of paying per-value lock traffic."""
+        instead of paying per-value lock traffic.  Large batches
+        bucket vectorized (searchsorted + bincount): the capacity
+        plane books a dispatch's whole rider set per call, and B
+        python bisects were a measurable slice of its overhead bar."""
         vals = [float(v) for v in values]
-        if not vals:
+        n = len(vals)
+        if not n:
+            return
+        if n >= 16:
+            import numpy as _np
+
+            arr = _np.asarray(vals)
+            where = _np.searchsorted(self.buckets, arr, side="left")
+            # match bisect_left's NaN placement (every comparison
+            # false -> bucket 0) so bucket counts cannot depend on
+            # which path a batch size selects
+            nan = _np.isnan(arr)
+            if nan.any():
+                where[nan] = 0
+            idxs = _np.bincount(
+                where, minlength=len(self._counts),
+            )
+            total = float(arr.sum())
+            with self._lock:
+                counts = self._counts
+                for i, c in enumerate(idxs):
+                    if c:
+                        counts[i] += int(c)
+                self._sum += total
+                self._count += n
             return
         idxs = [bisect.bisect_left(self.buckets, v) for v in vals]
         with self._lock:
             for i in idxs:
                 self._counts[i] += 1
             self._sum += sum(vals)
-            self._count += len(vals)
+            self._count += n
 
     @property
     def count(self) -> int:
@@ -511,6 +538,22 @@ class LatencyRecorder:
         if self._hist is not None:
             self._hist.observe(seconds)
 
+    def record_many(self, values) -> None:
+        """Record a batch of samples under ONE lock acquisition (and
+        one bulk histogram observe) — the dispatch paths book a whole
+        batch's latencies at once instead of paying per-request lock
+        traffic."""
+        vals = [float(v) for v in values]
+        if not vals:
+            return
+        with self._lock:
+            self.samples.extend(vals)
+            self.total += len(vals)
+            if len(self.samples) > self.maxlen:
+                del self.samples[: len(self.samples) // 2]
+        if self._hist is not None:
+            self._hist.observe_many(vals)
+
     def reset(self) -> None:
         """Forget the recorded samples (``total`` and the backing
         registry histogram keep their lifetime counts) — percentiles
@@ -546,6 +589,63 @@ class LatencyRecorder:
     @property
     def p99(self) -> float:
         return self.percentile(99.0)
+
+    @property
+    def p999(self) -> float:
+        return self.percentile(99.9)
+
+    def slo_violation_fraction(self, slo_s: float) -> float:
+        """Fraction of the recent sample window over ``slo_s`` seconds
+        — the quantity an error budget is written against (a single
+        p99 cannot say HOW MUCH of the traffic violated)."""
+        with self._lock:
+            samples = list(self.samples)
+        if not samples:
+            return 0.0
+        return sum(1 for v in samples if v > slo_s) / len(samples)
+
+    def stats(self, slo_s: Optional[float] = None) -> dict:
+        """Percentile snapshot (ms) for health/capacity endpoints:
+        window size, p50/p99/p999/mean, and — with an SLO — the
+        windowed violation fraction next to the stated bound.  ONE
+        locked snapshot and ONE sort serve every quantile, so the
+        numbers are mutually consistent and a health scrape pays a
+        single pass over the sample window."""
+        with self._lock:
+            samples = list(self.samples)
+            total = self.total
+        n = len(samples)
+        if not n:
+            ordered = []
+
+            def pct(q):
+                return 0.0
+        else:
+            ordered = sorted(samples)
+
+            def pct(q):
+                idx = min(
+                    n - 1, max(0, round(q / 100.0 * (n - 1)))
+                )
+                return ordered[idx]
+
+        out = {
+            "n": n,
+            "total": total,
+            "p50_ms": round(pct(50.0) * 1e3, 4),
+            "p99_ms": round(pct(99.0) * 1e3, 4),
+            "p999_ms": round(pct(99.9) * 1e3, 4),
+            "mean_ms": round(
+                (sum(ordered) / n if n else 0.0) * 1e3, 4
+            ),
+        }
+        if slo_s is not None:
+            out["slo_ms"] = slo_s * 1e3
+            out["slo_violation_fraction"] = round(
+                sum(1 for v in ordered if v > slo_s) / n if n
+                else 0.0, 6,
+            )
+        return out
 
     @property
     def mean(self) -> float:

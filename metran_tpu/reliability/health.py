@@ -410,6 +410,12 @@ class HealthMonitor:
             "the degraded threshold",
             callback=lambda: float(len(self.degraded_models())),
         )
+        registry.gauge(
+            f"{prefix}_changepoints_pending",
+            "models with an unexpired, unconsumed changepoint flag "
+            "(structural breaks awaiting a refit claim)",
+            callback=lambda: float(len(self.changepoint_models())),
+        )
 
     def snapshot(self, extra: Optional[Dict] = None) -> Dict:
         with self._lock:  # ONE acquisition: a consistent instant

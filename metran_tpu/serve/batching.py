@@ -180,6 +180,17 @@ class MicroBatcher:
         with self._lock:
             return sum(len(g.requests) for g in self._groups.values())
 
+    def oldest_wait(self) -> float:
+        """Seconds the oldest still-queued request has waited — the
+        queue-saturation signal next to :meth:`pending` (a deep queue
+        of fresh requests is coalescing; an OLD head means dispatch
+        is not keeping up).  0.0 when nothing is queued."""
+        with self._lock:
+            if not self._groups:
+                return 0.0
+            first = min(g.first_at for g in self._groups.values())
+        return max(0.0, time.monotonic() - first)
+
     def worker_alive(self) -> bool:
         """Whether the background flusher can still dispatch deadlines.
 

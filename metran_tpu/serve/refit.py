@@ -887,6 +887,10 @@ class RefitWorker:
         del self.swap_latencies[:-256]
         self.monitor.note_fit(model_id, new_state.t_seen)
         self.monitor.reset_gate(model_id)
+        if svc.capacity is not None:
+            # capacity & cost plane: refits are a per-model cost next
+            # to updates/reads (obs.capacity.ModelCostLedger)
+            svc.capacity.costs.count_refit(model_id)
         self._degraded_seen.discard(model_id)
         report["promoted"].append(model_id)
         self._book(

@@ -61,7 +61,17 @@ class CompiledFnCache:
     **first-call wall time** — trace + XLA compile + launch, the
     dominant cold-start cost of a new shape bucket — into a per-kernel
     ``metran_serve_compile_seconds{key=...}`` gauge, plus hit/miss/
-    resident callback gauges.
+    resident callback gauges — and keeps the **per-(bucket,
+    kernel-kind) capacity ledger** (docs/concepts.md "Capacity &
+    cost"): cumulative compile wall, dispatch count, and measured
+    device-seconds per compiled kernel.  Device time is bracketed with
+    ``jax.block_until_ready`` on the dispatch thread (the serving
+    paths materialize the outputs immediately afterward, so the block
+    moves a wait rather than adding one); ``device_sample_every=N``
+    blocks only every Nth call — the sampled-subset mode — and the
+    ledger's ``device_s`` is then the sampled mean scaled by the
+    dispatch count (an estimate, flagged by ``sampled_calls <
+    dispatches``).
     """
 
     def __init__(self, maxsize: int = 32):
@@ -77,10 +87,24 @@ class CompiledFnCache:
         self.hits = 0
         self.misses = 0
         self._compile_gauge = None
+        # capacity ledger: compile key -> mutable entry dict.  Ledger
+        # entries OUTLIVE LRU eviction deliberately — cost attribution
+        # must not forget a kernel because its executable was evicted.
+        self._ledger: "Dict[tuple, dict]" = {}
+        self._ledger_lock = threading.Lock()
+        self._ledger_enabled = False
+        self._device_sample_every = 1
+        self._dispatch_counter = None
+        self._device_counter = None
 
-    def bind_metrics(self, registry, prefix: str = "metran_serve") -> None:
-        """Publish cache counters and per-kernel compile wall time into
-        ``registry`` (idempotent; see class docstring)."""
+    def bind_metrics(self, registry, prefix: str = "metran_serve",
+                     device_sample_every: int = 1,
+                     ledger: bool = True) -> None:
+        """Publish cache counters, per-kernel compile wall time and —
+        with ``ledger`` (the capacity plane's knob) — the capacity
+        ledger's counter families into ``registry`` (idempotent; see
+        class docstring).  ``ledger=False`` keeps the historical
+        first-call-compile-gauge instrumentation only."""
         self._compile_gauge = registry.gauge(
             f"{prefix}_compile_seconds",
             "first-call wall time (trace+compile+launch) per kernel",
@@ -101,6 +125,22 @@ class CompiledFnCache:
             "compiled kernels currently held by the LRU",
             callback=lambda: float(len(self)),
         )
+        self._device_sample_every = max(1, int(device_sample_every))
+        self._ledger_enabled = bool(ledger)
+        if not ledger:
+            return
+        self._dispatch_counter = registry.counter(
+            f"{prefix}_kernel_dispatches_total",
+            "kernel executions per compiled serve kernel",
+            label_names=("key",),
+        )
+        self._device_counter = registry.counter(
+            f"{prefix}_kernel_device_seconds_total",
+            "measured device wall per compiled serve kernel "
+            "(block_until_ready-bracketed; sampled calls only when "
+            "device sampling is configured)",
+            label_names=("key",),
+        )
 
     @staticmethod
     def _key_label(key: tuple) -> str:
@@ -119,9 +159,9 @@ class CompiledFnCache:
         return "_".join(parts)
 
     def _timed_first_call(self, key: tuple, fn: Callable) -> Callable:
-        """Wrap a fresh cache entry so its first invocation — where
-        ``jax.jit`` traces and XLA compiles — lands in the compile
-        gauge.  Subsequent calls pay one boolean check."""
+        """The ledger-off instrumentation: only the first invocation —
+        where ``jax.jit`` traces and XLA compiles — lands in the
+        compile gauge; subsequent calls pay one boolean check."""
         gauge = self._compile_gauge
         label = self._key_label(key)
         done = [False]
@@ -137,6 +177,82 @@ class CompiledFnCache:
 
         return wrapper
 
+    def _instrumented(self, key: tuple, fn: Callable) -> Callable:
+        """Wrap a fresh cache entry with the capacity ledger: the
+        first invocation — where ``jax.jit`` traces and XLA compiles —
+        lands in the compile gauge and the ledger's ``compile_s``;
+        every invocation counts a dispatch, and sampled invocations
+        are ``block_until_ready``-bracketed into ``device_s``."""
+        gauge = self._compile_gauge
+        label = self._key_label(key)
+        entry = {
+            "kind": str(key[0]),
+            "bucket": key[1],
+            "label": label,
+            "compile_s": 0.0,
+            "dispatches": 0,
+            "sampled_calls": 0,
+            "device_s": 0.0,
+        }
+        with self._ledger_lock:
+            # re-created after an LRU eviction: keep accumulating into
+            # the existing ledger entry (cost is per kernel identity)
+            entry = self._ledger.setdefault(key, entry)
+        sample_every = self._device_sample_every
+        dispatch_counter = self._dispatch_counter
+        device_counter = self._device_counter
+        lock = self._ledger_lock
+
+        # per-CLOSURE first-call flag: a kernel re-created after an LRU
+        # eviction re-traces and re-compiles, and that wall belongs in
+        # compile_s too — never in the sampled device-time mean
+        done = [False]
+
+        def wrapper(*args, **kwargs):
+            with lock:
+                n = entry["dispatches"]
+                entry["dispatches"] = n + 1
+                first = not done[0]
+                if first:
+                    done[0] = True
+                    entry["compiles"] = entry.get("compiles", 0) + 1
+            sampled = first or (n % sample_every == 0)
+            if not sampled:
+                if dispatch_counter is not None:
+                    dispatch_counter.inc(key=label)
+                return fn(*args, **kwargs)
+            t0 = time.perf_counter()
+            out = fn(*args, **kwargs)
+            # block on ONE output leaf, not the generic pytree walk
+            # (measurably cheaper on ms-scale dispatches): every serve
+            # kernel is a single fused executable whose outputs
+            # complete together, and device_s is an estimate by
+            # contract either way
+            leaf = out
+            while isinstance(leaf, (tuple, list)) and leaf:
+                leaf = leaf[0]
+            block = getattr(leaf, "block_until_ready", None)
+            if block is not None:
+                block()
+            dt = time.perf_counter() - t0
+            with lock:
+                if first:
+                    # trace + compile + first launch: the cold-start
+                    # cost, booked apart from steady-state device time
+                    entry["compile_s"] += dt
+                else:
+                    entry["sampled_calls"] += 1
+                    entry["device_s"] += dt
+            if first and gauge is not None:
+                gauge.set(dt, key=label)
+            if dispatch_counter is not None:
+                dispatch_counter.inc(key=label)
+            if not first and device_counter is not None:
+                device_counter.inc(dt, key=label)
+            return out
+
+        return wrapper
+
     def get_or_create(self, key: tuple, factory: Callable[[], Callable]):
         with self._lock:
             entry = self._entries.get(key)
@@ -147,12 +263,42 @@ class CompiledFnCache:
             self.misses += 1
             entry = factory()
             if self._compile_gauge is not None:
-                entry = self._timed_first_call(key, entry)
+                entry = (
+                    self._instrumented(key, entry)
+                    if self._ledger_enabled
+                    else self._timed_first_call(key, entry)
+                )
             self._entries[key] = entry
             while len(self._entries) > self.maxsize:
                 evicted, _ = self._entries.popitem(last=False)
                 logger.info("evicting compiled serve fn %s", evicted)
             return entry
+
+    def ledger_snapshot(self) -> List[dict]:
+        """The capacity ledger, most device-expensive kernel first.
+        ``device_s`` is the estimated cumulative device wall: measured
+        seconds when every call was sampled, the sampled mean scaled
+        by the dispatch count otherwise (``sampled_calls`` says
+        which)."""
+        with self._ledger_lock:
+            entries = [dict(e) for e in self._ledger.values()]
+        for e in entries:
+            e.setdefault("compiles", 0)
+            runs = max(e["dispatches"] - e["compiles"], 0)
+            if e["sampled_calls"] and runs > e["sampled_calls"]:
+                e["device_s"] = (
+                    e["device_s"] / e["sampled_calls"] * runs
+                )
+            e["device_s"] = round(e["device_s"], 6)
+            e["compile_s"] = round(e["compile_s"], 6)
+            e["bucket"] = (
+                list(e["bucket"]) if isinstance(e["bucket"], tuple)
+                else e["bucket"]
+            )
+        entries.sort(
+            key=lambda e: (e["device_s"], e["compile_s"]), reverse=True
+        )
+        return entries
 
     def __len__(self) -> int:
         with self._lock:
@@ -284,7 +430,9 @@ class ModelRegistry:
         # snapshot entries stale (serve.readpath.SnapshotStore)
         self._commit_hooks: List[Callable[[str, int], None]] = []
 
-    def bind_observability(self, metrics=None, events=None) -> None:
+    def bind_observability(self, metrics=None, events=None,
+                           device_sample_every: int = 1,
+                           ledger: bool = True) -> None:
         """Attach this registry to an observability bundle.
 
         ``metrics`` (a :class:`~metran_tpu.obs.MetricsRegistry`) gets
@@ -303,8 +451,18 @@ class ModelRegistry:
                 "state-integrity events by kind (quarantines, load "
                 "failures, last-good fallbacks, temp sweeps)",
             )
-            self._compiled.bind_metrics(metrics)
+            self._compiled.bind_metrics(
+                metrics, device_sample_every=device_sample_every,
+                ledger=ledger,
+            )
             if self.arena_enabled:
+                metrics.gauge(
+                    "metran_serve_arena_bytes_resident",
+                    "device bytes pinned by resident arena rows, all "
+                    "buckets (state + built state-space + steady + "
+                    "detector leaves)",
+                    callback=lambda: float(self.arena_bytes_total()),
+                )
                 self.arena_events.bind(
                     metrics, "metran_serve_arena_events_total",
                     "state-arena lifecycle events by kind (loads, "
@@ -1159,6 +1317,38 @@ class ModelRegistry:
             ("arena_forecast", bucket, int(steps), sqrt),
             lambda: make_arena_forecast_fn(int(steps), sqrt=sqrt),
         )
+
+    def kernel_ledger(self) -> List[dict]:
+        """The per-(bucket, kernel-kind) capacity ledger: cumulative
+        compile wall, dispatch count, and estimated device-seconds per
+        compiled kernel, most expensive first (populated once the
+        registry is bound to a metrics registry —
+        :meth:`bind_observability`).  See docs/concepts.md
+        ("Capacity & cost")."""
+        return self._compiled.ledger_snapshot()
+
+    # ------------------------------------------------------------------
+    # arena memory accounting (capacity & cost plane)
+    # ------------------------------------------------------------------
+    def arena_bytes_total(self) -> int:
+        """Device bytes pinned by RESIDENT rows across every arena —
+        the capacity plane's memory-economics number (preallocated
+        free rows are capacity, not cost)."""
+        with self._arena_lock:
+            arenas = list(self._arenas.values())
+        return sum(a.occupied_rows * a.row_nbytes for a in arenas)
+
+    def arena_bytes_by_model(self) -> Dict[str, int]:
+        """Each resident model's device-byte footprint (its bucket
+        arena's per-row bytes — every row in a bucket costs the
+        same)."""
+        out: Dict[str, int] = {}
+        with self._arena_lock:
+            for mid, (bucket, _row) in self._row_map.items():
+                arena = self._arenas.get(bucket)
+                if arena is not None and not arena.lost:
+                    out[mid] = arena.row_nbytes
+        return out
 
     @property
     def compile_stats(self) -> Dict[str, int]:

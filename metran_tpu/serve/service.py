@@ -61,6 +61,7 @@ from typing import NamedTuple, Optional, Tuple
 import numpy as np
 
 from ..obs import Observability
+from ..obs.capacity import CapacityTracker, window_label
 from ..ops.kalman import GATE_DOWNWEIGHTED, GATE_REJECTED
 from ..reliability.faultinject import corrupt, corrupting, fire
 from ..reliability.health import HealthMonitor
@@ -526,8 +527,9 @@ class MetranService:
         fixed_lag: Optional[int] = None,
         refit: Optional[RefitSpec] = None,
         detect: Optional[DetectSpec] = None,
+        capacity=None,
     ):
-        from ..config import serve_defaults
+        from ..config import obs_defaults, serve_defaults
 
         defaults = serve_defaults()
         if flush_deadline == "default":
@@ -556,6 +558,28 @@ class MetranService:
             ServeMetrics.registered(self.obs.metrics)
             if self.obs.metrics is not None else ServeMetrics()
         )
+        # capacity & cost plane (obs.capacity; docs/concepts.md
+        # "Capacity & cost"): stage-latency decomposition, dispatch
+        # utilization, SLO burn rate and per-model cost accounting.
+        # Armed whenever metrics are (METRAN_TPU_OBS_CAPACITY, shipped
+        # on — per-dispatch stamps, measured under the 5%/1% bars by
+        # bench.py --phase capacity); pass capacity=False to disable,
+        # capacity=True to force it on regardless of the env knob, or
+        # a CapacityTracker (injectable clock) to control it.
+        obs_d = obs_defaults()
+        self.capacity: Optional[CapacityTracker] = None
+        if isinstance(capacity, CapacityTracker):
+            self.capacity = capacity
+        elif capacity or (
+            capacity is None
+            and self.obs.metrics is not None
+            and obs_d["capacity"]
+        ):
+            self.capacity = CapacityTracker(
+                registry=self.obs.metrics,
+                sample_every=obs_d["capacity_sample"],
+                slo_s=obs_d["slo_ms"] / 1e3,
+            )
         self.reliability = (
             reliability if reliability is not None
             else ReliabilityPolicy.from_defaults()
@@ -667,7 +691,14 @@ class MetranService:
         # the service's instruments, and the liveness/health state is
         # published as callback gauges (evaluated at scrape time)
         self.registry.bind_observability(
-            metrics=self.obs.metrics, events=self.events
+            metrics=self.obs.metrics, events=self.events,
+            device_sample_every=(
+                self.capacity.sample_every
+                if self.capacity is not None else 1
+            ),
+            # the kernel dispatch/device-seconds ledger is the
+            # capacity plane's attribution half — off with it
+            ledger=self.capacity is not None,
         )
         if self.readpath is not None:
             self.readpath.events = self.events
@@ -708,6 +739,14 @@ class MetranService:
                     "currently-active detection alerts "
                     "(raise/clear hysteresis applied at read time)",
                     callback=lambda: float(board.active_count()),
+                )
+            if self.capacity is not None:
+                m.gauge(
+                    "metran_serve_queue_oldest_wait_seconds",
+                    "age of the oldest still-queued request (an old "
+                    "head means dispatch is not keeping up — the "
+                    "queue-saturation signal next to queue depth)",
+                    callback=lambda: float(self.batcher.oldest_wait()),
                 )
         # continuous adaptation (serve.refit): a worker attaches via
         # _attach_refit (arming tail recording on the dispatch paths);
@@ -931,6 +970,10 @@ class MetranService:
         )
         if not (n_an or n_cp or n_lb):
             return
+        if self.capacity is not None:
+            self.capacity.costs.charge(
+                model_id, "detect_alarms", n_an + n_cp + n_lb
+            )
         booked = self.metrics.detect_total
         if n_an:
             booked.increment("anomaly", n_an)
@@ -1865,9 +1908,15 @@ class MetranService:
 
     def _update_batch_arena(self, ids, obs_list) -> list:
         t0 = time.monotonic()
+        cap = self.capacity
+        acc = cap.begin_dispatch() if cap is not None else None
         g_total = len(ids)
         results: list = [None] * g_total
+        t_lock0 = time.monotonic()
         with self._update_lock:
+            t_r0 = time.monotonic()
+            if acc is not None:
+                cap.observe_stage("lock", t_r0 - t_lock0)
             hits, errs = self.registry.rows_for(ids, pin=True)
             live, pinned = [], []
             for i, err in enumerate(errs):
@@ -1877,12 +1926,18 @@ class MetranService:
                 else:
                     self.metrics.errors.increment("lookup_failures")
                     results[i] = err
+            if acc is not None:
+                # row resolution + pinning for the whole tick
+                cap.observe_stage(
+                    "host_prep", time.monotonic() - t_r0
+                )
             try:
                 self._update_batch_buckets(
                     ids, obs_list, hits, live, results
                 )
             finally:
                 self.registry.release_rows(pinned)
+        t_pb0 = time.monotonic()
         n_err = sum(isinstance(r, BaseException) for r in results)
         self.monitor.record_many(g_total - n_err, n_err)
         if n_err:
@@ -1891,7 +1946,13 @@ class MetranService:
         # one latency sample for the whole tick: the feed sees one
         # call, and G copies of the same value would drown the
         # per-request percentiles
-        self.metrics.update_latency.record(time.monotonic() - t0)
+        now = time.monotonic()
+        self.metrics.update_latency.record(now - t0)
+        if acc is not None:
+            # trailing outcome booking is telemetry (publish), and a
+            # bulk tick is ONE caller request with no queue wait
+            cap.observe_stage("publish", now - t_pb0)
+            cap.end_dispatch(acc, [], t0, now)
         return results
 
     def _update_batch_buckets(self, ids, obs_list, hits, live, results):
@@ -1899,7 +1960,10 @@ class MetranService:
         (rows already resolved and pinned by the caller)."""
         gate = self.gate
         gated = gate.enabled
+        cap = self.capacity
+        acc = cap.active() if cap is not None else None
         for bucket, idxs in self._bucket_groups(hits, live).items():
+            t_b0 = time.monotonic()
             try:
                 arena = self.registry.arena_of(bucket)
             except Exception as exc:  # noqa: BLE001 - per-bucket
@@ -2004,6 +2068,12 @@ class MetranService:
                 arena.dtype, copy=False
             )
             m = mask & real
+            if acc is not None:
+                # vectorized validation + standardization above; the
+                # helper below stamps its own host/lock/device/publish
+                cap.observe_stage(
+                    "host_prep", time.monotonic() - t_b0
+                )
             # the steady/exact kernel split + lock regions + commit
             # snapshots + snapshot publish all live in the shared
             # helper (same engine as _run_update_arena); names are
@@ -2018,6 +2088,7 @@ class MetranService:
                     ),
                 )
             )
+            t_pb0 = time.monotonic()
             if gated:
                 self._book_gate_verdicts_bulk(
                     idxs, ids, zs, verdicts, n_sl
@@ -2066,6 +2137,11 @@ class MetranService:
                         "invalid posterior; the request was not "
                         "applied and the arena row is unchanged"
                     )
+            if acc is not None:
+                # gate/empty/result booking after the dispatch helper
+                cap.observe_stage(
+                    "publish", time.monotonic() - t_pb0
+                )
 
     def _book_gate_verdicts_bulk(self, idxs, ids, zs, verdicts, n_sl):
         """Vectorized gate-outcome booking for one bulk dispatch:
@@ -2092,6 +2168,12 @@ class MetranService:
             (ids[i], int(n_obs_m[gi]), int(n_flag_m[gi]))
             for gi, i in enumerate(idxs)
         )
+        if (n_rej or n_dw) and self.capacity is not None:
+            costs = self.capacity.costs
+            for gi, i in enumerate(idxs):
+                nf = int(n_flag_m[gi])
+                if nf:
+                    costs.charge(ids[i], "gate_flags", nf)
         if (n_rej or n_dw) and self.events is not None:
             for gi, row, col in zip(*np.nonzero(rej | dw)):
                 i = idxs[gi]
@@ -2111,6 +2193,8 @@ class MetranService:
         version/scaler snapshot, transferred to host.  Returns
         ``(means, variances, versions, sm, sd)`` or the exception that
         failed the whole bucket (per-bucket channel)."""
+        cap = self.capacity
+        acc = cap.active() if cap is not None else None
         try:
             arena = self.registry.arena_of(bucket)
             fn = self.registry.arena_forecast_fn(bucket, steps)
@@ -2118,21 +2202,32 @@ class MetranService:
             rows_p, _ = self._pad_dispatch(
                 rows_arr, arena.scratch_row, ()
             )
+            t_l0 = time.monotonic()
             with arena.lock:
+                t_d0 = time.monotonic()
+                if acc is not None:
+                    cap.observe_stage("lock", t_d0 - t_l0)
                 out = arena.query(fn, rows_p)
                 versions = arena.version_host[rows_arr].copy()
                 sm = arena.scaler_mean[rows_arr][:, None, :]
                 sd = arena.scaler_std[rows_arr][:, None, :]
             g = len(rows_arr)
-            return (
+            queried = (
                 np.asarray(out[0])[:g], np.asarray(out[1])[:g],
                 versions, sm, sd,
             )
+            if acc is not None:
+                cap.observe_stage(
+                    "device", time.monotonic() - t_d0
+                )
+            return queried
         except Exception as exc:  # noqa: BLE001 - per-bucket channel
             return exc
 
     def _forecast_batch_arena(self, ids, steps: int) -> list:
         t0 = time.monotonic()
+        cap = self.capacity
+        acc = cap.begin_dispatch() if cap is not None else None
         results: list = [None] * len(ids)
         hits, errs = self.registry.rows_for(ids, pin=True)
         live, pinned = [], []
@@ -2144,6 +2239,8 @@ class MetranService:
                 self.metrics.errors.increment("lookup_failures")
                 results[i] = err
         validate = self.reliability.validate_updates
+        if acc is not None:
+            cap.observe_stage("host_prep", time.monotonic() - t0)
         try:
             groups = [
                 (bucket, idxs, self._forecast_batch_query(
@@ -2154,6 +2251,7 @@ class MetranService:
             ]
         finally:
             self.registry.release_rows(pinned)
+        t_pb0 = time.monotonic()
         for bucket, idxs, queried in groups:
             if isinstance(queried, BaseException):
                 for i in idxs:
@@ -2193,7 +2291,19 @@ class MetranService:
         if n_err:
             self.metrics.errors.increment("forecast_errors", n_err)
         self.metrics.occupancy.record(len(ids))
-        self.metrics.forecast_latency.record(time.monotonic() - t0)
+        now = time.monotonic()
+        self.metrics.forecast_latency.record(now - t0)
+        if cap is not None:
+            if acc is not None:
+                cap.observe_stage("publish", now - t_pb0)
+                cap.end_dispatch(acc, [], t0, now)
+            cap.costs.charge_many(
+                [ids[i] for i in live
+                 if not isinstance(results[i], BaseException)],
+                "reads",
+                cap.device_charge(acc.stages["device"])
+                if acc is not None else 0.0,
+            )
         return results
 
     def health(self) -> dict:
@@ -2210,12 +2320,33 @@ class MetranService:
         """
         open_breakers = self.breakers.open_models()
         alive = self.batcher.worker_alive() and not self.batcher.closed
+        # the serve-SLO the latency snapshot is judged against: the
+        # capacity plane's configured bound, or the configured
+        # METRAN_TPU_OBS_SLO_MS when capacity instrumentation is off
+        if self.capacity is not None:
+            slo_s = self.capacity.slo.slo_s
+        else:
+            from ..config import obs_defaults
+
+            slo_s = obs_defaults()["slo_ms"] / 1e3
         snap = self.monitor.snapshot({
             "ready": bool(alive and self.monitor.healthy()),
             "batcher": {
                 "worker_alive": alive,
                 "pending": self.batcher.pending(),
+                "oldest_wait_s": round(self.batcher.oldest_wait(), 4),
                 "flush_deadline_s": self.batcher.flush_deadline,
+            },
+            # p50/p99/p999 + windowed SLO-violation fraction over the
+            # recent sample window (what bench.py computes offline,
+            # now live on the health endpoint)
+            "latency": {
+                "update": self.metrics.update_latency.stats(
+                    slo_s=slo_s
+                ),
+                "forecast": self.metrics.forecast_latency.stats(
+                    slo_s=slo_s
+                ),
             },
             "breakers": {
                 "open": open_breakers,
@@ -2249,8 +2380,71 @@ class MetranService:
             }} if self.detect.enabled else {}),
             **({"refit": self._refit_worker.stats()}
                if self._refit_worker is not None else {}),
+            **({"capacity": {
+                "coverage": round(self.capacity.coverage(), 4),
+                "utilization_60s": round(
+                    self.capacity.utilization(), 4
+                ),
+                "slo_burn": {
+                    window_label(w): round(
+                        self.capacity.slo.burn_rate(w), 4
+                    )
+                    for w in self.capacity.slo.windows
+                },
+            }} if self.capacity is not None else {}),
         })
         return snap
+
+    def capacity_report(self) -> dict:
+        """The capacity & cost plane's structured snapshot (requires
+        capacity instrumentation, on by default with metrics —
+        ``METRAN_TPU_OBS_CAPACITY``; docs/concepts.md "Capacity &
+        cost").  One dict answering, from live instruments alone:
+        where request time goes (stage decomposition + coverage
+        invariant), how saturated the dispatch thread is, how fast the
+        SLO error budget burns, what each compiled kernel has cost
+        (compile wall, dispatches, device-seconds), which models are
+        the expensive ones, and what the arena's resident rows pin in
+        device memory.  Rendered by ``tools/capacity_report.py``;
+        validated end-to-end by ``bench.py --phase capacity``."""
+        cap = self.capacity
+        if cap is None:
+            raise ValueError(
+                "capacity instrumentation is disabled; construct the "
+                "service with metrics enabled and "
+                "METRAN_TPU_OBS_CAPACITY=1 (the default), or pass "
+                "capacity=CapacityTracker(...)"
+            )
+        slo_s = cap.slo.slo_s
+        report = {
+            **cap.report(),
+            "queue_depth": self.batcher.pending(),
+            "queue_oldest_wait_s": round(
+                self.batcher.oldest_wait(), 4
+            ),
+            "latency": {
+                "update": self.metrics.update_latency.stats(
+                    slo_s=slo_s
+                ),
+                "forecast": self.metrics.forecast_latency.stats(
+                    slo_s=slo_s
+                ),
+            },
+            "kernels": self.registry.kernel_ledger(),
+            "compile_stats": dict(self.registry.compile_stats),
+        }
+        if self.registry.arena_enabled:
+            by_model = self.registry.arena_bytes_by_model()
+            report["arena"] = {
+                "bytes_resident": self.registry.arena_bytes_total(),
+                "rows": dict(self.registry.arena_stats),
+                "bytes_per_model_max": (
+                    max(by_model.values()) if by_model else 0
+                ),
+            }
+        if self.readpath is not None:
+            report["readpath"] = self.readpath.stats()
+        return report
 
     def close(self) -> None:
         # the refit worker stops FIRST: a promotion must never race
@@ -2303,6 +2497,12 @@ class MetranService:
     # ------------------------------------------------------------------
     def _dispatch(self, batch_key, requests):
         kind, bucket, horizon = batch_key
+        # capacity plane: one stage accumulator per sampled dispatch,
+        # parked thread-locally so the _run_* helpers below record
+        # host/device/publish segments without signature changes
+        cap = self.capacity
+        acc = cap.begin_dispatch() if cap is not None else None
+        t_claim = time.monotonic()
         tracer = self.tracer
         t_dispatch0 = None
         if tracer is not None:
@@ -2349,7 +2549,12 @@ class MetranService:
                     rounds.append([])
                 rounds[r].append(pos)
             results = [None] * len(requests)
+            t_lock0 = time.monotonic()
             with self._update_lock:
+                if acc is not None:
+                    cap.observe_stage(
+                        "lock", time.monotonic() - t_lock0
+                    )
                 failed = None
                 broken: set = set()  # models whose per-slot chain broke
                 for positions in rounds:
@@ -2416,9 +2621,20 @@ class MetranService:
             raise ValueError(f"unknown dispatch kind {kind!r}")
         self.metrics.occupancy.record(len(requests))
         now = time.monotonic()  # Request.enqueued_at is monotonic too
-        for req in requests:
-            # queueing time + dispatch time, as the caller experienced it
-            latency.record(now - req.enqueued_at)
+        # queueing time + dispatch time, as the caller experienced it
+        # (one bulk record per batch — per-request lock traffic was
+        # measurable on the forecast hot path)
+        lat = [now - req.enqueued_at for req in requests]
+        latency.record_many(lat)
+        if acc is not None:
+            # the queue stage is each rider's enqueue -> claim wait;
+            # end-to-end wall per rider is wait + the shared dispatch
+            # span (the decomposition invariant's denominator)
+            span = now - t_claim
+            cap.end_dispatch(
+                acc, [max(w - span, 0.0) for w in lat], t_claim, now,
+                latencies=lat,
+            )
         if tracer is not None:
             t_end = tracer.clock()
             if kind == "update":
@@ -2487,6 +2703,10 @@ class MetranService:
             self.metrics.gate_verdicts.increment("rejected", n_rej)
         if n_dw:
             self.metrics.gate_verdicts.increment("downweighted", n_dw)
+        if (n_rej or n_dw) and self.capacity is not None:
+            self.capacity.costs.charge(
+                st.model_id, "gate_flags", n_rej + n_dw
+            )
         if (n_rej or n_dw) and self.events is not None:
             request_id = (
                 trace_ctx.trace_id if trace_ctx is not None else None
@@ -2544,6 +2764,9 @@ class MetranService:
 
         if self.registry.arena_enabled:
             return self._run_forecast_arena(bucket, steps, requests)
+        cap = self.capacity
+        acc = cap.active() if cap is not None else None
+        t_h0 = time.monotonic()
         results: list = [None] * len(requests)
         states, live = self._lookup_states(requests, results)
         if not live:
@@ -2551,9 +2774,15 @@ class MetranService:
         tracer = self.tracer
         batch = stack_bucket(states, bucket)
         fn = self.registry.forecast_fn(bucket, steps)
+        t_k0 = time.monotonic()
+        if acc is not None:
+            cap.observe_stage("host_prep", t_k0 - t_h0)
         t_eng0 = tracer.clock() if tracer is not None else None
         means, variances = fn(batch.ss, batch.mean, batch.cov)
         means, variances = np.asarray(means), np.asarray(variances)
+        t_k1 = time.monotonic()
+        if acc is not None:
+            cap.observe_stage("device", t_k1 - t_k0)
         if tracer is not None:
             # the single batched kernel execution, attributed to every
             # live request; the name matches the device-trace
@@ -2594,6 +2823,18 @@ class MetranService:
                 variances=v * st.scaler_std**2,
                 names=st.names,
                 version=st.version,
+            )
+        if cap is not None:
+            if acc is not None:
+                cap.observe_stage("publish", time.monotonic() - t_k1)
+            # served slots only, like the update paths: a poisoned
+            # forecast must not buy its model a cost-ledger read
+            cap.costs.charge_many(
+                [st.model_id for st, j in zip(states, live)
+                 if not isinstance(results[j], BaseException)],
+                "reads",
+                cap.device_charge(t_k1 - t_k0)
+                if acc is not None else 0.0,
             )
         return results
 
@@ -2651,6 +2892,9 @@ class MetranService:
         external ``registry.put`` replaced it)."""
         from .engine import stack_bucket, state_slot_index
 
+        cap = self.capacity
+        acc = cap.active() if cap is not None else None
+        t_h0 = time.monotonic()
         sub = [requests[j] for j in idxs]
         local: list = [None] * len(sub)
         states, live = self._lookup_states(sub, local)
@@ -2708,6 +2952,9 @@ class MetranService:
             detect=det,
         )
         tracer = self.tracer
+        t_k0 = time.monotonic()
+        if acc is not None:
+            cap.observe_stage("host_prep", t_k0 - t_h0)
         t_eng0 = tracer.clock() if tracer is not None else None
         armed = (
             np.array(
@@ -2750,6 +2997,9 @@ class MetranService:
         else:
             mean_t, _sigma, _detf, broke = outs
         mean_t, broke = np.asarray(mean_t), np.asarray(broke)
+        t_k1 = time.monotonic()
+        if acc is not None:
+            cap.observe_stage("device", t_k1 - t_k0)
         if tracer is not None:
             tracer.record_shared(
                 "serve.engine.update",
@@ -2876,6 +3126,17 @@ class MetranService:
                 rp.publish_entries(snap_entries)
             except Exception:  # pragma: no cover - cache only
                 logger.exception("snapshot publish failed (cache only)")
+        if cap is not None:
+            if acc is not None:
+                cap.observe_stage("publish", time.monotonic() - t_k1)
+            cap.costs.charge_many(
+                [states[si].model_id for si, j, _ in keep
+                 if not isinstance(results[idxs[j]], BaseException)
+                 and results[idxs[j]] is not None],
+                "updates",
+                cap.device_charge(t_k1 - t_k0)
+                if acc is not None else 0.0,
+            )
         return thawed
 
     def _run_update_dict(self, bucket, k: int, requests):
@@ -2886,6 +3147,9 @@ class MetranService:
         ``conv``)."""
         from .engine import posterior_fault, stack_bucket, state_slot_index
 
+        cap = self.capacity
+        acc = cap.active() if cap is not None else None
+        t_h0 = time.monotonic()
         results: list = [None] * len(requests)
         states, live = self._lookup_states(requests, results)
         if not live:
@@ -2918,6 +3182,9 @@ class MetranService:
             detect=det,
         )
         tracer = self.tracer
+        t_k0 = time.monotonic()
+        if acc is not None:
+            cap.observe_stage("host_prep", t_k0 - t_h0)
         t_eng0 = tracer.clock() if tracer is not None else None
         chol_t = cov_t = z_t = verdict_t = None
         fac_b = batch.chol if sqrt_engine else batch.cov
@@ -2971,6 +3238,9 @@ class MetranService:
             cov_t = np.asarray(fac_t)
         mean_t = np.asarray(mean_t)
         sigma_t, detf_t = np.asarray(sigma_t), np.asarray(detf_t)
+        t_k1 = time.monotonic()
+        if acc is not None:
+            cap.observe_stage("device", t_k1 - t_k0)
         if tracer is not None:
             # the batched kernel execution (device round-trip included
             # — the asarray conversions block on it), attributed to
@@ -3258,6 +3528,17 @@ class MetranService:
                 rp.publish_entries(snap_entries)
             except Exception:  # pragma: no cover - cache only
                 logger.exception("snapshot publish failed (cache only)")
+        if cap is not None:
+            if acc is not None:
+                cap.observe_stage("publish", time.monotonic() - t_k1)
+            cap.costs.charge_many(
+                [st.model_id for st, j in zip(states, live)
+                 if not isinstance(results[j], BaseException)
+                 and results[j] is not None],
+                "updates",
+                cap.device_charge(t_k1 - t_k0)
+                if acc is not None else 0.0,
+            )
         return results
 
     # ------------------------------------------------------------------
@@ -3374,6 +3655,10 @@ class MetranService:
         rp = self.readpath
         det = self.detect if self.detect.enabled else None
         steady = self.steady if self.steady.enabled else None
+        cap = self.capacity
+        acc = cap.active() if cap is not None else None
+        t_seg = time.monotonic()  # running stage-segment cursor
+        dev_s = 0.0
         g = len(rows_arr)
         n_pad = bucket[0]
         ok = np.zeros(g, bool)
@@ -3419,7 +3704,13 @@ class MetranService:
                 (real_all[s_pos], y[s_pos], m[s_pos]),
             )
             fm_s = None
+            t_l0 = time.monotonic()
+            if acc is not None:
+                cap.observe_stage("host_prep", t_l0 - t_seg)
             with arena.lock:
+                t_d0 = time.monotonic()
+                if acc is not None:
+                    cap.observe_stage("lock", t_d0 - t_l0)
                 if det is not None:
                     outs = arena.apply_steady_det(
                         fn, rows_p, real_p, y_p, m_p,
@@ -3443,6 +3734,10 @@ class MetranService:
                     outs, fm_s = outs[:-1], np.asarray(outs[-1])
                 applied = np.asarray(outs[0])[: len(s_pos)]
                 vers, ts = arena.commit_rows(rows_s, applied, k)
+            t_seg = time.monotonic()
+            if acc is not None:
+                cap.observe_stage("device", t_seg - t_d0)
+            dev_s += t_seg - t_d0
             if det is not None:
                 det_counts[s_pos] = dc_s[: len(s_pos)]
                 det_stat_parts.append((s_pos, dst_s))
@@ -3489,7 +3784,13 @@ class MetranService:
                 (real_all[e_pos], y[e_pos], m[e_pos]),
             )
             conv = None
+            t_l0 = time.monotonic()
+            if acc is not None:
+                cap.observe_stage("host_prep", t_l0 - t_seg)
             with arena.lock:
+                t_d0 = time.monotonic()
+                if acc is not None:
+                    cap.observe_stage("lock", t_d0 - t_l0)
                 if det is not None:
                     # the detect kernel has ONE signature (engine.py):
                     # gate/steady args always present, unused halves
@@ -3527,6 +3828,10 @@ class MetranService:
                     )
                 ok_e = np.asarray(outs[0])[: len(e_pos)]
                 vers, ts = arena.commit_rows(rows_e, ok_e, k)
+            t_seg = time.monotonic()
+            if acc is not None:
+                cap.observe_stage("device", t_seg - t_d0)
+            dev_s += t_seg - t_d0
             if det is not None:
                 det_counts[e_pos] = dc_e[: len(e_pos)]
                 det_stat_parts.append((e_pos, dst_e))
@@ -3577,6 +3882,18 @@ class MetranService:
                 ids, rows_arr, ok, versions, t_seens, det_counts,
                 det_stat_parts, arena,
             )
+        if cap is not None:
+            cap.costs.charge_many(
+                [ids[gi] for gi in np.flatnonzero(ok)], "updates",
+                dev_s,
+            )
+            if acc is not None:
+                # everything after the last kernel — freeze DARE
+                # solves, snapshot publish, detection booking, the
+                # cost charge itself — is the publish stage
+                cap.observe_stage(
+                    "publish", time.monotonic() - t_seg
+                )
         return ok, versions, t_seens, zs, verdicts
 
     def _lookup_rows(self, requests, results):
@@ -3609,6 +3926,9 @@ class MetranService:
         horizon kernel, entirely on device — no state stacking, no
         (B, S, S) host transfer.  Per-slot isolation as in
         ``_run_forecast`` (non-finite moments fail that slot alone)."""
+        cap = self.capacity
+        acc = cap.active() if cap is not None else None
+        t_h0 = time.monotonic()
         results: list = [None] * len(requests)
         rows, metas, live, pinned = self._lookup_rows(requests, results)
         try:
@@ -3622,7 +3942,13 @@ class MetranService:
             rows_p, _ = self._pad_dispatch(
                 rows_arr, arena.scratch_row, ()
             )
+            t_l0 = time.monotonic()
+            if acc is not None:
+                cap.observe_stage("host_prep", t_l0 - t_h0)
             with arena.lock:  # versions must match the snapshot served
+                t_d0 = time.monotonic()
+                if acc is not None:
+                    cap.observe_stage("lock", t_d0 - t_l0)
                 out = arena.query(fn, rows_p)
                 versions = arena.version_host[rows_arr].copy()
         finally:
@@ -3630,6 +3956,9 @@ class MetranService:
         g = len(rows_arr)
         means = np.asarray(out[0])[:g]
         variances = np.asarray(out[1])[:g]
+        t_k1 = time.monotonic()
+        if acc is not None:
+            cap.observe_stage("device", t_k1 - t_d0)
         if tracer is not None:
             t_eng1 = tracer.clock()
             tracer.record_shared(
@@ -3667,6 +3996,16 @@ class MetranService:
                 variances=v * meta.scaler_std**2,
                 names=meta.names,
                 version=int(versions[i]),
+            )
+        if cap is not None:
+            if acc is not None:
+                cap.observe_stage("publish", time.monotonic() - t_k1)
+            cap.costs.charge_many(
+                [meta.model_id for meta, j in zip(metas, live)
+                 if not isinstance(results[j], BaseException)],
+                "reads",
+                cap.device_charge(t_k1 - t_d0)
+                if acc is not None else 0.0,
             )
         return results
 

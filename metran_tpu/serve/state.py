@@ -678,6 +678,34 @@ class StateArena:
 
     # -- row bookkeeping ------------------------------------------------
     @property
+    def row_nbytes(self) -> int:
+        """Device bytes one row pins across every leaf: posterior
+        (mean, factor), counters, the resident built state space
+        (phi/q/z/r), the steady leaves (flag, gain, innovation
+        variances) and the detector leaf — the capacity plane's
+        per-model memory cost in this bucket
+        (``ModelRegistry.arena_bytes_by_model``)."""
+        from ..ops.detect import DETECT_STATE_ROWS
+
+        n_pad, s_pad = self.bucket
+        per_row_floats = (
+            s_pad                      # mean
+            + s_pad * s_pad            # fac (chol or cov)
+            + s_pad                    # phi (diagonal transition)
+            + s_pad * s_pad            # q
+            + n_pad * s_pad            # z
+            + n_pad                    # r
+            + s_pad * n_pad            # kgain (steady leaf)
+            + n_pad                    # fdiag (steady leaf)
+            + DETECT_STATE_ROWS * n_pad  # detector accumulators
+        )
+        return (
+            per_row_floats * self.dtype.itemsize
+            + 2 * 4  # t_seen + version (int32)
+            + 1      # steady flag (bool)
+        )
+
+    @property
     def free_rows(self) -> int:
         with self.lock:
             return len(self._free)

@@ -1,0 +1,586 @@
+"""Capacity & cost plane (`metran_tpu.obs.capacity`) — ISSUE 13.
+
+Pins the plane's externally-consumed contracts:
+
+1. **burn rate** — deterministic multi-window error-budget math under
+   an injectable clock (violation fraction over budget, windowed
+   expiry, validation of inert configs);
+2. **stage decomposition** — the tracker's coverage invariant,
+   sampling semantics, and the per-stage recorder family's Prometheus
+   grammar on a LIVE service (reusing `test_obs.validate_prometheus`);
+3. **cost accounting** — per-model ledger counts/amortized
+   device-seconds, `top_models` ordering, bounded pruning;
+4. **kernel ledger** — per-(bucket, kind) compile wall / dispatch
+   count / device-seconds on a live registry;
+5. **satellites** — `health()`'s p999 + `slo_violation_fraction`,
+   event-sink size rotation, `tools/bench_trend.py` extraction and
+   regression flags, `tools/capacity_report.py` rendering.
+
+Select alone with `pytest -m obs`; everything here is inside tier-1.
+"""
+
+import importlib.util
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from metran_tpu.obs import EventLog, MetricsRegistry, Observability
+from metran_tpu.obs.capacity import (
+    STAGES,
+    BurnRateMonitor,
+    CapacityTracker,
+    ModelCostLedger,
+    window_label,
+)
+from metran_tpu.serve import MetranService, ModelRegistry, PosteriorState
+
+from test_obs import validate_prometheus
+
+pytestmark = pytest.mark.obs
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, REPO / "tools" / f"{name}.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ----------------------------------------------------------------------
+# burn rate (deterministic, injectable clock)
+# ----------------------------------------------------------------------
+def test_burn_rate_deterministic_windows():
+    now = [1000.0]
+    mon = BurnRateMonitor(
+        slo_s=0.05, budget=0.01, windows=(300.0, 3600.0),
+        bucket_s=10.0, clock=lambda: now[0],
+    )
+    # 90 fast + 10 slow requests: 10% violations = 10x the 1% budget
+    mon.observe_many([0.01] * 90 + [0.10] * 10)
+    for w in (300.0, 3600.0):
+        st = mon.window_stats(w)
+        assert st["requests"] == 100
+        assert st["violations"] == 10
+        assert st["violation_fraction"] == pytest.approx(0.1)
+        assert st["burn_rate"] == pytest.approx(10.0)
+    # 10 minutes later the 5m window has forgotten, the 1h one has not
+    now[0] += 600.0
+    assert mon.window_stats(300.0)["requests"] == 0
+    assert mon.burn_rate(300.0) == 0.0
+    assert mon.window_stats(3600.0)["violations"] == 10
+    # lifetime totals survive window expiry
+    assert mon.total == 100 and mon.violations == 10
+    snap = mon.snapshot()
+    assert snap["slo_ms"] == pytest.approx(50.0)
+    assert set(snap["windows"]) == {"5m", "1h"}
+
+
+def test_burn_rate_boundary_and_bulk_equivalence():
+    now = [0.0]
+    mon = BurnRateMonitor(clock=lambda: now[0])
+    mon.observe(0.05)   # exactly at the SLO: not a violation
+    mon.observe(0.0501)
+    assert mon.violations == 1
+    mon2 = BurnRateMonitor(clock=lambda: now[0])
+    mon2.observe_many([0.05, 0.0501])
+    assert mon2.violations == mon.violations
+    assert mon2.total == mon.total
+
+
+def test_burn_rate_rejects_inert_configs():
+    with pytest.raises(ValueError):
+        BurnRateMonitor(slo_s=0.0)
+    with pytest.raises(ValueError):
+        BurnRateMonitor(budget=0.0)
+    with pytest.raises(ValueError):
+        BurnRateMonitor(budget=1.5)
+    with pytest.raises(ValueError):
+        BurnRateMonitor(windows=())
+    with pytest.raises(ValueError):
+        BurnRateMonitor(windows=(0.0,))
+
+
+def test_window_label():
+    assert window_label(300) == "5m"
+    assert window_label(3600) == "1h"
+    assert window_label(7200) == "2h"
+    assert window_label(45) == "45s"
+
+
+# ----------------------------------------------------------------------
+# per-model cost ledger
+# ----------------------------------------------------------------------
+def test_cost_ledger_counts_amortization_and_top():
+    led = ModelCostLedger()
+    led.charge_many(["a", "b", "c", "d"], "updates", 0.4)
+    led.charge_many(["a", "b"], "reads", 0.2)
+    led.charge("a", "gate_flags", 3)
+    led.charge("d", "detect_alarms", 2)
+    led.count_refit("b")
+    top = led.top_models("device_s", limit=2)
+    # a and b each carry 0.1 (update share) + 0.1 (read share)
+    assert {t["model_id"] for t in top} == {"a", "b"}
+    assert top[0]["device_s"] == pytest.approx(0.2)
+    a = next(t for t in led.top_models("gate_flags")
+             if t["model_id"] == "a")
+    assert a["updates"] == 1 and a["reads"] == 1
+    assert a["gate_flags"] == 3 and a["refits"] == 0
+    b = next(t for t in led.top_models("refits")
+             if t["model_id"] == "b")
+    assert b["refits"] == 1
+    assert led.top_models("updates")[0]["updates"] == 1
+    with pytest.raises(ValueError):
+        led.top_models(by="nonsense")
+
+
+def test_cost_ledger_prunes_bounded():
+    led = ModelCostLedger(max_models=10)
+    for i in range(40):
+        # later models are hotter; the prune must keep the hot half
+        led.charge(f"m{i}", "updates", device_s=float(i))
+    assert len(led) <= 10
+    assert led.pruned > 0
+    kept = {t["model_id"] for t in led.top_models("device_s", 10)}
+    assert "m39" in kept  # the hottest model survived every prune
+    snap = led.snapshot(limit=3)
+    assert snap["tracked_models"] == len(led)
+    assert len(snap["top_by_device_s"]) == 3
+
+
+# ----------------------------------------------------------------------
+# capacity tracker (unit: manual dispatch lifecycle)
+# ----------------------------------------------------------------------
+def test_tracker_stage_accounting_and_coverage():
+    reg = MetricsRegistry()
+    now = [100.0]
+    cap = CapacityTracker(registry=reg, clock=lambda: now[0])
+    acc = cap.begin_dispatch()
+    assert acc is not None
+    # a leaked accumulator (a dispatch that died before end_dispatch)
+    # is discarded by the next begin, never left to blind accounting
+    acc2 = cap.begin_dispatch()
+    assert acc2 is not None and acc2 is not acc
+    assert cap.active() is acc2
+    acc = acc2
+    cap.observe_stage("lock", 0.01)
+    cap.observe_stage("host_prep", 0.02)
+    cap.observe_stage("device", 0.05)
+    cap.observe_stage("publish", 0.01)
+    now[0] += 0.1
+    # two riders: 0.02/0.04 queue waits on a 0.1 s shared span
+    cap.end_dispatch(acc, [0.02, 0.04], 100.0, 100.1)
+    # wall = 0.02 + 0.04 + 2*0.1; staged = 0.06 + 2*0.09
+    assert cap.coverage() == pytest.approx(0.24 / 0.26, abs=1e-6)
+    rep = cap.report()
+    assert rep["requests"] == 2 and rep["dispatches"] == 2
+    shares = {s: rep["stages"][s]["share"] for s in STAGES}
+    assert sum(shares.values()) == pytest.approx(1.0, abs=0.01)
+    assert rep["stages"]["device"]["count"] == 1
+    assert rep["stages"]["queue"]["count"] == 2
+    # utilization: 0.1 busy over the elapsed window
+    assert 0.0 < cap.utilization(60.0) <= 1.0
+    # the per-stage histograms render valid Prometheus
+    families = validate_prometheus(reg.render_prometheus())
+    for s in STAGES:
+        assert f"metran_serve_stage_{s}_seconds" in families
+    assert "metran_serve_stage_coverage_ratio" in families
+    assert "metran_serve_dispatch_utilization" in families
+    assert "metran_serve_slo_burn_rate_5m" in families
+    assert "metran_serve_slo_burn_rate_1h" in families
+
+
+def test_tracker_sampling_subset():
+    now = [0.0]
+    cap = CapacityTracker(sample_every=2, clock=lambda: now[0])
+    seen = 0
+    for i in range(6):
+        acc = cap.begin_dispatch()
+        if acc is not None:
+            seen += 1
+            cap.observe_stage("device", 0.001)
+            cap.end_dispatch(acc, [], 0.0, 0.002)
+    assert seen == 3  # every 2nd dispatch recorded
+    rep = cap.report()
+    assert rep["dispatches"] == 6
+    assert rep["sampled_dispatches"] == 3
+    # off a sampled dispatch, observe_stage is a no-op (never raises)
+    cap.observe_stage("device", 1.0)
+    assert rep["stages"]["device"]["count"] == 3
+
+
+def test_utilization_saturated_window_with_full_mark_ring():
+    from collections import deque
+
+    now = [0.0]
+    cap = CapacityTracker(clock=lambda: now[0])
+    # a long idle history, then a mark ring too small to span the
+    # window: the anchor must fall back to the OLDEST RETAINED mark,
+    # never to the process start (which would read saturation as idle)
+    cap._busy_marks = deque(maxlen=4)
+    now[0] = 10_000.0
+    for _ in range(12):  # back-to-back dispatches, 100% busy
+        acc = cap.begin_dispatch()
+        t0 = now[0]
+        now[0] += 1.0
+        cap.observe_stage("device", 1.0)
+        cap.end_dispatch(acc, [], t0, now[0])
+    assert cap.utilization(60.0) > 0.95
+
+
+def test_device_charge_scales_with_sampling():
+    cap = CapacityTracker(sample_every=4)
+    assert cap.device_charge(0.01) == pytest.approx(0.04)
+    assert CapacityTracker().device_charge(0.01) == pytest.approx(0.01)
+
+
+def test_capacity_true_forces_instrumentation(monkeypatch):
+    monkeypatch.setenv("METRAN_TPU_OBS_CAPACITY", "0")
+    rng = np.random.default_rng(9)
+    reg = ModelRegistry(root=None)
+    for st in _fleet_states(1, rng):
+        reg.put(st, persist=False)
+    off = MetranService(
+        reg, flush_deadline=None, persist_updates=False,
+    )
+    assert off.capacity is None  # the env knob disables the default
+    off.close()
+    on = MetranService(
+        reg, flush_deadline=None, persist_updates=False,
+        capacity=True,
+    )
+    assert on.capacity is not None  # an explicit True overrides it
+    on.capacity_report()
+    on.close()
+
+
+def test_tracker_unknown_stage_raises_on_sampled_dispatch():
+    cap = CapacityTracker()
+    acc = cap.begin_dispatch()
+    with pytest.raises(KeyError):
+        cap.observe_stage("not_a_stage", 0.1)
+    cap.end_dispatch(acc, [], 0.0, 0.001)
+
+
+# ----------------------------------------------------------------------
+# live service: decomposition, ledger, report, health satellites
+# ----------------------------------------------------------------------
+N_SERIES, T_HIST = 3, 24
+
+
+def _fleet_states(n_models, rng):
+    from metran_tpu.ops import dfm_statespace, kalman_filter
+
+    states = []
+    for i in range(n_models):
+        a_s = rng.uniform(5.0, 40.0, N_SERIES)
+        a_c = rng.uniform(10.0, 60.0, 1)
+        ld = rng.uniform(0.3, 0.8, (N_SERIES, 1))
+        y = rng.normal(size=(T_HIST, N_SERIES))
+        mask = np.ones_like(y, bool)
+        ss = dfm_statespace(a_s, a_c, ld, 1.0)
+        res = kalman_filter(ss, y, mask, engine="joint", store=False)
+        states.append(PosteriorState(
+            model_id=f"cap{i}", version=0, t_seen=T_HIST,
+            mean=np.asarray(res.mean_f), cov=np.asarray(res.cov_f),
+            params=np.concatenate([a_s, a_c]), loadings=ld, dt=1.0,
+            scaler_mean=np.zeros(N_SERIES),
+            scaler_std=np.ones(N_SERIES),
+            names=tuple(f"s{j}" for j in range(N_SERIES)),
+        ))
+    return states
+
+
+@pytest.fixture(scope="module")
+def capacity_service():
+    rng = np.random.default_rng(11)
+    reg = ModelRegistry(root=None)
+    for st in _fleet_states(3, rng):
+        reg.put(st, persist=False)
+    svc = MetranService(
+        reg, flush_deadline=None, persist_updates=False,
+    )
+    assert svc.capacity is not None  # metrics on -> capacity on
+    obs = rng.normal(size=(1, N_SERIES))
+    for _ in range(2):
+        futs = [svc.update_async(f"cap{i}", obs) for i in range(3)]
+        svc.flush()
+        [f.result() for f in futs]
+        futs = [svc.forecast_async(f"cap{i}", 4) for i in range(3)]
+        svc.flush()
+        [f.result() for f in futs]
+    yield svc
+    svc.close()
+
+
+def test_live_decomposition_coverage_and_report(capacity_service):
+    svc = capacity_service
+    rep = svc.capacity_report()
+    # the >= 90% invariant holds on real dispatches
+    assert rep["coverage"] >= 0.9
+    assert rep["dispatches"] >= 4
+    assert rep["requests"] >= 12
+    staged = sum(
+        d["seconds_total"] for d in rep["stages"].values()
+    )
+    assert staged > 0.0
+    # the kernel ledger attributes compile + dispatches per kernel
+    kinds = {k["kind"] for k in rep["kernels"]}
+    assert {"update", "forecast"} <= kinds
+    upd = next(k for k in rep["kernels"] if k["kind"] == "update")
+    assert upd["dispatches"] >= 2
+    assert upd["compile_s"] > 0.0
+    assert upd["device_s"] > 0.0  # post-compile calls measured
+    assert upd["bucket"] == [8, 16]
+    # per-model accounting covers every served model
+    top = rep["models"]["top_by_device_s"]
+    assert {t["model_id"] for t in top} == {"cap0", "cap1", "cap2"}
+    assert all(t["updates"] == 2 and t["reads"] == 2 for t in top)
+    # SLO snapshot + latency percentiles ride along
+    assert rep["slo"]["windows"]["5m"]["requests"] >= 12
+    assert rep["latency"]["update"]["p999_ms"] >= 0.0
+
+
+def test_live_prometheus_grammar_carries_capacity_families(
+    capacity_service,
+):
+    families = validate_prometheus(
+        capacity_service.obs.metrics.render_prometheus()
+    )
+    for s in STAGES:
+        fam = families[f"metran_serve_stage_{s}_seconds"]
+        assert fam["type"] == "histogram"
+    for name in (
+        "metran_serve_stage_coverage_ratio",
+        "metran_serve_dispatch_utilization",
+        "metran_serve_slo_burn_rate_5m",
+        "metran_serve_slo_burn_rate_1h",
+        "metran_serve_queue_oldest_wait_seconds",
+        "metran_serve_kernel_dispatches_total",
+        "metran_serve_kernel_device_seconds_total",
+        "metran_serve_changepoints_pending",
+    ):
+        assert name in families, name
+    # the kernel families carry one labelled sample per compiled kernel
+    dispatch_samples = families[
+        "metran_serve_kernel_dispatches_total"
+    ]["samples"]
+    assert any(
+        lb.get("key", "").startswith("update_")
+        for _, lb, _ in dispatch_samples
+    )
+
+
+def test_health_latency_snapshot_p999_and_slo(capacity_service):
+    h = capacity_service.health()
+    for kind in ("update", "forecast"):
+        lat = h["latency"][kind]
+        assert lat["n"] > 0
+        assert lat["p50_ms"] <= lat["p99_ms"] <= lat["p999_ms"]
+        assert lat["slo_ms"] == pytest.approx(50.0)
+        assert 0.0 <= lat["slo_violation_fraction"] <= 1.0
+    assert "capacity" in h
+    assert 0.0 <= h["capacity"]["coverage"] <= 1.0
+    assert set(h["capacity"]["slo_burn"]) == {"5m", "1h"}
+    assert "oldest_wait_s" in h["batcher"]
+
+
+def test_capacity_disabled_service():
+    rng = np.random.default_rng(5)
+    reg = ModelRegistry(root=None)
+    for st in _fleet_states(1, rng):
+        reg.put(st, persist=False)
+    svc = MetranService(
+        reg, flush_deadline=None, persist_updates=False,
+        observability=Observability.disabled(),
+    )
+    assert svc.capacity is None
+    fut = svc.update_async("cap0", np.zeros((1, N_SERIES)))
+    svc.flush()
+    fut.result()
+    with pytest.raises(ValueError, match="capacity"):
+        svc.capacity_report()
+    # health still carries the latency snapshot at the default SLO
+    assert svc.health()["latency"]["update"]["n"] == 1
+    svc.close()
+
+
+def test_capacity_false_opt_out_keeps_metrics():
+    rng = np.random.default_rng(6)
+    reg = ModelRegistry(root=None)
+    for st in _fleet_states(1, rng):
+        reg.put(st, persist=False)
+    svc = MetranService(
+        reg, flush_deadline=None, persist_updates=False,
+        capacity=False,
+    )
+    assert svc.capacity is None
+    assert svc.obs.metrics is not None
+    # no capacity families registered, and no kernel ledger built
+    text = svc.obs.metrics.render_prometheus()
+    assert "metran_serve_stage_" not in text
+    assert "metran_serve_kernel_dispatches_total" not in text
+    svc.close()
+
+
+def test_arena_bytes_accounting():
+    rng = np.random.default_rng(7)
+    reg = ModelRegistry(root=None, arena=True, arena_rows=4)
+    for st in _fleet_states(2, rng):
+        reg.put(st, persist=False)
+    svc = MetranService(
+        reg, flush_deadline=None, persist_updates=False,
+    )
+    res = svc.update_batch(
+        ["cap0", "cap1"], rng.normal(size=(2, 1, N_SERIES))
+    )
+    assert not any(isinstance(r, BaseException) for r in res)
+    by_model = reg.arena_bytes_by_model()
+    assert set(by_model) == {"cap0", "cap1"}
+    assert all(v > 0 for v in by_model.values())
+    assert reg.arena_bytes_total() == sum(by_model.values())
+    rep = svc.capacity_report()
+    assert rep["arena"]["bytes_resident"] == reg.arena_bytes_total()
+    # the bulk tick decomposes too (queue-less single request)
+    assert rep["coverage"] >= 0.9
+    families = validate_prometheus(
+        svc.obs.metrics.render_prometheus()
+    )
+    assert "metran_serve_arena_bytes_resident" in families
+    svc.close()
+
+
+# ----------------------------------------------------------------------
+# satellite: event-sink size rotation
+# ----------------------------------------------------------------------
+def test_event_sink_rotates_by_size(tmp_path):
+    sink = tmp_path / "events.jsonl"
+    # ~1 KB bound: a handful of events overflows it
+    log = EventLog(sink=str(sink), max_sink_mb=0.001)
+    for i in range(40):
+        log.emit("retry", model_id=f"m{i}", fault_point="serve.call",
+                 attempt=i, padding="x" * 64)
+    assert log.rotations >= 1
+    rotated = tmp_path / "events.jsonl.1"
+    assert rotated.exists()
+    # at most two files ever exist; both parse as JSON lines
+    for path in (sink, rotated):
+        for line in path.read_text().splitlines():
+            json.loads(line)
+    # the live file stays under bound + one event's slack
+    assert sink.stat().st_size < 1024 + 512
+    log.close()
+    assert log._sink is None  # owned fd released
+
+
+def test_event_sink_rotation_never_touches_caller_file(tmp_path):
+    path = tmp_path / "caller.jsonl"
+    fh = open(path, "a", encoding="utf-8")
+    try:
+        log = EventLog(sink=fh, max_sink_mb=0.0001)
+        for i in range(50):
+            log.emit("retry", model_id="m", padding="y" * 64)
+        # caller-provided file objects are never rotated nor closed
+        assert log.rotations == 0
+        assert not (tmp_path / "caller.jsonl.1").exists()
+        log.close()
+        assert not fh.closed
+    finally:
+        fh.close()
+
+
+def test_event_sink_unbounded_without_knob(tmp_path):
+    sink = tmp_path / "e.jsonl"
+    log = EventLog(sink=str(sink))
+    for i in range(50):
+        log.emit("retry", padding="z" * 64)
+    assert log.rotations == 0
+    assert not (tmp_path / "e.jsonl.1").exists()
+    log.close()
+
+
+# ----------------------------------------------------------------------
+# satellite: bench_trend extraction + regression gate
+# ----------------------------------------------------------------------
+def test_bench_trend_extraction_and_regressions(tmp_path):
+    bt = _load_tool("bench_trend")
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps({
+        "n": 1, "rc": 0, "parsed": None,
+        "tail": '... "fits_per_s": 40.0} ... "arena_speedup": 8.0,',
+    }))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps({
+        "n": 2, "rc": 0,
+        "parsed": {
+            "metric": "x", "value": 30.0,
+            "summary": {"serve_arena_speedup": 9.0,
+                        "detect_overhead_pct": 2.0},
+        },
+        "tail": "ignored when parsed is present",
+    }))
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps({
+        "n": 3, "rc": 0,
+        "parsed": {
+            "metric": "x", "value": 31.0,
+            "summary": {"serve_arena_speedup": 9.1,
+                        "detect_overhead_pct": 2.6},
+        },
+    }))
+    rounds = bt.load_rounds(str(tmp_path))
+    assert [r["source"] for r in rounds] == ["tail", "parsed", "parsed"]
+    assert rounds[0]["headlines"]["value"] == 40.0
+    assert rounds[0]["headlines"]["serve_arena_speedup"] == 8.0
+    trend = bt.build_trend(rounds)
+    assert trend["value"][0] == ("r01", 40.0)
+    flags = bt.flag_regressions(trend, threshold=0.10)
+    flagged = {(f["headline"], f["to_round"]) for f in flags}
+    # fits/s 40 -> 30 is a 25% drop (higher-better)
+    assert ("value", "r02") in flagged
+    # overhead 2.0 -> 2.6 is 30% worse (lower-better)
+    assert ("detect_overhead_pct", "r03") in flagged
+    # arena speedup only improved: never flagged
+    assert not any(f["headline"] == "serve_arena_speedup"
+                   for f in flags)
+    out = bt.render(rounds, trend, flags)
+    assert "regression(s) worse than 10%" in out
+    # the real repo's rounds parse without error
+    real = bt.load_rounds(str(REPO))
+    assert len(real) >= 5
+
+
+# ----------------------------------------------------------------------
+# satellite: capacity_report CLI rendering
+# ----------------------------------------------------------------------
+def test_capacity_report_cli_renders(capacity_service, tmp_path):
+    cr = _load_tool("capacity_report")
+    snapshot = capacity_service.capacity_report()
+    text = cr.render(snapshot)
+    for s in STAGES:
+        assert s in text
+    assert "decomposition coverage" in text
+    assert "kernel ledger" in text
+    assert "top models" in text
+    # a bench detail artifact wrapping the report is dug out
+    wrapped = {"detail": {"capacity": {"report": snapshot}}}
+    assert cr.dig_report(wrapped) == snapshot
+    path = tmp_path / "cap.json"
+    path.write_text(json.dumps(wrapped))
+    assert cr.main([str(path)]) == 0
+    assert cr.main([str(path), "--top", "3"]) == 0
+
+
+def test_latency_recorder_p999_and_violation_fraction():
+    from metran_tpu.obs import LatencyRecorder
+
+    rec = LatencyRecorder()
+    rec.record_many([0.001] * 998 + [0.2, 0.3])
+    assert rec.p999 >= 0.2
+    assert rec.slo_violation_fraction(0.05) == pytest.approx(0.002)
+    st = rec.stats(slo_s=0.05)
+    assert st["n"] == 1000
+    assert st["slo_violation_fraction"] == pytest.approx(0.002)
+    assert rec.stats()["p999_ms"] == st["p999_ms"]

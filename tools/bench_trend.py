@@ -1,0 +1,257 @@
+"""Per-phase headline trends across benchmark rounds, with a
+regression gate.
+
+Rounds r01-r05 all recorded ``"parsed": null`` (bench.py streamed the
+ever-growing detail blob to stdout) and NOTHING consumed the round
+artifacts — five rounds of measurements nobody could diff.  PR 10
+fixed the emitter (compact final JSON + ``summary``); this tool is the
+consumer: it reads every ``BENCH_r*.json`` round file (plus bench
+artifacts like ``bench_artifacts/BENCH_detail_latest.json``), extracts
+the per-phase headline numbers into one trend table, and flags
+round-over-round regressions worse than 10%.
+
+Extraction is layered:
+
+1. ``parsed`` (r06+): the compact final JSON — ``value`` plus the
+   per-phase ``summary`` dict, taken verbatim;
+2. ``tail`` fallback (r01-r05): the captured stdout tail is truncated
+   mid-JSON, so known headline keys are regex-scanned out of it —
+   best-effort, last occurrence wins, and clearly marked as such;
+3. detail artifacts: ``summary`` / ``detail`` dug directly.
+
+Directions matter: ``fits/s`` regressing means going DOWN,
+``overhead %`` regressing means going UP — each headline carries its
+direction and the gate compares consecutive non-null values.
+
+Usage::
+
+    python tools/bench_trend.py                # table + regressions
+    python tools/bench_trend.py --json         # machine-readable
+    python tools/bench_trend.py --strict       # exit 1 on regressions
+    python tools/bench_trend.py --dir /path    # scan another repo
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+#: headline key -> direction (+1 = higher is better, -1 = lower is
+#: better).  Keys match bench.py main()'s ``_phase_summary`` plus the
+#: top-level ``value`` (the round's primary metric).
+HEADLINES: Dict[str, int] = {
+    "value": +1,                        # fits/s/chip (round metric)
+    "cpu_fit_s": -1,                    # reference fit wall
+    "serve_arena_speedup": +1,
+    "serve_load_reads_per_s": +1,
+    "serve_faults_degraded_qps": +1,
+    "steady_speedup": +1,
+    "refit_models_per_s": +1,
+    "detect_overhead_pct": -1,
+    "grad_backward_speedup": +1,
+    "grad_mem_peak_mb_adjoint": -1,
+    "capacity_overhead_pct": -1,
+    "capacity_cached_overhead_pct": -1,
+    "capacity_coverage": +1,
+}
+
+#: tail-fallback regexes for rounds with ``"parsed": null``: the raw
+#: detail keys whose last occurrence approximates each headline.
+_NUM = r"(-?[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?)"
+TAIL_PATTERNS: Dict[str, str] = {
+    "value": rf'\\?"fits_per_s\\?":\s*{_NUM}',
+    "cpu_fit_s": rf'\\?"fit_s\\?":\s*{_NUM}',
+    "serve_arena_speedup": rf'\\?"arena_speedup\\?":\s*{_NUM}',
+    "serve_load_reads_per_s": rf'\\?"achieved_read_rps\\?":\s*{_NUM}',
+    "steady_speedup": rf'\\?"throughput_ratio\\?":\s*{_NUM}',
+    "refit_models_per_s": rf'\\?"models_per_s\\?":\s*{_NUM}',
+    "grad_backward_speedup": rf'\\?"backward_speedup\\?":\s*{_NUM}',
+}
+
+
+def extract_round(payload: dict, label: str) -> dict:
+    """One round file's headline numbers: ``{"label", "source",
+    "headlines": {key: float}}`` (source says which layer produced
+    them — "parsed", "tail" or "detail")."""
+    headlines: Dict[str, float] = {}
+    source = "empty"
+    parsed = payload.get("parsed")
+    if isinstance(parsed, dict):
+        source = "parsed"
+        if isinstance(parsed.get("value"), (int, float)):
+            headlines["value"] = float(parsed["value"])
+        for k, v in (parsed.get("summary") or {}).items():
+            if k in HEADLINES and isinstance(v, (int, float)):
+                headlines[k] = float(v)
+    elif "summary" in payload or "detail" in payload:
+        # a detail artifact (BENCH_detail_latest.json): same schema as
+        # the parsed final line, detail inline
+        source = "detail"
+        if isinstance(payload.get("value"), (int, float)):
+            headlines["value"] = float(payload["value"])
+        for k, v in (payload.get("summary") or {}).items():
+            if k in HEADLINES and isinstance(v, (int, float)):
+                headlines[k] = float(v)
+    elif isinstance(payload.get("tail"), str):
+        source = "tail"
+        tail = payload["tail"]
+        for key, pattern in TAIL_PATTERNS.items():
+            hits = re.findall(pattern, tail)
+            if hits:
+                headlines[key] = float(hits[-1])
+    return {"label": label, "source": source, "headlines": headlines}
+
+
+def load_rounds(repo: str) -> List[dict]:
+    """Every round/artifact file, in round order (lexicographic on the
+    ``BENCH_r*`` names, artifacts after)."""
+    paths = sorted(glob.glob(os.path.join(repo, "BENCH_r*.json")))
+    art = os.path.join(repo, "bench_artifacts", "BENCH_detail_latest.json")
+    if os.path.exists(art):
+        paths.append(art)
+    out = []
+    for path in paths:
+        label = os.path.splitext(os.path.basename(path))[0]
+        label = label.replace("BENCH_", "")
+        try:
+            with open(path) as fh:
+                payload = json.load(fh)
+        except (OSError, ValueError):
+            out.append({"label": label, "source": "unreadable",
+                        "headlines": {}})
+            continue
+        out.append(extract_round(payload, label))
+    return out
+
+
+def build_trend(rounds: List[dict]) -> Dict[str, List[Tuple[str, Optional[float]]]]:
+    """``{headline: [(round_label, value-or-None), ...]}`` over every
+    headline any round produced, in round order."""
+    keys = [
+        k for k in HEADLINES
+        if any(k in r["headlines"] for r in rounds)
+    ]
+    return {
+        k: [(r["label"], r["headlines"].get(k)) for r in rounds]
+        for k in keys
+    }
+
+
+def flag_regressions(trend, threshold: float = 0.10) -> List[dict]:
+    """Round-over-round changes worse than ``threshold`` in each
+    headline's BAD direction (consecutive non-null values compared)."""
+    flags = []
+    for key, series in trend.items():
+        direction = HEADLINES.get(key, +1)
+        prev_label = prev = None
+        for label, value in series:
+            if value is None:
+                continue
+            if prev not in (None, 0.0):
+                change = (value - prev) / abs(prev)
+                worse = -change if direction > 0 else change
+                if worse > threshold:
+                    flags.append({
+                        "headline": key,
+                        "from_round": prev_label,
+                        "to_round": label,
+                        "from": prev,
+                        "to": value,
+                        "worse_pct": round(100 * worse, 1),
+                    })
+            prev_label, prev = label, value
+    return flags
+
+
+def render(rounds: List[dict], trend, flags,
+           threshold: float = 0.10) -> str:
+    lines = []
+    labels = [r["label"] for r in rounds]
+    srcs = {r["label"]: r["source"] for r in rounds}
+    w0 = max([len("headline")] + [len(k) for k in trend])
+    wc = max([8] + [len(lb) for lb in labels]) + 1
+    lines.append(
+        "headline".ljust(w0) + "".join(lb.rjust(wc) for lb in labels)
+    )
+    lines.append(
+        "source".ljust(w0)
+        + "".join(srcs[lb][:6].rjust(wc) for lb in labels)
+    )
+    lines.append("-" * (w0 + wc * len(labels)))
+    for key, series in trend.items():
+        cells = "".join(
+            ("-" if v is None else f"{v:.4g}").rjust(wc)
+            for _, v in series
+        )
+        lines.append(key.ljust(w0) + cells)
+    lines.append("")
+    if flags:
+        lines.append(
+            f"{len(flags)} regression(s) worse than "
+            f"{threshold * 100:.0f}%:"
+        )
+        for f in flags:
+            lines.append(
+                f"  [!] {f['headline']}: {f['from']:.4g} "
+                f"({f['from_round']}) -> {f['to']:.4g} "
+                f"({f['to_round']}), {f['worse_pct']}% worse"
+            )
+    else:
+        lines.append(
+            f"no regressions worse than {threshold * 100:.0f}% "
+            "between consecutive measured rounds"
+        )
+    lines.append(
+        "note: 'tail'-sourced rounds are best-effort regex extraction "
+        "from truncated stdout (r01-r05 recorded parsed: null)"
+    )
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Benchmark-round headline trends + regression gate."
+    )
+    parser.add_argument(
+        "--dir", default=os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        ),
+        help="repo root holding BENCH_r*.json (default: this repo)",
+    )
+    parser.add_argument(
+        "--threshold", type=float, default=0.10,
+        help="regression flag threshold as a fraction (default 0.10)",
+    )
+    parser.add_argument("--json", action="store_true",
+                        help="emit machine-readable JSON instead")
+    parser.add_argument(
+        "--strict", action="store_true",
+        help="exit 1 when any regression is flagged",
+    )
+    args = parser.parse_args(argv)
+    rounds = load_rounds(args.dir)
+    if not rounds:
+        print(f"no BENCH_r*.json files under {args.dir}",
+              file=sys.stderr)
+        return 1
+    trend = build_trend(rounds)
+    flags = flag_regressions(trend, args.threshold)
+    if args.json:
+        print(json.dumps({
+            "rounds": rounds,
+            "trend": {k: [[lb, v] for lb, v in s]
+                      for k, s in trend.items()},
+            "regressions": flags,
+        }, indent=1))
+    else:
+        print(render(rounds, trend, flags, args.threshold), end="")
+    return 1 if (flags and args.strict) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,229 @@
+"""Render a ``MetranService.capacity_report()`` snapshot as tables.
+
+The capacity & cost plane (``metran_tpu/obs/capacity.py``,
+docs/concepts.md "Capacity & cost") answers "where does every
+millisecond — and every device-second — go" from live instruments; a
+service dumps the structured snapshot with::
+
+    import json
+    json.dump(service.capacity_report(), open("capacity.json", "w"))
+
+and this CLI renders it for a terminal::
+
+    python tools/capacity_report.py capacity.json
+    python tools/capacity_report.py bench_artifacts/BENCH_detail_latest.json
+    python tools/capacity_report.py capacity.json --top 20
+
+A bench detail artifact is accepted directly: the report is dug out of
+``detail.capacity.report`` (or ``capacity.report``) so the
+``--phase capacity`` round output renders without surgery.
+
+Stdlib-only; ``render(snapshot)`` is the testable core.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+
+def _fmt(v, width: int = 10) -> str:
+    if v is None:
+        return "-".rjust(width)
+    if isinstance(v, float):
+        return f"{v:.4g}".rjust(width)
+    return str(v).rjust(width)
+
+
+def _bar(share: float, width: int = 20) -> str:
+    n = max(0, min(width, round(float(share) * width)))
+    return "#" * n + "." * (width - n)
+
+
+def _table(headers: List[str], rows: List[List[str]]) -> List[str]:
+    widths = [
+        max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+        for i, h in enumerate(headers)
+    ]
+    out = [
+        "  ".join(h.ljust(w) for h, w in zip(headers, widths)),
+        "  ".join("-" * w for w in widths),
+    ]
+    out += ["  ".join(c.ljust(w) for c, w in zip(r, widths))
+            for r in rows]
+    return out
+
+
+def dig_report(payload: dict) -> Optional[dict]:
+    """Find a capacity report inside ``payload``: the snapshot itself,
+    or nested in a bench detail artifact."""
+    if not isinstance(payload, dict):
+        return None
+    if "stages" in payload and "coverage" in payload:
+        return payload
+    for path in (
+        ("capacity", "report"),
+        ("detail", "capacity", "report"),
+        ("report",),
+    ):
+        node = payload
+        for key in path:
+            node = node.get(key) if isinstance(node, dict) else None
+            if node is None:
+                break
+        if isinstance(node, dict) and "stages" in node:
+            return node
+    return None
+
+
+def render(report: dict, top: int = 10) -> str:
+    """The snapshot as readable tables (the testable core)."""
+    lines: List[str] = []
+    cov = report.get("coverage")
+    lines.append("== capacity report ==")
+    lines.append(
+        f"dispatches {report.get('dispatches', 0)} "
+        f"(sampled {report.get('sampled_dispatches', 0)}, "
+        f"every {report.get('sample_every', 1)}), "
+        f"requests {report.get('requests', 0)}"
+    )
+    lines.append(
+        f"decomposition coverage {cov} (bar >= 0.9)"
+        + ("  [!] BELOW BAR" if cov is not None and cov < 0.9 else "")
+    )
+    lines.append(
+        f"dispatch-thread utilization (60s) "
+        f"{report.get('utilization_60s')}  |  queue depth "
+        f"{report.get('queue_depth')}  |  oldest queued wait "
+        f"{report.get('queue_oldest_wait_s')} s"
+    )
+    lines.append("")
+
+    stages = report.get("stages") or {}
+    if stages:
+        lines.append("-- stage decomposition --")
+        rows = [
+            [s, _fmt(d.get("seconds_total")), _fmt(d.get("count")),
+             _fmt(d.get("p50_ms")), _fmt(d.get("p99_ms")),
+             _fmt(d.get("share"), 7), _bar(d.get("share", 0.0))]
+            for s, d in stages.items()
+        ]
+        lines += _table(
+            ["stage", "seconds", "count", "p50_ms", "p99_ms",
+             "share", ""],
+            rows,
+        )
+        lines.append("")
+
+    slo = report.get("slo") or {}
+    if slo:
+        lines.append(
+            f"-- SLO burn (slo {slo.get('slo_ms')} ms, budget "
+            f"{slo.get('budget')}) --"
+        )
+        rows = [
+            [label, _fmt(w.get("requests")), _fmt(w.get("violations")),
+             _fmt(w.get("violation_fraction")),
+             _fmt(w.get("burn_rate"))]
+            for label, w in (slo.get("windows") or {}).items()
+        ]
+        lines += _table(
+            ["window", "requests", "violations", "viol_frac", "burn"],
+            rows,
+        )
+        lines.append("")
+
+    lat = report.get("latency") or {}
+    if lat:
+        lines.append("-- request latency (recent window) --")
+        rows = [
+            [kind, _fmt(d.get("n")), _fmt(d.get("p50_ms")),
+             _fmt(d.get("p99_ms")), _fmt(d.get("p999_ms")),
+             _fmt(d.get("slo_violation_fraction"))]
+            for kind, d in lat.items()
+        ]
+        lines += _table(
+            ["path", "n", "p50_ms", "p99_ms", "p999_ms", "slo_viol"],
+            rows,
+        )
+        lines.append("")
+
+    kernels = report.get("kernels") or []
+    if kernels:
+        lines.append(f"-- kernel ledger (top {top} by device_s) --")
+        rows = [
+            [k.get("label", "?"), _fmt(k.get("dispatches")),
+             _fmt(k.get("compile_s")), _fmt(k.get("device_s")),
+             _fmt(k.get("sampled_calls"))]
+            for k in kernels[:top]
+        ]
+        lines += _table(
+            ["kernel", "dispatches", "compile_s", "device_s",
+             "sampled"],
+            rows,
+        )
+        lines.append("")
+
+    models = (report.get("models") or {})
+    top_models = models.get("top_by_device_s") or []
+    if top_models:
+        lines.append(
+            f"-- top models by device_s "
+            f"({models.get('tracked_models')} tracked, "
+            f"{models.get('pruned', 0)} pruned) --"
+        )
+        rows = [
+            [m.get("model_id", "?"), _fmt(m.get("device_s")),
+             _fmt(m.get("updates")), _fmt(m.get("reads")),
+             _fmt(m.get("gate_flags")), _fmt(m.get("detect_alarms")),
+             _fmt(m.get("refits"))]
+            for m in top_models[:top]
+        ]
+        lines += _table(
+            ["model", "device_s", "updates", "reads", "gate",
+             "detect", "refits"],
+            rows,
+        )
+        lines.append("")
+
+    arena = report.get("arena") or {}
+    if arena:
+        lines.append(
+            f"arena bytes resident: {arena.get('bytes_resident')} "
+            f"(max per model {arena.get('bytes_per_model_max')})"
+        )
+    return "\n".join(lines).rstrip() + "\n"
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Render a capacity_report() snapshot as tables."
+    )
+    parser.add_argument(
+        "snapshot",
+        help="capacity_report() JSON dump, or a bench detail artifact "
+             "containing one",
+    )
+    parser.add_argument(
+        "--top", type=int, default=10,
+        help="rows shown in the kernel/model tables (default 10)",
+    )
+    args = parser.parse_args(argv)
+    with open(args.snapshot) as fh:
+        payload = json.load(fh)
+    report = dig_report(payload)
+    if report is None:
+        print(
+            f"FAIL {args.snapshot}: no capacity report found (expected "
+            "a capacity_report() dump or a bench detail artifact with "
+            "detail.capacity.report)", file=sys.stderr,
+        )
+        return 1
+    print(render(report, top=args.top), end="")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -46,6 +46,14 @@ must match at least one declared kind when its runtime fragments are
 wildcarded.  An event nobody documented is an event no post-mortem
 can interpret.
 
+**Stage-name drift gate.**  Same pattern for the capacity plane's
+stage-latency decomposition: every literal stage label passed to an
+``.observe_stage(...)`` call must be declared in
+``metran_tpu/obs/capacity.py::STAGES``, and every declared stage must
+be documented in the stage table of docs/concepts.md (the table whose
+header row's first cell is "stage").  A stage the concepts table does
+not define is a stage no capacity report can be read against.
+
 Usage::
 
     python tools/check_metrics.py            # exit 1 on any violation
@@ -64,6 +72,7 @@ from typing import Dict, List, Optional
 REPO = Path(__file__).resolve().parent.parent
 PACKAGE = REPO / "metran_tpu"
 EVENTS_MODULE = PACKAGE / "obs" / "events.py"
+CAPACITY_MODULE = PACKAGE / "obs" / "capacity.py"
 CONCEPTS_DOC = REPO / "docs" / "concepts.md"
 
 NAME_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
@@ -94,9 +103,20 @@ class EmitSite:
 
 
 @dataclass
+class StageSite:
+    """One ``.observe_stage(<stage>, ...)`` call site in the package."""
+
+    stage: str  # literal text, with "x" placeholders when dynamic
+    file: str
+    lineno: int
+    dynamic: bool = False
+
+
+@dataclass
 class Report:
     registrations: List[Registration] = field(default_factory=list)
     emits: List[EmitSite] = field(default_factory=list)
+    stages: List[StageSite] = field(default_factory=list)
     violations: List[str] = field(default_factory=list)
 
 
@@ -193,6 +213,13 @@ class _FileScanner(ast.NodeVisitor):
                         kind="".join(parts), file=self.rel,
                         lineno=node.lineno, dynamic=True,
                     ))
+            if func.attr == "observe_stage" and node.args:
+                got = _literal_or_placeholder(node.args[0])
+                if got is not None:
+                    self.report.stages.append(StageSite(
+                        stage=got[0], file=self.rel,
+                        lineno=node.lineno, dynamic=got[1],
+                    ))
             if func.attr == "bind" and len(node.args) >= 2:
                 got = _literal_or_placeholder(node.args[1])
                 if got is not None and got[0].startswith("metran_"):
@@ -229,33 +256,37 @@ class _FileScanner(ast.NodeVisitor):
         return bool(alias.search(self.source))
 
 
-def declared_event_kinds() -> List[str]:
-    """The ``EVENT_KINDS`` tuple literal from ``obs/events.py`` (pure
-    AST — no import)."""
-    tree = ast.parse(
-        EVENTS_MODULE.read_text(), filename=str(EVENTS_MODULE)
-    )
+def _declared_tuple(module: Path, name: str) -> List[str]:
+    """A module-level ``NAME = (...)`` string-tuple literal, via pure
+    AST (no import)."""
+    tree = ast.parse(module.read_text(), filename=str(module))
     for node in ast.walk(tree):
         if not isinstance(node, ast.Assign):
             continue
         for target in node.targets:
-            if isinstance(target, ast.Name) and target.id == "EVENT_KINDS":
+            if isinstance(target, ast.Name) and target.id == name:
                 value = ast.literal_eval(node.value)
                 return [str(v) for v in value]
     raise SystemExit(
-        f"FAIL {EVENTS_MODULE}: no EVENT_KINDS tuple found — the event "
-        "catalogue must be declared there"
+        f"FAIL {module}: no {name} tuple found — the catalogue must "
+        "be declared there"
     )
 
 
-def documented_event_kinds() -> List[str]:
-    """Event kinds named in docs/concepts.md's event-schema table.
+def declared_event_kinds() -> List[str]:
+    """The ``EVENT_KINDS`` tuple literal from ``obs/events.py``."""
+    return _declared_tuple(EVENTS_MODULE, "EVENT_KINDS")
 
-    The table is located by its header row (a markdown ``|``-row whose
-    first cell says "event kind", case-insensitive); the backticked
-    first cell of every subsequent row is a documented kind.
-    """
-    kinds: List[str] = []
+
+def declared_stages() -> List[str]:
+    """The ``STAGES`` tuple literal from ``obs/capacity.py``."""
+    return _declared_tuple(CAPACITY_MODULE, "STAGES")
+
+
+def _documented_firstcol(header: str) -> List[str]:
+    """Backticked first-cell entries of the concepts.md table whose
+    header row's first cell is ``header`` (case-insensitive)."""
+    entries: List[str] = []
     in_table = False
     for line in CONCEPTS_DOC.read_text().splitlines():
         stripped = line.strip()
@@ -266,7 +297,7 @@ def documented_event_kinds() -> List[str]:
         if not cells:
             continue
         first = cells[0].strip("`").strip().lower()
-        if first == "event kind":
+        if first == header:
             in_table = True
             continue
         if in_table:
@@ -274,8 +305,24 @@ def documented_event_kinds() -> List[str]:
                 continue  # the header separator row
             m = re.match(r"`([a-z0-9_]+)`", cells[0])
             if m:
-                kinds.append(m.group(1))
-    return kinds
+                entries.append(m.group(1))
+    return entries
+
+
+def documented_event_kinds() -> List[str]:
+    """Event kinds named in docs/concepts.md's event-schema table.
+
+    The table is located by its header row (a markdown ``|``-row whose
+    first cell says "event kind", case-insensitive); the backticked
+    first cell of every subsequent row is a documented kind.
+    """
+    return _documented_firstcol("event kind")
+
+
+def documented_stages() -> List[str]:
+    """Stage labels named in docs/concepts.md's capacity stage table
+    (header row's first cell is "stage")."""
+    return _documented_firstcol("stage")
 
 
 def check_event_kinds(report: Report) -> None:
@@ -304,6 +351,38 @@ def check_event_kinds(report: Report) -> None:
                 f"{EVENTS_MODULE.relative_to(REPO)}: event kind "
                 f"{kind!r} is declared but not documented in the "
                 f"event-schema table of {CONCEPTS_DOC.relative_to(REPO)}"
+            )
+
+
+def check_stages(report: Report) -> None:
+    """Append stage-catalogue drift violations (module docstring)."""
+    declared = declared_stages()
+    documented = set(documented_stages())
+    declared_set = set(declared)
+    for site in report.stages:
+        if site.dynamic:
+            pat = re.compile(
+                "^" + re.escape(site.stage).replace("x", "[a-z0-9_]+")
+                + "$"
+            )
+            if not any(pat.match(s) for s in declared):
+                report.violations.append(
+                    f"{site.file}:{site.lineno}: dynamic stage label "
+                    f"/{site.stage}/ matches no declared stage in "
+                    "obs/capacity.py::STAGES"
+                )
+        elif site.stage not in declared_set:
+            report.violations.append(
+                f"{site.file}:{site.lineno}: stage label "
+                f"{site.stage!r} is recorded but not declared in "
+                "obs/capacity.py::STAGES"
+            )
+    for stage in declared:
+        if stage not in documented:
+            report.violations.append(
+                f"{CAPACITY_MODULE.relative_to(REPO)}: stage "
+                f"{stage!r} is declared but not documented in the "
+                f"stage table of {CONCEPTS_DOC.relative_to(REPO)}"
             )
 
 
@@ -365,6 +444,9 @@ def scan(verbose: bool = False) -> Report:
     # 4. event-kind drift (declared vs emitted vs documented)
     check_event_kinds(report)
 
+    # 5. stage-name drift (recorded vs declared vs documented)
+    check_stages(report)
+
     if verbose:
         for reg in sorted(report.registrations,
                           key=lambda r: (r.name, r.file, r.lineno)):
@@ -391,10 +473,11 @@ def main() -> int:
         print(f"{len(report.violations)} metric violation(s)")
         return 1
     print(
-        f"checked {len(report.registrations)} metric registration(s) "
-        f"and {len(report.emits)} event emit site(s): no duplicate, "
+        f"checked {len(report.registrations)} metric registration(s), "
+        f"{len(report.emits)} event emit site(s) and "
+        f"{len(report.stages)} stage-label site(s): no duplicate, "
         "non-snake_case, or never-updated metrics; all event kinds "
-        "declared and documented"
+        "and capacity stages declared and documented"
     )
     return 0
 

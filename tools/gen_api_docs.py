@@ -60,6 +60,7 @@ MODULES = [
     "metran_tpu.reliability.health",
     "metran_tpu.reliability.faultinject",
     "metran_tpu.reliability.scenarios",
+    "metran_tpu.obs.capacity",
     "metran_tpu.obs.metrics",
     "metran_tpu.obs.tracing",
     "metran_tpu.obs.events",
