@@ -3053,6 +3053,235 @@ def run_detect_bench(out_path: str, budget_s: float) -> dict:
     return out
 
 
+def run_durability_bench(out_path: str, budget_s: float) -> dict:
+    """Durability-plane scenario: WAL overhead + recovery replay rate.
+
+    Two acceptance claims (docs/concepts.md "Durability & recovery",
+    ISSUE 14):
+
+    1. the ARMED write-ahead log (per-commit CRC-framed records,
+       group-fdatasynced before every ack) costs <= 10% update
+       throughput on the ARENA BULK path versus the same service with
+       the WAL off, at matched observability — paired interleaved
+       laps, ratio of medians (the PR 5/11 methodology).  Checkpoints
+       are excluded from the laps (cadence 0) and measured separately:
+       the bar is the PER-COMMIT price of durable acks;
+    2. recovery replay throughput >= 10k commits/s: WAL tails of
+       increasing length are replayed through
+       ``MetranService.recover`` (bulk commit-group replay, same
+       kernels as serving) and the wall clock is reported per tail —
+       the RTO half of the durability contract, next to the
+       ``recovery ms per 1k replayed commits`` headline
+       ``tools/bench_trend.py`` trends.
+    """
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", JAX_CACHE + "-cpu")
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from metran_tpu.obs import Observability
+    from metran_tpu.ops import dfm_statespace, kalman_filter
+    from metran_tpu.serve import (
+        DurabilitySpec, MetranService, ModelRegistry, PosteriorState,
+    )
+
+    deadline = time.monotonic() + budget_s
+    # batch 512 at flagship-like dimensions (n=16 series, 2 common
+    # factors, k=2 rows per tick — the groundwater workload's shape,
+    # not the n=8 toy): the WAL's group commit amortizes ONE
+    # fdatasync (~0.6 ms median on this host's ext4 at live cadence)
+    # over the whole tick, so the per-commit price is judged at the
+    # batch size and kernel weight the bulk path actually runs
+    n_models, n, k_fct, k_rows, t_hist = 512, 16, 2, 2, 200
+    rounds = 24
+    tails = (2048, 8192, 32768)
+    if os.environ.get("METRAN_TPU_BENCH_SMALL"):
+        n_models, t_hist, rounds = 32, 60, 6
+        tails = (64, 256)
+    out = {
+        "platform": jax.default_backend(),
+        "n_models": n_models, "n_series": n, "n_factors": k_fct,
+    }
+
+    rng = np.random.default_rng(37)
+    alpha_sdf = rng.uniform(5.0, 40.0, (n_models, n))
+    alpha_cdf = rng.uniform(10.0, 60.0, (n_models, k_fct))
+    loadings = rng.uniform(0.3, 0.8, (n_models, n, k_fct)) / np.sqrt(k_fct)
+    y = rng.normal(size=(n_models, t_hist, n))
+    mask = np.ones(y.shape, bool)
+
+    def one(a_s, a_c, ld, yy, mm):
+        ss = dfm_statespace(a_s, a_c, ld, 1.0)
+        res = kalman_filter(ss, yy, mm, engine="joint", store=False)
+        return res.mean_f, res.cov_f
+
+    means, covs = jax.jit(jax.vmap(one))(
+        jnp.asarray(alpha_sdf), jnp.asarray(alpha_cdf),
+        jnp.asarray(loadings), jnp.asarray(y), jnp.asarray(mask),
+    )
+    means, covs = np.asarray(means), np.asarray(covs)
+    states = [
+        PosteriorState(
+            model_id=f"m{i}", version=0, t_seen=t_hist,
+            mean=means[i], cov=covs[i],
+            params=np.concatenate([alpha_sdf[i], alpha_cdf[i]]),
+            loadings=loadings[i], dt=1.0,
+            scaler_mean=np.zeros(n), scaler_std=np.ones(n),
+            names=tuple(f"s{j}" for j in range(n)),
+        )
+        for i in range(n_models)
+    ]
+    ids = [st.model_id for st in states]
+    work = tempfile.mkdtemp(prefix="metran-durability-")
+
+    def make_service(wal: bool, sub: str):
+        root = os.path.join(work, sub)
+        reg = ModelRegistry(
+            root=root, arena=True, arena_rows=n_models, arena_mesh=0,
+        )
+        for st in states:
+            reg.put(st, persist=False)
+        return MetranService(
+            reg, flush_deadline=None, max_batch=4 * n_models,
+            persist_updates=False,
+            durability=DurabilitySpec(
+                enabled=wal, checkpoint_every=0
+            ) if wal else None,
+        )
+
+    try:
+        services = {
+            "off": make_service(False, "off"),
+            "wal": make_service(True, "wal"),
+        }
+        obs_rows = rng.normal(
+            size=(rounds + 2, n_models, k_rows, n)
+        ) * 0.2
+
+        def tick(svc, t) -> float:
+            t0 = time.perf_counter()
+            svc.update_batch(ids, obs_rows[t])
+            return time.perf_counter() - t0
+
+        for svc in services.values():  # compile + warm (excluded)
+            tick(svc, 0)
+            tick(svc, 1)
+        names = list(services)
+        ratios = []
+        for r in range(rounds):
+            if time.monotonic() > deadline - 90:
+                break
+            order = names if r % 2 == 0 else names[::-1]
+            lap = {m: tick(services[m], r + 2) for m in order}
+            ratios.append(lap["wal"] / lap["off"])
+        wal_status = services["wal"].health()["durability"]
+        # one checkpoint at fleet size, timed separately (the cadence
+        # cost the laps deliberately exclude)
+        t_ck0 = time.perf_counter()
+        ck = services["wal"].checkpoint()
+        ck_wall = time.perf_counter() - t_ck0
+        for svc in services.values():
+            svc.close()
+
+        r_med = float(np.median(ratios)) if ratios else 1.0
+        out["overhead"] = {
+            "batch": n_models,
+            "laps": len(ratios),
+            # qps overhead = 1 - 1/r for a paired lap-time ratio
+            "update_qps_pct": round(100.0 * (1.0 - 1.0 / r_med), 2),
+            "bar_pct": 10.0,
+            "records_logged": wal_status["records_logged"],
+            "bytes_logged": wal_status["bytes_logged"],
+            "group_syncs": wal_status["group_syncs"],
+            "checkpoint_wall_s": round(ck_wall, 4),
+            "checkpoint_spilled": ck.get("spilled"),
+        }
+        progress(
+            "durability_overhead",
+            pct=out["overhead"]["update_qps_pct"],
+            laps=len(ratios),
+            syncs=wal_status["group_syncs"],
+        )
+        write_partial(out_path, out)
+
+        # -- recovery replay rate vs tail length -----------------------
+        out["recovery"] = {"tails": []}
+        for tail in tails:
+            if time.monotonic() > deadline - 30:
+                out["truncated"] = "budget"
+                break
+            ticks = max(1, tail // n_models)
+            root = os.path.join(work, f"rec-{tail}")
+            reg = ModelRegistry(
+                root=root, arena=True, arena_rows=n_models,
+                arena_mesh=0,
+            )
+            for st in states:
+                reg.put(st, persist=False)
+            svc = MetranService(
+                reg, flush_deadline=None, max_batch=4 * n_models,
+                persist_updates=False,
+                durability=DurabilitySpec(
+                    enabled=True, checkpoint_every=0
+                ),
+            )
+            stream = rng.normal(
+                size=(ticks, n_models, k_rows, n)
+            ) * 0.2
+            for t in range(ticks):
+                svc.update_batch(ids, stream[t])
+            svc.batcher.close()  # crash: abandon, no close/spill
+            del svc, reg
+            t0 = time.perf_counter()
+            rec = MetranService.recover(
+                root,
+                registry_kwargs={
+                    "arena": True, "arena_rows": n_models,
+                    "arena_mesh": 0,
+                },
+                flush_deadline=None, max_batch=4 * n_models,
+                persist_updates=False,
+                checkpoint_after=False,
+            )
+            wall = time.perf_counter() - t0
+            rep = dict(rec.last_recovery or {})
+            rec.close()
+            n_replayed = int(rep.get("replayed", 0))
+            out["recovery"]["tails"].append({
+                "commits": ticks * n_models,
+                "replayed": n_replayed,
+                "recover_wall_s": round(wall, 4),
+                "replay_wall_s": rep.get("replay_wall_s"),
+                "commits_per_s": rep.get("commits_per_s"),
+                "ms_per_1k_commits": round(
+                    1e3 * wall / max(n_replayed / 1e3, 1e-9), 2
+                ) if n_replayed else None,
+            })
+            progress(
+                "durability_recovery", tail=ticks * n_models,
+                replayed=n_replayed,
+                commits_per_s=rep.get("commits_per_s"),
+            )
+            write_partial(out_path, out)
+        longest = (
+            out["recovery"]["tails"][-1]
+            if out["recovery"]["tails"] else {}
+        )
+        out["recovery"]["replay_commits_per_s"] = longest.get(
+            "commits_per_s"
+        )
+        out["recovery"]["ms_per_1k_commits"] = longest.get(
+            "ms_per_1k_commits"
+        )
+        out["recovery"]["bar_commits_per_s"] = 10000.0
+        write_partial(out_path, out)
+        return out
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def run_capacity_bench(out_path: str, budget_s: float) -> dict:
     """Capacity & cost plane scenario (`obs/capacity.py`, ISSUE 13).
 
@@ -3848,6 +4077,16 @@ def main() -> None:
             "capacity_coverage": g(
                 detail, "capacity", "decomposition", "coverage"
             ),
+            "durability_overhead_pct": g(
+                detail, "durability", "overhead", "update_qps_pct"
+            ),
+            "durability_recovery_ms_per_1k": g(
+                detail, "durability", "recovery", "ms_per_1k_commits"
+            ),
+            "durability_replay_commits_per_s": g(
+                detail, "durability", "recovery",
+                "replay_commits_per_s"
+            ),
             "grad_backward_speedup": g(
                 detail, "grad", "backward_speedup"
             ),
@@ -4108,6 +4347,20 @@ def main() -> None:
         _wait(cp_proc, cp_budget + 15.0, "capacity")
         capacity = _read_json(cp_path) or {}
 
+    # durability-plane scenario (ISSUE 14's measurement story):
+    # WAL-armed arena bulk overhead (paired interleaved, 10% bar) +
+    # recovery replay throughput vs WAL tail length — CPU-pinned like
+    # the other serve phases
+    durability = {}
+    if budget - elapsed() > 120:
+        du_path = os.path.join(CACHE_DIR, "bench_durability.json")
+        if os.path.exists(du_path):
+            os.remove(du_path)
+        du_budget = max(min(180.0, budget - elapsed() - 60.0), 60.0)
+        du_proc = _spawn("durability", du_path, du_budget, cpu_env)
+        _wait(du_proc, du_budget + 15.0, "durability")
+        durability = _read_json(du_path) or {}
+
     # gradient-engine scenario (ISSUE 10's measurement story): adjoint
     # vs autodiff backward wall time at the standard workload, the
     # flat-in-T backward-memory curve, and the anchored refit
@@ -4143,6 +4396,7 @@ def main() -> None:
               "refit": refit,
               "detect": detect,
               "capacity": capacity,
+              "durability": durability,
               "grad": grad,
               "workload": {"n_series": N_SERIES, "n_factors": N_FACTORS,
                            "t_steps": T_STEPS, "missing": MISSING,
@@ -4174,7 +4428,7 @@ if __name__ == "__main__":
                                  "serve-load", "serve-faults", "sqrt",
                                  "obs", "robust-obs", "steady",
                                  "refit", "detect", "capacity",
-                                 "grad", "grad-mem"])
+                                 "durability", "grad", "grad-mem"])
     parser.add_argument("--out", default=None)
     parser.add_argument("--budget", type=float, default=900.0)
     parser.add_argument(
@@ -4415,6 +4669,31 @@ if __name__ == "__main__":
                 "value": ov.get("update_qps_pct", 0.0),
                 "unit": "%", "vs_baseline": 0.0,
                 "detail": cp_out,
+            }), flush=True)
+    elif args.phase == "durability":
+        out_path = args.out or os.path.join(
+            CACHE_DIR, "bench_durability.json"
+        )
+        os.makedirs(CACHE_DIR, exist_ok=True)
+        du_out = run_durability_bench(out_path, args.budget)
+        if args.out is None:
+            # standalone run: emit the BENCH_r* result-line schema
+            # with the WAL-overhead headline (bar: <= 10% on the
+            # arena bulk path) next to the recovery replay rate
+            # (bar: >= 10k commits/s)
+            ov = du_out.get("overhead") or {}
+            rc = du_out.get("recovery") or {}
+            print(json.dumps({
+                "metric": (
+                    "WAL-armed arena bulk update overhead (batch "
+                    f"{ov.get('batch')}, {ov.get('laps')} paired "
+                    "laps; recovery replay "
+                    f"{rc.get('replay_commits_per_s')} commits/s vs "
+                    "10k bar)"
+                ),
+                "value": ov.get("update_qps_pct", 0.0),
+                "unit": "%", "vs_baseline": 0.0,
+                "detail": du_out,
             }), flush=True)
     elif args.phase == "grad":
         out_path = args.out or os.path.join(CACHE_DIR, "bench_grad.json")

@@ -245,6 +245,20 @@ SERVE_REFIT_COOLDOWN_S = 60.0  # hysteresis after any refit outcome
 SERVE_REFIT_DEADLINE_S = 120.0  # fit wall-clock budget per cycle;
 #                                 an overrun rejects (champion keeps
 #                                 serving) instead of promoting late
+# crash-safe durability plane (serve.durability; docs/concepts.md
+# "Durability & recovery").  Ships OFF: the WAL adds one group-synced
+# append per update dispatch (measured <= 10% on the arena bulk path,
+# bench.py --phase durability) and checkpoints spend disk, both
+# deployment decisions.  Armed, every acked update is durable before
+# its ack and MetranService.recover() reconstructs acked state
+# bit-identically at f64.
+SERVE_WAL = 0  # 1 = per-commit write-ahead log + checkpoints
+SERVE_WAL_DIR = ""  # WAL directory ("" = <registry root>/wal)
+SERVE_WAL_FSYNC = 1  # group fdatasync before each dispatch's acks
+#                      (0 = OS page cache only: survives process
+#                      death, not power loss)
+SERVE_WAL_CHECKPOINT_EVERY = 1024  # auto-checkpoint cadence in logged
+#                                    commits (0 = manual only)
 # observability defaults (metran_tpu.obs wired into MetranService)
 OBS_TRACE = 0  # request-scoped span tracing (metrics/events stay on)
 OBS_TRACE_BUFFER = 4096  # finished spans kept in the tracer ring
@@ -430,6 +444,17 @@ def serve_defaults() -> dict:
         "refit_deadline_s": _env(
             "METRAN_TPU_SERVE_REFIT_DEADLINE_S", float,
             SERVE_REFIT_DEADLINE_S,
+        ),
+        "wal": _env("METRAN_TPU_SERVE_WAL", int, SERVE_WAL),
+        "wal_dir": os.environ.get(
+            "METRAN_TPU_SERVE_WAL_DIR", SERVE_WAL_DIR
+        ),
+        "wal_fsync": _env(
+            "METRAN_TPU_SERVE_WAL_FSYNC", int, SERVE_WAL_FSYNC
+        ),
+        "wal_checkpoint_every": _env(
+            "METRAN_TPU_SERVE_WAL_CHECKPOINT_EVERY", int,
+            SERVE_WAL_CHECKPOINT_EVERY,
         ),
     }
 

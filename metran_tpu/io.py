@@ -39,6 +39,33 @@ class LoadedFit:
         self.pcor = pcor
 
 
+def fsync_dir(directory) -> None:
+    """fsync a directory so a just-renamed entry survives power loss.
+
+    ``rename()`` alone updates the directory in the page cache; until
+    the directory inode itself is flushed, a power cut can roll the
+    rename back (the classic crash-consistency gap — the file's DATA
+    was fsynced, but the NAME pointing at it was not).  Called by
+    :func:`atomic_savez` and the WAL manifest writer after every
+    rename-into-place.  The descriptor is closed on every path,
+    including an fsync failure.  Platforms whose directories refuse
+    ``fsync`` (some network filesystems raise ``EINVAL``/
+    ``ENOTSUP``) degrade to a no-op — the rename is still atomic
+    against process death, just not against power loss.
+    """
+    import errno
+    import os
+
+    fd = os.open(str(directory), os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    except OSError as exc:  # pragma: no cover - odd filesystems
+        if exc.errno not in (errno.EINVAL, errno.ENOTSUP, errno.EBADF):
+            raise
+    finally:
+        os.close(fd)
+
+
 def atomic_savez(path, **arrays) -> Path:
     """Write ``arrays`` to ``path`` as an ``.npz``, atomically.
 
@@ -81,6 +108,11 @@ def atomic_savez(path, **arrays) -> Path:
             os.fsync(fh.fileno())
         fire("io.atomic_savez.rename", str(path))
         tmp.replace(path)
+        # rename alone is not durable across power loss: the directory
+        # entry lives in the page cache until the directory inode is
+        # flushed — fsync it so a power cut cannot resurrect the old
+        # file under a name whose new bytes were already acked durable
+        fsync_dir(path.parent)
     except SimulatedCrash:
         raise  # a killed writer leaves its temp behind; the sweep reclaims it
     except BaseException:
@@ -348,6 +380,7 @@ def load_fleet_state(path, like_theta, like_state, like_frozen):
 
 __all__ = [
     "atomic_savez",
+    "fsync_dir",
     "FORMAT_VERSION",
     "LoadedFit",
     "load_fleet_state",

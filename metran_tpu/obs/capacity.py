@@ -81,6 +81,7 @@ STAGES = (
     "host_prep",  # lookup/stacking/validation/standardization
     "device",     # kernel dispatch -> outputs materialized on host
     "publish",    # per-slot finalize: commit, snapshot, telemetry
+    "wal",        # write-ahead-log group append + fdatasync (pre-ack)
 )
 
 #: default burn-rate windows (seconds) and their gauge labels

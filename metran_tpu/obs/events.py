@@ -78,6 +78,13 @@ EVENT_KINDS = (
     "refit_promoted",
     "refit_rejected",
     "refit_failed",
+    "wal_sync_failure",
+    "wal_torn_record",
+    "checkpoint",
+    "checkpoint_failure",
+    "spill_failure",
+    "recovery_start",
+    "recovery_complete",
 )
 
 

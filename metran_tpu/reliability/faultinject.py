@@ -45,13 +45,22 @@ Named fault points currently compiled into the stack: ``serve.dispatch``
 (whole-batch dispatch failures / wedged workers), ``serve.state.load``
 (state-file reads), ``serve.update.new_obs`` (the data-corruption hook
 on raw update payloads), ``io.atomic_savez.rename`` (the atomic-write
-commit step), and the continuous-adaptation pair ``serve.refit.fit``
+commit step), the continuous-adaptation pair ``serve.refit.fit``
 (the background batch fit — inject errors/delays to prove a failed or
 slow refit leaves serving untouched) and ``serve.refit.promote``
 (inside the promotion's update-lock region, BEFORE any mutation — a
 :class:`SimulatedCrash` here, or at ``io.atomic_savez.rename`` during
 the promotion's write-through, proves hot-swap crash consistency:
-recovery lands on exactly the old or exactly the new parameters).
+recovery lands on exactly the old or exactly the new parameters), and
+the durability plane's kill points
+(``reliability.scenarios.CRASH_POINTS``): ``durability.wal.
+pre_commit`` / ``durability.wal.mid_record`` / ``durability.wal.
+pre_sync`` inside the write-ahead log's group commit,
+``durability.spill.model`` between a checkpoint's per-model state
+writes, and ``durability.manifest.rotate`` between the manifest's
+temp fsync and its rename — each a point where
+``run_crash_recovery_scenario`` kills the "process" and asserts
+``MetranService.recover`` loses nothing acked.
 
 The active injector is process-global (not thread-local) on purpose:
 the serving stack hops threads (caller -> batcher worker -> dispatch),

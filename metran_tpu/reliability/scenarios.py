@@ -36,15 +36,28 @@ from typing import Optional
 import numpy as np
 
 from . import faultinject
-from .faultinject import SensorFault
+from .faultinject import SensorFault, SimulatedCrash
 
 __all__ = [
     "run_changepoint_scenario",
+    "run_crash_recovery_scenario",
     "run_detection_delay_scenario",
     "run_drift_recovery_scenario",
     "run_sensor_fault_scenario",
     "simulate_dfm_panel",
 ]
+
+#: the durability plane's named kill points (docs/concepts.md
+#: "Durability & recovery"): each is a ``fire()`` site inside the
+#: WAL / checkpoint machinery where :func:`run_crash_recovery_scenario`
+#: injects a :class:`SimulatedCrash` to model a process death there.
+CRASH_POINTS = (
+    "durability.wal.pre_commit",    # post-ack (prior), pre-WAL-write
+    "durability.wal.mid_record",    # mid-record: torn frame on disk
+    "durability.wal.pre_sync",      # records written, fdatasync not run
+    "durability.spill.model",       # between per-model checkpoint writes
+    "durability.manifest.rotate",   # between manifest fsync and rename
+)
 
 
 def simulate_dfm_panel(ss, t_steps: int, rng, missing_p: float = 0.0):
@@ -581,6 +594,288 @@ def run_changepoint_scenario(
         "params_true": np.concatenate([alpha_sdf, alpha_cdf]),
         "params_refit": refit["params"],
     }
+
+
+def run_crash_recovery_scenario(
+    mode: str = "arena",
+    kill_point: Optional[str] = None,
+    n_models: int = 6,
+    n_series: int = 4,
+    n_factors: int = 1,
+    t_hist: int = 60,
+    n_ticks: int = 10,
+    pre_ticks: int = 6,
+    checkpoint_every: int = 0,
+    seed: int = 0,
+    engine: str = "sqrt",
+    kill_match: Optional[str] = None,
+    fixed_lag: int = 0,
+    directory=None,
+) -> dict:
+    """Crash-point chaos harness for the durability plane
+    (docs/concepts.md "Durability & recovery").
+
+    Builds a synthetic fleet serving under a WAL-armed
+    :class:`~metran_tpu.serve.MetranService` in one of three
+    configurations — ``"dict"`` (per-request dict registry),
+    ``"arena"`` (device-resident bulk path), ``"arena_full"`` (arena +
+    materialized read path + streaming detection + observation gate, +
+    fixed-lag smoothing when ``fixed_lag > 0``) — streams ``n_ticks``
+    fleet ticks of acked updates, and **kills the process** (a
+    :class:`SimulatedCrash` armed at one of :data:`CRASH_POINTS`,
+    firing on the first matching event after ``pre_ticks`` clean
+    ticks; ``kill_point=None`` streams to completion and abandons the
+    service un-closed — the plain kill -9 case).  The service is then
+    abandoned exactly as a dead process leaves it — no close, no
+    spill — and :meth:`~metran_tpu.serve.MetranService.recover`
+    rebuilds from the directory.
+
+    The verdict compares against a crash-free CONTROL service (same
+    configuration, no durability) streaming the same ticks, capturing
+    its state after every tick:
+
+    - **no acked update is lost**: every model's recovered version is
+      at least its last acked version;
+    - **no torn record is replayed**: recovered versions never exceed
+      the WAL's last complete commit group;
+    - **bit-identical state**: each model's recovered posterior
+      (mean/cov/chol, f64) equals the control's at the same version
+      EXACTLY, and (``arena_full``) so do the detector accumulators
+      and the fixed-lag smoothed window.
+
+    Returns the verdict dict the ``faults``-marked tests and
+    ``bench.py --phase durability`` assert on.
+    """
+    import shutil
+    import tempfile
+
+    from ..ops import dfm_statespace, kalman_filter, sqrt_kalman_filter
+    from ..serve import (
+        DetectSpec,
+        DurabilitySpec,
+        GateSpec,
+        MetranService,
+        ModelRegistry,
+        PosteriorState,
+    )
+
+    if mode not in ("dict", "arena", "arena_full"):
+        raise ValueError(f"unknown crash-recovery mode {mode!r}")
+    if kill_point is not None and kill_point not in CRASH_POINTS:
+        raise ValueError(
+            f"unknown kill point {kill_point!r}; expected one of "
+            f"{CRASH_POINTS}"
+        )
+    rng = np.random.default_rng(seed)
+    loadings = rng.uniform(0.4, 0.7, (n_series, n_factors))
+    loadings /= np.sqrt(n_factors)
+    alpha_sdf = rng.uniform(5.0, 40.0, n_series)
+    alpha_cdf = rng.uniform(10.0, 60.0, n_factors)
+    ss = dfm_statespace(alpha_sdf, alpha_cdf, loadings, 1.0)
+    _, y_all, _ = simulate_dfm_panel(ss, t_hist + n_ticks, rng)
+    y_hist = y_all[:t_hist]
+    mask_hist = np.ones(y_hist.shape, bool)
+    sqrt_engine = engine in ("sqrt", "sqrt_parallel")
+    if sqrt_engine:
+        filt = sqrt_kalman_filter(ss, y_hist, mask_hist)
+        chol0 = np.asarray(filt.chol_f[-1])
+        cov0 = chol0 @ chol0.T
+    else:
+        filt = kalman_filter(ss, y_hist, mask_hist, engine=engine)
+        chol0, cov0 = None, np.asarray(filt.cov_f[-1])
+    ids = [f"cm{i}" for i in range(n_models)]
+
+    def make_state(mid):
+        return PosteriorState(
+            model_id=mid, version=0, t_seen=t_hist,
+            mean=np.asarray(filt.mean_f[-1]), cov=cov0,
+            params=np.concatenate([alpha_sdf, alpha_cdf]),
+            loadings=loadings, dt=1.0,
+            scaler_mean=np.zeros(n_series),
+            scaler_std=np.ones(n_series),
+            names=tuple(f"s{j}" for j in range(n_series)),
+            chol=chol0,
+        )
+
+    # per-model observation jitter so the fleet's states diverge (a
+    # uniform fleet would hide cross-model scatter/restore mixups)
+    obs = y_all[t_hist:][:, None, None, :] + (
+        rng.normal(size=(n_ticks, n_models, 1, n_series)) * 0.1
+    )
+    full = mode == "arena_full"
+    feature_kwargs = dict(
+        flush_deadline=None,
+        persist_updates=False,
+        gate=GateSpec(policy="reject", nsigma=50.0, min_seen=1)
+        if full else None,
+        detect=DetectSpec(enabled=True, min_seen=1) if full else None,
+        readpath=full,
+        fixed_lag=fixed_lag if full and fixed_lag else None,
+    )
+    registry_kwargs = dict(
+        engine=engine,
+        arena=mode != "dict",
+        arena_rows=n_models + 4,
+    )
+
+    def tick(svc, t) -> list:
+        return svc.update_batch(ids, obs[t])
+
+    # ---- crash run (WAL-armed, killed mid-stream) ---------------------
+    tmp = None
+    if directory is None:
+        tmp = tempfile.mkdtemp(prefix="metran-crash-")
+        directory = tmp
+    try:
+        reg = ModelRegistry(root=directory, **registry_kwargs)
+        for mid in ids:
+            reg.put(make_state(mid), persist=False)
+        svc = MetranService(
+            reg,
+            durability=DurabilitySpec(
+                enabled=True, checkpoint_every=checkpoint_every
+            ),
+            **feature_kwargs,
+        )
+        acked = {mid: 0 for mid in ids}
+        crashed_at = None
+        try:
+            for t in range(min(pre_ticks, n_ticks)):
+                for mid, res in zip(ids, tick(svc, t)):
+                    if not isinstance(res, BaseException):
+                        acked[mid] = int(res.version)
+            if kill_point is not None:
+                with faultinject.active() as inj:
+                    inj.add(
+                        kill_point, error=SimulatedCrash,
+                        match=kill_match, times=1,
+                    )
+                    for t in range(pre_ticks, n_ticks):
+                        for mid, res in zip(ids, tick(svc, t)):
+                            if not isinstance(res, BaseException):
+                                acked[mid] = int(res.version)
+            else:
+                for t in range(pre_ticks, n_ticks):
+                    for mid, res in zip(ids, tick(svc, t)):
+                        if not isinstance(res, BaseException):
+                            acked[mid] = int(res.version)
+        except SimulatedCrash:
+            crashed_at = "injected"
+        # the process is now DEAD: no close(), no spill — the
+        # directory holds exactly what a kill -9 leaves behind
+        del svc, reg
+
+        # ---- recovery --------------------------------------------------
+        rec = MetranService.recover(
+            directory,
+            registry_kwargs=registry_kwargs,
+            **feature_kwargs,
+        )
+        report = dict(rec.last_recovery or {})
+
+        # ---- crash-free control ---------------------------------------
+        creg = ModelRegistry(root=None, **registry_kwargs)
+        for mid in ids:
+            creg.put(make_state(mid), persist=False)
+        ctrl = MetranService(creg, **feature_kwargs)
+        # state snapshots after every control tick: version after tick
+        # t (0-based) is t+1
+        snapshots: list = []
+        det_snaps: list = []
+        smooth_snaps: list = []
+        for t in range(n_ticks):
+            tick(ctrl, t)
+            snapshots.append({mid: creg.get(mid) for mid in ids})
+            if full:
+                det_snaps.append(creg.arena_detect_states())
+                if fixed_lag:
+                    snap = {}
+                    for mid in ids:
+                        try:
+                            snap[mid] = ctrl.smoothed(mid)
+                        except ValueError:
+                            # window still refilling after tracking
+                            # (re)started — nothing to compare yet
+                            snap[mid] = None
+                    smooth_snaps.append(snap)
+
+        # ---- verdict ---------------------------------------------------
+        recovered = {
+            mid: int(rec.registry.get(mid).version) for mid in ids
+        }
+        lost = {
+            mid: acked[mid] - recovered[mid]
+            for mid in ids if recovered[mid] < acked[mid]
+        }
+        max_diff = 0.0
+        bit_identical = True
+        detector_identical = None
+        smoothed_identical = None
+        for mid in ids:
+            v = recovered[mid]
+            got = rec.registry.get(mid)
+            if v == 0:
+                continue
+            want = snapshots[v - 1][mid]
+            for leg in ("mean", "cov"):
+                a = np.asarray(getattr(got, leg))
+                b = np.asarray(getattr(want, leg))
+                max_diff = max(max_diff, float(np.abs(a - b).max()))
+                if not np.array_equal(a, b):
+                    bit_identical = False
+            if got.t_seen != want.t_seen:
+                bit_identical = False
+        if full:
+            detector_identical = True
+            rec_det = rec.registry.arena_detect_states()
+            for mid in ids:
+                v = recovered[mid]
+                if v == 0:
+                    continue
+                a, b = rec_det.get(mid), det_snaps[v - 1].get(mid)
+                if a is None or b is None or not np.array_equal(a, b):
+                    detector_identical = False
+            if fixed_lag:
+                smoothed_identical = True
+                for mid in ids:
+                    v = recovered[mid]
+                    if v == 0:
+                        continue
+                    b = smooth_snaps[v - 1][mid]
+                    try:
+                        a = rec.smoothed(mid)
+                    except ValueError:
+                        a = None
+                    if (a is None) != (b is None):
+                        smoothed_identical = False
+                    elif a is not None and not (
+                        np.array_equal(a.means, b.means)
+                        and np.array_equal(a.variances, b.variances)
+                        and a.t_end == b.t_end
+                    ):
+                        smoothed_identical = False
+        out = {
+            "mode": mode,
+            "engine": engine,
+            "kill_point": kill_point,
+            "crashed": crashed_at is not None,
+            "n_ticks": n_ticks,
+            "acked": acked,
+            "recovered": recovered,
+            "acked_lost": lost,          # MUST be empty
+            "no_acked_loss": not lost,
+            "bit_identical": bit_identical,
+            "max_posterior_diff": max_diff,
+            "detector_identical": detector_identical,
+            "smoothed_identical": smoothed_identical,
+            "report": report,
+        }
+        rec.close()
+        ctrl.close()
+        return out
+    finally:
+        if tmp is not None:
+            shutil.rmtree(tmp, ignore_errors=True)
 
 
 def run_sensor_fault_scenario(

@@ -30,6 +30,12 @@ refilters history:
   halves — alert raise/clear hysteresis and per-model detection
   mirrors over the fused streaming detectors
   (``METRAN_TPU_SERVE_DETECT``, :mod:`metran_tpu.ops.detect`);
+- :mod:`~metran_tpu.serve.durability` — :class:`WriteAheadLog` /
+  :class:`DurabilityManager`: the crash-safe durability plane —
+  per-commit group-synced write-ahead logging, incremental
+  checkpoints with torn-write-safe manifests, and the deterministic
+  recovery replay behind :meth:`MetranService.recover`
+  (``METRAN_TPU_SERVE_WAL``);
 - :mod:`~metran_tpu.serve.service` — :class:`MetranService`, the
   in-process ``update``/``forecast`` API with latency and occupancy
   telemetry, hard request deadlines, per-model circuit breakers, and
@@ -46,6 +52,13 @@ from ..reliability.policy import (
     StateIntegrityError,
 )
 from .batching import MicroBatcher
+from .durability import (
+    DurabilityManager,
+    DurabilitySpec,
+    RecoveryError,
+    WalRecord,
+    WriteAheadLog,
+)
 from .engine import (
     DetectSpec,
     GateSpec,
@@ -97,6 +110,8 @@ __all__ = [
     "Decomposition",
     "DetectSpec",
     "DetectorMirror",
+    "DurabilityManager",
+    "DurabilitySpec",
     "FixedLagTracker",
     "Forecast",
     "ForecastSnapshot",
@@ -107,9 +122,12 @@ __all__ = [
     "ModelRegistry",
     "ObservationTail",
     "PosteriorState",
+    "RecoveryError",
     "RefitSpec",
     "RefitWorker",
     "ServeMetrics",
+    "WalRecord",
+    "WriteAheadLog",
     "SmoothedWindow",
     "SnapshotEntry",
     "SnapshotStore",

@@ -349,6 +349,54 @@ class DetectorMirror:
                 for s in slots:
                     e.slots_flagged[s] = e.slots_flagged.get(s, 0) + 1
 
+    # -- durability (serve.durability sidecar) ---------------------------
+    def dump(self) -> Dict[str, dict]:
+        """Snapshot every entry for the durability sidecar — the
+        cumulative tallies plus (dict mode) the raw accumulator state,
+        captured at the checkpoint's consistent cut."""
+        out: Dict[str, dict] = {}
+        with self._lock:
+            for mid, e in self._entries.items():
+                out[mid] = {
+                    "meta": {
+                        "version": int(e.version),
+                        "t_seen": int(e.t_seen),
+                        "n_series": int(e.n_series),
+                        "alarms_total": int(e.alarms_total),
+                        "last_alarm_t_seen": e.last_alarm_t_seen,
+                        "slots_flagged": dict(e.slots_flagged),
+                    },
+                    "stats": e.stats,
+                    "counts": e.counts,
+                    "state": e.state,
+                }
+        return out
+
+    def restore(self, dump: Dict[str, dict]) -> None:
+        """Install entries captured by :meth:`dump` (recovery path) —
+        WAL replay then advances them exactly like the original
+        commits did, reconstructing the crash-free mirror."""
+        with self._lock:
+            for mid, d in dump.items():
+                m = d["meta"]
+                last = m.get("last_alarm_t_seen")
+                self._entries[mid] = _DetectEntry(
+                    version=int(m["version"]),
+                    t_seen=int(m["t_seen"]),
+                    n_series=int(m["n_series"]),
+                    stats=np.asarray(d["stats"], float).copy(),
+                    counts=np.asarray(d["counts"], np.int64).copy(),
+                    state=(
+                        None if d.get("state") is None
+                        else np.asarray(d["state"]).copy()
+                    ),
+                    alarms_total=int(m.get("alarms_total", 0)),
+                    last_alarm_t_seen=(
+                        None if last is None else int(last)
+                    ),
+                    slots_flagged=dict(m.get("slots_flagged", {})),
+                )
+
     # -- queries ---------------------------------------------------------
     def snapshot(self, model_id: Optional[str] = None) -> dict:
         """Per-model detection view: per-slot ``cusum_pos`` /

@@ -38,6 +38,7 @@ import numpy as np
 
 from ..io import sweep_stale_tmps
 from ..parallel.mesh import pad_to_multiple
+from ..reliability.faultinject import fire
 from ..reliability.policy import StateIntegrityError
 from ..utils.profiling import EventCounters
 from .state import ModelMeta, PosteriorState, StateArena
@@ -429,6 +430,9 @@ class ModelRegistry:
         # update, refit hot-swap, operator restore) marks the model's
         # snapshot entries stale (serve.readpath.SnapshotStore)
         self._commit_hooks: List[Callable[[str, int], None]] = []
+        #: monotonic instant of the last completed spill() — the
+        #: spill-mode durability-lag signal (last_spill_age)
+        self._last_spill_at: Optional[float] = None
 
     def bind_observability(self, metrics=None, events=None,
                            device_sample_every: int = 1,
@@ -1015,15 +1019,22 @@ class ModelRegistry:
                 )
             return state
 
-    def spill(self, dirty_only: bool = True) -> int:
+    def spill(self, dirty_only: bool = True, directory=None) -> int:
         """Checkpoint resident rows to disk WITHOUT freeing them
         (``registry.root`` required; no-op otherwise).  The arena's
         durability contract: updates dirty their row in place, and
         dirty rows persist here — on :meth:`MetranService.close`, or
         on an operator-driven checkpoint cadence.  Returns the number
-        of rows written."""
+        of rows written.
+
+        ``directory`` redirects the per-model files away from the
+        registry root — the WAL checkpoint's **staging** step
+        (serve.durability): a crash mid-spill must leave the root's
+        baseline untouched, so staged files only replace the live ones
+        after the checkpoint manifest is durable."""
         if not self.arena_enabled or self.root is None:
             return 0
+        target = Path(directory) if directory is not None else None
         # snapshot phase, under the lock: pick the dirty rows and pull
         # their values (ONE device→host gather per leaf per bucket —
         # spill at fleet size is transfer-bound otherwise)
@@ -1061,7 +1072,16 @@ class ModelRegistry:
         n = 0
         try:
             for arena, bucket, mid, row, state in snapshots:
-                state.save(self.path_for(mid))
+                # named crash point for the chaos harness: a process
+                # killed between per-model checkpoint writes leaves a
+                # PARTIAL spill — each file is individually atomic,
+                # and a staged (WAL-checkpoint) spill only replaces
+                # the live baseline after its manifest is durable
+                fire("durability.spill.model", mid)
+                state.save(
+                    target / f"{self.check_model_id(mid)}.npz"
+                    if target is not None else self.path_for(mid)
+                )
                 with self._arena_lock:
                     # the row stays spill-clean only if nothing moved
                     # or updated it while we wrote: a concurrent
@@ -1089,7 +1109,115 @@ class ModelRegistry:
                 n += 1
         finally:
             self.release_rows([mid for _, _, mid, _, _ in snapshots])
+        self._last_spill_at = time.monotonic()
         return n
+
+    def last_spill_age(self) -> Optional[float]:
+        """Seconds since the last completed :meth:`spill` (``None``
+        before the first one) — the spill-mode durability-lag signal
+        ``MetranService.health()`` reports when no WAL is armed."""
+        at = self._last_spill_at
+        return None if at is None else max(0.0, time.monotonic() - at)
+
+    def loaded_model_ids(self) -> List[str]:
+        """Ids with an in-memory state (the dict-mode checkpoint
+        working set; arena registries also keep the last packed/
+        spilled state here as the rebuild fallback)."""
+        return list(self._states)
+
+    def last_good_state(self, model_id: str) -> Optional[PosteriorState]:
+        """The in-memory copy of a model's state WITHOUT touching the
+        device (arena mode: the last packed/spilled snapshot, possibly
+        behind the live row — compare against
+        :meth:`current_versions`).  The durability checkpoint uses it
+        to persist states that were ``put(persist=False)`` and never
+        spilled."""
+        return self._states.get(model_id)
+
+    def current_versions(self) -> Dict[str, int]:
+        """Every known model's CURRENT serving version, host-side only
+        (arena rows answer from the version mirror — no device read):
+        the consistent-cut version map a durability checkpoint
+        records."""
+        out = {mid: int(st.version) for mid, st in self._states.items()}
+        if self.arena_enabled:
+            with self._arena_lock:
+                for mid, (bucket, row) in self._row_map.items():
+                    arena = self._arenas.get(bucket)
+                    if arena is None or arena.lost:
+                        continue
+                    out[mid] = int(arena.version_host[row])
+        return out
+
+    def arena_detect_states(self) -> Dict[str, np.ndarray]:
+        """Every resident row's raw (6, N) detector accumulators (one
+        device→host gather per bucket) — the sidecar-capture half of
+        detector durability; :meth:`restore_arena_detect_states` is
+        the inverse."""
+        out: Dict[str, np.ndarray] = {}
+        if not self.arena_enabled:
+            return out
+        with self._arena_lock:
+            by_bucket: Dict[ShapeBucket, list] = {}
+            for mid, (bucket, row) in self._row_map.items():
+                arena = self._arenas.get(bucket)
+                if arena is None or arena.lost:
+                    continue
+                by_bucket.setdefault(bucket, []).append((mid, row))
+            for bucket, entries in by_bucket.items():
+                arena = self._arenas[bucket]
+                states = arena.read_det_rows([r for _, r in entries])
+                for (mid, _row), st in zip(entries, states):
+                    out[mid] = st
+        return out
+
+    def restore_arena_detect_states(
+        self, states: Dict[str, np.ndarray]
+    ) -> int:
+        """Scatter checkpointed detector accumulators back into the
+        arena leaves (models made resident first; a re-pack resets the
+        leaf by design, so restore must run AFTER residency)."""
+        n = 0
+        by_bucket: Dict[ShapeBucket, list] = {}
+        for mid, st in states.items():
+            try:
+                bucket, row = self.ensure_resident(mid)
+            except Exception:  # noqa: BLE001 - per-model isolation
+                logger.exception(
+                    "could not restore detector state for %r", mid
+                )
+                continue
+            by_bucket.setdefault(bucket, []).append((row, st))
+        for bucket, entries in by_bucket.items():
+            arena = self.arena_of(bucket)
+            n_pad = bucket[0]
+            padded = np.zeros(
+                (len(entries), entries[0][1].shape[0], n_pad),
+                arena.dtype,
+            )
+            for i, (_row, st) in enumerate(entries):
+                padded[i, :, : st.shape[1]] = st
+            arena.write_det_rows(
+                np.asarray([r for r, _ in entries], np.int32), padded
+            )
+            n += len(entries)
+        return n
+
+    def arena_steady_models(self) -> List[str]:
+        """Ids of currently FROZEN (steady) arena rows — the
+        steady-freeze half of the durability sidecar."""
+        out: List[str] = []
+        if not self.arena_enabled:
+            return out
+        with self._arena_lock:
+            for mid, (bucket, row) in self._row_map.items():
+                arena = self._arenas.get(bucket)
+                if (
+                    arena is not None and not arena.lost
+                    and bool(arena.steady_host[row])
+                ):
+                    out.append(mid)
+        return out
 
     @property
     def arena_stats(self) -> Dict[str, int]:

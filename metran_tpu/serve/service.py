@@ -50,12 +50,14 @@ rejects their traffic cheaply until a cooldown probe succeeds.
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from concurrent.futures import Future
 from concurrent.futures import TimeoutError as _FutureTimeout
 from dataclasses import dataclass, field
 from logging import getLogger
+from pathlib import Path
 from typing import NamedTuple, Optional, Tuple
 
 import numpy as np
@@ -63,7 +65,12 @@ import numpy as np
 from ..obs import Observability
 from ..obs.capacity import CapacityTracker, window_label
 from ..ops.kalman import GATE_DOWNWEIGHTED, GATE_REJECTED
-from ..reliability.faultinject import corrupt, corrupting, fire
+from ..reliability.faultinject import (
+    SimulatedCrash,
+    corrupt,
+    corrupting,
+    fire,
+)
 from ..reliability.health import HealthMonitor
 from ..reliability.policy import (
     BreakerBoard,
@@ -77,6 +84,17 @@ from ..reliability.policy import (
 from ..utils.profiling import EventCounters, LatencyRecorder, OccupancyCounter
 from ..ops.detect import DETECT_STATE_ROWS
 from .batching import MicroBatcher
+from .durability import (
+    DurabilityManager,
+    DurabilitySpec,
+    WalGroup,
+    load_latest_manifest,
+    load_sidecar,
+    promote_stage,
+    replay_wal,
+    restore_sidecar,
+    scan_wal,
+)
 from .engine import DetectSpec, GateSpec, SteadySpec
 from .monitoring import AlertBoard, DetectorMirror
 from .readpath import ForecastSnapshot, SnapshotEntry, SnapshotStore, \
@@ -341,6 +359,13 @@ class ServeMetrics:
     #: episodes; ``alert_raised`` / ``alert_cleared`` — alert
     #: lifecycle transitions)
     detect_total: EventCounters = field(default_factory=EventCounters)
+    #: durability-plane events by kind (``records`` — WAL records
+    #: group-committed before their acks; ``sync_failures`` — failed
+    #: group commits (the covered commits ride
+    #: ``durability.unsynced_commits`` until the next durable point);
+    #: ``torn_records`` — torn WAL tails found at recovery;
+    #: ``replayed`` — commits re-applied by recovery replay)
+    wal_total: EventCounters = field(default_factory=EventCounters)
     #: gate-score histogram (squared normalized innovation per observed
     #: slot); only present on registry-backed instances
     gate_scores: Optional[object] = None
@@ -397,6 +422,12 @@ class ServeMetrics:
                 help="streaming-detection outcomes by kind (anomaly, "
                      "changepoint_cusum, changepoint_lb, alert_raised, "
                      "alert_cleared)",
+            ),
+            wal_total=EventCounters(
+                registry=registry,
+                name="metran_serve_wal_total",
+                help="durability-plane events by kind (records, "
+                     "sync_failures, torn_records, replayed)",
             ),
             gate_scores=registry.histogram(
                 "metran_serve_gate_score",
@@ -510,6 +541,18 @@ class MetranService:
         sdf/cdf split, and a detected changepoint feeds
         ``HealthMonitor.refit_candidates`` so a structural break
         schedules a refit.  See docs/concepts.md "Online monitoring".
+    durability : crash-safe durability policy
+        (:class:`~metran_tpu.serve.durability.DurabilitySpec`; default
+        from ``serve_defaults()`` — ``METRAN_TPU_SERVE_WAL*``, shipped
+        off).  Enabled, every committed update is appended to a
+        CRC-framed **write-ahead log** and group-fsynced on the
+        dispatch thread BEFORE its ack resolves, periodic incremental
+        **checkpoints** (dirty-row spills + a torn-write-safe
+        manifest) advance the WAL low-water mark, and
+        :meth:`recover` reconstructs acked state bit-identically at
+        f64 after a crash by replaying the WAL tail through the same
+        incremental kernels.  See docs/concepts.md "Durability &
+        recovery".
     """
 
     def __init__(
@@ -528,6 +571,7 @@ class MetranService:
         refit: Optional[RefitSpec] = None,
         detect: Optional[DetectSpec] = None,
         capacity=None,
+        durability: Optional[DurabilitySpec] = None,
     ):
         from ..config import obs_defaults, serve_defaults
 
@@ -545,6 +589,22 @@ class MetranService:
         self.horizons = parse_horizons(horizons)
         self.registry = registry
         self.persist_updates = persist_updates
+        #: recovery-replay payloads are ALREADY standardized (the WAL
+        #: logs exactly what the kernels consumed): while True, the
+        #: ingest paths skip standardization + the corruption hook so
+        #: the replayed kernel input is bit-identical to the original
+        #: dispatch.  Recovery owns the service exclusively.
+        self._ingest_standardized = False
+        #: the attached durability plane (serve.durability), armed at
+        #: the END of construction (its baseline checkpoint needs the
+        #: fully-built service) or by :meth:`recover`
+        self._durability: Optional[DurabilityManager] = None
+        #: commit-group sequence for WAL records (one id per
+        #: _wal_commit call — the replay batching unit)
+        self._wal_group_seq = itertools.count(1)
+        #: the last :meth:`recover` replay report (None on a
+        #: normally-constructed service)
+        self.last_recovery: Optional[dict] = None
         # a default-constructed bundle is OURS to close (its event log
         # may own a file sink); a caller-provided one is theirs
         self._owns_obs = observability is None
@@ -762,6 +822,41 @@ class MetranService:
             worker = RefitWorker(self, refit_spec)
             self._owns_refit = True
             worker.start()
+        # crash-safe durability plane (serve.durability; docs/
+        # concepts.md "Durability & recovery"): per-commit WAL group-
+        # synced before every ack + incremental checkpoints.  Attached
+        # LAST — its baseline checkpoint takes a consistent cut of the
+        # fully-constructed service.  Shipped off
+        # (METRAN_TPU_SERVE_WAL).
+        dur_spec = (
+            durability.validate() if durability is not None
+            else DurabilitySpec.from_defaults()
+        )
+        if dur_spec.enabled:
+            self._durability = DurabilityManager(self, dur_spec)
+            self._register_durability_gauges()
+
+    def _register_durability_gauges(self) -> None:
+        """Durability-lag gauges, registered once the manager exists
+        (normal construction arms it last; :meth:`recover` attaches
+        it after replay)."""
+        dur = self._durability
+        if dur is None or self.obs.metrics is None:
+            return
+        m = self.obs.metrics
+        m.gauge(
+            "metran_serve_durability_lag_seconds",
+            "seconds since the last durable point (WAL group sync or "
+            "checkpoint) — the live RPO estimate",
+            callback=lambda: float(dur.lag_seconds()),
+        )
+        m.gauge(
+            "metran_serve_wal_unsynced_commits",
+            "acked commits whose WAL group commit failed since the "
+            "last successful sync (at risk until the next durable "
+            "point; 0 in healthy operation)",
+            callback=lambda: float(dur.unsynced_commits),
+        )
 
     def _attach_refit(self, worker: RefitWorker) -> None:
         """Install ``worker`` as this service's refit loop (called by
@@ -1496,12 +1591,21 @@ class MetranService:
             self._record_failure_without_request("update", model_id)
             raise
         new_obs = np.atleast_2d(np.asarray(new_obs, float))
-        # data-corrupting fault point: sensor faults (spike, stuck-at,
-        # drift, unit-error) injected on the raw payload exactly as a
-        # broken upstream feed would deliver them — what the
-        # observation gate exists to catch (reliability.faultinject;
-        # `-m faults` tests and `bench.py --phase robust-obs`)
-        new_obs = corrupt("serve.update.new_obs", new_obs, detail=model_id)
+        # recovery replay hands back the WAL's already-standardized
+        # rows: no corruption hook (the log holds post-hook payloads)
+        # and no re-standardization below — the kernel input must be
+        # bit-identical to the original dispatch
+        replaying = self._ingest_standardized
+        if not replaying:
+            # data-corrupting fault point: sensor faults (spike,
+            # stuck-at, drift, unit-error) injected on the raw payload
+            # exactly as a broken upstream feed would deliver them —
+            # what the observation gate exists to catch
+            # (reliability.faultinject; `-m faults` tests and
+            # `bench.py --phase robust-obs`)
+            new_obs = corrupt(
+                "serve.update.new_obs", new_obs, detail=model_id
+            )
         if new_obs.shape[1] != state.n_series:
             self.metrics.errors.increment("validation_errors")
             raise ValueError(
@@ -1533,10 +1637,16 @@ class MetranService:
         if n_masked:
             self.metrics.data_quality.increment("masked_values", n_masked)
         # standardize at the boundary; masked slots go to 0 like the
-        # panel packer does (ignored under mask either way)
-        y_std = np.where(
-            mask, (new_obs - state.scaler_mean) / state.scaler_std, 0.0
-        )
+        # panel packer does (ignored under mask either way).  Replay
+        # payloads are already standardized — only the mask fill runs.
+        if replaying:
+            y_std = np.where(mask, new_obs, 0.0)
+        else:
+            y_std = np.where(
+                mask,
+                (new_obs - state.scaler_mean) / state.scaler_std,
+                0.0,
+            )
         bucket = self.registry.bucket_of(state)
         key = ("update", bucket, new_obs.shape[0])
         payload = (y_std, mask)
@@ -1953,6 +2063,10 @@ class MetranService:
             # bulk tick is ONE caller request with no queue wait
             cap.observe_stage("publish", now - t_pb0)
             cap.end_dispatch(acc, [], t0, now)
+        if self._durability is not None:
+            # checkpoint cadence, outside the update lock (the
+            # consistent cut re-takes it)
+            self._durability.maybe_checkpoint()
         return results
 
     def _update_batch_buckets(self, ids, obs_list, hits, live, results):
@@ -1962,6 +2076,8 @@ class MetranService:
         gated = gate.enabled
         cap = self.capacity
         acc = cap.active() if cap is not None else None
+        replaying = self._ingest_standardized
+        wal_groups: list = [] if self._durability is not None else None
         for bucket, idxs in self._bucket_groups(hits, live).items():
             t_b0 = time.monotonic()
             try:
@@ -1977,7 +2093,7 @@ class MetranService:
             )
             y_raw = np.zeros((len(idxs), k, n_pad))
             n_expect = arena.n_series_host[rows_arr]
-            if corrupting():
+            if corrupting() and not replaying:
                 obs_group = [
                     corrupt(
                         "serve.update.new_obs", obs_list[i],
@@ -2058,15 +2174,24 @@ class MetranService:
                     "masked_values", n_masked
                 )
             # vectorized standardization against the arena's host
-            # scaler mirrors (padded cols have mean 0 / std 1)
-            sm = arena.scaler_mean[rows_arr][:, None, :]
-            sd = arena.scaler_std[rows_arr][:, None, :]
-            # standardized in f64 (like the per-request path), cast
-            # to the arena dtype so bulk and per-request dispatches
-            # share ONE compiled executable per (bucket, k)
-            y = np.where(mask, (y_raw - sm) / sd, 0.0).astype(
-                arena.dtype, copy=False
-            )
+            # scaler mirrors (padded cols have mean 0 / std 1).
+            # Recovery-replay payloads are ALREADY standardized (the
+            # WAL logs what the kernel consumed): only the mask fill +
+            # dtype cast run, so the replayed kernel input is
+            # bit-identical to the original dispatch.
+            if replaying:
+                y = np.where(mask, y_raw, 0.0).astype(
+                    arena.dtype, copy=False
+                )
+            else:
+                sm = arena.scaler_mean[rows_arr][:, None, :]
+                sd = arena.scaler_std[rows_arr][:, None, :]
+                # standardized in f64 (like the per-request path), cast
+                # to the arena dtype so bulk and per-request dispatches
+                # share ONE compiled executable per (bucket, k)
+                y = np.where(mask, (y_raw - sm) / sd, 0.0).astype(
+                    arena.dtype, copy=False
+                )
             m = mask & real
             if acc is not None:
                 # vectorized validation + standardization above; the
@@ -2078,7 +2203,7 @@ class MetranService:
             # snapshots + snapshot publish all live in the shared
             # helper (same engine as _run_update_arena); names are
             # only materialized when a snapshot will be published
-            ok, versions, t_seens, zs, verdicts = (
+            ok, versions, t_seens, zs, verdicts, det_counts = (
                 self._arena_dispatch_rows(
                     bucket, arena, rows_arr, y, m, k,
                     [ids[i] for i in idxs],
@@ -2089,6 +2214,20 @@ class MetranService:
                 )
             )
             t_pb0 = time.monotonic()
+            if wal_groups is not None and ok.any():
+                # one stacked frame per bucket sub-batch (vectorized;
+                # committed through ONE group fsync at tick end)
+                sel = np.flatnonzero(ok)
+                wal_groups.append(self._wal_group(
+                    [ids[idxs[gi]] for gi in sel],
+                    y[sel], m[sel], versions[sel], t_seens[sel],
+                    n_sl[sel],
+                    verdicts=verdicts[sel] if gated else None,
+                    det_counts=(
+                        det_counts[sel] if det_counts is not None
+                        else None
+                    ),
+                ))
             if gated:
                 self._book_gate_verdicts_bulk(
                     idxs, ids, zs, verdicts, n_sl
@@ -2142,6 +2281,11 @@ class MetranService:
                 cap.observe_stage(
                     "publish", time.monotonic() - t_pb0
                 )
+        # ONE group commit for the whole tick (all buckets), before
+        # _update_batch_arena returns and the caller sees any ack —
+        # maximal fsync coalescing on the bulk path
+        if wal_groups is not None:
+            self._wal_commit(wal_groups, acc)
 
     def _book_gate_verdicts_bulk(self, idxs, ids, zs, verdicts, n_sl):
         """Vectorized gate-outcome booking for one bulk dispatch:
@@ -2380,6 +2524,7 @@ class MetranService:
             }} if self.detect.enabled else {}),
             **({"refit": self._refit_worker.stats()}
                if self._refit_worker is not None else {}),
+            **self._durability_health(),
             **({"capacity": {
                 "coverage": round(self.capacity.coverage(), 4),
                 "utilization_60s": round(
@@ -2394,6 +2539,25 @@ class MetranService:
             }} if self.capacity is not None else {}),
         })
         return snap
+
+    def _durability_health(self) -> dict:
+        """The ``durability`` health/capacity-report section: the WAL
+        manager's live status when the plane is armed, else the
+        spill-mode lag (seconds since the last arena spill — the
+        pre-WAL durability frontier) so ``durability_lag`` is always
+        answerable on a path that loses data on crash."""
+        if self._durability is not None:
+            return {"durability": self._durability.status()}
+        if self.registry.arena_enabled:
+            age = self.registry.last_spill_age()
+            return {"durability": {
+                "mode": "spill",
+                "last_spill_age_s": (
+                    None if age is None else round(age, 4)
+                ),
+                "unsynced_commits": None,  # unbounded: no WAL armed
+            }}
+        return {}
 
     def capacity_report(self) -> dict:
         """The capacity & cost plane's structured snapshot (requires
@@ -2444,7 +2608,281 @@ class MetranService:
             }
         if self.readpath is not None:
             report["readpath"] = self.readpath.stats()
+        report.update(self._durability_health())
         return report
+
+    # ------------------------------------------------------------------
+    # durability plane (serve.durability)
+    # ------------------------------------------------------------------
+    def _wal_commit(self, groups, acc=None) -> None:
+        """Group-commit one dispatch's committed updates to the WAL
+        BEFORE any caller's ack resolves (every ``_run_update*`` body
+        calls this last, and futures only resolve after the dispatch
+        returns).  An ordinary append/sync failure degrades durability
+        — booked as ``wal_sync_failure`` + a growing
+        ``unsynced_commits`` gauge — rather than failing updates that
+        are already applied; a :class:`SimulatedCrash` propagates (the
+        process is dying)."""
+        dur = self._durability
+        if dur is None:
+            return
+        groups = [g for g in groups if g.n_records]
+        if not groups:
+            return
+        # stamp the commit group: replay re-dispatches exactly this
+        # member set as one batch (the kernel-call batch shape is part
+        # of the computation — see durability.WalRecord); one id may
+        # span several frames (one per bucket sub-batch of a tick)
+        grp = next(self._wal_group_seq)
+        total = sum(g.n_records for g in groups)
+        groups = [
+            g._replace(group=grp, group_size=total) for g in groups
+        ]
+        t0 = time.monotonic()
+        try:
+            dur.log_commits(groups)
+            self.metrics.wal_total.increment("records", total)
+        except SimulatedCrash:
+            raise
+        except Exception:
+            dur.note_failed_commits(total)
+            self.metrics.wal_total.increment("sync_failures")
+            if self.events is not None:
+                self.events.emit(
+                    "wal_sync_failure",
+                    fault_point="durability.wal",
+                    commits=total,
+                )
+            logger.exception(
+                "WAL group commit failed (%d commit(s) at risk until "
+                "the next durable point)", total,
+            )
+        if acc is not None and self.capacity is not None:
+            self.capacity.observe_stage("wal", time.monotonic() - t0)
+
+    @staticmethod
+    def _wal_group(ids, y, m, versions, t_seens, n_series,
+                   verdicts=None, det_counts=None) -> WalGroup:
+        """One dispatch sub-batch's committed rows as a stacked WAL
+        frame: the standardized rows exactly as the kernels consumed
+        them (NaN at masked cells — the mask round-trips as
+        ``isfinite``) plus vectorized gate/detector audit counts.
+        Everything here is one numpy pass over the already-stacked
+        dispatch block — per-record Python framing measured half the
+        WAL-overhead budget at fleet batch sizes."""
+        verd = None
+        if verdicts is not None:
+            verd = np.ascontiguousarray(verdicts, np.int8)
+        dc3 = None
+        if det_counts is not None:
+            dc3 = det_counts.sum(axis=2, dtype=np.int64)
+        return WalGroup(
+            model_ids=tuple(ids),
+            versions=np.asarray(versions, np.int64),
+            t_seens=np.asarray(t_seens, np.int64),
+            n_series=np.asarray(n_series, np.int64),
+            y=np.where(m, y, np.nan),
+            gate_flagged=(
+                (verd != 0).sum(axis=(1, 2)).astype(np.int32)
+                if verd is not None
+                else np.zeros(len(ids), np.int32)
+            ),
+            alarms=(
+                dc3.sum(axis=1).astype(np.int32)
+                if dc3 is not None
+                else np.zeros(len(ids), np.int32)
+            ),
+            verdicts=verd,
+            det_counts=dc3,
+        )
+
+    def _replay_apply(self, ids, obs_list) -> list:
+        """Recovery replay's ingest: one ``update_batch`` tick whose
+        payloads are the WAL's already-standardized rows (NaN =
+        masked).  The flag routes every ingest path around
+        standardization and the corruption hook, so the kernels see
+        bit-identical inputs; recovery owns the service exclusively,
+        so flipping the instance flag is race-free."""
+        self._ingest_standardized = True
+        try:
+            return self.update_batch(ids, obs_list)
+        finally:
+            self._ingest_standardized = False
+
+    def _restore_steady_frozen(self, model_ids) -> int:
+        """Re-freeze checkpointed-frozen models at recovery: the
+        gains/innovation variances are deterministic functions of the
+        (restored) parameters, so they are RECOMPUTED (one DARE solve
+        per model) rather than stored — the replayed tail then rides
+        the steady kernels exactly like the original commits did."""
+        n = 0
+        for mid in model_ids:
+            try:
+                st = self.registry.get(mid)
+                if self.registry.arena_enabled:
+                    bucket, row = self.registry.ensure_resident(mid)
+                    arena = self.registry.arena_of(bucket)
+                    kg, fd, hvars = self._compute_steady(
+                        st, bucket, arena.dtype
+                    )
+                    with arena.lock:
+                        arena.freeze_rows(
+                            np.asarray([row], np.int32),
+                            kg[None], fd[None],
+                        )
+                    if hvars is not None:
+                        self._steady_hvars[mid] = hvars
+                else:
+                    bucket = self.registry.bucket_of(st)
+                    kg, fd, hvars = self._compute_steady(
+                        st, bucket, st.dtype
+                    )
+                    self._steady_info[mid] = _SteadyInfo(
+                        version=st.version, kgain=kg, fdiag=fd,
+                        hvars=hvars, params_ref=st.params,
+                        loadings_ref=st.loadings,
+                    )
+                n += 1
+            except Exception:  # noqa: BLE001 - per-model isolation
+                logger.exception(
+                    "could not restore steady freeze for %r (it "
+                    "recovers thawed and may refreeze on its own)",
+                    mid,
+                )
+        return n
+
+    def checkpoint(self) -> dict:
+        """Take one durability checkpoint NOW (spill dirty state,
+        rotate + truncate the WAL, write the manifest/sidecar) —
+        the operator-driven form of the ``checkpoint_every`` cadence.
+        Requires the durability plane
+        (``MetranService(durability=DurabilitySpec(enabled=True))`` /
+        ``METRAN_TPU_SERVE_WAL=1``)."""
+        if self._durability is None:
+            raise ValueError(
+                "durability plane is disabled; construct the service "
+                "with durability=DurabilitySpec(enabled=True) or set "
+                "METRAN_TPU_SERVE_WAL=1"
+            )
+        return self._durability.checkpoint()
+
+    @classmethod
+    def recover(cls, directory, *, registry=None, registry_kwargs=None,
+                durability: Optional[DurabilitySpec] = None,
+                checkpoint_after: bool = True,
+                **service_kwargs) -> "MetranService":
+        """Reconstruct a service from a durability directory after a
+        crash (docs/concepts.md "Durability & recovery").
+
+        Loads the latest valid checkpoint manifest under
+        ``<directory>/wal`` (or ``durability.dir``), builds a registry
+        over ``directory`` (pass ``registry=``/``registry_kwargs=`` to
+        control its configuration; the manifest's recorded engine/
+        arena mode are the defaults), restores the checkpoint's
+        sidecar state (detector accumulators, fixed-lag smoother
+        windows, steady-freeze flags), then **replays the WAL tail
+        through the same incremental update kernels that served the
+        original commits** — per-model order preserved, batched across
+        models per round, standardization skipped so the kernel inputs
+        are bit-identical.  The result provably reconstructs every
+        acked update: each replayed record must land exactly on its
+        logged version, a torn tail record is never applied, and a
+        torn record anywhere before live segments refuses recovery
+        (:class:`~metran_tpu.serve.durability.RecoveryError`) instead
+        of silently losing acked data.
+
+        Pass the SAME feature configuration (engine, gate, steady,
+        detect, fixed_lag) the crashed service ran with — replay
+        determinism depends on it.  ``checkpoint_after`` (default)
+        takes a fresh checkpoint once replay completes, so the
+        recovered state is immediately durable and the replayed
+        segments are truncated.  Returns the service with the
+        durability plane re-armed and the replay report in
+        ``service.last_recovery``."""
+        directory = Path(directory)
+        spec = (
+            durability.validate() if durability is not None
+            else DurabilitySpec.from_defaults()._replace(enabled=True)
+        )
+        wal_dir = Path(spec.dir) if spec.dir else directory / "wal"
+        manifest = load_latest_manifest(wal_dir)
+        if manifest is not None and manifest.get("stage"):
+            # finish a crash-interrupted promotion FIRST (idempotent:
+            # each staged file atomically replaces its root
+            # counterpart) — the manifest committed this checkpoint,
+            # so its staged states are the authoritative baseline
+            promote_stage(wal_dir / manifest["stage"], directory)
+        if registry is None:
+            rkw = dict(registry_kwargs or {})
+            if manifest is not None:
+                rkw.setdefault("engine", manifest.get("engine"))
+                rkw.setdefault("arena", bool(manifest.get("arena")))
+            registry = ModelRegistry(root=directory, **rkw)
+        svc = cls(
+            registry,
+            durability=DurabilitySpec(enabled=False),
+            **service_kwargs,
+        )
+        report: dict = {
+            "manifest_seq": (
+                int(manifest["seq"]) if manifest is not None else None
+            ),
+        }
+        if svc.events is not None:
+            svc.events.emit(
+                "recovery_start", fault_point="durability.recover",
+                dir=str(wal_dir), manifest_seq=report["manifest_seq"],
+            )
+        try:
+            if manifest is not None and manifest.get("sidecar"):
+                sidecar_path = wal_dir / manifest["sidecar"]
+                if sidecar_path.exists():
+                    tree, arrays = load_sidecar(sidecar_path)
+                    report["sidecar"] = restore_sidecar(
+                        svc, tree, arrays
+                    )
+            from_seq = (
+                int(manifest["wal_from_seq"]) if manifest is not None
+                else 1
+            )
+            records, torn_tail = scan_wal(wal_dir, from_seq)
+            if torn_tail:
+                svc.metrics.wal_total.increment("torn_records")
+                if svc.events is not None:
+                    svc.events.emit(
+                        "wal_torn_record",
+                        fault_point="durability.recover",
+                        dir=str(wal_dir),
+                    )
+            report.update(replay_wal(svc, records))
+            report["torn_tail"] = torn_tail
+        except BaseException:
+            # leave the directory untouched for forensics: the close
+            # below must not spill a half-replayed state over the
+            # checkpoint recovery would need to retry from
+            svc.persist_updates = False
+            try:
+                svc.close()
+            except Exception:  # pragma: no cover - teardown only
+                logger.exception("teardown after failed recovery")
+            raise
+        svc.metrics.wal_total.increment(
+            "replayed", report.get("replayed", 0)
+        )
+        svc._durability = DurabilityManager(
+            svc,
+            spec._replace(enabled=True, dir=str(wal_dir)),
+            recovered=True,
+            initial_checkpoint=checkpoint_after,
+        )
+        svc._register_durability_gauges()
+        svc.last_recovery = report
+        if svc.events is not None:
+            svc.events.emit(
+                "recovery_complete", fault_point="durability.recover",
+                **{k: v for k, v in report.items() if k != "sidecar"},
+            )
+        return svc
 
     def close(self) -> None:
         # the refit worker stops FIRST: a promotion must never race
@@ -2466,20 +2904,39 @@ class MetranService:
         # updates that only enqueue from done-callbacks mid-drain —
         # before it starts refusing submissions
         self.batcher.close()
+        if self._durability is not None:
+            # final checkpoint: the WAL truncates to (near) nothing and
+            # the next process recovers from the manifest alone
+            try:
+                self._durability.close()
+            except Exception:  # pragma: no cover - shutdown only
+                logger.exception("durability close failed")
         if self.readpath is not None:
             # detach the snapshot store's invalidation hook: a shared
             # registry outliving this service must not keep the store
             # alive or call into it after close
             self.registry.remove_commit_hook(self.readpath.note_commit)
         if self.registry.arena_enabled and self.persist_updates:
-            # the arena's durability frontier: updates dirtied rows in
-            # place on device, and a clean shutdown spills them so the
-            # next process warm-starts from disk (crash windows are
-            # bounded by the last spill/evict — see docs/concepts.md
-            # "Scale & sharding")
+            # the arena's durability frontier without a WAL: updates
+            # dirtied rows in place on device, and a clean shutdown
+            # spills them so the next process warm-starts from disk
+            # (crash windows are bounded by the last spill/evict — see
+            # docs/concepts.md "Durability & recovery")
             try:
                 self.registry.spill(dirty_only=True)
             except Exception:  # pragma: no cover - disk trouble
+                # surfaced, not swallowed: a failed close-time spill IS
+                # lost durability (the in-memory state dies with this
+                # process) — counted + attributed so the capacity/
+                # health surfaces show it before anyone trusts the
+                # shutdown
+                self.metrics.errors.increment("spill_failures")
+                if self.events is not None:
+                    self.events.emit(
+                        "spill_failure",
+                        fault_point="registry.arena",
+                        phase="close",
+                    )
                 logger.exception("arena spill on close failed")
         if self._owns_obs and self.events is not None:
             # release a default bundle's owned event-sink fd (a caller-
@@ -2616,6 +3073,11 @@ class MetranService:
                         results[p] = res
                         if isinstance(res, BaseException):
                             broken.add(requests[p].model_id)
+            if self._durability is not None:
+                # checkpoint cadence, OUTSIDE the update lock (the
+                # consistent cut re-takes it); amortized on the
+                # dispatch thread like the spills it replaces
+                self._durability.maybe_checkpoint()
             latency = self.metrics.update_latency
         else:  # pragma: no cover - batch keys are service-constructed
             raise ValueError(f"unknown dispatch kind {kind!r}")
@@ -3009,6 +3471,9 @@ class MetranService:
                 {"batch": len(kstates), "engine": "steady"},
             )
         snap_entries: list = []
+        wal_sel: "Optional[list]" = (
+            [] if self._durability is not None else None
+        )
         for i, (si, j, info) in enumerate(keep):
             st = states[si]
             trace_ctx = sub[j].trace if tracer is not None else None
@@ -3059,6 +3524,11 @@ class MetranService:
                         "write-through persist failed for model %r "
                         "(serving from memory)", st.model_id,
                     )
+                if wal_sel is not None:
+                    wal_sel.append((
+                        i, st.model_id, new_state.version,
+                        new_state.t_seen, st.n_series,
+                    ))
                 results[idxs[j]] = new_state
                 self._observe_smoother(
                     st.model_id, y[i, :, : st.n_series],
@@ -3121,6 +3591,20 @@ class MetranService:
                     "was not applied", st.model_id,
                 )
                 results[idxs[j]] = exc
+        # group commit BEFORE returning (futures resolve after the
+        # dispatch): acked == WAL-durable; thawed rows commit theirs
+        # in the exact-kernel body that replays them
+        if wal_sel:
+            idx = np.asarray([t[0] for t in wal_sel])
+            self._wal_commit([self._wal_group(
+                [t[1] for t in wal_sel], y[idx], m[idx],
+                [t[2] for t in wal_sel], [t[3] for t in wal_sel],
+                [t[4] for t in wal_sel],
+                verdicts=verdict_t[idx] if gated else None,
+                det_counts=(
+                    det_counts[idx] if det is not None else None
+                ),
+            )], acc)
         if rp is not None and snap_entries:
             try:
                 rp.publish_entries(snap_entries)
@@ -3264,6 +3748,9 @@ class MetranService:
             fac_before = np.asarray(fac_b)
             fac_after = chol_t if sqrt_engine else cov_t
         snap_entries: list = []
+        wal_sel: "Optional[list]" = (
+            [] if self._durability is not None else None
+        )
         for i, (st, j) in enumerate(zip(states, live)):
             # per-slot finalize: everything between here and a
             # successful registry.put can raise on one slot's own data
@@ -3396,6 +3883,15 @@ class MetranService:
                         "write-through persist failed for model %r "
                         "(serving from memory)", st.model_id,
                     )
+                if wal_sel is not None:
+                    # collected the instant the commit happened: even
+                    # a finalize hiccup AFTER the put cannot drop a
+                    # committed update from the log (a version hole
+                    # would refuse the next recovery)
+                    wal_sel.append((
+                        i, st.model_id, new_state.version,
+                        new_state.t_seen, st.n_series,
+                    ))
                 if trace_ctx is not None:
                     tracer.record(
                         "serve.commit", trace_ctx, t_commit0,
@@ -3521,6 +4017,19 @@ class MetranService:
                         "snapshot build failed for model %r (cache "
                         "only; the update is applied)", st.model_id,
                     )
+        # group commit BEFORE the dispatch returns (and the callers'
+        # futures resolve): acked == WAL-durable
+        if wal_sel:
+            idx = np.asarray([t[0] for t in wal_sel])
+            self._wal_commit([self._wal_group(
+                [t[1] for t in wal_sel], y[idx], m[idx],
+                [t[2] for t in wal_sel], [t[3] for t in wal_sel],
+                [t[4] for t in wal_sel],
+                verdicts=verdict_t[idx] if gated else None,
+                det_counts=(
+                    det_counts[idx] if det is not None else None
+                ),
+            )], acc)
         if rp is not None and snap_entries:
             # published BEFORE the dispatch returns (and the callers'
             # futures resolve): read-your-writes for acked updates
@@ -3646,8 +4155,10 @@ class MetranService:
         contract) and publishes the fused snapshot before returning,
         while the callers' pins still hold the rows in place.
 
-        Returns ``(ok, versions, t_seens, zs, verdicts)`` over the G
-        rows (``zs``/``verdicts`` ``None`` when the gate is off).
+        Returns ``(ok, versions, t_seens, zs, verdicts, det_counts)``
+        over the G rows (``zs``/``verdicts`` ``None`` when the gate is
+        off; ``det_counts`` the (G, 3, N) per-slot alarm counts, or
+        ``None`` when detection is off — the WAL's audit annotations).
         """
         gate = self.gate
         gated = gate.enabled
@@ -3894,7 +4405,7 @@ class MetranService:
                 cap.observe_stage(
                     "publish", time.monotonic() - t_seg
                 )
-        return ok, versions, t_seens, zs, verdicts
+        return ok, versions, t_seens, zs, verdicts, det_counts
 
     def _lookup_rows(self, requests, results):
         """Per-request row resolution (arena mode): ensure each model
@@ -4025,6 +4536,7 @@ class MetranService:
         rebuilds the arena from last-good states on the next touch.
         """
         results: list = [None] * len(requests)
+        wal_groups: list = [] if self._durability is not None else None
         rows, metas, live, pinned = self._lookup_rows(requests, results)
         try:
             if not live:
@@ -4047,7 +4559,7 @@ class MetranService:
             # contract), commit snapshots taken BEFORE the pins
             # release, and the fused snapshot published while the
             # pins still hold the rows — all inside the helper
-            ok, versions, t_seens, zs, verdicts = (
+            ok, versions, t_seens, zs, verdicts, det_counts = (
                 self._arena_dispatch_rows(
                     bucket, arena, rows_arr, y, m, k,
                     [mt.model_id for mt in metas],
@@ -4134,6 +4646,29 @@ class MetranService:
                     "arena finalize failed for model %r", meta.model_id,
                 )
                 results[j] = exc
+        # group commit BEFORE returning: the callers' futures resolve
+        # only after this dispatch returns, so acked == WAL-durable
+        if wal_groups is not None and live:
+            okm = np.asarray(ok, bool)
+            if okm.any():
+                sel = np.flatnonzero(okm)
+                wal_groups.append(self._wal_group(
+                    [metas[i].model_id for i in sel],
+                    y[sel], m[sel], versions[sel], t_seens[sel],
+                    np.asarray(
+                        [metas[i].n_series for i in sel], np.int64
+                    ),
+                    verdicts=verdicts[sel] if gated else None,
+                    det_counts=(
+                        det_counts[sel] if det_counts is not None
+                        else None
+                    ),
+                ))
+            self._wal_commit(
+                wal_groups,
+                self.capacity.active()
+                if self.capacity is not None else None,
+            )
         return results
 
 

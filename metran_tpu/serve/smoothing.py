@@ -190,6 +190,67 @@ class FixedLagTracker:
         tr.anchor_t_seen += excess
         del tr.rows[:excess]
 
+    # -- durability (serve.durability sidecar) --------------------------
+    def dump(self) -> Dict[str, dict]:
+        """Snapshot every track for the durability sidecar: plain
+        arrays + a JSON-able ``meta`` dict per model, the shape
+        :meth:`restore` rebuilds from.  Captured at a consistent cut
+        (the durability checkpoint holds the update lock), so the
+        windows line up exactly with the spilled posteriors."""
+        out: Dict[str, dict] = {}
+        with self._lock:
+            for mid, tr in self._tracks.items():
+                rows_y = (
+                    np.stack([r[0] for r in tr.rows])
+                    if tr.rows else np.zeros((0, len(tr.names)))
+                )
+                rows_m = (
+                    np.stack([r[1] for r in tr.rows])
+                    if tr.rows else np.zeros((0, len(tr.names)), bool)
+                )
+                out[mid] = {
+                    "meta": {
+                        "dt": float(tr.dt),
+                        "names": list(tr.names),
+                        "anchor_t_seen": int(tr.anchor_t_seen),
+                    },
+                    "params": tr.params,
+                    "loadings": tr.loadings,
+                    "scaler_mean": tr.scaler_mean,
+                    "scaler_std": tr.scaler_std,
+                    "anchor_mean": tr.anchor_mean,
+                    "anchor_chol": tr.anchor_chol,
+                    "rows_y": rows_y,
+                    "rows_m": rows_m,
+                }
+        return out
+
+    def restore(self, dump: Dict[str, dict]) -> None:
+        """Install tracks captured by :meth:`dump` (recovery path).
+        Replacing any live track is intended: recovery owns the
+        service exclusively and the restored windows are then advanced
+        by the WAL replay, reproducing the crash-free tracker state
+        bit-identically."""
+        with self._lock:
+            for mid, d in dump.items():
+                tr = object.__new__(_Track)
+                tr.params = np.asarray(d["params"], float)
+                tr.loadings = np.asarray(d["loadings"], float)
+                tr.dt = float(d["meta"]["dt"])
+                tr.names = tuple(d["meta"]["names"])
+                tr.scaler_mean = np.asarray(d["scaler_mean"], float)
+                tr.scaler_std = np.asarray(d["scaler_std"], float)
+                tr.anchor_mean = np.asarray(d["anchor_mean"], float)
+                tr.anchor_chol = np.asarray(d["anchor_chol"], float)
+                tr.anchor_t_seen = int(d["meta"]["anchor_t_seen"])
+                rows_y = np.asarray(d["rows_y"], float)
+                rows_m = np.asarray(d["rows_m"], bool)
+                tr.rows = [
+                    (rows_y[i], rows_m[i])
+                    for i in range(rows_y.shape[0])
+                ]
+                self._tracks[mid] = tr
+
     def smooth(self, model_id: str,
                lag: Optional[int] = None) -> SmoothedWindow:
         """Smoothed moments for the model's trailing window.

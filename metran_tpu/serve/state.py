@@ -836,6 +836,24 @@ class StateArena:
             self._check()
             return np.asarray(self._det[rows])
 
+    def write_det_rows(self, rows, states) -> None:
+        """Scatter detector accumulators back into the leaf ((R, 6, N)
+        per row) — the recovery path's inverse of
+        :meth:`read_det_rows`: a re-packed row resets its detector
+        state by design (``write_row``), so restoring a checkpointed
+        arena must re-install the sidecar-captured accumulators AFTER
+        its rows are resident, or recovered models would redetect from
+        zero evidence."""
+        rows = np.asarray(rows, np.int32)
+        vals = np.asarray(states, self.dtype)
+        with self.lock:
+            self._check()
+            try:
+                self._det = self._det.at[rows].set(vals)
+            except BaseException:
+                self._lost = True
+                raise
+
     def query(self, fn, *args):
         """Run a read-only kernel ``fn(mean, fac, static, *args)``
         under the arena lock (so it can never race a donating swap)."""

@@ -1,0 +1,658 @@
+"""Crash-safe durability plane: WAL framing/group-commit, checkpoint
+manifests, deterministic recovery replay, and the crash-point chaos
+matrix (serve/durability.py; docs/concepts.md "Durability &
+recovery").
+
+The tier-1 subset covers the mechanics (framing, torn-record
+termination, manifest rotation, fsync coalescing, sidecar round-trips,
+lag reporting) plus two representative chaos cells; the FULL
+kill-point x mode matrix rides the ``slow`` marker
+(``pytest -m 'durability and slow'``)."""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from metran_tpu.reliability.scenarios import (
+    CRASH_POINTS,
+    run_crash_recovery_scenario,
+)
+from metran_tpu.serve import DurabilitySpec, MetranService, ModelRegistry
+from metran_tpu.serve.durability import (
+    RecoveryError,
+    WalGroup,
+    WalRecord,
+    WriteAheadLog,
+    _split_groups,
+    decode_group,
+    encode_group,
+    list_segments,
+    load_latest_manifest,
+    load_manifest,
+    scan_segment,
+    write_manifest,
+)
+from metran_tpu.serve.monitoring import DetectorMirror
+from metran_tpu.serve.smoothing import FixedLagTracker
+
+pytestmark = pytest.mark.durability
+
+
+# ----------------------------------------------------------------------
+# record framing
+# ----------------------------------------------------------------------
+def test_wal_group_roundtrip():
+    y = np.array([[1.5, np.nan, -2.25], [0.0, 3.125, np.nan]])
+    recs = [
+        WalRecord(
+            model_id="m-7", version=12, t_seen=300, y=y,
+            gate_flagged=2, alarms=1,
+            verdicts=np.array([[0, 1, 0], [0, 0, 2]], np.int8),
+            det_counts=np.array([1, 0, 0], np.int64),
+            group=42, group_size=2,
+        ),
+        WalRecord(
+            model_id="other", version=5, t_seen=80,
+            y=np.array([[0.5, -0.5]]),  # narrower width, same group
+            group=42, group_size=2,
+        ),
+    ]
+    # mixed row counts cannot share one frame (one dispatch, one k) —
+    # split like the service does, per sub-batch
+    back = decode_group(encode_group(WalGroup.of(recs[:1]))[10:])
+    assert len(back) == 1
+    b = back[0]
+    assert b.model_id == "m-7"
+    assert b.version == 12 and b.t_seen == 300
+    assert b.group == 42 and b.group_size == 2
+    assert b.gate_flagged == 2 and b.alarms == 1
+    # NaN cells (the mask encoding) survive bit-exactly
+    np.testing.assert_array_equal(b.y, y)
+    np.testing.assert_array_equal(b.verdicts, recs[0].verdicts)
+    np.testing.assert_array_equal(b.det_counts, recs[0].det_counts)
+    b2 = decode_group(encode_group(WalGroup.of(recs[1:]))[10:])[0]
+    assert b2.model_id == "other" and b2.y.shape == (1, 2)
+    np.testing.assert_array_equal(b2.y, recs[1].y)
+
+
+def test_wal_group_roundtrip_minimal():
+    grp = WalGroup.of([WalRecord("m", 1, 10, np.zeros((1, 4)))])
+    back = decode_group(encode_group(grp)[10:])[0]
+    assert back.verdicts is None and back.det_counts is None
+    assert back.group == 0 and back.group_size == 1
+
+
+def _mk_records(n, k=1, width=3, group=1, group_size=None):
+    return [
+        WalRecord(
+            f"m{i}", version=1, t_seen=10 + k,
+            y=np.full((k, width), float(i)),
+            group=group, group_size=group_size or n,
+        )
+        for i in range(n)
+    ]
+
+
+# ----------------------------------------------------------------------
+# segments: append, scan, torn-record termination
+# ----------------------------------------------------------------------
+def test_wal_scan_roundtrip_and_rotate(tmp_path):
+    wal = WriteAheadLog(tmp_path, fsync=False)
+    wal.commit([WalGroup.of(_mk_records(3))])
+    seq2 = wal.rotate()
+    wal.commit([WalGroup.of(_mk_records(2, group=2, group_size=2))])
+    wal.close()
+    assert seq2 == 2
+    segs = list_segments(tmp_path)
+    assert [s for s, _ in segs] == [1, 2]
+    recs1, torn1, _ = scan_segment(segs[0][1])
+    recs2, torn2, _ = scan_segment(segs[1][1])
+    assert not torn1 and not torn2
+    assert [r.model_id for r in recs1] == ["m0", "m1", "m2"]
+    assert [r.model_id for r in recs2] == ["m0", "m1"]
+
+
+def test_wal_torn_record_terminates_scan(tmp_path):
+    """Nothing at or past a torn frame is ever returned — even when
+    VALID record bytes follow the tear."""
+    wal = WriteAheadLog(tmp_path, fsync=False)
+    # two FRAMES (separate groups), so the tear can sit between them
+    wal.commit([
+        WalGroup.of(_mk_records(1)),
+        WalGroup.of(_mk_records(1, group=2, group_size=1)),
+    ])
+    path = wal.path
+    wal.close()
+    data = path.read_bytes()
+    good, _, _ = scan_segment(path)
+    assert len(good) == 2
+    # truncate inside the second frame's payload: torn tail
+    cut = len(data) - 5
+    path.write_bytes(data[:cut])
+    recs, torn, reason = scan_segment(path)
+    assert torn and len(recs) == 1
+    # corrupt one payload byte of the FIRST frame (CRC mismatch):
+    # the scan stops immediately — the intact second record behind it
+    # is NOT replayed (order could not be trusted past a hole)
+    corrupted = bytearray(data)
+    corrupted[30] ^= 0xFF
+    path.write_bytes(bytes(corrupted))
+    recs, torn, reason = scan_segment(path)
+    assert torn and len(recs) == 0 and "CRC" in reason
+
+
+def test_wal_group_commit_single_fsync(tmp_path, monkeypatch):
+    """One dispatch batch of G records costs ONE fdatasync."""
+    calls = []
+    real = os.fdatasync
+    monkeypatch.setattr(
+        os, "fdatasync", lambda fd: (calls.append(fd), real(fd))[1]
+    )
+    wal = WriteAheadLog(tmp_path, fsync=True)
+    calls.clear()  # segment-header sync is construction, not commit
+    wal.commit([WalGroup.of(_mk_records(16, group_size=16))])
+    assert len(calls) == 1
+    assert wal.records_total == 16
+    wal.commit([WalGroup.of(_mk_records(8, group=2, group_size=8))])
+    assert len(calls) == 2
+    wal.close()
+
+
+def test_split_groups_drops_torn_tail_group_only():
+    g1 = _mk_records(3, group=1)
+    g2 = _mk_records(3, group=2)
+    groups, dropped = _split_groups(g1 + g2)
+    assert len(groups) == 2 and dropped == 0
+    # a short group at the END is dropped (its commit never acked)
+    groups, dropped = _split_groups(g1 + g2[:2])
+    assert len(groups) == 1 and dropped == 2
+    # a short group MID-log is corruption
+    with pytest.raises(RecoveryError):
+        _split_groups(g1[:2] + g2)
+
+
+# ----------------------------------------------------------------------
+# manifests
+# ----------------------------------------------------------------------
+def test_manifest_crc_and_latest_valid_wins(tmp_path):
+    write_manifest(tmp_path, 1, {"wal_from_seq": 2, "versions": {}})
+    p2 = write_manifest(tmp_path, 2, {"wal_from_seq": 5, "versions": {}})
+    assert load_latest_manifest(tmp_path)["seq"] == 2
+    # torn/corrupt newest -> the previous valid manifest wins (the
+    # mid-rotate crash contract)
+    raw = p2.read_text()
+    p2.write_text(raw[: len(raw) // 2])
+    assert load_latest_manifest(tmp_path)["seq"] == 1
+    assert load_manifest(p2) is None
+
+
+# ----------------------------------------------------------------------
+# sidecar dump/restore round-trips (pure host state)
+# ----------------------------------------------------------------------
+def test_detector_mirror_dump_restore_roundtrip():
+    m = DetectorMirror()
+    m.commit(
+        "a", version=3, t_seen=40, n_series=2,
+        stats=np.arange(6.0).reshape(3, 2),
+        counts=np.array([1, 0, 2]),
+        state=np.arange(12.0).reshape(6, 2),
+        slots=("s0",),
+    )
+    m2 = DetectorMirror()
+    m2.restore(m.dump())
+    a, b = m.snapshot("a")["a"], m2.snapshot("a")["a"]
+    assert a == b
+
+
+def test_fixed_lag_tracker_dump_restore_roundtrip():
+    class _St:
+        params = np.array([5.0, 20.0])
+        loadings = np.array([[0.6]])
+        dt = 1.0
+        names = ("s0",)
+        scaler_mean = np.zeros(1)
+        scaler_std = np.ones(1)
+        t_seen = 10
+        mean = np.array([0.1, 0.2])
+        cov = np.eye(2) * 0.5
+        chol = np.linalg.cholesky(np.eye(2) * 0.5)
+
+    tr = FixedLagTracker(lag=4)
+    tr.observe("a", np.zeros((1, 1)), np.ones((1, 1), bool), 11,
+               lambda: _St())
+    tr.observe("a", np.ones((1, 1)), np.ones((1, 1), bool), 12,
+               lambda: _St())
+    tr2 = FixedLagTracker(lag=4)
+    tr2.restore(tr.dump())
+    t1 = tr._tracks["a"]
+    t2 = tr2._tracks["a"]
+    assert t1.anchor_t_seen == t2.anchor_t_seen
+    np.testing.assert_array_equal(t1.anchor_mean, t2.anchor_mean)
+    np.testing.assert_array_equal(t1.anchor_chol, t2.anchor_chol)
+    assert len(t1.rows) == len(t2.rows)
+    for (y1, m1), (y2, m2) in zip(t1.rows, t2.rows):
+        np.testing.assert_array_equal(y1, y2)
+        np.testing.assert_array_equal(m1, m2)
+
+
+# ----------------------------------------------------------------------
+# manager guards + live service wiring
+# ----------------------------------------------------------------------
+def _simple_state(mid, n=3):
+    from metran_tpu.serve import PosteriorState
+
+    rng = np.random.default_rng(3)
+    chol = np.eye(n + 1) * 0.5
+    return PosteriorState(
+        model_id=mid, version=0, t_seen=40,
+        mean=np.zeros(n + 1), cov=chol @ chol.T,
+        params=np.concatenate([
+            rng.uniform(5, 40, n), rng.uniform(10, 60, 1)
+        ]),
+        loadings=rng.uniform(0.4, 0.7, (n, 1)), dt=1.0,
+        scaler_mean=np.zeros(n), scaler_std=np.ones(n),
+        names=tuple(f"s{j}" for j in range(n)), chol=chol,
+    )
+
+
+def test_durability_requires_storage_root():
+    reg = ModelRegistry(root=None)
+    with pytest.raises(ValueError, match="storage root"):
+        MetranService(
+            reg, flush_deadline=None,
+            durability=DurabilitySpec(enabled=True),
+        )
+
+
+def test_durability_refuses_unrecovered_history(tmp_path):
+    reg = ModelRegistry(root=tmp_path)
+    reg.put(_simple_state("m0"), persist=False)
+    svc = MetranService(
+        reg, flush_deadline=None, persist_updates=False,
+        durability=DurabilitySpec(enabled=True, checkpoint_every=0),
+    )
+    svc.update("m0", np.zeros((1, 3)))
+    svc.batcher.close()  # "crash": no durability close, WAL remains
+    reg2 = ModelRegistry(root=tmp_path)
+    with pytest.raises(ValueError, match="recover"):
+        MetranService(
+            reg2, flush_deadline=None,
+            durability=DurabilitySpec(enabled=True),
+        )
+
+
+def test_wal_validate_rejects_negative_cadence():
+    with pytest.raises(ValueError, match="checkpoint_every"):
+        DurabilitySpec(enabled=True, checkpoint_every=-1).validate()
+
+
+def test_health_reports_durability_lag(tmp_path):
+    reg = ModelRegistry(root=tmp_path)
+    reg.put(_simple_state("m0"), persist=False)
+    svc = MetranService(
+        reg, flush_deadline=None, persist_updates=False,
+        durability=DurabilitySpec(enabled=True, checkpoint_every=0),
+    )
+    try:
+        svc.update("m0", np.array([[0.1, -0.2, 0.3]]))
+        dur = svc.health()["durability"]
+        assert dur["mode"] == "wal"
+        assert dur["records_logged"] == 1
+        assert dur["unsynced_commits"] == 0
+        assert dur["durability_lag_s"] >= 0.0
+        assert dur["commits_since_checkpoint"] == 1
+        # the capacity report carries the same section
+        assert svc.capacity_report()["durability"]["mode"] == "wal"
+    finally:
+        svc.close()
+
+
+def test_health_spill_mode_lag_without_wal(tmp_path):
+    reg = ModelRegistry(root=tmp_path, arena=True, arena_rows=4)
+    reg.put(_simple_state("m0"), persist=False)
+    svc = MetranService(reg, flush_deadline=None, persist_updates=True)
+    try:
+        svc.update("m0", np.array([[0.1, -0.2, 0.3]]))
+        dur = svc.health()["durability"]
+        assert dur["mode"] == "spill"
+        assert dur["last_spill_age_s"] is None  # never spilled yet
+        reg.spill(dirty_only=True)
+        age = svc.health()["durability"]["last_spill_age_s"]
+        assert age is not None and age >= 0.0
+    finally:
+        svc.close()
+
+
+def test_wal_sync_failure_degrades_not_fails(tmp_path):
+    """An update whose WAL group commit fails still acks — the lost
+    durability is booked (event + unsynced_commits), never silently
+    swallowed, and never relabels an applied update as failed."""
+    reg = ModelRegistry(root=tmp_path)
+    reg.put(_simple_state("m0"), persist=False)
+    svc = MetranService(
+        reg, flush_deadline=None, persist_updates=False,
+        durability=DurabilitySpec(enabled=True, checkpoint_every=0),
+    )
+    try:
+        def boom(records):
+            raise OSError("disk gone")
+
+        svc._durability.log_commits = boom
+        st = svc.update("m0", np.array([[0.1, -0.2, 0.3]]))
+        assert st.version == 1  # applied and acked
+        assert svc._durability.unsynced_commits == 1
+        assert svc.metrics.wal_total.snapshot().get(
+            "sync_failures"
+        ) == 1
+        assert any(
+            e["kind"] == "wal_sync_failure"
+            for e in svc.events.tail(10)
+        )
+    finally:
+        svc.close()
+
+
+def test_spill_failure_on_close_is_surfaced(tmp_path, monkeypatch):
+    reg = ModelRegistry(root=tmp_path, arena=True, arena_rows=4)
+    reg.put(_simple_state("m0"), persist=False)
+    svc = MetranService(reg, flush_deadline=None, persist_updates=True)
+    svc.update("m0", np.array([[0.1, -0.2, 0.3]]))
+    monkeypatch.setattr(
+        reg, "spill",
+        lambda *a, **k: (_ for _ in ()).throw(OSError("disk full")),
+    )
+    events = svc.events
+    svc.close()
+    assert svc.metrics.errors.snapshot().get("spill_failures") == 1
+    assert any(e["kind"] == "spill_failure" for e in events.tail(10))
+
+
+def test_checkpoint_truncates_wal_and_replays_nothing(tmp_path):
+    reg = ModelRegistry(root=tmp_path)
+    reg.put(_simple_state("m0"), persist=False)
+    svc = MetranService(
+        reg, flush_deadline=None, persist_updates=False,
+        durability=DurabilitySpec(enabled=True, checkpoint_every=0),
+    )
+    obs = np.random.default_rng(0).normal(size=(4, 1, 3)) * 0.1
+    for t in range(4):
+        svc.update("m0", obs[t])
+    ck = svc.checkpoint()
+    assert ck["spilled"] >= 1
+    wal_dir = svc._durability.dir
+    svc.batcher.close()  # crash after a clean checkpoint
+    live_segments = [
+        s for s, _ in list_segments(wal_dir)
+        if s >= ck["wal_from_seq"]
+    ]
+    assert live_segments  # only the post-checkpoint segment remains
+    rec = MetranService.recover(
+        tmp_path, flush_deadline=None, persist_updates=False
+    )
+    try:
+        assert rec.last_recovery["replayed"] == 0
+        assert rec.registry.get("m0").version == 4
+    finally:
+        rec.close()
+
+
+def test_recover_fresh_directory_is_clean_attach(tmp_path):
+    (tmp_path / "wal").mkdir()
+    reg = ModelRegistry(root=tmp_path)
+    reg.put(_simple_state("m0"), persist=False)
+    reg.get("m0").save(reg.path_for("m0"))
+    svc = MetranService.recover(
+        tmp_path, flush_deadline=None, persist_updates=False
+    )
+    try:
+        assert svc.last_recovery["replayed"] == 0
+        st = svc.update("m0", np.array([[0.1, -0.2, 0.3]]))
+        assert st.version == 1
+    finally:
+        svc.close()
+
+
+# ----------------------------------------------------------------------
+# chaos cells (two representative ones in tier-1; full matrix = slow)
+# ----------------------------------------------------------------------
+def _assert_cell(out):
+    assert out["no_acked_loss"], out["acked_lost"]
+    assert out["bit_identical"], out["max_posterior_diff"]
+    if out["detector_identical"] is not None:
+        assert out["detector_identical"]
+    if out["smoothed_identical"] is not None:
+        assert out["smoothed_identical"]
+
+
+@pytest.mark.faults
+def test_crash_recovery_arena_full_torn_record():
+    """The richest cell: arena + readpath + detect + fixed-lag, killed
+    MID-WAL-RECORD — the torn record is never replayed, every acked
+    update survives, and posterior/detector/smoother state is
+    bit-identical to a crash-free run."""
+    out = run_crash_recovery_scenario(
+        mode="arena_full", kill_point="durability.wal.mid_record",
+        n_models=4, n_series=3, t_hist=30, n_ticks=6, pre_ticks=3,
+        fixed_lag=3,
+    )
+    assert out["crashed"]
+    assert out["report"]["torn_tail"] or (
+        out["report"]["dropped_unacked"] > 0
+    )
+    _assert_cell(out)
+
+
+@pytest.mark.faults
+def test_crash_recovery_dict_post_ack():
+    """Dict mode, killed after the previous dispatch's acks and before
+    the next WAL byte: everything acked is durable."""
+    out = run_crash_recovery_scenario(
+        mode="dict", kill_point="durability.wal.pre_commit",
+        n_models=3, n_series=3, t_hist=30, n_ticks=5, pre_ticks=2,
+    )
+    assert out["crashed"]
+    _assert_cell(out)
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+@pytest.mark.parametrize("mode", ["dict", "arena", "arena_full"])
+@pytest.mark.parametrize("kill_point", list(CRASH_POINTS) + [None])
+def test_crash_recovery_matrix(mode, kill_point):
+    """The full chaos matrix: every named kill point x every serving
+    mode (plus the plain kill -9 row, kill_point=None) must recover
+    100% of acked updates bit-identically at f64."""
+    ckpt = (
+        24 if kill_point in (
+            "durability.spill.model", "durability.manifest.rotate"
+        ) else 0
+    )
+    out = run_crash_recovery_scenario(
+        mode=mode, kill_point=kill_point,
+        kill_match=("cm1" if kill_point == "durability.spill.model"
+                    else None),
+        n_models=4, n_series=3, t_hist=30, n_ticks=10, pre_ticks=4,
+        checkpoint_every=ckpt,
+        fixed_lag=3 if mode == "arena_full" else 0,
+    )
+    if kill_point is not None and ckpt == 0:
+        assert out["crashed"]
+    _assert_cell(out)
+
+
+# ----------------------------------------------------------------------
+# the bit-identity precondition: lane independence
+# ----------------------------------------------------------------------
+def test_replay_batch_lane_independence():
+    """The WAL's commit-group replay contract rests on this: with the
+    SAME batch width, a lane's result does not depend on the other
+    lanes' data (replay reproduces widths, not necessarily row
+    order/companions)."""
+    rng = np.random.default_rng(1)
+    obs = rng.normal(size=(3, 1, 3)) * 0.2
+
+    def run(jitters):
+        reg = ModelRegistry(root=None, engine="sqrt")
+        for i, j in enumerate(jitters):
+            st = _simple_state(f"m{i}")
+            reg.put(
+                st._replace(mean=st.mean + j), persist=False
+            )
+        svc = MetranService(
+            reg, flush_deadline=None, persist_updates=False
+        )
+        futs = [
+            svc.update_async(f"m{i}", obs[i]) for i in range(3)
+        ]
+        svc.flush()
+        [f.result() for f in futs]
+        out = np.asarray(reg.get("m0").mean)
+        svc.close()
+        return out
+
+    a = run([0.0, 0.0, 0.0])
+    b = run([0.0, 0.7, -1.3])  # same width, different companions
+    np.testing.assert_array_equal(a, b)
+
+
+def test_recover_after_external_hot_swap_mid_wal(tmp_path):
+    """A refit hot-swap / operator restore advances one model OUTSIDE
+    the WAL (registry.put persists the refreshed posterior directly).
+    Recovery must not refuse the now-mixed commit groups: the swapped
+    model's pre-swap records skip (the persisted posterior already
+    embodies them), the rest replay, and nothing acked is lost."""
+    reg = ModelRegistry(root=tmp_path)
+    for i in range(3):
+        reg.put(_simple_state(f"m{i}"), persist=False)
+    svc = MetranService(
+        reg, flush_deadline=None, persist_updates=False,
+        durability=DurabilitySpec(enabled=True, checkpoint_every=0),
+    )
+    rng = np.random.default_rng(7)
+    obs = rng.normal(size=(6, 3, 1, 3)) * 0.1
+    ids = [f"m{i}" for i in range(3)]
+
+    def tick(t):
+        futs = [svc.update_async(ids[i], obs[t, i]) for i in range(3)]
+        svc.flush()
+        return [f.result() for f in futs]
+
+    for t in range(3):
+        tick(t)
+    # the "promotion": replace m1's posterior at version+1, PERSISTED
+    # (exactly what the refit worker's hot-swap does)
+    st = reg.get("m1")
+    swapped = st._replace(
+        version=st.version + 1, mean=st.mean * 0.5
+    )
+    reg.put(swapped, persist=True)
+    for t in range(3, 6):
+        tick(t)
+    expect = {mid: reg.get(mid) for mid in ids}
+    svc.batcher.close()  # crash
+    rec = MetranService.recover(
+        tmp_path, flush_deadline=None, persist_updates=False
+    )
+    try:
+        assert rec.last_recovery["skipped"] >= 3  # m1's pre-swap tail
+        for mid in ids:
+            got = rec.registry.get(mid)
+            assert got.version == expect[mid].version
+            assert got.t_seen == expect[mid].t_seen
+            np.testing.assert_allclose(
+                got.mean, expect[mid].mean, rtol=0, atol=1e-12
+            )
+    finally:
+        rec.close()
+
+
+def test_recover_without_checkpoint_seals_torn_tail(tmp_path):
+    """recover(checkpoint_after=False) re-arms the WAL with NEW
+    segments after a crash's torn one — the torn tail must be sealed
+    (truncated to its intact prefix) first, or a SECOND crash would
+    read it as a hole before acked records and refuse recovery
+    forever."""
+    from metran_tpu.reliability import faultinject
+    from metran_tpu.reliability.faultinject import SimulatedCrash
+
+    reg = ModelRegistry(root=tmp_path)
+    for i in range(2):
+        reg.put(_simple_state(f"m{i}"), persist=False)
+    svc = MetranService(
+        reg, flush_deadline=None, persist_updates=False,
+        durability=DurabilitySpec(enabled=True, checkpoint_every=0),
+    )
+    ids = ["m0", "m1"]
+    rng = np.random.default_rng(11)
+    obs = rng.normal(size=(8, 2, 1, 3)) * 0.1
+    for t in range(3):
+        svc.update_batch(ids, obs[t])
+    with faultinject.active() as inj:
+        inj.add(
+            "durability.wal.mid_record", error=SimulatedCrash, times=1
+        )
+        try:
+            svc.update_batch(ids, obs[3])
+        except SimulatedCrash:
+            pass
+    svc.batcher.close()  # first crash: torn tail on disk
+    rec = MetranService.recover(
+        tmp_path, flush_deadline=None, persist_updates=False,
+        checkpoint_after=False,
+    )
+    assert rec.last_recovery["torn_tail"]
+    assert rec.registry.get("m0").version == 3
+    for t in range(4, 6):
+        rec.update_batch(ids, obs[t])  # new segments past the old tear
+    rec.batcher.close()  # second crash
+    rec2 = MetranService.recover(
+        tmp_path, flush_deadline=None, persist_updates=False
+    )
+    try:  # the old tear must not read as a hole
+        assert rec2.registry.get("m0").version == 5
+        assert rec2.registry.get("m1").version == 5
+    finally:
+        rec2.close()
+
+
+def test_checkpoint_concurrent_with_dispatch_no_deadlock(tmp_path):
+    """checkpoint() (manager lock -> update lock) racing live
+    dispatches (update lock -> stats lock) must never deadlock — the
+    per-commit write path takes only the leaf-level stats lock."""
+    reg = ModelRegistry(root=tmp_path)
+    for i in range(2):
+        reg.put(_simple_state(f"m{i}"), persist=False)
+    svc = MetranService(
+        reg, flush_deadline=None, persist_updates=False,
+        durability=DurabilitySpec(enabled=True, checkpoint_every=0),
+    )
+    ids = ["m0", "m1"]
+    rng = np.random.default_rng(13)
+    obs = rng.normal(size=(40, 2, 1, 3)) * 0.1
+    svc.update_batch(ids, obs[0])  # compile outside the race
+    stop = threading.Event()
+    errors: list = []
+
+    def writer():
+        t = 1
+        while not stop.is_set() and t < 40:
+            try:
+                svc.update_batch(ids, obs[t])
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+                break
+            t += 1
+
+    w = threading.Thread(target=writer)
+    w.start()
+    try:
+        for _ in range(5):
+            svc.checkpoint()
+    finally:
+        stop.set()
+        w.join(timeout=30)
+    assert not w.is_alive(), "writer wedged: checkpoint deadlocked it"
+    assert not errors, errors
+    svc.close()

@@ -144,3 +144,94 @@ def test_fleet_checkpoint_rejects_dtype_mismatch(tmp_path):
     # matching template -> restores
     got = mio.load_fleet_state(path, theta, state, frozen)
     assert got is not None and got[4] == {"k": 1}
+
+
+def test_atomic_savez_fsyncs_parent_directory(tmp_path, monkeypatch):
+    """A rename alone is not durable across power loss: after the
+    temp-file replace, the PARENT DIRECTORY must be fsynced so the new
+    directory entry survives a power cut (io.fsync_dir)."""
+    import os
+
+    from metran_tpu import io as mio
+
+    synced_dirs = []
+    real_open, real_fsync = os.open, os.fsync
+
+    def spy_fsync(fd):
+        try:
+            import stat
+
+            if stat.S_ISDIR(os.fstat(fd).st_mode):
+                synced_dirs.append(fd)
+        except OSError:
+            pass
+        return real_fsync(fd)
+
+    monkeypatch.setattr(os, "fsync", spy_fsync)
+    mio.atomic_savez(tmp_path / "out.npz", a=np.arange(3))
+    assert synced_dirs, "parent directory was never fsynced"
+    with np.load(tmp_path / "out.npz") as d:
+        np.testing.assert_array_equal(d["a"], np.arange(3))
+
+
+def test_atomic_savez_closes_fds_on_failure_paths(tmp_path, monkeypatch):
+    """Every descriptor is released on failure: the temp-file handle
+    when the write itself raises (and the temp is unlinked), and the
+    directory fd when the directory fsync raises."""
+    import os
+
+    from metran_tpu import io as mio
+
+    # --- write failure: np.savez raises mid-write ---------------------
+    opened = []
+    real_open = open
+
+    def spy_open(path, *a, **k):
+        fh = real_open(path, *a, **k)
+        if str(path).endswith(".tmp.npz"):
+            opened.append(fh)
+        return fh
+
+    monkeypatch.setattr("builtins.open", spy_open)
+    monkeypatch.setattr(
+        np, "savez",
+        lambda *a, **k: (_ for _ in ()).throw(OSError("disk full")),
+    )
+    with pytest.raises(OSError, match="disk full"):
+        mio.atomic_savez(tmp_path / "fail.npz", a=np.arange(3))
+    assert opened and all(fh.closed for fh in opened)
+    assert not list(tmp_path.glob(".*.tmp.npz"))  # no litter
+    monkeypatch.undo()
+
+    # --- directory-fsync failure: the dir fd must still close ---------
+    dir_fds = []
+    real_os_open, real_close = os.open, os.close
+    closed = []
+
+    def spy_os_open(path, flags, *a, **k):
+        fd = real_os_open(path, flags, *a, **k)
+        import stat
+
+        if stat.S_ISDIR(os.fstat(fd).st_mode):
+            dir_fds.append(fd)
+        return fd
+
+    def spy_close(fd):
+        closed.append(fd)
+        return real_close(fd)
+
+    real_fsync = os.fsync
+
+    def fail_fsync(fd):
+        import stat
+
+        if stat.S_ISDIR(os.fstat(fd).st_mode):
+            raise OSError(5, "EIO")  # not in the tolerated errno set
+        return real_fsync(fd)  # the temp-file fsync stays healthy
+
+    monkeypatch.setattr(os, "open", spy_os_open)
+    monkeypatch.setattr(os, "close", spy_close)
+    monkeypatch.setattr(os, "fsync", fail_fsync)
+    with pytest.raises(OSError):
+        mio.atomic_savez(tmp_path / "fail2.npz", a=np.arange(3))
+    assert dir_fds and all(fd in closed for fd in dir_fds)

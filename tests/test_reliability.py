@@ -1262,3 +1262,131 @@ def test_refit_chaos_faults_never_touch_serving(rng, tmp_path):
     assert recovered.version == v0
     np.testing.assert_array_equal(recovered.params, before.params)
     np.testing.assert_array_equal(recovered.mean, before.mean)
+
+
+# ----------------------------------------------------------------------
+# FaultInjector claim/fire semantics under concurrent dispatch threads
+# ----------------------------------------------------------------------
+@pytest.mark.faults
+def test_injector_times_budget_claims_once_under_contention():
+    """A times-bounded rule must fire EXACTLY its budget across N
+    racing threads — _claim serializes the budget under the injector
+    lock, so concurrent dispatches can neither over-fire it nor lose
+    claims."""
+    from metran_tpu.reliability import faultinject
+
+    n_threads, per_thread, budget = 8, 200, 17
+    inj = faultinject.FaultInjector()
+    rule = inj.add("race.point", error=RuntimeError, times=budget)
+    raised = []
+    barrier = threading.Barrier(n_threads)
+
+    def worker():
+        barrier.wait()
+        mine = 0
+        for _ in range(per_thread):
+            try:
+                inj.fire("race.point")
+            except RuntimeError:
+                mine += 1
+        raised.append(mine)
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sum(raised) == budget
+    assert rule.fired == budget
+    assert inj.fired["race.point"] == budget
+    # the budget is exhausted: later fires are clean no-ops
+    inj.fire("race.point")
+    assert rule.fired == budget
+
+
+@pytest.mark.faults
+def test_injector_seeded_probability_deterministic_under_contention():
+    """A seeded probabilistic rule's TOTAL fire count over N matches
+    is a pure function of (seed, N) even when the matches race: the
+    draws are serialized under the lock, so the threads consume one
+    deterministic draw sequence (which thread gets which draw varies;
+    how many fire does not)."""
+    from metran_tpu.reliability import faultinject
+
+    n_threads, per_thread = 6, 150
+
+    def run() -> int:
+        inj = faultinject.FaultInjector()
+        rule = inj.add(
+            "race.prob", error=RuntimeError,
+            probability=0.31, seed=1234,
+        )
+        barrier = threading.Barrier(n_threads)
+
+        def worker():
+            barrier.wait()
+            for _ in range(per_thread):
+                try:
+                    inj.fire("race.prob")
+                except RuntimeError:
+                    pass
+
+        threads = [
+            threading.Thread(target=worker) for _ in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return rule.fired
+
+    first, second = run(), run()
+    assert first == second
+    total = n_threads * per_thread
+    # sanity: the rate is in the right ballpark, not 0 or everything
+    assert 0.2 * total < first < 0.45 * total
+
+
+@pytest.mark.faults
+def test_injector_corrupt_and_error_rules_stay_partitioned_under_race():
+    """A corruption rule and an error rule armed at ONE point must
+    each be claimed only by their own hook even under concurrent
+    fire()/corrupt() callers (the corrupting flag filters inside the
+    same locked _claim pass)."""
+    from metran_tpu.reliability import faultinject
+
+    inj = faultinject.FaultInjector()
+    err_rule = inj.add("race.mixed", error=RuntimeError, times=50)
+    cor_rule = inj.add(
+        "race.mixed", corrupt=lambda a: a + 1.0, times=50
+    )
+    errors, corruptions = [], []
+    barrier = threading.Barrier(4)
+
+    def fire_worker():
+        barrier.wait()
+        for _ in range(100):
+            try:
+                inj.fire("race.mixed")
+            except RuntimeError:
+                errors.append(1)
+
+    def corrupt_worker():
+        barrier.wait()
+        for _ in range(100):
+            out = inj.corrupt("race.mixed", np.zeros(2))
+            if out[0] == 1.0:
+                corruptions.append(1)
+
+    threads = [
+        threading.Thread(target=fire_worker),
+        threading.Thread(target=fire_worker),
+        threading.Thread(target=corrupt_worker),
+        threading.Thread(target=corrupt_worker),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert err_rule.fired == 50 and len(errors) == 50
+    assert cor_rule.fired == 50 and len(corruptions) == 50

@@ -58,6 +58,9 @@ HEADLINES: Dict[str, int] = {
     "capacity_overhead_pct": -1,
     "capacity_cached_overhead_pct": -1,
     "capacity_coverage": +1,
+    "durability_overhead_pct": -1,        # WAL-armed bulk update cost
+    "durability_recovery_ms_per_1k": -1,  # recovery ms / 1k replayed
+    "durability_replay_commits_per_s": +1,
 }
 
 #: tail-fallback regexes for rounds with ``"parsed": null``: the raw

@@ -51,6 +51,7 @@ MODULES = [
     "metran_tpu.serve.engine",
     "metran_tpu.serve.registry",
     "metran_tpu.serve.batching",
+    "metran_tpu.serve.durability",
     "metran_tpu.serve.monitoring",
     "metran_tpu.serve.readpath",
     "metran_tpu.serve.refit",
