@@ -193,6 +193,26 @@ SERVE_GATE_POLICY = "off"  # "reject" | "huber" | "inflate" | "off"
 SERVE_GATE_NSIGMA = 4.0  # gate at z^2 > nsigma^2 (chi-square(1) null)
 SERVE_GATE_MIN_SEEN = 32  # disarm models with t_seen below this (cold
 #                           filters' innovations are over-dispersed)
+# non-Gaussian observation robustness: implicit-MAP update engine for
+# censored / quantized / heavy-tailed sensors (docs/concepts.md
+# "Non-Gaussian observations").  Ships OFF: arming it is a
+# per-deployment sensor-model decision (rails and quanta describe the
+# physical logger), and the robust spec is mutually exclusive with an
+# enabled observation gate (the likelihood IS the outlier treatment).
+SERVE_ROBUST = 0  # 1 = arm the implicit-MAP robust update path
+SERVE_ROBUST_LIKELIHOOD = "censored"  # "censored" | "quantized" |
+#                                       "huber_t" (| "gaussian": the
+#                                       exact kernel, for pinning)
+SERVE_ROBUST_RAIL_LO = float("-inf")  # low saturation rail, data units
+SERVE_ROBUST_RAIL_HI = float("inf")  # high saturation rail, data units
+SERVE_ROBUST_QUANTUM = 0.0  # quantization cell width, data units
+SERVE_ROBUST_NU = 4.0  # Student-t degrees of freedom (huber_t; > 2)
+SERVE_ROBUST_SCALE = 0.05  # sensor-noise scale in STANDARDIZED units
+#                            (smooths the censored/quantized
+#                            likelihoods; the DFM's r = 0 channel is a
+#                            hard indicator without it)
+SERVE_ROBUST_MIN_SEEN = 32  # disarm models below this t_seen (cold
+#                             filters' innovations are over-dispersed)
 # steady-state (frozen-gain) serving defaults (docs/concepts.md
 # "Bounded-cost serving").  Ships OFF (tol = 0.0): freezing trades a
 # bounded, measured posterior deviation (within the freeze tolerance)
@@ -361,6 +381,35 @@ def serve_defaults() -> dict:
         ),
         "gate_min_seen": _env(
             "METRAN_TPU_SERVE_GATE_MIN_SEEN", int, SERVE_GATE_MIN_SEEN
+        ),
+        "robust": _env(
+            "METRAN_TPU_SERVE_ROBUST", int, SERVE_ROBUST
+        ),
+        "robust_likelihood": _env(
+            "METRAN_TPU_SERVE_ROBUST_LIKELIHOOD", str,
+            SERVE_ROBUST_LIKELIHOOD,
+        ),
+        "robust_rail_lo": _env(
+            "METRAN_TPU_SERVE_ROBUST_RAIL_LO", float,
+            SERVE_ROBUST_RAIL_LO,
+        ),
+        "robust_rail_hi": _env(
+            "METRAN_TPU_SERVE_ROBUST_RAIL_HI", float,
+            SERVE_ROBUST_RAIL_HI,
+        ),
+        "robust_quantum": _env(
+            "METRAN_TPU_SERVE_ROBUST_QUANTUM", float,
+            SERVE_ROBUST_QUANTUM,
+        ),
+        "robust_nu": _env(
+            "METRAN_TPU_SERVE_ROBUST_NU", float, SERVE_ROBUST_NU
+        ),
+        "robust_scale": _env(
+            "METRAN_TPU_SERVE_ROBUST_SCALE", float, SERVE_ROBUST_SCALE
+        ),
+        "robust_min_seen": _env(
+            "METRAN_TPU_SERVE_ROBUST_MIN_SEEN", int,
+            SERVE_ROBUST_MIN_SEEN,
         ),
         "steady_tol": _env(
             "METRAN_TPU_SERVE_STEADY_TOL", float, SERVE_STEADY_TOL

@@ -63,6 +63,9 @@ EVENT_KINDS = (
     "persist_failure",
     "observation_rejected",
     "observation_downweighted",
+    "robust_update",
+    "robust_fallback",
+    "robust_solver_nonconverged",
     "empty_update",
     "arena_load",
     "arena_spill",

@@ -244,7 +244,7 @@ class FaultInjector:
 
 
 class SensorFault:
-    """The four classic sensor pathologies as a corruption callable.
+    """The classic sensor pathologies as a corruption callable.
 
     Arm one on an injector's data hook::
 
@@ -268,21 +268,38 @@ class SensorFault:
       drifting calibration.
     - ``"unit"``: multiply by ``factor`` — a unit-conversion error
       (cm vs inch, m vs mm).
+    - ``"censor"``: clip to ``[rail_lo, rail_hi]`` — a logger
+      saturating at its rails, the **rail value recorded exactly**
+      (what a real data logger emits; the implicit-MAP censored
+      likelihood flags readings AT the rail, so exact recording is
+      the contract the robust serving path tests against).
+    - ``"quantize"``: round to the nearest multiple of ``quantum`` —
+      ADC / storage quantization onto a grid.
 
     Deterministic: no internal randomness (intermittency belongs to
     the rule's ``probability``/``seed``), and the drift counter
     advances only when the rule actually fires.  Thread-safe.
     """
 
-    MODES = ("spike", "stuck", "drift", "unit")
+    MODES = ("spike", "stuck", "drift", "unit", "censor", "quantize")
 
     def __init__(self, mode: str, series=None, magnitude: float = 8.0,
                  factor: float = 10.0, value: Optional[float] = None,
-                 row: int = 0):
+                 row: int = 0, rail_lo: float = float("-inf"),
+                 rail_hi: float = float("inf"), quantum: float = 1.0):
         if mode not in self.MODES:
             raise ValueError(
                 f"unknown sensor-fault mode {mode!r}; expected one of "
                 f"{self.MODES}"
+            )
+        if mode == "censor" and not rail_lo < rail_hi:
+            raise ValueError(
+                f"censor rails are inverted: rail_lo {rail_lo!r} must "
+                f"be < rail_hi {rail_hi!r}"
+            )
+        if mode == "quantize" and not quantum > 0.0:
+            raise ValueError(
+                f"quantize needs quantum > 0, got {quantum!r}"
             )
         self.mode = mode
         self.series = series
@@ -290,6 +307,9 @@ class SensorFault:
         self.factor = float(factor)
         self.value = value
         self.row = int(row)
+        self.rail_lo = float(rail_lo)
+        self.rail_hi = float(rail_hi)
+        self.quantum = float(quantum)
         self._rows_seen = 0  # drift state: rows corrupted so far
         self._stuck_value = None if value is None else float(value)
         self._lock = threading.Lock()
@@ -319,6 +339,14 @@ class SensorFault:
                 )
                 arr[:, cols] += ramp[:, None]
                 self._rows_seen += k
+            elif self.mode == "censor":
+                arr[:, cols] = np.clip(
+                    arr[:, cols], self.rail_lo, self.rail_hi
+                )
+            elif self.mode == "quantize":
+                arr[:, cols] = self.quantum * np.round(
+                    arr[:, cols] / self.quantum
+                )
             else:  # "unit"
                 arr[:, cols] *= self.factor
         return arr

@@ -62,6 +62,7 @@ from .durability import (
 from .engine import (
     DetectSpec,
     GateSpec,
+    RobustSpec,
     SteadySpec,
     forecast_bucket,
     make_arena_forecast_fn,
@@ -125,6 +126,7 @@ __all__ = [
     "RecoveryError",
     "RefitSpec",
     "RefitWorker",
+    "RobustSpec",
     "ServeMetrics",
     "WalRecord",
     "WriteAheadLog",

@@ -1019,6 +1019,21 @@ class DurabilityManager:
                 "stage": stage_name,
                 "engine": registry.engine,
                 "arena": bool(registry.arena_enabled),
+                # the robust spec's statics (operator record: recovery
+                # must be constructed with the SAME spec so the replay
+                # selects bit-identical implicit-MAP executables — the
+                # spec rides the update-kernel compile keys).  Infinite
+                # rails serialize as strings: bare Infinity tokens are
+                # not valid JSON and break strict parsers (jq)
+                "robust": (
+                    [
+                        str(v)
+                        if isinstance(v, float) and not np.isfinite(v)
+                        else v
+                        for v in svc.robust
+                    ]
+                    if svc.robust.enabled else None
+                ),
                 "spilled": int(spilled),
                 "created_at": time.time(),
             })

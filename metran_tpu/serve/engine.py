@@ -28,11 +28,14 @@ import numpy as np
 
 from ..ops import (
     GATE_POLICIES,
+    ROBUST_LIKELIHOODS,
     filter_append,
     forecast_horizons,
     forecast_observation_moments,
     gated_filter_append,
     gated_sqrt_filter_append,
+    implicit_map_filter_append,
+    implicit_map_sqrt_filter_append,
     sqrt_filter_append,
     steady_converged,
     steady_filter_append,
@@ -249,6 +252,151 @@ class DetectSpec(NamedTuple):
             lb_thresh=float(self.lb_thresh), nsigma=float(self.nsigma),
         )
 
+
+class RobustSpec(NamedTuple):
+    """Non-Gaussian observation policy for the serving update path
+    (docs/concepts.md "Non-Gaussian observations").
+
+    Armed (``likelihood != "off"``), each update's observed slots are
+    conditioned through the **implicit-MAP** kernels
+    (:mod:`metran_tpu.ops.implicit_map`): flagged slots solve the
+    per-step MAP problem under the configured likelihood and commit
+    its Laplace summary, while clean Gaussian slots fall back
+    **bit-identically** to the closed-form kernels (the PR 5
+    ``policy="off"`` contract, pinned at f32 + f64).
+
+    - ``likelihood="censored"``: readings at/beyond ``rail_lo``/
+      ``rail_hi`` (data units — standardized per model at dispatch)
+      contribute the one-sided Tobit tail mass; un-railed readings
+      stay exact Gaussian.
+    - ``likelihood="quantized"``: every reading contributes the
+      interval likelihood over its ``quantum``-wide cell (data
+      units).
+    - ``likelihood="huber_t"``: every reading is scored under the
+      heavy-tailed Student-t(``nu``) loss — bounded outlier
+      influence without the gate's hard reject.
+
+    ``scale`` is the sensor-noise scale in **standardized** units
+    (fraction of the series' fitted std) that smooths the censored /
+    quantized likelihoods and scales the Student-t residuals — the
+    DFM's exact ``r = 0`` observation channel would otherwise make
+    them hard indicators.  ``min_seen`` disarms the robust path for
+    cold models exactly like the gate's floor (traced per model —
+    never a recompile); the likelihood statics join the kernel
+    compile keys.  Mutually exclusive with an enabled
+    :class:`GateSpec`: the robust likelihood IS the outlier
+    treatment (``huber_t`` subsumes the gate's ``huber`` policy), and
+    one slot cannot serve two masters.  Any armed robust slot is a
+    time-invariance break — frozen steady-state rows thaw, same
+    contract as the gate.
+
+    Defaults from :func:`metran_tpu.config.serve_defaults`
+    (``METRAN_TPU_SERVE_ROBUST{,_LIKELIHOOD,_RAIL_LO,_RAIL_HI,
+    _QUANTUM,_NU,_SCALE,_MIN_SEEN}``); shipped off.
+    """
+
+    likelihood: str = "off"
+    rail_lo: float = float("-inf")
+    rail_hi: float = float("inf")
+    quantum: float = 0.0
+    nu: float = 4.0
+    scale: float = 0.05
+    min_seen: int = 32
+
+    @property
+    def enabled(self) -> bool:
+        return self.likelihood != "off"
+
+    @property
+    def time_varying(self) -> bool:
+        """Whether an armed model breaks time-invariance (the steady
+        freeze/thaw trigger): every real likelihood can flag a slot
+        and change the gain, but ``"gaussian"`` — the pinning
+        configuration — can never flag, so it must not cost the
+        steady-state serving speedup."""
+        return self.enabled and self.likelihood != "gaussian"
+
+    @property
+    def flags_selectively(self) -> bool:
+        """Whether flagged slots are the EXCEPTION (censored: railed
+        readings only).  Always-flagging likelihoods
+        (quantized/huber_t) book counters but skip the per-update
+        ``robust_update`` event — one event per model per commit
+        carries no information and floods the log on the hot path."""
+        return self.likelihood == "censored"
+
+    @classmethod
+    def from_defaults(cls) -> "RobustSpec":
+        from ..config import serve_defaults
+
+        d = serve_defaults()
+        return cls(
+            likelihood=str(d["robust_likelihood"])
+            if d["robust"] else "off",
+            rail_lo=float(d["robust_rail_lo"]),
+            rail_hi=float(d["robust_rail_hi"]),
+            quantum=float(d["robust_quantum"]),
+            nu=float(d["robust_nu"]),
+            scale=float(d["robust_scale"]),
+            min_seen=int(d["robust_min_seen"]),
+        ).validate()
+
+    def validate(self) -> "RobustSpec":
+        """Reject inert or broken combinations — an armed robust path
+        that could never flag a slot (or that would blow up the inner
+        solve) is paid for and silently useless."""
+        if not self.enabled:
+            return self
+        if self.likelihood not in ROBUST_LIKELIHOODS:
+            raise ValueError(
+                f"unknown robust likelihood {self.likelihood!r}; "
+                f"expected one of {('off',) + ROBUST_LIKELIHOODS}"
+            )
+        if self.min_seen < 0:
+            raise ValueError(
+                f"robust min_seen must be >= 0, got {self.min_seen}"
+            )
+        if not self.scale > 0.0:
+            raise ValueError(
+                "robust scale must be > 0 (it smooths the censored/"
+                f"quantized likelihoods), got {self.scale!r}"
+            )
+        if self.likelihood == "censored":
+            if not self.rail_lo < self.rail_hi:
+                raise ValueError(
+                    "censored rails are inverted: rail_lo "
+                    f"{self.rail_lo!r} must be < rail_hi "
+                    f"{self.rail_hi!r}"
+                )
+            if not (
+                np.isfinite(self.rail_lo) or np.isfinite(self.rail_hi)
+            ):
+                raise ValueError(
+                    "censored likelihood needs at least one finite "
+                    "rail; both are infinite — no reading could ever "
+                    "flag"
+                )
+        if self.likelihood == "quantized" and not self.quantum > 0.0:
+            raise ValueError(
+                "quantized likelihood needs quantum > 0 (the cell "
+                f"width), got {self.quantum!r}"
+            )
+        if self.likelihood == "huber_t" and not self.nu > 2.0:
+            raise ValueError(
+                "huber_t needs nu > 2 (finite observation variance), "
+                f"got {self.nu!r}"
+            )
+        return self
+
+    def compile_key(self) -> tuple:
+        """The spec's compile-key suffix — every field that selects the
+        kernel's behavior rides the key (the WAL replay contract:
+        recovery selects bit-identical executables from it)."""
+        return (
+            "rob", self.likelihood, float(self.rail_lo),
+            float(self.rail_hi), float(self.quantum), float(self.nu),
+            float(self.scale),
+        )
 
 class BucketBatch(NamedTuple):
     """A shape bucket's models stacked for one device dispatch.
@@ -470,6 +618,58 @@ def _annotated(fn, name: str):
     return annotated
 
 
+def _make_robust_core(sqrt_engine: bool, robust: "RobustSpec"):
+    """The shared robust-update body of the dict and arena kernel
+    factories: ``core(ss, mean, fac, y, mask, armed, rail_lo, rail_hi,
+    quantum, scale) -> (mean', fac', sigma, detf, zscore, verdict,
+    iters)``, batch-leading.
+
+    The inner solve's capped while loop exits the moment every lane
+    converges, so a dispatch where nothing flags pays one
+    gradient/curvature evaluation per slot over the plain kernel
+    (measured ~1.16x kernel wall at fleet batch shape — the <10%
+    armed-overhead bar end to end; a batch-level ``lax.cond`` fallback
+    was measured SLOWER than just running the adaptive kernel, the
+    XLA conditional boundary costing more than the epilogue it
+    saved).  ``likelihood="gaussian"`` — the pinning configuration —
+    is the one static fallback: the z-score-emitting gated kernel
+    with the gate permanently disarmed (bit-identical posteriors,
+    real z-scores, zero verdicts/iters).
+    """
+    lik, nu = robust.likelihood, float(robust.nu)
+    kernel = (
+        implicit_map_sqrt_filter_append if sqrt_engine
+        else implicit_map_filter_append
+    )
+    gated_kernel = (
+        gated_sqrt_filter_append if sqrt_engine else gated_filter_append
+    )
+
+    if lik == "gaussian":
+
+        def fallback_core(ss, mean, fac, y, mask, armed, rl, rh, q,
+                          sc):
+            out = jax.vmap(
+                lambda s, m, c, yy, kk: gated_kernel(
+                    s, m, c, yy, kk, armed=False, policy="reject",
+                    nsigma=4.0,
+                )
+            )(ss, mean, fac, y, mask)
+            return out + (jnp.zeros(y.shape, jnp.int32),)
+
+        return fallback_core
+
+    def core(ss, mean, fac, y, mask, armed, rl, rh, q, sc):
+        return jax.vmap(
+            lambda s, m, c, yy, kk, a, l, h, qq, scc: kernel(
+                s, m, c, yy, kk, armed=a, rail_lo=l, rail_hi=h,
+                quantum=qq, scale=scc, likelihood=lik, nu=nu,
+            )
+        )(ss, mean, fac, y, mask, armed, rl, rh, q, sc)
+
+    return core
+
+
 def _horizon_pass(ss, mean_t, fac_t, horizons: Tuple[int, ...],
                   sqrt_engine: bool):
     """The fused commit-time forecast pass: batched
@@ -484,7 +684,8 @@ def _horizon_pass(ss, mean_t, fac_t, horizons: Tuple[int, ...],
 
 def make_update_fn(engine: str = "joint", gate: Optional[GateSpec] = None,
                    horizons: Optional[Tuple[int, ...]] = None,
-                   detect: Optional[DetectSpec] = None):
+                   detect: Optional[DetectSpec] = None,
+                   robust: Optional[RobustSpec] = None):
     """A fresh jitted batched incremental-update kernel.
 
     ``fn(ss, mean, cov, y_new, mask_new) -> (mean_T, cov_T, sigma,
@@ -529,13 +730,35 @@ def make_update_fn(engine: str = "joint", gate: Optional[GateSpec] = None,
     registry arming detection serves through the gated kernel variant
     with the gate permanently disarmed — real z-scores, posteriors
     bit-identical to the plain kernel (the PR 5 no-trip contract).
+
+    With an **enabled** ``robust`` (:class:`RobustSpec`, mutually
+    exclusive with an enabled gate), the kernel is the implicit-MAP
+    variant (:mod:`metran_tpu.ops.implicit_map`): it takes the traced
+    ``armed`` flag plus four (B, N) per-slot parameter vectors
+    (``rail_lo, rail_hi, quantum, scale`` — standardized per model
+    from the physical spec, so heterogeneous fleets share one
+    executable) and returns ``(zscore, verdict, iters)`` after the
+    plain outputs — z-scores in the gate's positions, so detection
+    and verdict booking ride unchanged, with the inner-solver
+    iteration counts appended.  Clean Gaussian slots are bit-identical
+    to the plain kernels (the pinned fallback contract).
     """
     sqrt_engine = engine in ("sqrt", "sqrt_parallel")
     gated = gate is not None and gate.enabled
     det_on = detect is not None and detect.enabled
+    robust_on = robust is not None and robust.enabled
     if det_on:
         detect.validate()
-    if gated:
+    if robust_on:
+        robust.validate()
+        if gated:
+            raise ValueError(
+                "gate and robust are mutually exclusive on one "
+                "update kernel (the robust likelihood IS the outlier "
+                "treatment); arm one of them"
+            )
+        core = _make_robust_core(sqrt_engine, robust)
+    elif gated:
         gate.validate()
         policy, nsigma = gate.policy, float(gate.nsigma)
         if sqrt_engine:
@@ -603,8 +826,9 @@ def make_update_fn(engine: str = "joint", gate: Optional[GateSpec] = None,
             out = core(ss, mean, fac, y_new, mask_new, *gate_extra)
             # the core is a z-score-emitting variant either way; the
             # detect-only path strips zs/verdicts back off the public
-            # outputs (the service books no gate verdicts then)
-            res = out if gated else out[:4]
+            # outputs (the service books no gate verdicts then) —
+            # gated/robust cores keep them (plus the robust iters)
+            res = out if (gated or robust_on) else out[:4]
             if hz:
                 fm, fv = _horizon_pass(
                     ss, out[0], out[1], hz, sqrt_engine
@@ -821,6 +1045,7 @@ def make_arena_update_fn(
     horizons: Optional[Tuple[int, ...]] = None,
     steady_tol: float = 0.0,
     detect: Optional[DetectSpec] = None,
+    robust: Optional[RobustSpec] = None,
 ):
     """A fresh jitted **arena** assimilation kernel (in-place).
 
@@ -875,24 +1100,46 @@ def make_arena_update_fn(
     gate REJECTS carries its detector state bit-identically unchanged
     and books zero counts — observations that were never assimilated
     are never detected on either.
+
+    With an enabled ``robust`` (:class:`RobustSpec`, mutually
+    exclusive with an enabled gate) the kernel is the implicit-MAP
+    variant: per-row ``armed`` comes from the resident ``t_seen``
+    against the traced ``min_seen`` (the spec's robust floor), four
+    (G, N) traced per-slot parameter vectors follow it in the
+    signature (``rail_lo, rail_hi, quantum, scale`` — standardized
+    per row by the service from the physical spec), and
+    ``(zscore, verdict, iters)`` ride after ``ok``/``sigma``/``detf``
+    — z-scores in the gate's position, so the fused detection tail
+    consumes them unchanged.
     """
     sqrt_engine = engine in ("sqrt", "sqrt_parallel")
     gated = gate is not None and gate.enabled
     det_on = detect is not None and detect.enabled
+    robust_on = robust is not None and robust.enabled
     if det_on:
         detect.validate()
+    if robust_on:
+        robust.validate()
+        if gated:
+            raise ValueError(
+                "gate and robust are mutually exclusive on one "
+                "arena update kernel; arm one of them"
+            )
+        robust_core = _make_robust_core(sqrt_engine, robust)
     # detection needs per-slot z-scores: an ungated registry arming it
     # runs the gated kernel variant with the gate permanently disarmed
-    # (bit-identical posteriors — no slot can trip at armed=False)
-    run_gated = gated or det_on
+    # (bit-identical posteriors — no slot can trip at armed=False);
+    # a robust registry's implicit-MAP kernel emits them natively
+    run_gated = (gated or det_on) and not robust_on
     hz = tuple(int(h) for h in horizons) if horizons else ()
     if gated:
         gate.validate()
         policy, nsigma = gate.policy, float(gate.nsigma)
-    elif det_on:
+    elif det_on and not robust_on:
         policy, nsigma = "reject", 4.0
 
-    def _body(dyn, static, rows, y, mask, armed, real=None):
+    def _body(dyn, static, rows, y, mask, armed, real=None,
+              rob_args=None):
         mean_a, fac_a, t_a, v_a = dyn
         phi_a, q_a, z_a, r_a = static
         k = y.shape[1]
@@ -904,7 +1151,16 @@ def make_arena_update_fn(
         mean_g = mean_a[rows]
         fac_g = fac_a[rows]
         extra = ()
-        if run_gated:
+        if robust_on:
+            rail_lo, rail_hi, quantum, scale = rob_args
+            mean_n, fac_n, sigma, detf, zs, verdicts, iters = (
+                robust_core(
+                    ss, mean_g, fac_g, y, mask, armed, rail_lo,
+                    rail_hi, quantum, scale,
+                )
+            )
+            extra = (zs, verdicts, iters)
+        elif run_gated:
             if sqrt_engine:
                 mean_n, fac_n, sigma, detf, zs, verdicts = jax.vmap(
                     lambda s, m, c, yy, kk, a: gated_sqrt_filter_append(
@@ -963,6 +1219,43 @@ def make_arena_update_fn(
     if det_on:
         dpar = detect.kernel_params
 
+        def _det_tail(det_a, rows, mask, ok, zs, det_armed):
+            """The fused detection pass shared by the gated and robust
+            detect signatures: advance the donated detector leaf over
+            the kernel's z-scores with per-slot isolation (a rejected
+            row's state writes back unchanged, its counts zero out)."""
+            det_g = det_a[rows]
+            det_n, det_counts = jax.vmap(
+                lambda st, z, m, a: detect_append(st, z, m, a, **dpar)
+            )(det_g, zs, mask, det_armed)
+            det_w = jnp.where(ok[:, None, None], det_n, det_g)
+            det_counts = jnp.where(ok[:, None, None], det_counts, 0)
+            return det_a.at[rows].set(det_w), det_w, det_counts
+
+        if robust_on:
+
+            @functools.partial(jax.jit, donate_argnums=(0, 2))
+            def fn(dyn, static, det_a, rows, y, mask, min_seen,
+                   rail_lo, rail_hi, quantum, scale, real,
+                   det_min_seen):
+                armed = dyn[2][rows] >= min_seen
+                det_armed = dyn[2][rows] >= det_min_seen
+                out = _body(dyn, static, rows, y, mask, armed,
+                            real if steady_tol > 0.0 else None,
+                            (rail_lo, rail_hi, quantum, scale))
+                new_dyn, rest = out[0], out[1:]
+                # rest = (ok, sigma, detf, zs, verdicts, iters
+                #         [, fm, fv][, conv])
+                ok, zs = rest[0], rest[3]
+                new_det, det_w, det_counts = _det_tail(
+                    det_a, rows, mask, ok, zs, det_armed
+                )
+                return (new_dyn, new_det) + rest + (
+                    det_counts, detect_stats(det_w)
+                )
+
+            return _annotated(fn, UPDATE_ANNOTATION)
+
         @functools.partial(jax.jit, donate_argnums=(0, 2))
         def fn(dyn, static, det_a, rows, y, mask, min_seen, real,
                det_min_seen):
@@ -976,20 +1269,36 @@ def make_arena_update_fn(
             new_dyn, rest = out[0], out[1:]
             # rest = (ok, sigma, detf, zs, verdicts[, fm, fv][, conv])
             ok, zs = rest[0], rest[3]
-            det_g = det_a[rows]
-            det_n, det_counts = jax.vmap(
-                lambda st, z, m, a: detect_append(st, z, m, a, **dpar)
-            )(det_g, zs, mask, det_armed)
-            # per-slot isolation extends to the detector: a rejected
-            # row's state writes back unchanged, its counts zero out
-            det_w = jnp.where(ok[:, None, None], det_n, det_g)
-            det_counts = jnp.where(ok[:, None, None], det_counts, 0)
-            new_det = det_a.at[rows].set(det_w)
+            new_det, det_w, det_counts = _det_tail(
+                det_a, rows, mask, ok, zs, det_armed
+            )
             if not gated:
                 rest = rest[:3] + rest[5:]
             return (new_dyn, new_det) + rest + (
                 det_counts, detect_stats(det_w)
             )
+
+        return _annotated(fn, UPDATE_ANNOTATION)
+
+    if robust_on and steady_tol > 0.0:
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def fn(dyn, static, rows, y, mask, min_seen, rail_lo, rail_hi,
+               quantum, scale, real):
+            armed = dyn[2][rows] >= min_seen
+            return _body(dyn, static, rows, y, mask, armed, real,
+                         (rail_lo, rail_hi, quantum, scale))
+
+        return _annotated(fn, UPDATE_ANNOTATION)
+
+    if robust_on:
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def fn(dyn, static, rows, y, mask, min_seen, rail_lo, rail_hi,
+               quantum, scale):
+            armed = dyn[2][rows] >= min_seen
+            return _body(dyn, static, rows, y, mask, armed, None,
+                         (rail_lo, rail_hi, quantum, scale))
 
         return _annotated(fn, UPDATE_ANNOTATION)
 
@@ -1219,6 +1528,7 @@ __all__ = [
     "DetectSpec",
     "FORECAST_ANNOTATION",
     "GateSpec",
+    "RobustSpec",
     "SteadySpec",
     "UPDATE_ANNOTATION",
     "forecast_bucket",

@@ -1262,8 +1262,20 @@ class ModelRegistry:
             float(detect.nsigma),
         )
 
+    @staticmethod
+    def _robust_key(robust) -> tuple:
+        """The compile-key suffix of an enabled robust spec (its
+        static likelihood half — the traced ``min_seen``/per-slot
+        parameter vectors never recompile), or ``()``.  The WAL
+        replay contract rides on this: a recovered service with the
+        same :class:`~metran_tpu.serve.engine.RobustSpec` selects
+        bit-identical executables."""
+        if robust is None or not getattr(robust, "enabled", False):
+            return ()
+        return robust.compile_key()
+
     def update_fn(self, bucket: ShapeBucket, k: int, gate=None,
-                  horizons=None, detect=None):
+                  horizons=None, detect=None, robust=None):
         """Compiled assimilation kernel for ``k`` appended steps.
 
         ``gate`` (an enabled :class:`~metran_tpu.serve.engine.
@@ -1285,11 +1297,11 @@ class ModelRegistry:
         if horizons:
             horizons = tuple(int(h) for h in horizons)
             key = key + ("hz", horizons)
-        key = key + self._detect_key(detect)
+        key = key + self._detect_key(detect) + self._robust_key(robust)
         return self._compiled.get_or_create(
             key, lambda: make_update_fn(
                 engine=self.engine, gate=gate, horizons=horizons,
-                detect=detect,
+                detect=detect, robust=robust,
             ),
         )
 
@@ -1304,7 +1316,8 @@ class ModelRegistry:
 
     def arena_update_fn(self, bucket: ShapeBucket, k: int, gate=None,
                         validate: bool = True, horizons=None,
-                        steady_tol: float = 0.0, detect=None):
+                        steady_tol: float = 0.0, detect=None,
+                        robust=None):
         """Compiled arena assimilation kernel (donating, in-place) for
         ``k`` appended steps — same compile-key discipline as
         :meth:`update_fn` plus the ``validate`` bit (the on-device
@@ -1323,13 +1336,13 @@ class ModelRegistry:
             key = key + ("hz", horizons)
         if steady_tol > 0.0:
             key = key + ("conv", float(steady_tol))
-        key = key + self._detect_key(detect)
+        key = key + self._detect_key(detect) + self._robust_key(robust)
         return self._compiled.get_or_create(
             key,
             lambda: make_arena_update_fn(
                 engine=self.engine, gate=gate, validate=validate,
                 horizons=horizons, steady_tol=float(steady_tol),
-                detect=detect,
+                detect=detect, robust=robust,
             ),
         )
 

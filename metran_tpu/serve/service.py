@@ -64,6 +64,7 @@ import numpy as np
 
 from ..obs import Observability
 from ..obs.capacity import CapacityTracker, window_label
+from ..ops.implicit_map import ROBUST_MAP, ROBUST_NONCONV
 from ..ops.kalman import GATE_DOWNWEIGHTED, GATE_REJECTED
 from ..reliability.faultinject import (
     SimulatedCrash,
@@ -95,7 +96,7 @@ from .durability import (
     restore_sidecar,
     scan_wal,
 )
-from .engine import DetectSpec, GateSpec, SteadySpec
+from .engine import DetectSpec, GateSpec, RobustSpec, SteadySpec
 from .monitoring import AlertBoard, DetectorMirror
 from .readpath import ForecastSnapshot, SnapshotEntry, SnapshotStore, \
     parse_horizons
@@ -120,6 +121,12 @@ STEADY_REFREEZE_COOLDOWN_S = 30.0
 GATE_SCORE_BUCKETS = (
     0.1, 0.5, 1.0, 2.0, 4.0, 9.0, 16.0, 25.0, 50.0, 100.0,
 )
+
+#: robust inner-solver iteration buckets: the damped Newton solve
+#: (ops.implicit_map, budget ``NEWTON_ITERS`` = 12) typically lands in
+#: 2-6 steps from the prior mean; mass near the budget ceiling means
+#: the likelihood scale is mis-set for the feed.
+ROBUST_ITER_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 10.0, 12.0)
 
 
 def _transfer(src: Future, dst: Future) -> None:
@@ -359,6 +366,13 @@ class ServeMetrics:
     #: episodes; ``alert_raised`` / ``alert_cleared`` — alert
     #: lifecycle transitions)
     detect_total: EventCounters = field(default_factory=EventCounters)
+    #: implicit-MAP robust-update outcomes by kind (``map_updates`` —
+    #: commits with at least one MAP-conditioned slot; ``map_slots`` —
+    #: total MAP-conditioned observations; ``fallback_updates`` —
+    #: armed commits that fell back bit-identically to the exact
+    #: Gaussian kernel (nothing flagged); ``nonconverged`` — flagged
+    #: slots whose inner Newton solve missed the residual bar)
+    robust_total: EventCounters = field(default_factory=EventCounters)
     #: durability-plane events by kind (``records`` — WAL records
     #: group-committed before their acks; ``sync_failures`` — failed
     #: group commits (the covered commits ride
@@ -369,6 +383,9 @@ class ServeMetrics:
     #: gate-score histogram (squared normalized innovation per observed
     #: slot); only present on registry-backed instances
     gate_scores: Optional[object] = None
+    #: robust inner-solver iteration histogram (Newton steps per
+    #: MAP-conditioned slot); only present on registry-backed instances
+    robust_iters: Optional[object] = None
 
     @classmethod
     def registered(cls, registry) -> "ServeMetrics":
@@ -429,11 +446,24 @@ class ServeMetrics:
                 help="durability-plane events by kind (records, "
                      "sync_failures, torn_records, replayed)",
             ),
+            robust_total=EventCounters(
+                registry=registry,
+                name="metran_serve_robust_total",
+                help="implicit-MAP robust-update outcomes by kind "
+                     "(map_updates, map_slots, fallback_updates, "
+                     "nonconverged)",
+            ),
             gate_scores=registry.histogram(
                 "metran_serve_gate_score",
                 "squared normalized innovation per observed slot at "
                 "update time (chi-square(1) under the model)",
                 buckets=GATE_SCORE_BUCKETS,
+            ),
+            robust_iters=registry.histogram(
+                "metran_serve_robust_solver_iterations",
+                "damped-Newton steps per MAP-conditioned slot "
+                "(implicit-MAP robust update inner solve)",
+                buckets=ROBUST_ITER_BUCKETS,
             ),
         )
 
@@ -476,6 +506,26 @@ class MetranService:
         flags dying sensors as degraded.  Models with
         ``t_seen < gate.min_seen`` are disarmed (cold filters reject
         real data).
+    robust : non-Gaussian observation policy
+        (:class:`~metran_tpu.serve.engine.RobustSpec`; default from
+        ``serve_defaults()`` — ``METRAN_TPU_SERVE_ROBUST*``, shipped
+        off).  Enabled, updates run through the **implicit-MAP**
+        kernels (:mod:`metran_tpu.ops.implicit_map`): censored
+        (railed) readings contribute their one-sided Tobit tail mass,
+        quantized readings their cell's interval likelihood, and
+        heavy-tailed feeds the Student-t robust loss — each flagged
+        slot solved by a fixed-iteration Newton inner solve and
+        committed as its Laplace summary, while clean Gaussian slots
+        fall back **bit-identically** to the closed-form kernels.
+        Per-slot z-scores/verdicts are booked exactly like gate
+        verdicts (``metran_serve_robust_total`` counters, the
+        gate-score histogram, ``robust_update`` /
+        ``robust_solver_nonconverged`` events), streaming detection
+        consumes the MAP z-scores in the same launch, and any armed
+        robust model is excluded from steady-state freezing (frozen
+        rows thaw — the gate's time-invariance contract).  Mutually
+        exclusive with an enabled ``gate``.  See docs/concepts.md
+        "Non-Gaussian observations".
     observability : metrics/tracing/event bundle
         (:class:`~metran_tpu.obs.Observability`); default from
         :meth:`~metran_tpu.obs.Observability.default` (metrics + event
@@ -564,6 +614,7 @@ class MetranService:
         reliability: Optional[ReliabilityPolicy] = None,
         observability: Optional[Observability] = None,
         gate: Optional[GateSpec] = None,
+        robust: Optional[RobustSpec] = None,
         readpath: "bool | str" = "default",
         horizons=None,
         steady: Optional[SteadySpec] = None,
@@ -648,6 +699,23 @@ class MetranService:
             gate.validate() if gate is not None
             else GateSpec.from_defaults()
         )
+        # non-Gaussian observation robustness (ops.implicit_map wired
+        # through the update kernels; docs/concepts.md "Non-Gaussian
+        # observations").  Armed, flagged slots take the implicit-MAP
+        # path while clean Gaussian slots fall back bit-identically to
+        # the closed-form kernels; any armed robust model is excluded
+        # from steady-state freezing (time-invariance contract).
+        # Shipped off.
+        self.robust = (
+            robust.validate() if robust is not None
+            else RobustSpec.from_defaults()
+        )
+        if self.robust.enabled and self.gate.enabled:
+            raise ValueError(
+                "gate and robust are mutually exclusive: the robust "
+                "likelihood IS the outlier treatment (huber_t "
+                "subsumes the gate's huber policy); arm one of them"
+            )
         # steady-state (frozen-gain) serving: once a model's covariance
         # recursion converges, its updates collapse to the mean-only
         # steady kernel; a time-invariance break thaws it back to the
@@ -2222,7 +2290,10 @@ class MetranService:
                     [ids[idxs[gi]] for gi in sel],
                     y[sel], m[sel], versions[sel], t_seens[sel],
                     n_sl[sel],
-                    verdicts=verdicts[sel] if gated else None,
+                    verdicts=(
+                        verdicts[sel]
+                        if (gated or self.robust.enabled) else None
+                    ),
                     det_counts=(
                         det_counts[sel] if det_counts is not None
                         else None
@@ -2249,7 +2320,9 @@ class MetranService:
                         int(t_seens[gi]),
                         lambda mid=ids[i]: self.registry.get(mid),
                         verdicts=(
-                            verdicts[gi, :, :n_i] if gated else None
+                            verdicts[gi, :, :n_i]
+                            if (gated or self.robust.enabled)
+                            else None
                         ),
                         version=int(versions[gi]),
                     )
@@ -2791,9 +2864,14 @@ class MetranService:
         (:class:`~metran_tpu.serve.durability.RecoveryError`) instead
         of silently losing acked data.
 
-        Pass the SAME feature configuration (engine, gate, steady,
-        detect, fixed_lag) the crashed service ran with — replay
-        determinism depends on it.  ``checkpoint_after`` (default)
+        Pass the SAME feature configuration (engine, gate, robust,
+        steady, detect, fixed_lag) the crashed service ran with —
+        replay determinism depends on it: the robust spec's statics
+        ride the update-kernel compile keys, so a recovered service
+        with the same :class:`~metran_tpu.serve.engine.RobustSpec`
+        replays the WAL tail through bit-identical implicit-MAP
+        executables (the manifest records the crashed service's spec
+        for the operator).  ``checkpoint_after`` (default)
         takes a fresh checkpoint once replay completes, so the
         recovered state is immediately durable and the replayed
         segments are truncated.  Returns the service with the
@@ -3187,6 +3265,167 @@ class MetranService:
                     policy=self.gate.policy,
                 )
 
+    def _book_robust(self, model_id, names, armed: bool, zs, verdicts,
+                     iters, trace_ctx) -> None:
+        """Book one batch slot's implicit-MAP outcome — the robust twin
+        of :meth:`_book_gate_verdicts`, off the SAME z-scores the MAP
+        kernel emits (the gate-booking contract: scores feed the
+        gate-score histogram, the health monitor's windowed flag rate
+        counts solver failures, and every acted-on update becomes an
+        attributed event).
+
+        ``verdicts`` carries the robust codes (0 pass,
+        :data:`~metran_tpu.ops.ROBUST_MAP`,
+        :data:`~metran_tpu.ops.ROBUST_NONCONV`); ``iters`` the inner
+        Newton steps per slot.  An ARMED update with no flagged slot
+        is the bit-identical Gaussian fallback — counted
+        (``fallback_updates``) and emitted as one ``robust_fallback``
+        event so the fallback contract is observable, not assumed.
+        """
+        obs = np.isfinite(zs)
+        n_obs = int(np.count_nonzero(obs))
+        flagged = verdicts != 0
+        nonconv = verdicts == ROBUST_NONCONV
+        n_map = int(np.count_nonzero(flagged))
+        n_nonconv = int(np.count_nonzero(nonconv))
+        if n_obs:
+            hist = self.metrics.gate_scores
+            if hist is not None:
+                hist.observe_many(np.square(zs[obs]))
+            # the windowed health flag rate counts SOLVER FAILURES
+            # (a flagged slot that converged was handled, not lost —
+            # a persistently-railed sensor still serves information)
+            self.monitor.record_gate(model_id, n_obs, n_nonconv)
+        request_id = (
+            trace_ctx.trace_id if trace_ctx is not None else None
+        )
+        if not armed:
+            return
+        if not n_map:
+            self.metrics.robust_total.increment("fallback_updates")
+            if self.events is not None:
+                self.events.emit(
+                    "robust_fallback", model_id=model_id,
+                    request_id=request_id,
+                    fault_point="serve.robust_update",
+                    likelihood=self.robust.likelihood,
+                )
+            return
+        self.metrics.robust_total.increment("map_updates")
+        self.metrics.robust_total.increment("map_slots", n_map)
+        if n_nonconv:
+            self.metrics.robust_total.increment(
+                "nonconverged", n_nonconv
+            )
+        rh = self.metrics.robust_iters
+        if rh is not None:
+            rh.observe_many(np.asarray(iters)[flagged])
+        if self.events is not None:
+            if self.robust.flags_selectively:
+                # one attributed event per MAP-acted commit — for the
+                # always-flagging likelihoods (quantized/huber_t)
+                # EVERY armed commit flags, so the event carries no
+                # information and would flood the log on the hot
+                # path; the counters tell that story instead
+                slots = sorted({
+                    names[int(c)]
+                    for _r, c in zip(*np.nonzero(flagged))
+                })
+                self.events.emit(
+                    "robust_update", model_id=model_id,
+                    request_id=request_id,
+                    fault_point="serve.robust_update",
+                    likelihood=self.robust.likelihood,
+                    map_slots=n_map, slots=slots,
+                )
+            if n_nonconv:
+                self.events.emit(
+                    "robust_solver_nonconverged", model_id=model_id,
+                    request_id=request_id,
+                    fault_point="serve.robust_update",
+                    likelihood=self.robust.likelihood,
+                    slots=sorted({
+                        names[int(c)]
+                        for _r, c in zip(*np.nonzero(nonconv))
+                    }),
+                    count=n_nonconv,
+                )
+
+    def _book_robust_rows(self, ids, armed_rb, zs, verdicts, iters,
+                          n_sl) -> None:
+        """Vectorized robust booking for one arena dispatch (the bulk
+        twin of :meth:`_book_robust`: one histogram ``observe_many``,
+        bulk counter increments, per-model health windows, events only
+        for models with MAP activity)."""
+        n_pad = zs.shape[2]
+        real = np.arange(n_pad)[None, None, :] < n_sl[:, None, None]
+        obs = np.isfinite(zs) & real
+        hist = self.metrics.gate_scores
+        if hist is not None and obs.any():
+            hist.observe_many(np.square(zs[obs]))
+        flagged = (verdicts != 0) & real
+        nonconv = (verdicts == ROBUST_NONCONV) & real
+        n_obs_m = obs.sum(axis=(1, 2))
+        n_map_m = flagged.sum(axis=(1, 2))
+        n_nc_m = nonconv.sum(axis=(1, 2))
+        self.monitor.record_gate_many(
+            (ids[gi], int(n_obs_m[gi]), int(n_nc_m[gi]))
+            for gi in range(len(ids))
+        )
+        n_map = int(n_map_m.sum())
+        n_fb = int(np.count_nonzero(armed_rb & (n_map_m == 0)))
+        if n_fb:
+            self.metrics.robust_total.increment(
+                "fallback_updates", n_fb
+            )
+        if not n_map:
+            return
+        self.metrics.robust_total.increment(
+            "map_updates", int(np.count_nonzero(n_map_m))
+        )
+        self.metrics.robust_total.increment("map_slots", n_map)
+        n_nc = int(n_nc_m.sum())
+        if n_nc:
+            self.metrics.robust_total.increment("nonconverged", n_nc)
+        rh = self.metrics.robust_iters
+        if rh is not None:
+            rh.observe_many(np.asarray(iters)[flagged])
+        if self.events is not None:
+            # per-model events only where they carry information:
+            # MAP-acted commits for selectively-flagging likelihoods
+            # (censored — railed readings are the exception), solver
+            # nonconvergence always (rare, actionable).  The
+            # always-flagging likelihoods would emit one event per
+            # model per commit on the hot path.
+            emit_map = self.robust.flags_selectively
+            for gi in np.flatnonzero(
+                n_map_m if emit_map else n_nc_m
+            ):
+                names = self.registry.meta(ids[gi]).names
+                if emit_map:
+                    cols = sorted({
+                        names[int(c)]
+                        for _r, c in zip(*np.nonzero(flagged[gi]))
+                    })
+                    self.events.emit(
+                        "robust_update", model_id=ids[gi],
+                        fault_point="serve.robust_update",
+                        likelihood=self.robust.likelihood,
+                        map_slots=int(n_map_m[gi]), slots=cols,
+                    )
+                if n_nc_m[gi]:
+                    self.events.emit(
+                        "robust_solver_nonconverged",
+                        model_id=ids[gi],
+                        fault_point="serve.robust_update",
+                        likelihood=self.robust.likelihood,
+                        slots=sorted({
+                            names[int(c)]
+                            for _r, c in zip(*np.nonzero(nonconv[gi]))
+                        }),
+                        count=int(n_nc_m[gi]),
+                    )
+
     def _emit_chain_break(self, request, failed: Optional[str] = None):
         """One attributed chain-break event (dispatch-side paths)."""
         if self.events is None:
@@ -3328,9 +3567,26 @@ class MetranService:
             return self._run_update_dict(bucket, k, requests)
         results: list = [None] * len(requests)
         steady_idx, exact_idx = [], []
+        rob_on = self.robust.time_varying
         for j, req in enumerate(requests):
-            (steady_idx if req.model_id in self._steady_info
-             else exact_idx).append(j)
+            if req.model_id not in self._steady_info:
+                exact_idx.append(j)
+                continue
+            if rob_on:
+                # an armed robust model is time-varying by contract
+                # (a flagged slot's MAP conditioning changes the
+                # gain): thaw it BEFORE the frozen kernel can serve
+                # it, and replay exact — the steady twin of
+                # thaw-on-gate-fire
+                try:
+                    st = self.registry.get(req.model_id)
+                except Exception:  # noqa: BLE001 - lookup fails below
+                    st = None
+                if st is not None and st.t_seen >= self.robust.min_seen:
+                    self._thaw_dict(req.model_id, reason="robust_armed")
+                    exact_idx.append(j)
+                    continue
+            steady_idx.append(j)
         if steady_idx:
             thawed = self._run_update_dict_steady(
                 bucket, k, requests, steady_idx, results
@@ -3660,17 +3916,19 @@ class MetranService:
         # moments of the NEW posteriors — same dispatch, no second
         # launch
         det = self.detect if self.detect.enabled else None
+        rob = self.robust if self.robust.enabled else None
         fn = self.registry.update_fn(
             bucket, k, gate=gate if gated else None,
             horizons=self.horizons if rp is not None else None,
-            detect=det,
+            detect=det, robust=rob,
         )
         tracer = self.tracer
         t_k0 = time.monotonic()
         if acc is not None:
             cap.observe_stage("host_prep", t_k0 - t_h0)
         t_eng0 = tracer.clock() if tracer is not None else None
-        chol_t = cov_t = z_t = verdict_t = None
+        chol_t = cov_t = z_t = verdict_t = iters_t = None
+        armed_rb = None
         fac_b = batch.chol if sqrt_engine else batch.cov
         det_args = ()
         if det is not None:
@@ -3687,7 +3945,38 @@ class MetranService:
                     [st.t_seen >= det.min_seen for st in states], bool
                 ),
             )
-        if gated:
+        if rob is not None:
+            # same traced per-model arming as the gate, plus the
+            # per-slot likelihood parameters standardized through
+            # each model's scaler (the physical rails/quantum in the
+            # spec, the kernel's standardized units on the wire) —
+            # built in ONE vectorized pass over the stacked scalers
+            # (a per-model python loop measured over half the armed
+            # path's host overhead at fleet batch sizes)
+            armed_rb = np.array(
+                [st.t_seen >= rob.min_seen for st in states], bool
+            )
+            b = len(states)
+            sm = np.zeros((b, n_pad))
+            sd = np.ones((b, n_pad))
+            real = np.zeros((b, n_pad), bool)
+            for i, st in enumerate(states):
+                n_i = st.n_series
+                sm[i, :n_i] = st.scaler_mean
+                sd[i, :n_i] = st.scaler_std
+                real[i, :n_i] = True
+            rob_args = (
+                np.where(real, (rob.rail_lo - sm) / sd, -np.inf),
+                np.where(real, (rob.rail_hi - sm) / sd, np.inf),
+                np.where(
+                    real & (rob.quantum > 0.0),
+                    np.divide(rob.quantum, sd), 1.0,
+                ),
+                np.full((b, n_pad), rob.scale),
+            )
+            outs = fn(batch.ss, batch.mean, fac_b, y, m, armed_rb,
+                      *rob_args, *det_args)
+        elif gated:
             # the gate disarms per model below min_seen assimilated
             # steps (a cold filter's innovations are over-dispersed
             # until it forgets its N(0, I) init — a live gate would
@@ -3711,7 +4000,15 @@ class MetranService:
         if rp is not None:
             fm_t, fv_t = np.asarray(outs[-2]), np.asarray(outs[-1])
             outs = outs[:-2]
-        if gated:
+        if rob is not None:
+            mean_t, fac_t, sigma_t, detf_t, z_t, verdict_t, iters_t = (
+                outs
+            )
+            z_t, verdict_t, iters_t = (
+                np.asarray(z_t), np.asarray(verdict_t),
+                np.asarray(iters_t),
+            )
+        elif gated:
             mean_t, fac_t, sigma_t, detf_t, z_t, verdict_t = outs
             z_t, verdict_t = np.asarray(z_t), np.asarray(verdict_t)
         else:
@@ -3776,6 +4073,16 @@ class MetranService:
                     self._book_gate_verdicts(
                         st, z_t[i, :, : st.n_series],
                         verdict_t[i, :, : st.n_series], trace_ctx,
+                    )
+                elif rob is not None:
+                    # robust outcomes book in the same position for
+                    # the same reason (verdicts/z-scores off the MAP
+                    # kernel — the gate-booking contract)
+                    self._book_robust(
+                        st.model_id, st.names, bool(armed_rb[i]),
+                        z_t[i, :, : st.n_series],
+                        verdict_t[i, :, : st.n_series],
+                        iters_t[i, :, : st.n_series], trace_ctx,
                     )
                 t_gate0 = (
                     tracer.clock() if trace_ctx is not None else None
@@ -3929,7 +4236,8 @@ class MetranService:
                 m[i, :, : st.n_series], new_state.t_seen,
                 lambda ns=new_state: ns,
                 verdicts=(
-                    verdict_t[i, :, : st.n_series] if gated else None
+                    verdict_t[i, :, : st.n_series]
+                    if (gated or rob is not None) else None
                 ),
                 version=new_state.version,
             )
@@ -3955,9 +4263,11 @@ class MetranService:
                     )
             if steady_on and st.model_id not in self._steady_info:
                 # freeze detection: converged factor + fully-observed
-                # append + warm enough + no gate verdicts.  Its OWN
-                # guard like the snapshot below — the update IS
-                # applied, a freeze hiccup must never relabel it.
+                # append + warm enough + no gate verdicts + not an
+                # armed robust model (its flagged slots change the
+                # gain — time-varying by contract).  Its OWN guard
+                # like the snapshot below — the update IS applied, a
+                # freeze hiccup must never relabel it.
                 try:
                     delta = float(
                         np.max(np.abs(fac_after[i] - fac_before[i]))
@@ -3969,6 +4279,10 @@ class MetranService:
                         and (
                             not gated
                             or bool((verdict_t[i] == 0).all())
+                        )
+                        and not (
+                            rob is not None and rob.time_varying
+                            and new_state.t_seen >= rob.min_seen
                         )
                         and self._steady_freezable(st.model_id)
                     ):
@@ -4025,7 +4339,10 @@ class MetranService:
                 [t[1] for t in wal_sel], y[idx], m[idx],
                 [t[2] for t in wal_sel], [t[3] for t in wal_sel],
                 [t[4] for t in wal_sel],
-                verdicts=verdict_t[idx] if gated else None,
+                verdicts=(
+                    verdict_t[idx]
+                    if (gated or rob is not None) else None
+                ),
                 det_counts=(
                     det_counts[idx] if det is not None else None
                 ),
@@ -4162,6 +4479,8 @@ class MetranService:
         """
         gate = self.gate
         gated = gate.enabled
+        rob = self.robust if self.robust.enabled else None
+        scored = gated or rob is not None
         validate = self.reliability.validate_updates
         rp = self.readpath
         det = self.detect if self.detect.enabled else None
@@ -4175,8 +4494,16 @@ class MetranService:
         ok = np.zeros(g, bool)
         versions = np.zeros(g, np.int64)
         t_seens = np.zeros(g, np.int64)
-        zs = np.full((g, k, n_pad), np.nan) if gated else None
-        verdicts = np.zeros((g, k, n_pad), np.int8) if gated else None
+        zs = np.full((g, k, n_pad), np.nan) if scored else None
+        verdicts = np.zeros((g, k, n_pad), np.int8) if scored else None
+        iters = (
+            np.zeros((g, k, n_pad), np.int32) if rob is not None
+            else None
+        )
+        armed_rb = (
+            arena.t_seen_host[rows_arr] >= rob.min_seen
+            if rob is not None else None
+        )
         n_hz = len(self.horizons) if rp is not None else 0
         fm = np.zeros((g, n_hz, n_pad)) if rp is not None else None
         fv = np.zeros((g, n_hz, n_pad)) if rp is not None else None
@@ -4191,6 +4518,22 @@ class MetranService:
         sel = np.zeros(g, bool)
         if steady is not None:
             sel = arena.steady_host[rows_arr].copy()
+            if rob is not None and rob.time_varying and sel.any():
+                # an armed robust row is time-varying by contract (a
+                # flagged slot's MAP conditioning changes the gain):
+                # thaw it BEFORE the frozen kernel can serve it — the
+                # arena twin of the dict path's thaw-on-robust-armed
+                frozen_rb = sel & armed_rb
+                if frozen_rb.any():
+                    pos = np.flatnonzero(frozen_rb)
+                    with arena.lock:
+                        arena.thaw_rows(rows_arr[pos])
+                    for gi in pos:
+                        self._steady_hvars.pop(ids[gi], None)
+                        self._book_steady(
+                            "thaw", ids[gi], reason="robust_armed"
+                        )
+                    sel &= ~frozen_rb
             if rp is not None and sel.any():
                 # a frozen row can only ride the amortized snapshot
                 # path when its frozen variance half is cached
@@ -4288,12 +4631,34 @@ class MetranService:
                 validate=validate,
                 horizons=self.horizons if rp is not None else None,
                 steady_tol=steady.tol if steady is not None else 0.0,
-                detect=det,
+                detect=det, robust=rob,
             )
-            rows_p, (real_p, y_p, m_p) = self._pad_dispatch(
-                rows_e, arena.scratch_row,
-                (real_all[e_pos], y[e_pos], m[e_pos]),
+            pad_arrays = (real_all[e_pos], y[e_pos], m[e_pos])
+            if rob is not None:
+                # the traced per-slot likelihood parameters,
+                # standardized per row through the arena's host scaler
+                # mirrors (the rows are pinned, so the mirrors cannot
+                # move under us); padded slots carry (-inf, +inf, 1)
+                # and can never flag
+                sm_e = arena.scaler_mean[rows_e]
+                sd_e = arena.scaler_std[rows_e]
+                re = real_all[e_pos]
+                rl = np.where(
+                    re, (rob.rail_lo - sm_e) / sd_e, -np.inf
+                ).astype(arena.dtype)
+                rh = np.where(
+                    re, (rob.rail_hi - sm_e) / sd_e, np.inf
+                ).astype(arena.dtype)
+                qv = np.where(
+                    re & (rob.quantum > 0.0), rob.quantum / sd_e, 1.0
+                ).astype(arena.dtype)
+                sc = np.full_like(sd_e, rob.scale, arena.dtype)
+                pad_arrays = pad_arrays + (rl, rh, qv, sc)
+            rows_p, padded = self._pad_dispatch(
+                rows_e, arena.scratch_row, pad_arrays
             )
+            real_p, y_p, m_p = padded[:3]
+            rob_p = tuple(padded[3:])
             conv = None
             t_l0 = time.monotonic()
             if acc is not None:
@@ -4302,7 +4667,22 @@ class MetranService:
                 t_d0 = time.monotonic()
                 if acc is not None:
                     cap.observe_stage("lock", t_d0 - t_l0)
-                if det is not None:
+                if rob is not None and det is not None:
+                    outs = arena.apply_det(
+                        fn, rows_p, y_p, m_p, np.int32(rob.min_seen),
+                        *rob_p, real_p, np.int32(det.min_seen),
+                    )
+                elif rob is not None and steady is not None:
+                    outs = arena.apply(
+                        fn, rows_p, y_p, m_p, np.int32(rob.min_seen),
+                        *rob_p, real_p,
+                    )
+                elif rob is not None:
+                    outs = arena.apply(
+                        fn, rows_p, y_p, m_p, np.int32(rob.min_seen),
+                        *rob_p,
+                    )
+                elif det is not None:
                     # the detect kernel has ONE signature (engine.py):
                     # gate/steady args always present, unused halves
                     # traced out by XLA
@@ -4349,9 +4729,11 @@ class MetranService:
             ok[e_pos] = ok_e
             versions[e_pos] = vers
             t_seens[e_pos] = ts
-            if gated:
+            if scored:
                 zs[e_pos] = np.asarray(outs[3])[: len(e_pos)]
                 verdicts[e_pos] = np.asarray(outs[4])[: len(e_pos)]
+            if rob is not None:
+                iters[e_pos] = np.asarray(outs[5])[: len(e_pos)]
             if rp is not None:
                 fm[e_pos] = fm_e[: len(e_pos)]
                 fv[e_pos] = fv_e[: len(e_pos)]
@@ -4362,6 +4744,15 @@ class MetranService:
                 cand = conv & ok_e & (t_seens[e_pos] >= steady.min_seen)
                 if gated:
                     cand &= (verdicts[e_pos] == 0).all(axis=(1, 2))
+                if rob is not None and rob.time_varying:
+                    # an armed robust row must never freeze (and a
+                    # disarmed one that will arm at this t_seen floor
+                    # would thaw right back — exclude it too); the
+                    # "gaussian" pinning likelihood can never flag,
+                    # so it keeps the steady speedup
+                    cand &= ~(
+                        t_seens[e_pos] >= rob.min_seen
+                    )
                 cand &= ~arena.steady_host[rows_e]
                 if cand.any():
                     cand &= np.array([
@@ -4392,6 +4783,13 @@ class MetranService:
             self._book_detect_rows(
                 ids, rows_arr, ok, versions, t_seens, det_counts,
                 det_stat_parts, arena,
+            )
+        if rob is not None and g:
+            # robust booking is central here so the per-request and
+            # bulk arena callers share one (vectorized) path
+            self._book_robust_rows(
+                ids, armed_rb, zs, verdicts, iters,
+                arena.n_series_host[rows_arr],
             )
         if cap is not None:
             cap.costs.charge_many(
@@ -4623,7 +5021,7 @@ class MetranService:
                     lambda mid=meta.model_id: self.registry.get(mid),
                     verdicts=(
                         verdicts[i, :, : meta.n_series]
-                        if gated else None
+                        if (gated or self.robust.enabled) else None
                     ),
                     version=int(versions[i]),
                 )
@@ -4658,7 +5056,10 @@ class MetranService:
                     np.asarray(
                         [metas[i].n_series for i in sel], np.int64
                     ),
-                    verdicts=verdicts[sel] if gated else None,
+                    verdicts=(
+                        verdicts[sel]
+                        if (gated or self.robust.enabled) else None
+                    ),
                     det_counts=(
                         det_counts[sel] if det_counts is not None
                         else None

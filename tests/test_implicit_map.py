@@ -1,0 +1,685 @@
+"""Implicit-MAP non-Gaussian observation robustness (`ops.implicit_map`
++ the `RobustSpec` serving path).
+
+Pins the engine's contracts:
+
+1. **bit-exact Gaussian fallback** — with `likelihood="gaussian"`,
+   with `armed=False`, and on censored streams that never rail, the
+   implicit-MAP kernels return posteriors and likelihood terms
+   *bit-identical* to `filter_append`/`sqrt_filter_append`, at f64 and
+   f32 (arming the robust path is free until a sensor degrades);
+2. **MAP semantics** — railed readings move the state only toward the
+   rail bound (one-sided), the Laplace factor stays PSD, verdicts name
+   the MAP-conditioned cells, the inner solver converges within its
+   budget;
+3. **serving interplay** — armed-robust dict == arena bit-identical,
+   verdict booking rides the gate machinery off the MAP z-scores,
+   steady-frozen rows thaw when the robust floor arms, streaming
+   detection through the MAP path counts each observation once, and a
+   robust-armed WAL replay recovers bit-identically (chaos cell);
+4. **the headline scenario** — on railed streams the censored engine
+   beats reject-gating by >= 2x observation-space RMSE
+   (`run_robust_fault_scenario`).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metran_tpu.ops import (
+    ROBUST_MAP,
+    dfm_statespace,
+    filter_append,
+    implicit_map_filter_append,
+    implicit_map_sqrt_filter_append,
+    kalman_filter,
+    sqrt_filter_append,
+    sqrt_kalman_filter,
+)
+from metran_tpu.reliability.scenarios import simulate_dfm_panel
+
+pytestmark = pytest.mark.robust
+
+LIKELIHOODS = ("censored", "quantized", "huber_t")
+
+
+def _model_and_stream(rng, n=5, k_fct=1, t_hist=300, k_app=12,
+                      missing=0.2, dtype=None):
+    loadings = rng.uniform(0.3, 0.8, (n, k_fct)) / np.sqrt(k_fct)
+    alpha_sdf = rng.uniform(5.0, 40.0, n)
+    alpha_cdf = rng.uniform(10.0, 60.0, k_fct)
+    if dtype is not None:
+        ss = dfm_statespace(
+            jnp.asarray(alpha_sdf, dtype), jnp.asarray(alpha_cdf, dtype),
+            jnp.asarray(loadings, dtype), 1.0,
+        )
+    else:
+        ss = dfm_statespace(alpha_sdf, alpha_cdf, loadings, 1.0)
+    _, y_all, mask_all = simulate_dfm_panel(
+        ss, t_hist + k_app, rng, missing_p=missing
+    )
+    y_hist = np.where(mask_all[:t_hist], y_all[:t_hist], 0.0)
+    return (ss, y_hist, mask_all[:t_hist],
+            y_all[t_hist:].copy(), mask_all[t_hist:].copy())
+
+
+def _assert_first4_bitequal(got, want, label=""):
+    for i, name in enumerate(("mean", "fac", "sigma", "detf")):
+        assert np.array_equal(
+            np.asarray(got[i]), np.asarray(want[i])
+        ), f"{label}: {name} not bit-identical"
+
+
+# ----------------------------------------------------------------------
+# 1. bit-exact Gaussian fallback
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("dtype", [jnp.float64, jnp.float32])
+def test_gaussian_likelihood_bit_identical(rng, dtype):
+    ss, y, mask, y_new, m_new = _model_and_stream(rng, dtype=dtype)
+    res = kalman_filter(ss, y, mask, engine="sequential")
+    base = filter_append(
+        ss, res.mean_f[-1], res.cov_f[-1], y_new, m_new,
+        engine="sequential",
+    )
+    got = implicit_map_filter_append(
+        ss, res.mean_f[-1], res.cov_f[-1], y_new, m_new,
+        likelihood="gaussian",
+    )
+    _assert_first4_bitequal(got, base, f"cov gaussian {dtype}")
+    assert np.all(np.asarray(got[5]) == 0)
+    assert np.all(np.asarray(got[6]) == 0)
+
+    sres = sqrt_kalman_filter(ss, y, mask)
+    sbase = sqrt_filter_append(
+        ss, sres.mean_f[-1], sres.chol_f[-1], y_new, m_new
+    )
+    sgot = implicit_map_sqrt_filter_append(
+        ss, sres.mean_f[-1], sres.chol_f[-1], y_new, m_new,
+        likelihood="gaussian",
+    )
+    _assert_first4_bitequal(sgot, sbase, f"sqrt gaussian {dtype}")
+
+
+@pytest.mark.parametrize("dtype", [jnp.float64, jnp.float32])
+@pytest.mark.parametrize("likelihood", LIKELIHOODS)
+def test_disarmed_bit_identical(rng, dtype, likelihood):
+    """armed=False computes the exact same floating-point operations
+    as the plain kernels, whatever the likelihood."""
+    ss, y, mask, y_new, m_new = _model_and_stream(rng, dtype=dtype)
+    res = kalman_filter(ss, y, mask, engine="sequential")
+    base = filter_append(
+        ss, res.mean_f[-1], res.cov_f[-1], y_new, m_new,
+        engine="sequential",
+    )
+    got = implicit_map_filter_append(
+        ss, res.mean_f[-1], res.cov_f[-1], y_new, m_new, armed=False,
+        likelihood=likelihood, quantum=0.5, scale=0.1,
+    )
+    _assert_first4_bitequal(got, base, f"cov {likelihood} off {dtype}")
+    assert int(np.asarray(got[5]).sum()) == 0
+
+    sres = sqrt_kalman_filter(ss, y, mask)
+    sbase = sqrt_filter_append(
+        ss, sres.mean_f[-1], sres.chol_f[-1], y_new, m_new
+    )
+    sgot = implicit_map_sqrt_filter_append(
+        ss, sres.mean_f[-1], sres.chol_f[-1], y_new, m_new,
+        armed=False, likelihood=likelihood, quantum=0.5, scale=0.1,
+    )
+    _assert_first4_bitequal(sgot, sbase, f"sqrt {likelihood} off {dtype}")
+
+
+@pytest.mark.parametrize("dtype", [jnp.float64, jnp.float32])
+def test_censored_unrailed_bit_identical(rng, dtype):
+    """An ARMED censored kernel whose stream never rails is the plain
+    kernel, bit for bit — the flagged-slot test is the only gate."""
+    ss, y, mask, y_new, m_new = _model_and_stream(rng, dtype=dtype)
+    res = kalman_filter(ss, y, mask, engine="sequential")
+    base = filter_append(
+        ss, res.mean_f[-1], res.cov_f[-1], y_new, m_new,
+        engine="sequential",
+    )
+    got = implicit_map_filter_append(
+        ss, res.mean_f[-1], res.cov_f[-1], y_new, m_new, armed=True,
+        likelihood="censored", rail_lo=-1e6, rail_hi=1e6,
+    )
+    _assert_first4_bitequal(got, base, f"cov unrailed {dtype}")
+    assert int(np.asarray(got[5]).sum()) == 0
+
+    sres = sqrt_kalman_filter(ss, y, mask)
+    sbase = sqrt_filter_append(
+        ss, sres.mean_f[-1], sres.chol_f[-1], y_new, m_new
+    )
+    sgot = implicit_map_sqrt_filter_append(
+        ss, sres.mean_f[-1], sres.chol_f[-1], y_new, m_new,
+        armed=True, likelihood="censored", rail_lo=-1e6, rail_hi=1e6,
+    )
+    _assert_first4_bitequal(sgot, sbase, f"sqrt unrailed {dtype}")
+
+
+# ----------------------------------------------------------------------
+# 2. MAP semantics
+# ----------------------------------------------------------------------
+def test_censored_moves_state_toward_rail_only(rng):
+    """A railed-high reading can only RAISE the slot's predicted
+    observation (one-sided information), never drag it below the
+    ungated prior prediction; the verdicts name the railed cells and
+    the posterior factor stays PSD."""
+    ss, y, mask, y_new, m_new = _model_and_stream(rng, missing=0.0)
+    res = kalman_filter(ss, y, mask, engine="sequential")
+    mean0, cov0 = res.mean_f[-1], res.cov_f[-1]
+    rail = float(np.quantile(y_new, 0.3))
+    y_c = np.clip(y_new, rail, None)
+    railed = y_new <= rail  # clipped up to the LOW rail
+    # low-rail censoring: readings clip UP to `rail`, flag as <= rail
+    out = implicit_map_filter_append(
+        ss, mean0, cov0, y_c, m_new, armed=True,
+        likelihood="censored", rail_lo=rail, rail_hi=1e6, scale=0.1,
+    )
+    verdicts = np.asarray(out[5])
+    assert bool((verdicts[railed & m_new] != 0).all())
+    assert bool((verdicts[~railed & m_new] == 0).all())
+    assert np.all(np.isfinite(np.asarray(out[0])))
+    w = np.linalg.eigvalsh(np.asarray(out[1]))
+    assert w.min() > -1e-9
+    # inner solver stays within its budget on every flagged cell
+    iters = np.asarray(out[6])
+    from metran_tpu.ops.implicit_map import NEWTON_ITERS
+
+    assert iters.max() <= NEWTON_ITERS
+    # some flagged cell did real Newton work (a cell whose prior sits
+    # deep inside the feasible side legitimately converges at 0 steps)
+    assert iters[railed & m_new].max() >= 1
+
+
+def test_cov_and_sqrt_engines_agree(rng):
+    """The sequential (covariance) and marginal+QR (square-root)
+    robust reductions agree to float tolerance — the same contract the
+    gate carries across engines."""
+    ss, y, mask, y_new, m_new = _model_and_stream(rng, missing=0.0)
+    res = kalman_filter(ss, y, mask, engine="sequential")
+    sres = sqrt_kalman_filter(ss, y, mask)
+    rail = float(np.quantile(y_new, 0.7))
+    y_c = np.clip(y_new, None, rail)
+    out = implicit_map_filter_append(
+        ss, res.mean_f[-1], res.cov_f[-1], y_c, m_new, armed=True,
+        likelihood="censored", rail_hi=rail, scale=0.1,
+    )
+    sout = implicit_map_sqrt_filter_append(
+        ss, sres.mean_f[-1], sres.chol_f[-1], y_c, m_new, armed=True,
+        likelihood="censored", rail_hi=rail, scale=0.1,
+    )
+    assert np.allclose(
+        np.asarray(out[0]), np.asarray(sout[0]), atol=2e-2
+    )
+    chol = np.asarray(sout[1])
+    cov_sqrt = chol @ chol.T
+    assert np.allclose(np.asarray(out[1]), cov_sqrt, atol=2e-2)
+
+
+def test_huber_t_bounds_spike_influence(rng):
+    """A gross spike moves the Student-t posterior far less than the
+    exact Gaussian conditioning (bounded influence), while clean rows
+    stay close to the exact update."""
+    ss, y, mask, y_new, m_new = _model_and_stream(rng, missing=0.0)
+    res = kalman_filter(ss, y, mask, engine="sequential")
+    mean0, cov0 = res.mean_f[-1], res.cov_f[-1]
+    # a SINGLE appended row: the influence of the spike on the state
+    # it just hit (further exact rows would recondition and wash the
+    # naive damage out, confounding the comparison)
+    y_new, m_new = y_new[:1], m_new[:1]
+    clean = filter_append(
+        ss, mean0, cov0, y_new, m_new, engine="sequential"
+    )
+    y_sp = np.asarray(y_new).copy()
+    y_sp[0, 0] += 25.0
+    naive = filter_append(
+        ss, mean0, cov0, y_sp, m_new, engine="sequential"
+    )
+    rob_kwargs = dict(armed=True, likelihood="huber_t", nu=4.0,
+                      scale=0.1)
+    rob_clean = implicit_map_filter_append(
+        ss, mean0, cov0, y_new, m_new, **rob_kwargs
+    )
+    rob_spike = implicit_map_filter_append(
+        ss, mean0, cov0, y_sp, m_new, **rob_kwargs
+    )
+    # influence of the SPIKE itself, each model against its own
+    # clean-feed twin (the t likelihood conditions softly on every
+    # reading, so the exact kernel is not its clean baseline)
+    shift_naive = np.abs(np.asarray(naive[0]) - np.asarray(clean[0]))
+    shift_rob = np.abs(
+        np.asarray(rob_spike[0]) - np.asarray(rob_clean[0])
+    )
+    # bounded influence: at least 3x less movement than exact
+    # conditioning on the spike
+    assert shift_rob.max() < shift_naive.max() / 3.0
+
+
+def test_quantized_recovers_within_cell(rng):
+    """Interval conditioning lands the predicted observation inside
+    (or within a scale of) each reading's quantization cell."""
+    ss, y, mask, y_new, m_new = _model_and_stream(rng, missing=0.0)
+    res = kalman_filter(ss, y, mask, engine="sequential")
+    q = 1.0
+    y_q = q * np.round(np.asarray(y_new) / q)
+    out = implicit_map_filter_append(
+        ss, res.mean_f[-1], res.cov_f[-1], y_q, m_new, armed=True,
+        likelihood="quantized", quantum=q, scale=0.1,
+    )
+    pred = np.asarray(ss.z) @ np.asarray(out[0])
+    # the last row's readings bound the final posterior's projection
+    err = np.abs(pred - y_q[-1])
+    assert err.max() < q / 2 + 0.35
+    assert bool((np.asarray(out[5])[m_new] != 0).all())
+
+
+def test_robust_spec_validation():
+    from metran_tpu.serve import RobustSpec
+
+    RobustSpec().validate()  # off: always valid
+    RobustSpec(likelihood="censored", rail_hi=0.5).validate()
+    with pytest.raises(ValueError, match="unknown robust likelihood"):
+        RobustSpec(likelihood="cauchy").validate()
+    with pytest.raises(ValueError, match="inverted"):
+        RobustSpec(likelihood="censored", rail_lo=1.0,
+                   rail_hi=-1.0).validate()
+    with pytest.raises(ValueError, match="finite rail"):
+        RobustSpec(likelihood="censored").validate()
+    with pytest.raises(ValueError, match="quantum > 0"):
+        RobustSpec(likelihood="quantized", quantum=0.0).validate()
+    with pytest.raises(ValueError, match="nu > 2"):
+        RobustSpec(likelihood="huber_t", nu=2.0).validate()
+    with pytest.raises(ValueError, match="min_seen"):
+        RobustSpec(likelihood="huber_t", min_seen=-1).validate()
+    with pytest.raises(ValueError, match="scale"):
+        RobustSpec(likelihood="censored", rail_hi=1.0,
+                   scale=0.0).validate()
+
+
+def test_gate_and_robust_mutually_exclusive():
+    from metran_tpu.serve import (
+        GateSpec,
+        MetranService,
+        ModelRegistry,
+        RobustSpec,
+    )
+
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        MetranService(
+            ModelRegistry(root=None),
+            flush_deadline=None,
+            gate=GateSpec(policy="reject"),
+            robust=RobustSpec(likelihood="huber_t"),
+        )
+
+
+def test_sensor_fault_censor_and_quantize_modes():
+    from metran_tpu.reliability import SensorFault
+
+    arr = np.array([[-3.0, 0.2, 4.0], [1.0, -0.5, 2.5]])
+    censored = SensorFault("censor", rail_lo=-1.0, rail_hi=2.0)(arr)
+    assert np.array_equal(
+        censored, np.clip(arr, -1.0, 2.0)
+    )
+    quant = SensorFault("quantize", quantum=0.5)(arr)
+    assert np.array_equal(quant, 0.5 * np.round(arr / 0.5))
+    # determinism: same input, same output, input untouched
+    assert np.array_equal(quant, SensorFault("quantize", quantum=0.5)(arr))
+    assert arr[0, 0] == -3.0
+    with pytest.raises(ValueError, match="inverted"):
+        SensorFault("censor", rail_lo=2.0, rail_hi=-2.0)
+    with pytest.raises(ValueError, match="quantum > 0"):
+        SensorFault("quantize", quantum=0.0)
+
+
+# ----------------------------------------------------------------------
+# 3. serving interplay
+# ----------------------------------------------------------------------
+def _serving_fixture(rng, n=4, k_fct=1, t_hist=120, engine="sqrt"):
+    from metran_tpu.serve import PosteriorState
+
+    loadings = rng.uniform(0.4, 0.7, (n, k_fct)) / np.sqrt(k_fct)
+    alpha_sdf = rng.uniform(5.0, 40.0, n)
+    alpha_cdf = rng.uniform(10.0, 60.0, k_fct)
+    ss = dfm_statespace(alpha_sdf, alpha_cdf, loadings, 1.0)
+    _, y_all, _ = simulate_dfm_panel(ss, t_hist + 60, rng)
+    y_hist = y_all[:t_hist]
+    if engine in ("sqrt", "sqrt_parallel"):
+        filt = sqrt_kalman_filter(ss, y_hist, np.ones(y_hist.shape, bool))
+        chol0 = np.asarray(filt.chol_f[-1])
+        cov0 = chol0 @ chol0.T
+    else:
+        filt = kalman_filter(ss, y_hist, np.ones(y_hist.shape, bool),
+                             engine=engine)
+        chol0, cov0 = None, np.asarray(filt.cov_f[-1])
+
+    def make_state(mid):
+        return PosteriorState(
+            model_id=mid, version=0, t_seen=t_hist,
+            mean=np.asarray(filt.mean_f[-1]), cov=cov0,
+            params=np.concatenate([alpha_sdf, alpha_cdf]),
+            loadings=loadings, dt=1.0,
+            scaler_mean=np.zeros(n), scaler_std=np.ones(n),
+            names=tuple(f"s{j}" for j in range(n)), chol=chol0,
+        )
+
+    return make_state, y_all[t_hist:], n
+
+
+@pytest.mark.parametrize("engine", ["sqrt", "joint"])
+def test_armed_robust_dict_arena_parity(rng, engine):
+    """The same censored stream through a dict and an arena registry
+    commits bit-identical posteriors (f64) with identical version /
+    t_seen bookkeeping."""
+    from metran_tpu.serve import MetranService, ModelRegistry, RobustSpec
+
+    make_state, stream, n = _serving_fixture(rng, engine=engine)
+    rob = RobustSpec(likelihood="censored", rail_lo=-0.3,
+                     rail_hi=1e6, min_seen=1, scale=0.2)
+    stream = np.clip(stream[:20], -0.3, None)
+    results = {}
+    for arena in (False, True):
+        reg = ModelRegistry(root=None, engine=engine, arena=arena,
+                            arena_rows=4)
+        reg.put(make_state("m0"), persist=False)
+        svc = MetranService(reg, flush_deadline=None,
+                            persist_updates=False, robust=rob)
+        try:
+            for t in range(stream.shape[0]):
+                svc.update("m0", stream[t][None, :])
+            st = reg.get("m0")
+            results[arena] = (
+                np.asarray(st.mean), np.asarray(st.cov),
+                st.version, st.t_seen,
+            )
+            assert svc.metrics.robust_total.get("map_updates") > 0
+        finally:
+            svc.close()
+    assert np.array_equal(results[False][0], results[True][0])
+    assert np.array_equal(results[False][1], results[True][1])
+    assert results[False][2:] == results[True][2:]
+
+
+@pytest.mark.parametrize("arena", [False, True])
+def test_armed_clean_service_bit_identical_to_plain(rng, arena):
+    """A robust-armed service on a never-railing stream serves
+    bit-identically to a plain service — the fallback contract at the
+    service level, and the fallback is BOOKED (robust_fallback)."""
+    from metran_tpu.serve import MetranService, ModelRegistry, RobustSpec
+
+    make_state, stream, n = _serving_fixture(rng)
+    stream = stream[:10]
+    rob = RobustSpec(likelihood="censored", rail_lo=-1e6,
+                     rail_hi=1e6, min_seen=1)
+
+    def run(robust):
+        reg = ModelRegistry(root=None, engine="sqrt", arena=arena,
+                            arena_rows=4)
+        reg.put(make_state("m0"), persist=False)
+        svc = MetranService(reg, flush_deadline=None,
+                            persist_updates=False, robust=robust)
+        try:
+            for t in range(stream.shape[0]):
+                svc.update("m0", stream[t][None, :])
+            st = reg.get("m0")
+            return (np.asarray(st.mean), np.asarray(st.cov), svc)
+        finally:
+            svc.close()
+
+    mean_p, cov_p, _ = run(None)
+    mean_r, cov_r, svc_r = run(rob)
+    assert np.array_equal(mean_p, mean_r)
+    assert np.array_equal(cov_p, cov_r)
+    assert svc_r.metrics.robust_total.get("fallback_updates") == 10
+    assert svc_r.metrics.robust_total.get("map_updates") == 0
+
+
+def test_robust_verdict_booking_off_map_zscores(rng):
+    """The MAP kernel's z-scores feed the gate-score histogram and the
+    health monitor, MAP slots feed the robust counters + the
+    solver-iterations histogram, and robust_update events name the
+    slots — the gate-booking contract, robust flavor."""
+    from metran_tpu.serve import MetranService, ModelRegistry, RobustSpec
+
+    make_state, stream, n = _serving_fixture(rng)
+    rob = RobustSpec(likelihood="censored", rail_lo=-0.2,
+                     rail_hi=1e6, min_seen=1, scale=0.2)
+    stream = np.clip(stream[:15], -0.2, None)
+    reg = ModelRegistry(root=None, engine="sqrt")
+    reg.put(make_state("m0"), persist=False)
+    svc = MetranService(reg, flush_deadline=None,
+                        persist_updates=False, robust=rob)
+    try:
+        for t in range(stream.shape[0]):
+            svc.update("m0", stream[t][None, :])
+        counters = svc.metrics.robust_total.snapshot()
+        assert counters.get("map_updates", 0) > 0
+        assert counters.get("map_slots", 0) >= counters["map_updates"]
+        # the gate-score histogram observed every observed slot
+        snap = svc.obs.metrics.snapshot()
+        assert snap["metran_serve_gate_score"]["count"] == 15 * n
+        assert (
+            snap["metran_serve_robust_solver_iterations"]["count"]
+            == counters["map_slots"]
+        )
+        kinds = [e["kind"] for e in svc.events.for_model("m0")]
+        assert "robust_update" in kinds
+        ev = next(
+            e for e in svc.events.for_model("m0")
+            if e["kind"] == "robust_update"
+        )
+        assert ev["detail"]["slots"]
+        assert ev["detail"]["likelihood"] == "censored"
+    finally:
+        svc.close()
+
+
+@pytest.mark.parametrize("arena", [False, True])
+def test_steady_thaw_on_robust_arm(rng, arena):
+    """A steady-frozen model THAWS the moment the robust floor arms —
+    the time-invariance contract; while disarmed (t_seen below the
+    robust floor) freezing still works."""
+    from metran_tpu.serve import (
+        MetranService,
+        ModelRegistry,
+        RobustSpec,
+        SteadySpec,
+    )
+
+    make_state, stream, n = _serving_fixture(rng, t_hist=200)
+    arm_at = 230  # t_seen threshold: freeze first, arm later
+    rob = RobustSpec(likelihood="censored", rail_lo=-1e6,
+                     rail_hi=1e6, min_seen=arm_at)
+    steady = SteadySpec(tol=1e-3, min_seen=8)
+    reg = ModelRegistry(root=None, engine="sqrt", arena=arena,
+                        arena_rows=4)
+    reg.put(make_state("m0"), persist=False)
+    svc = MetranService(reg, flush_deadline=None,
+                        persist_updates=False, robust=rob,
+                        steady=steady)
+    try:
+        froze = False
+        for t in range(stream.shape[0]):
+            svc.update("m0", stream[t][None, :])
+            frozen_now = svc._steady_count() > 0
+            t_seen = 200 + t + 1
+            if t_seen <= arm_at:
+                # the thaw check reads the PRE-commit t_seen, so the
+                # first armed dispatch is the one whose commit lands
+                # at arm_at + 1
+                froze = froze or frozen_now
+            else:
+                assert not frozen_now, (
+                    f"row still frozen at t_seen={t_seen} with the "
+                    "robust floor armed"
+                )
+        assert froze, "model never froze while robust was disarmed"
+        kinds = [
+            (e["kind"], e["detail"].get("reason"))
+            for e in svc.events.for_model("m0")
+            if e["kind"] in ("steady_freeze", "steady_thaw")
+        ]
+        assert ("steady_thaw", "robust_armed") in kinds
+    finally:
+        svc.close()
+
+
+@pytest.mark.parametrize("arena", [False, True])
+def test_gaussian_likelihood_keeps_steady_frozen(rng, arena):
+    """The "gaussian" pinning likelihood can never flag a slot, so it
+    is NOT a time-invariance break: frozen models stay frozen past
+    the robust floor (the steady-state speedup is not paid for a
+    config with zero behavioral effect)."""
+    from metran_tpu.serve import (
+        MetranService,
+        ModelRegistry,
+        RobustSpec,
+        SteadySpec,
+    )
+
+    make_state, stream, n = _serving_fixture(rng, t_hist=200)
+    rob = RobustSpec(likelihood="gaussian", min_seen=210)
+    steady = SteadySpec(tol=1e-3, min_seen=8)
+    reg = ModelRegistry(root=None, engine="sqrt", arena=arena,
+                        arena_rows=4)
+    reg.put(make_state("m0"), persist=False)
+    svc = MetranService(reg, flush_deadline=None,
+                        persist_updates=False, robust=rob,
+                        steady=steady)
+    try:
+        froze_past_floor = False
+        for t in range(40):
+            svc.update("m0", stream[t][None, :])
+            if 200 + t + 1 > 215 and svc._steady_count() > 0:
+                froze_past_floor = True
+        assert froze_past_floor, (
+            "gaussian-likelihood robust config thawed/blocked "
+            "steady freezing"
+        )
+        kinds = [
+            (e["kind"], e["detail"].get("reason"))
+            for e in svc.events.for_model("m0")
+            if e["kind"] == "steady_thaw"
+        ]
+        assert ("steady_thaw", "robust_armed") not in kinds
+    finally:
+        svc.close()
+
+
+@pytest.mark.parametrize("arena", [False, True])
+def test_detector_no_double_count_through_map_path(rng, arena):
+    """Streaming detection through the robust kernels counts each
+    observation exactly once: on a clean (never-flagging) stream the
+    detector state and anomaly counts are bit-identical to a
+    detect-only service."""
+    from metran_tpu.serve import (
+        DetectSpec,
+        MetranService,
+        ModelRegistry,
+        RobustSpec,
+    )
+
+    make_state, stream, n = _serving_fixture(rng)
+    stream = stream[:12]
+    det = DetectSpec(enabled=True, min_seen=1)
+    rob = RobustSpec(likelihood="censored", rail_lo=-1e6,
+                     rail_hi=1e6, min_seen=1)
+
+    def run(robust):
+        reg = ModelRegistry(root=None, engine="sqrt", arena=arena,
+                            arena_rows=4)
+        reg.put(make_state("m0"), persist=False)
+        svc = MetranService(reg, flush_deadline=None,
+                            persist_updates=False, detect=det,
+                            robust=robust)
+        try:
+            for t in range(stream.shape[0]):
+                svc.update("m0", stream[t][None, :])
+            anomalies = svc.anomalies("m0").get("m0", {})
+            if arena:
+                det_state = reg.arena_detect_states().get("m0")
+            else:
+                det_state = svc.detector.dump()["m0"]["state"]
+            st = reg.get("m0")
+            return anomalies, np.asarray(det_state), np.asarray(st.mean)
+        finally:
+            svc.close()
+
+    a_plain, d_plain, m_plain = run(None)
+    a_rob, d_rob, m_rob = run(rob)
+    assert np.array_equal(m_plain, m_rob)
+    assert np.array_equal(d_plain, d_rob)
+    for key in ("anomalies", "cusum_alarms", "lb_alarms"):
+        assert a_plain.get(key, 0) == a_rob.get(key, 0)
+
+
+@pytest.mark.faults
+def test_robust_armed_crash_recovery_bit_identical():
+    """The crash chaos cell with the robust path armed: a WAL-tail
+    replay through the implicit-MAP kernels (railed readings included)
+    reconstructs every acked posterior bit-identically — the robust
+    compile-key/replay contract."""
+    from metran_tpu.reliability.scenarios import (
+        run_crash_recovery_scenario,
+    )
+    from metran_tpu.serve import RobustSpec
+
+    rob = RobustSpec(likelihood="censored", rail_lo=-0.5,
+                     rail_hi=0.5, min_seen=1, scale=0.2)
+    out = run_crash_recovery_scenario(
+        mode="arena_full", kill_point="durability.wal.pre_sync",
+        robust=rob,
+    )
+    assert out["crashed"]
+    assert out["no_acked_loss"], out["acked_lost"]
+    assert out["bit_identical"], out["max_posterior_diff"]
+    assert out["detector_identical"]
+
+
+# ----------------------------------------------------------------------
+# 4. the headline scenario
+# ----------------------------------------------------------------------
+@pytest.mark.faults
+def test_censored_scenario_beats_reject_gating(rng):
+    """On railed streams the censored implicit-MAP engine's
+    observation-space RMSE beats the PR 5 reject gate by >= 2x (the
+    acceptance headline), and beats the undefended path too."""
+    from metran_tpu.reliability.scenarios import (
+        run_robust_fault_scenario,
+    )
+
+    out = run_robust_fault_scenario(mode="censor")
+    assert out["railed_fraction"] > 0.3  # genuinely railed streams
+    assert out["gated_vs_robust"] >= 2.0, out
+    assert out["naive_vs_robust"] >= 2.0, out
+    assert out["robust_counters"]["map_updates"] > 0
+
+
+@pytest.mark.faults
+def test_heavy_tailed_scenario(rng):
+    """The Student-t engine crushes the undefended path on heavy-
+    tailed (spiking) feeds and stays within the reject gate's order of
+    protection — without ever hard-rejecting a reading."""
+    from metran_tpu.reliability.scenarios import (
+        run_robust_fault_scenario,
+    )
+
+    out = run_robust_fault_scenario(mode="spike", n_steps=200)
+    assert out["naive_vs_robust"] >= 5.0, out
+    assert out["rmse_robust"] <= 4.0 * out["rmse_gated"], out
+
+
+@pytest.mark.faults
+def test_quantized_scenario(rng):
+    """Interval conditioning beats both the undefended path (which
+    assimilates quantization noise as truth) and the reject gate on a
+    coarsely quantized feed."""
+    from metran_tpu.reliability.scenarios import (
+        run_robust_fault_scenario,
+    )
+
+    out = run_robust_fault_scenario(mode="quantize", n_steps=200)
+    assert out["naive_vs_robust"] >= 1.15, out
+    assert out["gated_vs_robust"] >= 1.2, out

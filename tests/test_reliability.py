@@ -158,9 +158,9 @@ def test_degraded_filter_step_rejected_not_committed(rng, monkeypatch):
     real_update_fn = reg.update_fn
 
     def degraded_update_fn(bucket, k, gate=None, horizons=None,
-                           detect=None):
+                           detect=None, robust=None):
         fn = real_update_fn(bucket, k, gate=gate, horizons=horizons,
-                            detect=detect)
+                            detect=detect, robust=robust)
 
         def wrapped(ss, mean, cov, y, m):
             mean_t, cov_t, sigma, detf = fn(ss, mean, cov, y, m)

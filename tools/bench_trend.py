@@ -53,6 +53,8 @@ HEADLINES: Dict[str, int] = {
     "steady_speedup": +1,
     "refit_models_per_s": +1,
     "detect_overhead_pct": -1,
+    "robust_gated_vs_robust": +1,       # censored MAP vs reject gate
+    "robust_overhead_pct": -1,          # armed robust serving-mix cost
     "grad_backward_speedup": +1,
     "grad_mem_peak_mb_adjoint": -1,
     "capacity_overhead_pct": -1,

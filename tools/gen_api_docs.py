@@ -38,6 +38,7 @@ MODULES = [
     "metran_tpu.ops.forecast",
     "metran_tpu.ops.adjoint",
     "metran_tpu.ops.detect",
+    "metran_tpu.ops.implicit_map",
     "metran_tpu.ops.kalman",
     "metran_tpu.ops.pkalman",
     "metran_tpu.ops.lanes",
