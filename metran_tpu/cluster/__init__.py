@@ -1,0 +1,46 @@
+"""Multi-process serving plane (docs/concepts.md "Multi-process serving").
+
+The single-process :class:`~metran_tpu.serve.MetranService` tops out
+where one Python interpreter does: reads queue behind writes on one
+GIL however many cores the host has.  This package is the split that
+breaks it, with three pieces:
+
+- :mod:`~metran_tpu.cluster.snapplane` — a seqlock-versioned
+  ``multiprocessing.shared_memory`` slot table the writer publishes
+  committed forecast snapshots into (the ``SnapshotStore``'s second
+  sink); read workers probe it lock-free, with zero device traffic;
+- :mod:`~metran_tpu.cluster.writer` / :mod:`~metran_tpu.cluster.
+  worker` / :mod:`~metran_tpu.cluster.frontend` — the single-writer
+  split: ONE process owns update dispatch, the ``StateArena`` and the
+  WAL (the group-commit stream doubling as the cross-process commit
+  notification), N processes serve reads, and a thin frontend routes
+  while preserving the single-process API and semantics;
+- :mod:`~metran_tpu.cluster.mesh` — ``jax.distributed`` batch-axis
+  sharding that extends the arena's device mesh across processes,
+  bit-identical to single-process at f64.
+
+Opt-in end to end: ``MetranService(cluster=ClusterSpec(...))`` arms
+the writer-side plane, :class:`~metran_tpu.cluster.frontend.
+ClusterFrontend` runs the topology; shipped off
+(``METRAN_TPU_SERVE_CLUSTER``).
+"""
+
+from .frontend import ClusterFrontend
+from .ipc import RpcClient, RpcServer
+from .snapplane import SnapshotPlane, plane_bytes
+from .spec import ClusterSpec
+from .worker import ReadWorker, worker_main
+from .writer import WriterHost, writer_main
+
+__all__ = [
+    "ClusterFrontend",
+    "ClusterSpec",
+    "ReadWorker",
+    "RpcClient",
+    "RpcServer",
+    "SnapshotPlane",
+    "WriterHost",
+    "plane_bytes",
+    "worker_main",
+    "writer_main",
+]

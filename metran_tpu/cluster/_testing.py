@@ -1,0 +1,129 @@
+"""Spawn-safe fixtures for cluster tests and the serve-cluster bench.
+
+Writer and publisher processes are started with the **spawn** method,
+so their entry callables must be picklable module-level functions the
+child can re-import under the same dotted name.  Test modules are not
+reliably importable inside a spawned child (pytest's rootdir-relative
+imports do not exist there); this module is.  ``bench.py --phase
+serve-cluster`` uses the same factory, so the measured topology is
+exactly the tested topology.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "make_states",
+    "seed_root",
+    "storm_publisher",
+    "writer_service_factory",
+]
+
+
+def make_states(seed=7, n_models=4, n=5, kf=1, t=60,
+                dtype=np.float64):
+    """The readpath test fleet recipe: ``n_models`` small fitted DFMs
+    with deterministic parameters (same seed -> bit-identical states,
+    which the frontend parity test relies on)."""
+    from ..ops import dfm_statespace, kalman_filter
+    from ..serve import PosteriorState
+
+    rng = np.random.default_rng(seed)
+    states = []
+    for i in range(n_models):
+        loadings = (
+            rng.uniform(0.3, 0.8, (n, kf)) / np.sqrt(kf)
+        ).astype(dtype)
+        a_s = rng.uniform(5.0, 40.0, n).astype(dtype)
+        a_c = rng.uniform(10.0, 60.0, kf).astype(dtype)
+        ss = dfm_statespace(a_s, a_c, loadings, 1.0)
+        y = rng.normal(size=(t, n))
+        mask = rng.uniform(size=(t, n)) > 0.3
+        y = np.where(mask, y, 0.0)
+        res = kalman_filter(ss, y.astype(dtype), mask, engine="joint")
+        states.append(PosteriorState(
+            model_id=f"m{i}", version=0, t_seen=t,
+            mean=np.asarray(res.mean_f[-1], dtype),
+            cov=np.asarray(res.cov_f[-1], dtype),
+            params=np.concatenate([a_s, a_c]),
+            loadings=loadings, dt=1.0,
+            scaler_mean=rng.normal(size=n).astype(dtype),
+            scaler_std=rng.uniform(0.5, 2.0, n).astype(dtype),
+            names=tuple(f"s{j}" for j in range(n)),
+        ))
+    return states
+
+
+def seed_root(root, seed=7, n_models=4, n=5, kf=1, t=60):
+    """Persist the fixture fleet under ``root`` so a spawned writer
+    (whose factory only receives the path) can load it from disk.
+    Returns the model ids."""
+    from ..serve import ModelRegistry
+
+    reg = ModelRegistry(root=root)
+    states = make_states(seed=seed, n_models=n_models, n=n, kf=kf, t=t)
+    for st in states:
+        reg.put(st, persist=True)
+    return [st.model_id for st in states]
+
+
+def writer_service_factory(spec, recovering, root, horizons="1-5",
+                           durable=True):
+    """The ``ClusterFrontend`` service factory used by tests and bench.
+
+    Builds the writer's ``MetranService`` over the fleet persisted by
+    :func:`seed_root`; ``recovering=True`` (a frontend
+    ``restart_writer`` after a writer crash) routes through
+    ``MetranService.recover`` so the WAL tail replays before serving
+    resumes.
+    """
+    import jax
+
+    # the parity tests compare f64 bits against an in-process service
+    # whose conftest enabled x64; this factory runs in a spawned child
+    # where no conftest ever runs
+    jax.config.update("jax_enable_x64", True)
+    from ..serve import DurabilitySpec, MetranService, ModelRegistry
+
+    if recovering:
+        return MetranService.recover(
+            root, flush_deadline=None, persist_updates=False,
+            readpath=True, horizons=horizons, cluster=spec,
+        )
+    durability = (
+        DurabilitySpec(enabled=True, checkpoint_every=0)
+        if durable else None
+    )
+    reg = ModelRegistry(root=root)
+    return MetranService(
+        reg, flush_deadline=None, persist_updates=False,
+        readpath=True, horizons=horizons, durability=durability,
+        cluster=spec,
+    )
+
+
+def storm_publisher(plane_name, model_id, n_series, n_horizons,
+                    n_versions):
+    """Torn-write storm process: publish versions ``1..n_versions`` of
+    one model where every published buffer satisfies the invariant
+    ``means == version`` and ``variances == 2 * version`` elementwise.
+    A seqlock-violating reader would observe a mixed buffer; the storm
+    test asserts no read ever does."""
+    from ..serve.readpath import SnapshotEntry
+    from .snapplane import SnapshotPlane
+
+    plane = SnapshotPlane.attach(plane_name)
+    try:
+        names = tuple(f"s{j}" for j in range(n_series))
+        for version in range(1, n_versions + 1):
+            v = float(version)
+            plane.publish_entries([SnapshotEntry(
+                model_id=model_id, version=version, names=names,
+                means=np.full((n_horizons, n_series), v),
+                variances=np.full((n_horizons, n_series), 2.0 * v),
+                published_at=v,
+            )])
+    finally:
+        plane.close(unlink=False)
+    return 0

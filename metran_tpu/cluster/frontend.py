@@ -1,0 +1,467 @@
+"""Cluster frontend: process supervision + request routing.
+
+The thin half of the single-writer split.  A :class:`ClusterFrontend`
+spawns ONE writer process (:mod:`metran_tpu.cluster.writer` — update
+dispatch, ``StateArena``, WAL, snapshot-plane publication) and
+``spec.workers`` read processes (:mod:`metran_tpu.cluster.worker`),
+then routes: **updates to the writer** (whose in-process
+``MetranService`` preserves the per-model ordering chain, breaker,
+deadline and gate semantics — exceptions cross the socket as objects
+and re-raise here, so callers cannot tell the split happened) and
+**forecasts to the workers** round-robin (shared-memory plane hits;
+worker-side fallthrough to the writer on miss/stale).
+
+Failure policy (docs/concepts.md "Multi-process serving"):
+
+- a worker transport failure (killed process, half-open socket) moves
+  the read to the next worker and finally to the writer directly — a
+  killed worker loses **zero acked reads**; the monitor thread then
+  reaps and respawns it (``worker_exit`` → ``worker_restart`` events,
+  ``worker_start`` on every spawn);
+- application exceptions (breaker open, deadline, validation) are
+  NEVER retried here — they re-raise exactly as the single-process
+  service would, because retrying them would change semantics;
+- a dead writer is surfaced (``writer_alive()``), and
+  :meth:`restart_writer` respawns it with ``recovering=True`` so the
+  factory routes through the service's existing WAL replay
+  (:meth:`~metran_tpu.serve.MetranService.recover`) — no
+  acked-commit loss.
+
+Everything multiprocess uses the **spawn** start method: the children
+build their own jax runtime; device buffers, WAL handles and socket
+servers must never cross a fork.
+"""
+
+from __future__ import annotations
+
+import multiprocessing
+import os
+import shutil
+import threading
+import time
+from logging import getLogger
+from typing import Callable, List, Optional, Tuple
+
+from .ipc import RpcClient
+from .snapplane import SnapshotPlane
+from .spec import ClusterSpec
+from .worker import worker_main
+from .writer import writer_main
+
+logger = getLogger(__name__)
+
+__all__ = ["ClusterFrontend"]
+
+#: seconds a spawned process gets to signal readiness before the
+#: frontend declares the spawn failed (first jax import + compile
+#: cache warm can be slow on loaded CI hosts)
+SPAWN_TIMEOUT_S = 180.0
+
+
+def _wait_ready(path: str, proc, timeout_s: float = SPAWN_TIMEOUT_S):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            return
+        if not proc.is_alive():
+            raise RuntimeError(
+                f"cluster process {proc.name} died during startup "
+                f"(exitcode {proc.exitcode})"
+            )
+        time.sleep(0.02)
+    raise TimeoutError(
+        f"cluster process {proc.name} not ready after {timeout_s}s"
+    )
+
+
+class _Worker:
+    """One live read worker: process handle + RPC client + paths."""
+
+    def __init__(self, index: int, proc, client: RpcClient,
+                 socket_path: str, ready_path: str):
+        self.index = index
+        self.proc = proc
+        self.client = client
+        self.socket_path = socket_path
+        self.ready_path = ready_path
+
+
+class ClusterFrontend:
+    """Spawn, supervise and route for one serving cluster.
+
+    ``service_factory(spec, recovering, *factory_args)`` must be a
+    picklable module-level callable returning the writer's
+    ``MetranService`` (constructed with ``cluster=spec`` so the
+    service creates and publishes into the snapshot plane); it runs
+    INSIDE the writer process.
+    """
+
+    def __init__(
+        self,
+        spec: ClusterSpec,
+        service_factory: Callable,
+        factory_args: Tuple = (),
+        observability=None,
+    ):
+        from ..obs import Observability
+
+        self.spec = spec.validate()
+        if not self.spec.enabled:
+            raise ValueError(
+                "ClusterFrontend needs an enabled ClusterSpec — a "
+                "disabled spec means single-process serving, which "
+                "needs no frontend"
+            )
+        self._factory = service_factory
+        self._factory_args = tuple(factory_args)
+        self._owns_socket_dir = not spec.socket_dir
+        self.socket_dir = self.spec.resolve_socket_dir()
+        self._owns_obs = observability is None
+        self.obs = (
+            observability if observability is not None
+            else Observability.default()
+        )
+        self.events = self.obs.events
+        self._ctx = multiprocessing.get_context("spawn")
+        self._lock = threading.Lock()
+        self._closed = False
+        self._restarting = False  # pauses the monitor during bounces
+        self._rr = 0  # round-robin cursor
+        self.restarts = 0
+
+        self.writer_socket = os.path.join(self.socket_dir, "writer.sock")
+        self._writer_proc = None
+        self.writer = None  # RpcClient
+        self.plane: Optional[SnapshotPlane] = None
+        self._workers: List[_Worker] = []
+        try:
+            self._spawn_writer(recovering=False)
+            for i in range(self.spec.workers):
+                self._spawn_worker(i, restart=False)
+        except BaseException:
+            self.close()
+            raise
+        self._register_metrics()
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="metran-cluster-monitor",
+            daemon=True,
+        )
+        self._monitor.start()
+
+    # -- spawning --------------------------------------------------------
+    def _spawn_writer(self, recovering: bool) -> None:
+        ready = os.path.join(
+            self.socket_dir, f"writer.ready.{self.restarts}"
+        )
+        proc = self._ctx.Process(
+            target=writer_main,
+            args=(self.spec, self.writer_socket, self._factory,
+                  self._factory_args, recovering, ready),
+            name="metran-writer",
+            daemon=True,
+        )
+        proc.start()
+        _wait_ready(ready, proc)
+        self._writer_proc = proc
+        self.writer = RpcClient(self.writer_socket)
+        hello = self.writer.call("hello")
+        plane_name = hello["plane"]
+        if self.plane is None or self.plane.name != plane_name:
+            if self.plane is not None:
+                self.plane.close(unlink=False)
+            self.plane = SnapshotPlane.attach(plane_name)
+
+    def _spawn_worker(self, index: int, restart: bool) -> None:
+        tag = f"{index}.{self.restarts}"
+        socket_path = os.path.join(self.socket_dir, f"worker{tag}.sock")
+        ready = os.path.join(self.socket_dir, f"worker{tag}.ready")
+        proc = self._ctx.Process(
+            target=worker_main,
+            args=(self.plane.name, socket_path, self.writer_socket,
+                  self.spec.heartbeat_s, ready),
+            name=f"metran-worker-{index}",
+            daemon=True,
+        )
+        proc.start()
+        _wait_ready(ready, proc)
+        worker = _Worker(index, proc, RpcClient(socket_path),
+                         socket_path, ready)
+        with self._lock:
+            if restart and index < len(self._workers):
+                self._workers[index] = worker
+            else:
+                self._workers.append(worker)
+        if self.events is not None:
+            self.events.emit(
+                "worker_start", fault_point="cluster.frontend",
+                worker=index, pid=proc.pid, restart=restart,
+            )
+
+    # -- supervision -----------------------------------------------------
+    def _monitor_loop(self) -> None:
+        while not self._closed:
+            time.sleep(self.spec.heartbeat_s)
+            if self._closed:
+                return
+            if self._restarting:
+                continue
+            for worker in list(self._workers):
+                if self._closed:
+                    return
+                if worker.proc.is_alive():
+                    continue
+                if self.events is not None:
+                    self.events.emit(
+                        "worker_exit", fault_point="cluster.frontend",
+                        worker=worker.index, pid=worker.proc.pid,
+                        exitcode=worker.proc.exitcode,
+                    )
+                try:
+                    self._restart_worker(worker)
+                except Exception:  # pragma: no cover - spawn failure
+                    logger.exception(
+                        "worker %d restart failed", worker.index
+                    )
+
+    def _restart_worker(self, worker: _Worker) -> None:
+        worker.client.close()
+        self.restarts += 1
+        self._spawn_worker(worker.index, restart=True)
+        if self.events is not None:
+            self.events.emit(
+                "worker_restart", fault_point="cluster.frontend",
+                worker=worker.index,
+            )
+
+    def writer_alive(self) -> bool:
+        proc = self._writer_proc
+        return proc is not None and proc.is_alive()
+
+    def restart_writer(self) -> None:
+        """Respawn a dead writer with ``recovering=True`` — the factory
+        routes through the service's WAL replay, so every acked commit
+        survives (the existing durability contract, now cross-process).
+        """
+        if self.writer_alive():
+            raise RuntimeError(
+                "writer is alive; restart_writer is for crash recovery"
+            )
+        self._restarting = True
+        try:
+            if self.writer is not None:
+                self.writer.close()
+            old_plane = (
+                self.plane.name if self.plane is not None else None
+            )
+            self.restarts += 1
+            self._spawn_writer(recovering=True)
+            if old_plane is not None and (
+                self.plane is None or self.plane.name != old_plane
+            ):
+                # the crashed writer never unlinked its segment; reap
+                # it, then bounce every worker onto the new plane —
+                # they still hold read views of the dead one
+                try:
+                    leaked = SnapshotPlane.attach(old_plane)
+                except (FileNotFoundError, ValueError):
+                    pass
+                else:
+                    leaked.close(unlink=True)
+                for worker in list(self._workers):
+                    try:
+                        worker.client.call("shutdown")
+                    except Exception:
+                        pass
+                    worker.proc.join(timeout=10.0)
+                    if worker.proc.is_alive():
+                        worker.proc.terminate()
+                        worker.proc.join(timeout=5.0)
+                    self._restart_worker(worker)
+        finally:
+            self._restarting = False
+
+    # -- routing (the preserved MetranService surface) -------------------
+    def update(self, model_id: str, new_obs):
+        """Route to the writer's serialized update dispatch; the
+        returned posterior crossed the socket as host numpy."""
+        return self.writer.call(
+            "update", {"model_id": model_id, "new_obs": new_obs}
+        )
+
+    def forecast(self, model_id: str, steps: int):
+        """Route to a read worker (round-robin); a TRANSPORT failure
+        moves to the next worker and finally the writer — zero failed
+        reads under worker death.  Application exceptions re-raise
+        unchanged (retrying a breaker/deadline would change
+        semantics)."""
+        payload = {"model_id": model_id, "steps": int(steps)}
+        with self._lock:
+            workers = list(self._workers)
+            self._rr += 1
+            start = self._rr
+        for i in range(len(workers)):
+            worker = workers[(start + i) % len(workers)]
+            try:
+                return worker.client.call("forecast", payload)
+            except (ConnectionError, OSError, EOFError):
+                continue
+        return self.writer.call("forecast", payload)
+
+    def put(self, state, persist: bool = False):
+        return self.writer.call(
+            "put", {"state": state, "persist": persist}
+        )
+
+    def meta(self, model_id: str):
+        return self.writer.call("meta", {"model_id": model_id})
+
+    def flush(self):
+        return self.writer.call("flush")
+
+    def capacity_report(self) -> dict:
+        """The writer service's report — its ``cluster`` section is the
+        plane's writer-side view; this side grafts the frontend's
+        aggregate so one call answers for the whole topology."""
+        report = self.writer.call("capacity_report")
+        report["cluster"] = self.stats()
+        return report
+
+    def stats(self) -> dict:
+        stats = self.plane.stats(heartbeat_s=self.spec.heartbeat_s)
+        stats["workers"] = len(self._workers)
+        stats["restarts"] = self.restarts
+        stats["writer_alive"] = self.writer_alive()
+        return stats
+
+    def read_loop(self, model_ids, steps: int, iters: int) -> List[dict]:
+        """Fan the bench read loop over every worker concurrently; one
+        result dict per worker (the paired-throughput measurement
+        surface for ``bench.py --phase serve-cluster``)."""
+        payload = {"model_ids": list(model_ids), "steps": int(steps),
+                   "iters": int(iters)}
+        results: List[Optional[dict]] = [None] * len(self._workers)
+
+        def _one(i: int, worker: _Worker) -> None:
+            results[i] = worker.client.call("read_loop", payload)
+
+        threads = [
+            threading.Thread(target=_one, args=(i, w), daemon=True)
+            for i, w in enumerate(list(self._workers))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return [r for r in results if r is not None]
+
+    # -- observability ---------------------------------------------------
+    def _plane_stat(self, fn: Callable, default: float = 0.0) -> float:
+        """Scrape-time plane accessor for gauge callbacks: resolve
+        ``self.plane`` on every call — ``restart_writer`` swaps the
+        plane when the recovered writer allocates a fresh segment, and
+        a closure over the dead one would fail every scrape after the
+        bounce (released memoryview)."""
+        plane = self.plane
+        if plane is None:
+            return default
+        try:
+            return float(fn(plane))
+        except (ValueError, OSError):  # mid-bounce: segment released
+            return default
+
+    def _register_metrics(self) -> None:
+        if self.obs.metrics is None:
+            return
+        m = self.obs.metrics
+        grace = 3.0 * self.spec.heartbeat_s
+        m.gauge(
+            "metran_serve_cluster_workers_live",
+            "read workers with a fresh heartbeat in the shared plane's "
+            "worker table (the fleet's live read capacity)",
+            callback=lambda: self._plane_stat(
+                lambda p: p.workers_live(grace)
+            ),
+        )
+        m.gauge(
+            "metran_serve_cluster_reader_hits_total",
+            "forecast reads served straight from the shared-memory "
+            "snapshot plane across all read workers (monotone; "
+            "aggregated by one shared-memory scan at scrape time)",
+            callback=lambda: self._plane_stat(
+                lambda p: p.reader_counts()["hits"]
+            ),
+        )
+        m.gauge(
+            "metran_serve_cluster_reader_stale_total",
+            "plane reads that exhausted their seqlock retries under "
+            "write contention and degraded to fallthrough (monotone)",
+            callback=lambda: self._plane_stat(
+                lambda p: p.reader_counts()["stale"]
+            ),
+        )
+        m.gauge(
+            "metran_serve_cluster_fallbacks_total",
+            "worker reads that fell through to the writer's compute "
+            "path on miss/stale (monotone; the cluster's degraded-"
+            "read counter)",
+            callback=lambda: self._plane_stat(
+                lambda p: p.reader_counts()["fallbacks"]
+            ),
+        )
+
+    # -- lifecycle -------------------------------------------------------
+    def close(self) -> None:
+        """Shut down workers, then the writer (whose service close
+        unlinks the plane), then local views and the rendezvous dir."""
+        self._closed = True
+        for worker in list(self._workers):
+            try:
+                worker.client.call("shutdown")
+            except Exception:
+                pass
+            worker.client.close()
+        for worker in list(self._workers):
+            worker.proc.join(timeout=10.0)
+            if worker.proc.is_alive():
+                worker.proc.terminate()
+                worker.proc.join(timeout=5.0)
+        self._workers = []
+        plane_name = self.plane.name if self.plane is not None else None
+        if self.writer is not None:
+            try:
+                self.writer.call("shutdown")
+            except Exception:
+                pass
+            self.writer.close()
+            self.writer = None
+        if self._writer_proc is not None:
+            self._writer_proc.join(timeout=15.0)
+            if self._writer_proc.is_alive():
+                self._writer_proc.terminate()
+                self._writer_proc.join(timeout=5.0)
+            self._writer_proc = None
+        if self.plane is not None:
+            self.plane.close(unlink=False)
+            self.plane = None
+        if plane_name is not None:
+            # a SIGKILLed writer never unlinked its segment; reap it
+            # so a crashed cluster cannot leak /dev/shm across runs
+            try:
+                leaked = SnapshotPlane.attach(plane_name)
+            except (FileNotFoundError, ValueError):
+                pass
+            else:
+                leaked.close(unlink=True)
+        if self._owns_obs and self.obs.events is not None:
+            try:
+                self.obs.events.close()
+            except Exception:
+                pass
+        if self._owns_socket_dir:
+            shutil.rmtree(self.socket_dir, ignore_errors=True)
+
+    def __enter__(self) -> "ClusterFrontend":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

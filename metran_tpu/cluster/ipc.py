@@ -1,0 +1,194 @@
+"""Local-socket RPC for the cluster plane (unix domain sockets).
+
+Deliberately minimal: the hot read path never touches a socket (it is
+a shared-memory ``SnapshotPlane`` probe) — RPC carries only the cold
+paths: update routing to the single writer, reader fallthrough on
+miss/stale, admin (register/meta/stats), and the bench driver's
+``read_loop``.  Framing is a 4-byte little-endian length prefix over a
+pickled ``(op, payload)`` request and a pickled ``(ok, value)``
+response; errors cross the boundary as the raised exception object, so
+a frontend re-raises the writer's actual ``BreakerOpen`` /
+``DeadlineExceeded`` / ``ValueError`` and the single-process semantics
+survive the process split (tests/test_cluster.py parity suite).
+
+Pickle is acceptable HERE and only here: both endpoints are processes
+of the same trusted service on the same host, rendezvousing on a
+0700-mode private socket directory — this is an IPC seam, not a
+network protocol.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from logging import getLogger
+from typing import Any, Callable, Optional, Tuple
+
+logger = getLogger(__name__)
+
+__all__ = ["RpcServer", "RpcClient", "rpc_call"]
+
+_LEN = struct.Struct("<I")
+#: sanity ceiling on one frame (a corrupt length prefix must not
+#: trigger a multi-GB allocation)
+MAX_FRAME = 256 * 1024 * 1024
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        buf += chunk
+    return bytes(buf)
+
+
+def _send_frame(sock: socket.socket, obj: Any) -> None:
+    blob = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    if len(blob) > MAX_FRAME:
+        raise ValueError(f"frame of {len(blob)} bytes exceeds MAX_FRAME")
+    sock.sendall(_LEN.pack(len(blob)) + blob)
+
+
+def _recv_frame(sock: socket.socket) -> Any:
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if n > MAX_FRAME:
+        raise ValueError(f"frame of {n} bytes exceeds MAX_FRAME")
+    return pickle.loads(_recv_exact(sock, n))
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:
+        # one connection, many requests: clients hold the socket open
+        while True:
+            try:
+                op, payload = _recv_frame(self.request)
+            except (ConnectionError, EOFError, OSError):
+                return
+            try:
+                value = self.server.dispatch(op, payload)  # type: ignore
+                reply = (True, value)
+            except BaseException as exc:  # noqa: BLE001 - crossed to caller
+                if isinstance(exc, (KeyboardInterrupt, SystemExit)):
+                    raise
+                reply = (False, exc)
+            try:
+                _send_frame(self.request, reply)
+            except (ConnectionError, OSError):
+                return
+
+
+class _ThreadedUnixServer(
+    socketserver.ThreadingMixIn, socketserver.UnixStreamServer
+):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class RpcServer:
+    """Serve ``(op, payload)`` requests on a unix socket.
+
+    ``dispatch(op, payload)`` routes into the handler table; unknown
+    ops raise (and the error crosses back to the caller).  Runs its
+    accept loop on a daemon thread — ``close()`` shuts it down and
+    unlinks the socket path.
+    """
+
+    def __init__(self, path: str,
+                 handlers: dict[str, Callable[[Any], Any]]):
+        self.path = path
+        self._handlers = dict(handlers)
+        if os.path.exists(path):
+            os.unlink(path)
+        self._server = _ThreadedUnixServer(path, _Handler)
+        self._server.dispatch = self.dispatch  # type: ignore[attr-defined]
+        os.chmod(path, 0o600)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            kwargs={"poll_interval": 0.1},
+            name=f"metran-rpc[{os.path.basename(path)}]",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def dispatch(self, op: str, payload: Any) -> Any:
+        handler = self._handlers.get(op)
+        if handler is None:
+            raise ValueError(f"unknown rpc op {op!r}")
+        return handler(payload)
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5.0)
+        try:
+            os.unlink(self.path)
+        except FileNotFoundError:
+            pass
+
+
+class RpcClient:
+    """One persistent connection to an :class:`RpcServer`.
+
+    Thread-safe (one in-flight request at a time under a lock — the
+    cold paths this carries are not throughput-critical).  A broken
+    connection reconnects once per call; a second failure raises to
+    the caller, whose fallback policy (frontend: next worker, then the
+    writer) decides what happens next.
+    """
+
+    def __init__(self, path: str, timeout_s: float = 30.0):
+        self.path = path
+        self.timeout_s = timeout_s
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+
+    def _connect(self) -> socket.socket:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(self.timeout_s)
+        sock.connect(self.path)
+        return sock
+
+    def call(self, op: str, payload: Any = None) -> Any:
+        with self._lock:
+            for attempt in (0, 1):
+                if self._sock is None:
+                    self._sock = self._connect()
+                try:
+                    _send_frame(self._sock, (op, payload))
+                    ok, value = _recv_frame(self._sock)
+                    break
+                except (ConnectionError, OSError, EOFError):
+                    self._close_locked()
+                    if attempt:
+                        raise
+        if not ok:
+            raise value
+        return value
+
+    def _close_locked(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def close(self) -> None:
+        with self._lock:
+            self._close_locked()
+
+
+def rpc_call(path: str, op: str, payload: Any = None,
+             timeout_s: float = 30.0) -> Any:
+    """One-shot convenience call (connect, request, close)."""
+    client = RpcClient(path, timeout_s=timeout_s)
+    try:
+        return client.call(op, payload)
+    finally:
+        client.close()

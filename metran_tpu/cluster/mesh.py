@@ -1,0 +1,232 @@
+"""Multi-host arena mesh: ``jax.distributed`` batch sharding.
+
+The single-process :class:`~metran_tpu.serve.state.StateArena` shards
+its bucket leaves along the batch axis over a *local* device mesh
+(``metran_tpu.parallel.mesh``, the virtual 8-CPU-device test topology
+or one host's chips).  This module extends that same batch-axis
+``NamedSharding`` across **processes**: a ``jax.distributed``-
+initialized mesh spans every participating host's devices, each leaf
+is assembled with ``jax.make_array_from_callback`` so every process
+materializes only its addressable rows, and the batched serve kernels
+(:func:`~metran_tpu.serve.engine.update_bucket` /
+:func:`~metran_tpu.serve.engine.forecast_bucket`) run unchanged —
+the fleet axis is embarrassingly parallel, so GSPMD inserts no
+runtime collectives and per-row results are **bit-identical** to the
+unsharded single-process kernels at f64 (tests/test_cluster.py,
+the same contract the virtual-mesh arena pins in tests/test_arena.py).
+
+The module doubles as its own subprocess entry point: the 2-process
+bit-identity test launches ``python -m metran_tpu.cluster.mesh`` once
+per process (gloo CPU collectives), each builds the SAME seeded
+fixture, runs the sharded kernels over the distributed mesh, and
+writes its local batch rows for the parent to reassemble and compare
+against the unsharded reference.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from logging import getLogger
+from typing import Optional
+
+import numpy as np
+
+logger = getLogger(__name__)
+
+__all__ = [
+    "init_distributed",
+    "global_batch_mesh",
+    "shard_batch_tree",
+    "local_batch_rows",
+]
+
+
+def init_distributed(coordinator_address: str, num_processes: int,
+                     process_id: int,
+                     initialization_timeout_s: float = 60.0) -> None:
+    """Join the ``jax.distributed`` mesh (idempotent per process).
+
+    On CPU backends the cross-process collective transport defaults
+    unset; we pin ``gloo`` (the one the wheel ships) BEFORE backend
+    init so a CPU pod behaves like the TPU pod the paper targets.
+    Must run before any other jax API touches the backend.
+    """
+    import jax
+
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # pragma: no cover - knob renamed/absent
+        logger.debug("gloo collectives knob unavailable", exc_info=True)
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        initialization_timeout=int(initialization_timeout_s),
+    )
+
+
+def global_batch_mesh():
+    """A 1D batch-axis mesh over EVERY process's devices (global view;
+    call after :func:`init_distributed`)."""
+    import jax
+
+    from ..parallel.mesh import make_mesh
+
+    return make_mesh(devices=jax.devices())
+
+
+def shard_batch_tree(mesh, tree, batch: Optional[int] = None):
+    """Shard every leaf of a host pytree along axis 0 over ``mesh``.
+
+    Uses ``jax.make_array_from_callback`` so each process materializes
+    only the rows its devices own — the multi-process-safe assembly
+    (a plain ``device_put`` of a global array assumes single
+    controller).  Leaves whose leading dimension is not the batch size
+    (``batch``, default the first leaf's) are replicated instead —
+    the same rule the arena applies to its scalar sidecars.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ..parallel.mesh import BATCH_AXIS, batch_sharding
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    if batch is None:
+        batch = int(np.shape(leaves[0])[0]) if leaves else 0
+
+    def _put(leaf):
+        arr = np.asarray(leaf)
+        if arr.ndim and arr.shape[0] == batch:
+            sharding = batch_sharding(mesh, arr.ndim)
+        else:
+            sharding = NamedSharding(mesh, PartitionSpec())
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx]
+        )
+
+    return jax.tree_util.tree_map(_put, tree)
+
+
+def local_batch_rows(arr) -> tuple:
+    """This process's addressable rows of a batch-sharded global array
+    as ``(row_indices, values)`` — what a process contributes when the
+    parent reassembles the global result."""
+    rows = []
+    vals = []
+    for shard in arr.addressable_shards:
+        idx = shard.index[0]
+        start = idx.start or 0
+        data = np.asarray(shard.data)
+        rows.extend(range(start, start + data.shape[0]))
+        vals.append(data)
+    order = np.argsort(np.asarray(rows))
+    stacked = np.concatenate(vals, axis=0)
+    return np.asarray(rows)[order], stacked[order]
+
+
+# ----------------------------------------------------------------------
+# subprocess selftest entry (2-process bit-identity harness)
+# ----------------------------------------------------------------------
+def _selftest_fixture(seed: int, n_models: int, n: int, kf: int, t: int):
+    """Deterministic same-in-every-process bucket fixture (the
+    test_readpath _make_states recipe, seeded)."""
+    from ..ops import dfm_statespace, kalman_filter
+    from ..serve.state import PosteriorState
+
+    rng = np.random.default_rng(seed)
+    states = []
+    for i in range(n_models):
+        loadings = (
+            rng.uniform(0.3, 0.8, (n, kf)) / np.sqrt(kf)
+        ).astype(np.float64)
+        a_s = rng.uniform(5.0, 40.0, n)
+        a_c = rng.uniform(10.0, 60.0, kf)
+        ss = dfm_statespace(a_s, a_c, loadings, 1.0)
+        y = rng.normal(size=(t, n))
+        mask = rng.uniform(size=(t, n)) > 0.3
+        y = np.where(mask, y, 0.0)
+        res = kalman_filter(ss, y, mask, engine="joint")
+        states.append(PosteriorState(
+            model_id=f"m{i}", version=0, t_seen=t,
+            mean=np.asarray(res.mean_f[-1], np.float64),
+            cov=np.asarray(res.cov_f[-1], np.float64),
+            params=np.concatenate([a_s, a_c]),
+            loadings=loadings, dt=1.0,
+            scaler_mean=rng.normal(size=n),
+            scaler_std=rng.uniform(0.5, 2.0, n),
+            names=tuple(f"s{j}" for j in range(n)),
+        ))
+    y_new = rng.normal(size=(n_models, 1, n))
+    mask_new = rng.uniform(size=(n_models, 1, n)) > 0.2
+    return states, y_new, mask_new
+
+
+def selftest_compute(states, y_new, mask_new, steps: int, mesh=None):
+    """The serve kernels the arena dispatches — batched update then
+    forecast — over ``mesh`` when given (leaves batch-sharded), else
+    unsharded.  Returns host f64 ``(mean, cov, fmeans, fvars)``."""
+    from ..serve.engine import forecast_bucket, stack_bucket, \
+        update_bucket
+
+    n = states[0].n_series
+    s_dim = states[0].mean.shape[0]
+    batch = stack_bucket(states, (n, s_dim), dtype=np.float64)
+    y = np.asarray(y_new, np.float64)
+    m = np.asarray(mask_new, bool)
+    ss, mean, cov = batch.ss, batch.mean, batch.cov
+    if mesh is not None:
+        ss = shard_batch_tree(mesh, ss, batch=len(states))
+        mean, cov, y, m = (
+            shard_batch_tree(mesh, leaf, batch=len(states))
+            for leaf in (mean, cov, y, m)
+        )
+    # (mean, cov, sigma, detf) — the sidecars are single-process
+    # service concerns, not part of the sharding contract under test
+    new_mean, new_cov = update_bucket(ss, mean, cov, y, m)[:2]
+    fmeans, fvars = forecast_bucket(ss, new_mean, new_cov, steps)
+    return new_mean, new_cov, fmeans, fvars
+
+
+def _selftest_main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="cluster mesh bit-identity selftest (one process "
+        "of a jax.distributed pod)"
+    )
+    parser.add_argument("--coordinator", required=True)
+    parser.add_argument("--num-processes", type=int, required=True)
+    parser.add_argument("--process-id", type=int, required=True)
+    parser.add_argument("--out", required=True)
+    parser.add_argument("--seed", type=int, default=7)
+    parser.add_argument("--n-models", type=int, default=4)
+    parser.add_argument("--n", type=int, default=5)
+    parser.add_argument("--kf", type=int, default=1)
+    parser.add_argument("--t", type=int, default=40)
+    parser.add_argument("--steps", type=int, default=5)
+    args = parser.parse_args(argv)
+
+    init_distributed(
+        args.coordinator, args.num_processes, args.process_id
+    )
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    mesh = global_batch_mesh()
+    states, y_new, mask_new = _selftest_fixture(
+        args.seed, args.n_models, args.n, args.kf, args.t
+    )
+    out = selftest_compute(states, y_new, mask_new, args.steps, mesh=mesh)
+    payload = {}
+    for name, arr in zip(("mean", "cov", "fmeans", "fvars"), out):
+        rows, vals = local_batch_rows(arr)
+        payload[f"{name}_rows"] = rows
+        payload[f"{name}"] = vals
+    # the .npz suffix keeps np.savez from appending its own
+    tmp = f"{args.out}.{os.getpid()}.tmp.npz"
+    np.savez(tmp, **payload)
+    os.replace(tmp, args.out)
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - subprocess entry
+    raise SystemExit(_selftest_main())

@@ -1,0 +1,727 @@
+"""Shared-memory snapshot plane: seqlock-versioned cross-process reads.
+
+The single-process read path (:mod:`metran_tpu.serve.readpath`) serves
+forecast hits from immutable host-memory snapshots — but those
+snapshots live in ONE interpreter, so read capacity is capped by one
+GIL however many cores the host has.  This module is the cross-process
+half: the writer process publishes every committed
+:class:`~metran_tpu.serve.readpath.SnapshotEntry` into a
+``multiprocessing.shared_memory`` segment laid out as an open-addressed
+slot table, and N read-worker processes (:mod:`metran_tpu.cluster.
+worker`) map the same segment and serve hits with **zero writer locks,
+zero sockets and zero device traffic** — read capacity now scales with
+processes, not threads.
+
+Consistency is a classic **seqlock** per slot, not a lock:
+
+- the (single) writer bumps the slot's sequence word to an odd value,
+  writes the record (header, key, names, moment payload), then bumps
+  it even again;
+- a reader snapshots the sequence word, copies the record, and
+  re-reads the word: equal-and-even proves the copy is torn-free, odd
+  or changed means a concurrent write — retry, and after a bounded
+  number of attempts report a miss (the caller falls through to the
+  compute path, exactly like a cache miss — contention degrades to a
+  fallthrough, never a wrong answer).
+
+The protocol is safe on the strong-store-order hosts this plane
+targets (x86-64's TSO; the sequence word is an aligned 8-byte store,
+atomic on every platform CPython runs on).  Nothing here depends on
+the GIL — the two sequence reads bracket a byte-copy of the record, so
+a torn write is always detected by the second read.
+
+**WAL-anchored publication.**  The plane's header carries a monotone
+``commit_seq`` the writer bumps once per publish batch — the same
+group-commit boundary the durability plane's WAL frames are cut at —
+plus the writer's pid and a heartbeat stamp.  Readers learn writer
+liveness and publication progress from this one header; there is no
+second notification protocol (docs/concepts.md "Multi-process
+serving").  A worker table in the same segment gives every reader
+process a claimed row for its own heartbeat and hit/stale/miss/
+fallback counters, so the frontend aggregates fleet read telemetry
+with one shared-memory scan and no RPC.
+
+Capacity is fixed at creation (``ClusterSpec.shm_mb``): slots are
+sized for the configured horizon set and the widest padded series
+count, and :func:`plane_bytes` is the sizing contract
+``ClusterSpec.validate_layout`` enforces before a writer ever maps the
+segment.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import struct
+import time
+from logging import getLogger
+from multiprocessing import resource_tracker, shared_memory
+from typing import Dict, Iterable, Optional
+
+import numpy as np
+
+from ..serve.readpath import SnapshotEntry, contiguous_prefix, \
+    parse_horizons
+
+logger = getLogger(__name__)
+
+__all__ = [
+    "SnapshotPlane",
+    "plane_bytes",
+]
+
+#: layout magic + version: an attach to a segment some OTHER program
+#: created (or an older layout) must fail loudly, not serve garbage
+MAGIC = 0x4D54524E53504C31  # "MTRNSPL1"
+LAYOUT_VERSION = 1
+
+HEADER_BYTES = 256
+#: fixed worker-table capacity: readers claim rows, the frontend scans
+#: them.  64 rows is far past any same-host worker count (the point of
+#: workers is one per core).
+MAX_WORKERS = 64
+WORKER_ROW_BYTES = 128
+WORKERS_OFF = HEADER_BYTES
+SLOTS_OFF = WORKERS_OFF + MAX_WORKERS * WORKER_ROW_BYTES
+
+# header field offsets (all naturally aligned)
+_OFF_MAGIC = 0  # u64
+_OFF_LAYOUT = 8  # u32
+_OFF_NSLOTS = 12  # u32
+_OFF_SLOT_BYTES = 16  # u64
+_OFF_H = 24  # u32
+_OFF_NPAD = 28  # u32
+_OFF_PREFIX = 32  # u32
+_OFF_WAL = 36  # u32 (1 = writer has an armed WAL: commit_seq is
+#                     stamped at group-commit boundaries)
+_OFF_COMMIT_SEQ = 40  # u64, monotone publish-batch counter
+_OFF_WRITER_PID = 48  # u64
+_OFF_WRITER_STAMP = 56  # f64, epoch-seconds heartbeat
+
+_HEADER_STRUCT = struct.Struct("<QIIQIIIIQQd")
+
+# worker-row field offsets (relative to the row)
+_W_STATE = 0  # u32: 0 = free, 1 = claimed
+_W_PID = 8  # u64
+_W_BEAT = 16  # f64 epoch heartbeat
+_W_HITS = 24  # u64
+_W_STALE = 32  # u64
+_W_MISSES = 40  # u64
+_W_FALLBACKS = 48  # u64
+
+# slot record: fixed header, then key/names/payload regions
+_S_SEQ = 0  # u64 seqlock word
+_S_HASH = 8  # u64 stable key hash
+_S_KEYLEN = 16  # u32 (0 + hash==0: never used; 0 + hash!=0: tombstone)
+_S_NSERIES = 20  # u32
+_S_NAMESLEN = 24  # u32
+_S_VERSION = 32  # i64
+_S_PUBLISHED = 40  # f64
+SLOT_FIXED = 48
+KEY_BYTES = 64
+#: per-series budget for the '\0'-joined names blob; entries whose
+#: joined names exceed it publish without names (readers fall back to
+#: the compute path for those models) — counted, never silent
+NAME_BYTES_PER_SERIES = 32
+
+#: probe ceiling for open addressing: past this the table is treated
+#: as full for that key (publish drops, read misses)
+PROBE_LIMIT = 64
+#: seqlock read retries before a contended slot degrades to a miss
+READ_RETRIES = 16
+
+
+def _key_hash(model_id: str) -> int:
+    """Stable (cross-process) 63-bit key hash — ``hash()`` is salted
+    per interpreter and useless as a shared-memory rendezvous."""
+    digest = hashlib.blake2b(
+        model_id.encode("utf-8"), digest_size=8
+    ).digest()
+    h = int.from_bytes(digest, "little") & 0x7FFFFFFFFFFFFFFF
+    return h or 1  # 0 means "never used" in the slot table
+
+
+def _slot_bytes(h: int, n_pad_max: int) -> int:
+    names = NAME_BYTES_PER_SERIES * n_pad_max
+    payload = 2 * h * n_pad_max * 8
+    raw = SLOT_FIXED + KEY_BYTES + names + payload
+    return (raw + 63) & ~63  # 64-byte aligned slots
+
+
+def plane_bytes(horizons, n_pad_max: int, n_slots: int) -> int:
+    """Total segment size for a plane with this geometry — the sizing
+    contract :meth:`ClusterSpec.validate_layout` checks against
+    ``shm_mb`` before any segment is created."""
+    horizons = parse_horizons(horizons)
+    return SLOTS_OFF + int(n_slots) * _slot_bytes(
+        len(horizons), int(n_pad_max)
+    )
+
+
+def _attach_segment(name: str) -> shared_memory.SharedMemory:
+    """Attach without adopting ownership: Python 3.10's
+    ``resource_tracker`` registers every attach and unlinks the
+    segment when THAT process exits — which would tear the plane down
+    under every other process the moment one worker dies.  3.13 grew
+    ``track=False`` for exactly this; on older interpreters the
+    documented workaround is unregistering the attach-side handle."""
+    try:
+        return shared_memory.SharedMemory(name=name, track=False)
+    except TypeError:
+        pass
+    shm = shared_memory.SharedMemory(name=name)
+    try:
+        resource_tracker.unregister("/" + shm.name, "shared_memory")
+    except Exception:  # pragma: no cover - tracker internals drifted
+        logger.debug("resource_tracker unregister failed", exc_info=True)
+    return shm
+
+
+class SnapshotPlane:
+    """One mapped view of the shared snapshot segment.
+
+    Exactly one process constructs with :meth:`create` (the writer —
+    it owns the segment and the slot directory); every other process
+    :meth:`attach`\\ es read-only semantics (readers never write slots;
+    they may claim a worker row for heartbeat/counters).  ``read`` is
+    the whole reader hot path: a probe over the open-addressed table
+    with a seqlock-consistent copy per candidate slot.
+    """
+
+    def __init__(self, shm: shared_memory.SharedMemory, *,
+                 owner: bool, events=None):
+        self.shm = shm
+        self.owner = owner
+        self.events = events
+        buf = shm.buf
+        (magic, layout, n_slots, slot_bytes, h, n_pad, prefix, wal,
+         _seq, _pid, _stamp) = _HEADER_STRUCT.unpack_from(buf, 0)
+        if magic != MAGIC or layout != LAYOUT_VERSION:
+            raise ValueError(
+                f"shared segment {shm.name!r} is not a snapshot plane "
+                f"(magic {magic:#x}, layout {layout}); refusing to "
+                "serve from it"
+            )
+        self.n_slots = int(n_slots)
+        self.slot_bytes = int(slot_bytes)
+        self.h = int(h)
+        self.n_pad_max = int(n_pad)
+        self.prefix = int(prefix)
+        # whole-segment u64/f64 views; every aligned field is read and
+        # written through these (single 8-byte stores — atomic)
+        self._u64 = np.frombuffer(buf, dtype=np.uint64)
+        self._f64 = np.frombuffer(buf, dtype=np.float64)
+        self._i64 = np.frombuffer(buf, dtype=np.int64)
+        self._mv = memoryview(buf)
+        #: writer-side slot directory (model_id -> slot index); readers
+        #: probe instead
+        self._dir: Dict[str, int] = {}
+        #: reader-side hot caches.  ``_rcache`` remembers where a model
+        #: last resolved (offset, encoded key, hash) so steady-state
+        #: reads skip hashing and probing; the in-slot hash + key check
+        #: still runs on every read, so a reclaimed or tombstoned slot
+        #: self-invalidates back to a full probe.  ``_names_cache``
+        #: memoizes decoded names blobs (they change only when a model's
+        #: series set does).
+        self._rcache: Dict[str, tuple] = {}
+        self._names_cache: Dict[bytes, tuple] = {}
+        #: per-slot payload views (offset -> (means, variances)); the
+        #: mapping is fixed for the segment's lifetime, so the
+        #: frombuffer construction cost is paid once per slot.  Cleared
+        #: in :meth:`close` — cached views pin the exported buffer.
+        self._views: Dict[int, tuple] = {}
+        self._worker_row: Optional[int] = None
+        # unlocked telemetry, same contract as SnapshotStore's
+        self.publishes = 0
+        self.dropped = 0  # entries that could not land (table/names)
+
+    # -- construction ----------------------------------------------------
+    @classmethod
+    def create(cls, horizons, n_pad_max: int, n_slots: int,
+               shm_mb: float, name: Optional[str] = None,
+               events=None, wal_anchored: bool = False
+               ) -> "SnapshotPlane":
+        """Create and initialize the segment (writer side).
+
+        Raises ``ValueError`` when the requested geometry does not fit
+        ``shm_mb`` — the same check :meth:`ClusterSpec.validate_layout`
+        runs, enforced again here so a mis-wired caller cannot map a
+        plane its readers would overrun."""
+        horizons = parse_horizons(horizons)
+        if not horizons:
+            raise ValueError(
+                "a snapshot plane needs a non-empty horizon set "
+                "(METRAN_TPU_SERVE_HORIZONS)"
+            )
+        n_slots = int(n_slots)
+        n_pad_max = int(n_pad_max)
+        if n_slots < 1 or n_pad_max < 1:
+            raise ValueError(
+                f"plane geometry must be positive, got n_slots="
+                f"{n_slots}, n_pad_max={n_pad_max}"
+            )
+        total = plane_bytes(horizons, n_pad_max, n_slots)
+        budget = int(float(shm_mb) * 1024 * 1024)
+        if total > budget:
+            raise ValueError(
+                f"snapshot plane needs {total} bytes for {n_slots} "
+                f"slots x {len(horizons)} horizons x {n_pad_max} "
+                f"padded series, but shm_mb={shm_mb} allows only "
+                f"{budget}; raise METRAN_TPU_SERVE_CLUSTER_SHM_MB or "
+                "shrink the horizon set"
+            )
+        if name is None:
+            name = f"metran_snap_{os.getpid()}_{os.urandom(4).hex()}"
+        shm = shared_memory.SharedMemory(
+            name=name, create=True, size=total
+        )
+        shm.buf[:total] = b"\x00" * total
+        _HEADER_STRUCT.pack_into(
+            shm.buf, 0, MAGIC, LAYOUT_VERSION, n_slots,
+            _slot_bytes(len(horizons), n_pad_max), len(horizons),
+            n_pad_max, contiguous_prefix(horizons), int(wal_anchored),
+            0, os.getpid(), time.time(),
+        )
+        return cls(shm, owner=True, events=events)
+
+    @classmethod
+    def attach(cls, name: str, events=None) -> "SnapshotPlane":
+        """Map an existing plane (reader side)."""
+        return cls(_attach_segment(name), owner=False, events=events)
+
+    @property
+    def name(self) -> str:
+        return self.shm.name
+
+    # -- header fields ---------------------------------------------------
+    def _u(self, off: int) -> int:
+        return int(self._u64[off // 8])
+
+    def _set_u(self, off: int, value: int) -> None:
+        self._u64[off // 8] = np.uint64(value)
+
+    @property
+    def commit_seq(self) -> int:
+        return self._u(_OFF_COMMIT_SEQ)
+
+    @property
+    def writer_pid(self) -> int:
+        return self._u(_OFF_WRITER_PID)
+
+    @property
+    def wal_anchored(self) -> bool:
+        return bool(struct.unpack_from("<I", self._mv, _OFF_WAL)[0])
+
+    def writer_beat(self) -> None:
+        """Stamp writer liveness (called per publish batch AND from the
+        writer's idle heartbeat thread)."""
+        self._f64[_OFF_WRITER_STAMP // 8] = time.time()
+        self._set_u(_OFF_WRITER_PID, os.getpid())
+
+    def writer_age_s(self) -> float:
+        """Seconds since the writer last stamped the header."""
+        return max(
+            time.time() - float(self._f64[_OFF_WRITER_STAMP // 8]), 0.0
+        )
+
+    def writer_alive(self, max_age_s: float) -> bool:
+        """Liveness as readers judge it: a recent heartbeat, or a
+        writer pid that still exists (a busy writer mid-dispatch may
+        miss a beat; a dead one cannot answer ``kill -0``)."""
+        if self.writer_age_s() <= max_age_s:
+            return True
+        pid = self.writer_pid
+        if pid <= 0:
+            return False
+        try:
+            os.kill(pid, 0)
+        except OSError:
+            return False
+        return True
+
+    # -- worker table ----------------------------------------------------
+    def _wrow(self, idx: int) -> int:
+        return WORKERS_OFF + int(idx) * WORKER_ROW_BYTES
+
+    def claim_worker(self) -> int:
+        """Claim a worker-table row for this process (heartbeat +
+        counters); returns the row index.  Rows whose pid is gone are
+        reclaimed, so restarts do not leak the table."""
+        for idx in range(MAX_WORKERS):
+            row = self._wrow(idx)
+            state = struct.unpack_from("<I", self._mv, row + _W_STATE)[0]
+            if state:
+                pid = self._u(row + _W_PID)
+                alive = False
+                if pid > 0:
+                    try:
+                        os.kill(pid, 0)
+                        alive = True
+                    except OSError:
+                        alive = False
+                if alive and pid != os.getpid():
+                    continue
+            # (re)claim: zero the counters, stamp pid + beat, mark live
+            self._mv[row:row + WORKER_ROW_BYTES] = (
+                b"\x00" * WORKER_ROW_BYTES
+            )
+            self._set_u(row + _W_PID, os.getpid())
+            self._f64[(row + _W_BEAT) // 8] = time.time()
+            struct.pack_into("<I", self._mv, row + _W_STATE, 1)
+            self._worker_row = idx
+            return idx
+        raise RuntimeError(
+            f"worker table full ({MAX_WORKERS} rows) — more reader "
+            "processes than the plane supports"
+        )
+
+    def release_worker(self) -> None:
+        """Mark this process's row free (clean worker shutdown)."""
+        if self._worker_row is None:
+            return
+        row = self._wrow(self._worker_row)
+        struct.pack_into("<I", self._mv, row + _W_STATE, 0)
+        self._worker_row = None
+
+    def worker_beat(self) -> None:
+        if self._worker_row is not None:
+            row = self._wrow(self._worker_row)
+            self._f64[(row + _W_BEAT) // 8] = time.time()
+
+    def _count(self, field_off: int, n: int = 1) -> None:
+        if self._worker_row is not None:
+            row = self._wrow(self._worker_row)
+            self._u64[(row + field_off) // 8] += np.uint64(n)
+
+    def count_fallback(self, n: int = 1) -> None:
+        """Book a read that fell through to the writer's compute path
+        (miss/stale/contended) — the cluster's degraded-read counter."""
+        self._count(_W_FALLBACKS, n)
+
+    def workers_live(self, max_age_s: float) -> int:
+        """Claimed rows with a fresh heartbeat or a live pid."""
+        now = time.time()
+        live = 0
+        for idx in range(MAX_WORKERS):
+            row = self._wrow(idx)
+            if not struct.unpack_from("<I", self._mv, row + _W_STATE)[0]:
+                continue
+            beat = float(self._f64[(row + _W_BEAT) // 8])
+            if now - beat <= max_age_s:
+                live += 1
+                continue
+            pid = self._u(row + _W_PID)
+            try:
+                os.kill(pid, 0)
+                live += 1
+            except OSError:
+                pass
+        return live
+
+    def reader_counts(self) -> Dict[str, int]:
+        """Aggregate hit/stale/miss/fallback counters across every
+        claimed worker row (one shared-memory scan, no RPC)."""
+        out = {"hits": 0, "stale": 0, "misses": 0, "fallbacks": 0}
+        for idx in range(MAX_WORKERS):
+            row = self._wrow(idx)
+            if not struct.unpack_from("<I", self._mv, row + _W_STATE)[0]:
+                continue
+            out["hits"] += self._u(row + _W_HITS)
+            out["stale"] += self._u(row + _W_STALE)
+            out["misses"] += self._u(row + _W_MISSES)
+            out["fallbacks"] += self._u(row + _W_FALLBACKS)
+        return out
+
+    # -- slot geometry ---------------------------------------------------
+    def _slot_off(self, idx: int) -> int:
+        return SLOTS_OFF + (idx % self.n_slots) * self.slot_bytes
+
+    def _payload_views(self, off: int):
+        views = self._views.get(off)
+        if views is not None:
+            return views
+        names_bytes = NAME_BYTES_PER_SERIES * self.n_pad_max
+        base = off + SLOT_FIXED + KEY_BYTES + names_bytes
+        n = self.h * self.n_pad_max
+        means = np.frombuffer(
+            self.shm.buf, dtype=np.float64, count=n, offset=base
+        ).reshape(self.h, self.n_pad_max)
+        variances = np.frombuffer(
+            self.shm.buf, dtype=np.float64, count=n, offset=base + 8 * n
+        ).reshape(self.h, self.n_pad_max)
+        self._views[off] = (means, variances)
+        return means, variances
+
+    # -- write (single writer process) -----------------------------------
+    def publish_entries(self, entries: Iterable[SnapshotEntry],
+                        commit_seq: Optional[int] = None) -> int:
+        """Publish one batch of committed entries into the slot table
+        (the :class:`~metran_tpu.serve.readpath.SnapshotStore` mirror
+        sink).  Bumps ``commit_seq`` once per non-empty batch — the
+        cross-process commit notification — and stamps the writer
+        heartbeat.  Returns entries landed; entries that cannot land
+        (table full past the probe limit, names blob over budget,
+        series count over the plane's pad width) are dropped and
+        counted, a capacity degradation that reads fall through on —
+        never a torn or wrong answer."""
+        landed = 0
+        for entry in entries:
+            if self._publish_one(entry):
+                landed += 1
+            else:
+                self.dropped += 1
+        if landed:
+            self.publishes += 1
+            if commit_seq is None:
+                commit_seq = self.commit_seq + 1
+            self._set_u(_OFF_COMMIT_SEQ, commit_seq)
+            self.writer_beat()
+            if self.events is not None:
+                self.events.emit(
+                    "snapshot_plane_publish",
+                    fault_point="cluster.snapplane",
+                    models=landed, commit_seq=int(commit_seq),
+                )
+        return landed
+
+    def _claim_slot(self, model_id: str, key_hash: int) -> Optional[int]:
+        idx = self._dir.get(model_id)
+        if idx is not None:
+            return idx
+        tomb = None
+        for i in range(PROBE_LIMIT):
+            idx = (key_hash + i) % self.n_slots
+            off = self._slot_off(idx)
+            slot_hash = self._u(off + _S_HASH)
+            key_len = struct.unpack_from(
+                "<I", self._mv, off + _S_KEYLEN
+            )[0]
+            if slot_hash == 0:  # never used: claimable, probe ends
+                self._dir[model_id] = idx if tomb is None else tomb
+                return self._dir[model_id]
+            if key_len == 0:  # tombstone: remember, keep probing
+                if tomb is None:
+                    tomb = idx
+                continue
+            if slot_hash == key_hash:
+                key = bytes(
+                    self._mv[off + SLOT_FIXED:off + SLOT_FIXED + key_len]
+                )
+                if key.decode("utf-8", "replace") == model_id:
+                    self._dir[model_id] = idx
+                    return idx
+        if tomb is not None:
+            self._dir[model_id] = tomb
+            return tomb
+        return None
+
+    def _publish_one(self, entry: SnapshotEntry) -> bool:
+        model_id = entry.model_id
+        key = model_id.encode("utf-8")
+        n = int(entry.means.shape[-1])
+        h = int(entry.means.shape[0])
+        names_blob = "\x00".join(entry.names).encode("utf-8")
+        if (
+            len(key) > KEY_BYTES
+            or n > self.n_pad_max
+            or h > self.h
+            or len(names_blob) > NAME_BYTES_PER_SERIES * self.n_pad_max
+        ):
+            return False
+        key_hash = _key_hash(model_id)
+        idx = self._claim_slot(model_id, key_hash)
+        if idx is None:
+            return False
+        off = self._slot_off(idx)
+        seq_i = (off + _S_SEQ) // 8
+        seq0 = int(self._u64[seq_i])
+        # seqlock write: odd while the record is inconsistent
+        self._u64[seq_i] = np.uint64(seq0 + 1)
+        struct.pack_into(
+            "<QIII", self._mv, off + _S_HASH,
+            key_hash, len(key), n, len(names_blob),
+        )
+        self._i64[(off + _S_VERSION) // 8] = np.int64(entry.version)
+        self._f64[(off + _S_PUBLISHED) // 8] = float(entry.published_at)
+        self._mv[off + SLOT_FIXED:off + SLOT_FIXED + len(key)] = key
+        names_off = off + SLOT_FIXED + KEY_BYTES
+        self._mv[names_off:names_off + len(names_blob)] = names_blob
+        means, variances = self._payload_views(off)
+        means[:h, :n] = np.asarray(entry.means, np.float64)
+        variances[:h, :n] = np.asarray(entry.variances, np.float64)
+        self._u64[seq_i] = np.uint64(seq0 + 2)
+        return True
+
+    def forget(self, model_id: str) -> None:
+        """Tombstone a model's slot (removed from service); later
+        probes skip it, later claims reuse it."""
+        key_hash = _key_hash(model_id)
+        idx = self._claim_slot(model_id, key_hash)
+        if idx is None:
+            return
+        off = self._slot_off(idx)
+        seq_i = (off + _S_SEQ) // 8
+        seq0 = int(self._u64[seq_i])
+        self._u64[seq_i] = np.uint64(seq0 + 1)
+        struct.pack_into("<I", self._mv, off + _S_KEYLEN, 0)
+        self._u64[seq_i] = np.uint64(seq0 + 2)
+        self._dir.pop(model_id, None)
+        self._rcache.pop(model_id, None)
+
+    # -- read (the cross-process hot path) -------------------------------
+    def read(self, model_id: str,
+             steps: int) -> Optional[SnapshotEntry]:
+        """Seqlock-consistent read of the model's published entry,
+        ``None`` on miss/contention/uncovered-steps (the caller falls
+        through to the compute path).  The returned entry's arrays are
+        COPIES — a reader must never hold views into slots the writer
+        re-publishes into."""
+        if steps < 1 or steps > self.prefix:
+            self._count(_W_MISSES)
+            return None
+        cached = self._rcache.get(model_id)
+        if cached is not None:
+            off, key, key_hash = cached
+            got = self._read_slot(off, key, key_hash, steps, model_id)
+            if isinstance(got, SnapshotEntry):
+                self._count(_W_HITS)
+                return got
+            if got == "contended":
+                self._count(_W_MISSES)
+                return None
+            del self._rcache[model_id]  # slot moved/reclaimed: reprobe
+        key = model_id.encode("utf-8")
+        key_hash = _key_hash(model_id)
+        for i in range(PROBE_LIMIT):
+            off = self._slot_off(key_hash + i)
+            got = self._read_slot(off, key, key_hash, steps, model_id)
+            if got == "empty":
+                break
+            if got is None or got == "tombstone":
+                continue
+            if got == "contended":
+                # bounded retries exhausted inside _read_slot: degrade
+                # to a miss rather than spin under a write storm
+                break
+            self._rcache[model_id] = (off, key, key_hash)
+            self._count(_W_HITS)
+            return got
+        self._count(_W_MISSES)
+        return None
+
+    def _read_slot(self, off: int, key: bytes, key_hash: int,
+                   steps: int, model_id: Optional[str] = None):
+        seq_i = (off + _S_SEQ) // 8
+        for _ in range(READ_RETRIES):
+            s1 = int(self._u64[seq_i])
+            if s1 & 1:
+                continue
+            slot_hash, key_len, n, names_len = struct.unpack_from(
+                "<QIII", self._mv, off + _S_HASH
+            )
+            if slot_hash == 0:
+                return "empty" if int(self._u64[seq_i]) == s1 else None
+            if slot_hash != key_hash:
+                return None  # other key: probe on (hash is stable)
+            if key_len == 0:
+                return (
+                    "tombstone" if int(self._u64[seq_i]) == s1 else None
+                )
+            stored = bytes(
+                self._mv[off + SLOT_FIXED:off + SLOT_FIXED + key_len]
+            )
+            version = int(self._i64[(off + _S_VERSION) // 8])
+            published = float(self._f64[(off + _S_PUBLISHED) // 8])
+            names_off = off + SLOT_FIXED + KEY_BYTES
+            names_blob = bytes(
+                self._mv[names_off:names_off + names_len]
+            )
+            means_v, vars_v = self._payload_views(off)
+            means = np.array(means_v[:steps, :n])
+            variances = np.array(vars_v[:steps, :n])
+            if int(self._u64[seq_i]) != s1:
+                continue  # torn copy detected: retry
+            if stored != key:
+                return None
+            if names_len:
+                names = self._names_cache.get(names_blob)
+                if names is None:
+                    names = tuple(
+                        names_blob.decode("utf-8", "replace")
+                        .split("\x00")
+                    )
+                    if len(self._names_cache) < 4096:  # bounded memo
+                        self._names_cache[names_blob] = names
+            else:
+                names = tuple(f"s{j}" for j in range(n))
+            return SnapshotEntry(
+                model_id=(
+                    key.decode("utf-8") if model_id is None
+                    else model_id
+                ),
+                version=version,
+                means=means, variances=variances, names=names,
+                published_at=published,
+            )
+        self._count(_W_STALE)
+        return "contended"
+
+    # -- introspection ---------------------------------------------------
+    def entries(self) -> int:
+        """Live (non-tombstoned) slots — an O(n_slots) scan, for
+        telemetry only."""
+        count = 0
+        for idx in range(self.n_slots):
+            off = self._slot_off(idx)
+            slot_hash, key_len = struct.unpack_from(
+                "<QI", self._mv, off + _S_HASH
+            )
+            if slot_hash and key_len:
+                count += 1
+        return count
+
+    def stats(self, heartbeat_s: float = 2.0) -> Dict[str, object]:
+        counts = self.reader_counts()
+        return {
+            "commit_seq": self.commit_seq,
+            "writer_pid": self.writer_pid,
+            "writer_age_s": round(self.writer_age_s(), 3),
+            "workers_live": self.workers_live(3.0 * heartbeat_s),
+            "entries": self.entries(),
+            "publishes": self.publishes,
+            "dropped": self.dropped,
+            **{f"reader_{k}": v for k, v in counts.items()},
+        }
+
+    # -- lifecycle -------------------------------------------------------
+    def close(self, unlink: Optional[bool] = None) -> None:
+        """Drop this mapping; the owner also unlinks the segment (pass
+        ``unlink=False`` to keep it — e.g. a writer handing off to a
+        recovery successor)."""
+        self.release_worker()
+        # numpy views pin the exported buffer; drop them before close
+        self._u64 = self._f64 = self._i64 = None
+        self._views.clear()
+        self._mv.release()
+        try:
+            self.shm.close()
+        except OSError:  # pragma: no cover - already closed
+            pass
+        if unlink is None:
+            unlink = self.owner
+        if unlink:
+            # re-register first (idempotent set add): when a test
+            # creates AND attaches in one process, the attach-side
+            # unregister in _attach_segment stripped the registration
+            # unlink() is about to remove, and the tracker logs a
+            # KeyError for the unmatched unregister otherwise
+            try:
+                resource_tracker.register(
+                    "/" + self.shm.name, "shared_memory"
+                )
+            except Exception:  # pragma: no cover - tracker internals
+                pass
+            try:
+                self.shm.unlink()
+            except FileNotFoundError:  # pragma: no cover - double close
+                pass

@@ -1,0 +1,154 @@
+"""ClusterSpec: the multi-process serving plane's policy surface.
+
+One NamedTuple spec in the DetectSpec/RobustSpec mold: defaults from
+:func:`metran_tpu.config.serve_defaults`
+(``METRAN_TPU_SERVE_CLUSTER{,_WORKERS,_SHM_MB,_SOCKET_DIR,
+_HEARTBEAT_S}``), shipped **off**, with a :meth:`validate` that
+rejects inert or broken combinations at construction instead of
+letting a mis-sized plane degrade silently at 3am.  Passed as
+``MetranService(cluster=ClusterSpec(...))`` on the writer side and to
+:class:`~metran_tpu.cluster.frontend.ClusterFrontend` on the routing
+side (docs/concepts.md "Multi-process serving").
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import NamedTuple, Optional
+
+__all__ = ["ClusterSpec"]
+
+#: floor on the shared segment so a misconfigured plane cannot be
+#: created too small to hold even its own header + worker table
+_MIN_SHM_MB = 1.0
+
+
+class ClusterSpec(NamedTuple):
+    """Multi-process serving topology and sizing.
+
+    Armed (``enabled=True``) on a :class:`~metran_tpu.serve.
+    MetranService`, the service creates the shared-memory snapshot
+    plane and mirrors every :class:`~metran_tpu.serve.readpath.
+    SnapshotStore` publication into it (the "second sink"); the same
+    spec drives :class:`~metran_tpu.cluster.frontend.ClusterFrontend`,
+    which spawns ONE writer process owning update dispatch, the
+    ``StateArena`` and the WAL, plus ``workers`` read processes
+    serving forecast hits straight from the plane.
+
+    ``shm_mb`` is a hard budget: :meth:`validate_layout` (called with
+    the actual horizon set and pad width before the segment is
+    created) rejects geometries that cannot fit, because a plane too
+    small for the bucket set silently drops every publish and serves
+    nothing — the definition of an inert combo.
+
+    ``heartbeat_s`` is both cadences: workers stamp their claimed
+    worker-table row and the writer stamps the plane header every
+    ``heartbeat_s``; liveness judgments (frontend restart of dead
+    workers, reader writer-alive checks) use a 3x grace multiple.
+    """
+
+    enabled: bool = False
+    workers: int = 2
+    shm_mb: float = 64.0
+    socket_dir: str = ""  # "" = a per-frontend tempfile directory
+    heartbeat_s: float = 2.0
+    #: slots in the plane's open-addressed table (models it can hold;
+    #: sized ~2x the expected fleet for probe headroom).  The default
+    #: geometry (1024 slots x 64 padded series over the default
+    #: ``1-30`` horizon set, ~34 MB) fits the default ``shm_mb`` so
+    #: that ``METRAN_TPU_SERVE_CLUSTER=1`` alone is never inert.
+    slots: int = 1024
+    #: widest (padded) per-model series count a slot can hold; models
+    #: wider than this publish nowhere and their reads fall through —
+    #: counted (``dropped``), never silent
+    max_series: int = 64
+
+    @classmethod
+    def from_defaults(cls) -> "ClusterSpec":
+        from ..config import serve_defaults
+
+        d = serve_defaults()
+        return cls(
+            enabled=bool(d["cluster"]),
+            workers=int(d["cluster_workers"]),
+            shm_mb=float(d["cluster_shm_mb"]),
+            socket_dir=str(d["cluster_socket_dir"]),
+            heartbeat_s=float(d["cluster_heartbeat_s"]),
+        ).validate()
+
+    def validate(self) -> "ClusterSpec":
+        """Reject inert or broken combinations — a cluster with no
+        readers, a heartbeat that never fires, or a segment too small
+        to exist is paid for and silently useless."""
+        if not self.enabled:
+            return self
+        if self.workers < 1:
+            raise ValueError(
+                f"cluster workers must be >= 1, got {self.workers} — "
+                "a cluster with no read workers serves nothing the "
+                "single-process path would not"
+            )
+        if not self.heartbeat_s > 0.0:
+            raise ValueError(
+                f"cluster heartbeat_s must be > 0, got "
+                f"{self.heartbeat_s} — liveness detection (worker "
+                "restart, writer-alive checks) keys off this cadence"
+            )
+        if self.shm_mb < _MIN_SHM_MB:
+            raise ValueError(
+                f"cluster shm_mb must be >= {_MIN_SHM_MB}, got "
+                f"{self.shm_mb} — the plane header and worker table "
+                "alone need real space, and a plane that cannot hold "
+                "the bucket set drops every publish"
+            )
+        if self.slots < 1:
+            raise ValueError(
+                f"cluster slots must be >= 1, got {self.slots}"
+            )
+        if self.max_series < 1:
+            raise ValueError(
+                f"cluster max_series must be >= 1, got "
+                f"{self.max_series}"
+            )
+        if self.socket_dir and not os.path.isdir(self.socket_dir):
+            raise ValueError(
+                f"cluster socket_dir {self.socket_dir!r} does not "
+                "exist — frontends and workers rendezvous on unix "
+                "sockets under it"
+            )
+        return self
+
+    def validate_layout(self, horizons,
+                        n_pad_max: Optional[int] = None) -> "ClusterSpec":
+        """Check the plane geometry the service will actually create
+        fits ``shm_mb`` (the shm-too-small-for-the-bucket-set reject).
+        Called with the resolved horizon set — and the widest padded
+        series count when it differs from ``max_series`` — before any
+        segment exists."""
+        self.validate()
+        if not self.enabled:
+            return self
+        if n_pad_max is None:
+            n_pad_max = self.max_series
+        from .snapplane import plane_bytes
+
+        need = plane_bytes(horizons, n_pad_max, self.slots)
+        budget = int(self.shm_mb * 1024 * 1024)
+        if need > budget:
+            raise ValueError(
+                f"cluster shm_mb={self.shm_mb} cannot hold the "
+                f"configured bucket set: {self.slots} slots x "
+                f"{n_pad_max} padded series over the horizon set "
+                f"need {need / 1e6:.1f} MB; raise "
+                "METRAN_TPU_SERVE_CLUSTER_SHM_MB or shrink "
+                "METRAN_TPU_SERVE_HORIZONS"
+            )
+        return self
+
+    def resolve_socket_dir(self) -> str:
+        """The rendezvous directory, creating a private one when the
+        spec leaves it to us."""
+        if self.socket_dir:
+            return self.socket_dir
+        return tempfile.mkdtemp(prefix="metran_cluster_")

@@ -1,0 +1,142 @@
+"""Cluster read workers: shared-memory forecast serving processes.
+
+Each worker attaches the writer's :class:`~metran_tpu.cluster.
+snapplane.SnapshotPlane`, claims a worker-table row (heartbeat +
+hit/stale/miss/fallback counters the frontend aggregates with one
+shared-memory scan), and answers ``forecast`` RPCs from the frontend
+with a plane probe — **zero device traffic, zero writer locks, zero
+GIL shared with the writer**.  A miss, stale (seqlock-contended) or
+uncovered-horizon read falls through to the writer over its unix
+socket exactly like today's single-process compute fallback, counted
+(``reader_fallback`` event, plane fallback counter) but never failed:
+contention and capacity degrade to fallthrough, never to a wrong or
+refused answer.
+
+The ``read_loop`` op is the bench harness's measurement surface: the
+paired ``--phase serve-cluster`` methodology needs each worker's
+tight in-process reads/s (the quantity that scales with processes),
+not socket round-trips — one RPC triggers N plane reads and returns
+the count and elapsed wall, so the per-call IPC cost amortizes out of
+the measurement exactly like the single-process bench loops.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from logging import getLogger
+from time import perf_counter
+from typing import Optional
+
+from .ipc import RpcClient, RpcServer
+from .snapplane import SnapshotPlane
+
+logger = getLogger(__name__)
+
+__all__ = ["ReadWorker", "worker_main"]
+
+
+class ReadWorker:
+    """One read process's serving state (plane view + writer client)."""
+
+    def __init__(self, plane_name: str, socket_path: str,
+                 writer_socket: str, heartbeat_s: float = 2.0,
+                 events=None):
+        self.plane = SnapshotPlane.attach(plane_name, events=events)
+        self.plane.claim_worker()
+        self.heartbeat_s = heartbeat_s
+        self.events = events
+        self._writer = RpcClient(writer_socket)
+        self._shutdown = threading.Event()
+        self.rpc = RpcServer(socket_path, {
+            "ping": lambda _p: "pong",
+            "forecast": self._forecast,
+            "read_loop": self._read_loop,
+            "stats": lambda _p: self.plane.stats(
+                heartbeat_s=self.heartbeat_s
+            ),
+            "shutdown": lambda _p: self._shutdown.set(),
+        })
+
+    def _forecast(self, payload):
+        """One forecast read: plane hit, else writer fallthrough."""
+        model_id = payload["model_id"]
+        steps = int(payload["steps"])
+        entry = self.plane.read(model_id, steps)
+        if entry is not None:
+            # late import: Forecast lives in serve.service, and a read
+            # worker should not pay the full service import just to
+            # name the result type at module load
+            from ..serve.service import Forecast
+
+            return Forecast(
+                means=entry.means[:steps],
+                variances=entry.variances[:steps],
+                names=entry.names,
+                version=entry.version,
+            )
+        self.plane.count_fallback()
+        if self.events is not None:
+            self.events.emit(
+                "reader_fallback", model_id=model_id,
+                fault_point="cluster.worker", steps=steps,
+            )
+        return self._writer.call(
+            "forecast", {"model_id": model_id, "steps": steps}
+        )
+
+    def _read_loop(self, payload):
+        """Bench surface: ``iters`` tight plane reads over a model
+        cycle, in-process.  Returns hit/fallback counts + elapsed."""
+        model_ids = payload["model_ids"]
+        steps = int(payload["steps"])
+        iters = int(payload["iters"])
+        plane = self.plane
+        n_models = len(model_ids)
+        hits = 0
+        t0 = perf_counter()
+        for i in range(iters):
+            if plane.read(model_ids[i % n_models], steps) is not None:
+                hits += 1
+        elapsed = perf_counter() - t0
+        return {"iters": iters, "hits": hits, "elapsed_s": elapsed,
+                "pid": os.getpid()}
+
+    def serve(self) -> None:
+        """Heartbeat loop until shutdown (RPC runs on daemon threads)."""
+        while not self._shutdown.wait(self.heartbeat_s):
+            self.plane.worker_beat()
+
+    def close(self) -> None:
+        self.rpc.close()
+        self._writer.close()
+        self.plane.close(unlink=False)
+
+
+def worker_main(plane_name: str, socket_path: str, writer_socket: str,
+                heartbeat_s: float = 2.0,
+                ready_path: Optional[str] = None) -> int:
+    """Process entry (spawn-friendly module-level function)."""
+    worker = None
+    try:
+        worker = ReadWorker(
+            plane_name, socket_path, writer_socket,
+            heartbeat_s=heartbeat_s,
+        )
+        if ready_path:
+            tmp = f"{ready_path}.{os.getpid()}.tmp"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                fh.write(str(os.getpid()))
+            os.replace(tmp, ready_path)
+        worker.serve()
+        return 0
+    except Exception:
+        logger.error("read worker failed:\n%s", traceback.format_exc())
+        return 1
+    finally:
+        if worker is not None:
+            try:
+                worker.close()
+            except Exception:  # pragma: no cover - teardown best-effort
+                pass

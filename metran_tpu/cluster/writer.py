@@ -1,0 +1,170 @@
+"""The cluster's single writer process.
+
+The multi-process split keeps ONE owner for everything that mutates:
+update dispatch, the device ``StateArena``, the WAL.  This module is
+that process.  It hosts a full :class:`~metran_tpu.serve.
+MetranService` constructed with ``cluster=ClusterSpec(...)`` — which
+makes the service create the shared-memory :class:`~metran_tpu.
+cluster.snapplane.SnapshotPlane` and mirror every committed
+publication into it — and exposes the cold paths over a unix-socket
+:class:`~metran_tpu.cluster.ipc.RpcServer`: update routing from the
+frontend, reader fallthrough on miss/stale, registration and admin.
+The hot read path never arrives here; that is the point.
+
+Because the writer's group-commit stream is already serialized, the
+WAL frame IS the cross-process commit notification: the plane header's
+``commit_seq`` advances with each publish batch at the same boundary
+the WAL frames are cut, so readers learn liveness and publication
+progress from shared memory without a second protocol — and a killed
+writer restarts through the service's existing
+:meth:`~metran_tpu.serve.MetranService.recover` replay with no
+acked-commit loss (the frontend's ``restart_writer``).
+
+The process entry (:func:`writer_main`) is spawn-friendly: the
+frontend passes a picklable module-level ``service_factory(spec,
+recovering, *args)`` that builds the service inside THIS process (jax
+state, device buffers and WAL handles must never cross a fork).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from logging import getLogger
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+from .ipc import RpcServer
+from .spec import ClusterSpec
+
+logger = getLogger(__name__)
+
+__all__ = ["WriterHost", "writer_main"]
+
+
+def _to_host(obj):
+    """Device arrays -> host numpy across an arbitrary result pytree,
+    so RPC replies never try to pickle live device buffers."""
+    import jax
+
+    def leaf(x):
+        if hasattr(x, "device_buffer") or type(x).__module__.startswith(
+            ("jaxlib", "jax")
+        ):
+            return np.asarray(x)
+        return x
+
+    return jax.tree_util.tree_map(leaf, obj)
+
+
+class WriterHost:
+    """The RPC surface wrapped around the writer's ``MetranService``.
+
+    Ops mirror the service API the frontend preserves: ``update`` /
+    ``forecast`` / ``flush`` / ``put`` / ``meta`` / ``capacity_report``
+    / ``stats``; ``hello`` hands readers the plane's segment name.
+    Exceptions cross the socket as objects and re-raise frontend-side,
+    so breaker/deadline/gate semantics survive the split.
+    """
+
+    def __init__(self, service, spec: ClusterSpec, socket_path: str):
+        self.service = service
+        self.spec = spec
+        self.plane = getattr(service, "cluster_plane", None)
+        if self.plane is None:
+            raise ValueError(
+                "writer service has no snapshot plane — construct it "
+                "with cluster=ClusterSpec(enabled=True)"
+            )
+        self._shutdown = threading.Event()
+        self.rpc = RpcServer(socket_path, self._handlers())
+
+    def _handlers(self) -> dict:
+        svc = self.service
+        return {
+            "hello": self._hello,
+            "ping": lambda _p: "pong",
+            "update": lambda p: _to_host(
+                svc.update(p["model_id"], p["new_obs"])
+            ),
+            "forecast": lambda p: _to_host(
+                svc.forecast(p["model_id"], p["steps"])
+            ),
+            "put": lambda p: svc.registry.put(
+                p["state"], persist=p.get("persist", False)
+            ),
+            "meta": lambda p: _to_host(svc.registry.meta(p["model_id"])),
+            "flush": lambda _p: svc.flush(),
+            "capacity_report": lambda _p: svc.capacity_report(),
+            "stats": lambda _p: self.plane.stats(
+                heartbeat_s=self.spec.heartbeat_s
+            ),
+            "shutdown": lambda _p: self._shutdown.set(),
+        }
+
+    def _hello(self, _payload) -> dict:
+        return {
+            "plane": self.plane.name,
+            "pid": os.getpid(),
+            "heartbeat_s": self.spec.heartbeat_s,
+        }
+
+    def serve(self) -> None:
+        """Block in the idle-heartbeat loop until ``shutdown`` arrives.
+        Publishes already stamp the plane header; this keeps
+        ``writer_age_s`` fresh through quiet stretches so reader
+        liveness checks do not need publish traffic."""
+        while not self._shutdown.wait(self.spec.heartbeat_s):
+            self.plane.writer_beat()
+
+    def close(self) -> None:
+        self.rpc.close()
+
+
+def writer_main(
+    spec: ClusterSpec,
+    socket_path: str,
+    service_factory: Callable,
+    factory_args: Tuple = (),
+    recovering: bool = False,
+    ready_path: Optional[str] = None,
+) -> int:
+    """Process entry: build the service, serve RPC until shutdown.
+
+    ``service_factory(spec, recovering, *factory_args)`` returns the
+    :class:`~metran_tpu.serve.MetranService`; ``recovering=True`` is
+    set when the frontend respawns a writer after a crash, so the
+    factory routes through ``MetranService.recover`` (WAL replay) —
+    construction vs recovery is the factory's policy, not ours.
+
+    Writes ``ready_path`` (when given) once RPC is up — the spawn
+    barrier the frontend waits on instead of polling the socket.
+    """
+    service = None
+    host = None
+    try:
+        service = service_factory(spec, recovering, *factory_args)
+        host = WriterHost(service, spec, socket_path)
+        if ready_path:
+            tmp = f"{ready_path}.{os.getpid()}.tmp"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                fh.write(str(os.getpid()))
+            os.replace(tmp, ready_path)
+        host.serve()
+        return 0
+    except Exception:
+        logger.error("writer process failed:\n%s", traceback.format_exc())
+        return 1
+    finally:
+        if host is not None:
+            try:
+                host.close()
+            except Exception:  # pragma: no cover - teardown best-effort
+                pass
+        if service is not None:
+            try:
+                service.close()
+            except Exception:  # pragma: no cover - teardown best-effort
+                logger.exception("writer service close failed")

@@ -279,6 +279,22 @@ SERVE_WAL_FSYNC = 1  # group fdatasync before each dispatch's acks
 #                      death, not power loss)
 SERVE_WAL_CHECKPOINT_EVERY = 1024  # auto-checkpoint cadence in logged
 #                                    commits (0 = manual only)
+# multi-process serving plane (metran_tpu.cluster; docs/concepts.md
+# "Multi-process serving").  Ships OFF: the split spawns a writer
+# process plus read workers and maps a shared-memory snapshot plane —
+# a process-topology decision, not a library default.  Armed, ONE
+# writer owns update dispatch / StateArena / WAL while N workers
+# serve forecast hits from the seqlock plane with zero writer locks.
+SERVE_CLUSTER = 0  # 1 = multi-process serving (ClusterFrontend)
+SERVE_CLUSTER_WORKERS = 2  # read-worker processes (>= 1)
+SERVE_CLUSTER_SHM_MB = 64.0  # shared snapshot-plane budget; validated
+#                              against the horizon set x slot count
+#                              at construction (too small = rejected)
+SERVE_CLUSTER_SOCKET_DIR = ""  # unix-socket rendezvous dir ("" = a
+#                                private per-frontend temp dir)
+SERVE_CLUSTER_HEARTBEAT_S = 2.0  # worker/writer liveness cadence
+#                                  (restart + writer-alive checks use
+#                                  a 3x grace multiple)
 # observability defaults (metran_tpu.obs wired into MetranService)
 OBS_TRACE = 0  # request-scoped span tracing (metrics/events stay on)
 OBS_TRACE_BUFFER = 4096  # finished spans kept in the tracer ring
@@ -493,6 +509,25 @@ def serve_defaults() -> dict:
         "refit_deadline_s": _env(
             "METRAN_TPU_SERVE_REFIT_DEADLINE_S", float,
             SERVE_REFIT_DEADLINE_S,
+        ),
+        "cluster": _env(
+            "METRAN_TPU_SERVE_CLUSTER", int, SERVE_CLUSTER
+        ),
+        "cluster_workers": _env(
+            "METRAN_TPU_SERVE_CLUSTER_WORKERS", int,
+            SERVE_CLUSTER_WORKERS,
+        ),
+        "cluster_shm_mb": _env(
+            "METRAN_TPU_SERVE_CLUSTER_SHM_MB", float,
+            SERVE_CLUSTER_SHM_MB,
+        ),
+        "cluster_socket_dir": os.environ.get(
+            "METRAN_TPU_SERVE_CLUSTER_SOCKET_DIR",
+            SERVE_CLUSTER_SOCKET_DIR,
+        ),
+        "cluster_heartbeat_s": _env(
+            "METRAN_TPU_SERVE_CLUSTER_HEARTBEAT_S", float,
+            SERVE_CLUSTER_HEARTBEAT_S,
         ),
         "wal": _env("METRAN_TPU_SERVE_WAL", int, SERVE_WAL),
         "wal_dir": os.environ.get(

@@ -98,8 +98,14 @@ def atomic_savez(path, **arrays) -> Path:
 
     path = Path(path)
     fire("io.atomic_savez", str(path))
+    # pid + process START TIME + random suffix: the pid alone is not an
+    # owner identity once several serving processes share a registry
+    # dir — the kernel recycles pids, and a sweep that trusts a live
+    # recycled pid would pin a dead writer's temp forever (see
+    # sweep_stale_tmps)
     tmp = path.with_name(
-        f".{path.name}.{os.getpid()}-{uuid.uuid4().hex[:8]}.tmp.npz"
+        f".{path.name}.{os.getpid()}-{_proc_start_ticks(os.getpid())}"
+        f"-{uuid.uuid4().hex[:8]}.tmp.npz"
     )
     try:
         with open(tmp, "wb") as fh:
@@ -125,13 +131,43 @@ def atomic_savez(path, **arrays) -> Path:
 _TMP_NAME_RE = None  # compiled lazily; module import stays regex-free
 
 
+def _proc_start_ticks(pid: int) -> int:
+    """The process's kernel start time in clock ticks since boot
+    (``/proc/<pid>/stat`` field 22), 0 when unreadable (non-/proc
+    platforms, or the process is already gone).
+
+    ``(pid, start_ticks)`` is the real owner identity for on-disk
+    artifacts: pids recycle, but a recycled pid gets a NEW start time,
+    so a temp tagged with both can never be pinned by an unrelated
+    process that happened to inherit its writer's pid.
+    """
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as fh:
+            stat = fh.read()
+        # the comm field (2) is a parenthesized, possibly-space-filled
+        # process name: split AFTER the last ')', then field 22 is at
+        # index 19 of the remainder (fields 3..)
+        rest = stat[stat.rindex(b")") + 2:].split()
+        return int(rest[19])
+    except (OSError, ValueError, IndexError):
+        return 0
+
+
 def sweep_stale_tmps(directory) -> list:
     """Delete ``atomic_savez`` temp files left by writers killed mid-write.
 
-    Matches the exact temp-name shape ``.{name}.{pid}-{hex8}.tmp.npz``
-    and only removes a temp whose writer pid is provably gone — a LIVE
-    pid (including this process: another thread may be mid-write right
-    now) is skipped, so the sweep can run concurrently with writers.
+    Matches the temp-name shape ``.{name}.{pid}-{starttime}-{hex8}
+    .tmp.npz`` (and the pre-start-time shape ``.{name}.{pid}-{hex8}
+    .tmp.npz`` older writers left behind) and only removes a temp whose
+    writer is provably gone.  A LIVE writer — same pid AND same process
+    start time, including this process: another thread may be mid-write
+    right now — is skipped, so the sweep can run concurrently with
+    writers.  The start-time check is what makes this safe with
+    multiple serving processes sharing a registry dir: a pid the kernel
+    recycled to an unrelated process no longer counts as the temp's
+    owner (it has a different start time), so a dead writer's temp can
+    never be pinned forever by pid reuse.  Old-shape temps carry no
+    start time and keep the conservative pid-only liveness check.
     Returns the paths removed.  Called by ``ModelRegistry`` at startup
     so a crash-looping service cannot accumulate unbounded garbage, and
     safe to call from any process that owns a checkpoint directory.
@@ -142,7 +178,8 @@ def sweep_stale_tmps(directory) -> list:
     global _TMP_NAME_RE
     if _TMP_NAME_RE is None:
         _TMP_NAME_RE = re.compile(
-            r"^\.(?P<name>.+)\.(?P<pid>\d+)-[0-9a-f]{8}\.tmp\.npz$"
+            r"^\.(?P<name>.+)\.(?P<pid>\d+)"
+            r"(?:-(?P<start>\d+))?-[0-9a-f]{8}\.tmp\.npz$"
         )
 
     def pid_alive(pid: int) -> bool:
@@ -162,8 +199,16 @@ def sweep_stale_tmps(directory) -> list:
         m = _TMP_NAME_RE.match(p.name)
         if m is None:
             continue
-        if pid_alive(int(m.group("pid"))):
-            continue  # writer still running (possibly this process)
+        pid = int(m.group("pid"))
+        if m.group("start") is not None:
+            # owner identity is (pid, start_ticks): a live pid with a
+            # DIFFERENT start time is a recycled pid, not the writer
+            if pid_alive(pid) and (
+                _proc_start_ticks(pid) == int(m.group("start"))
+            ):
+                continue
+        elif pid_alive(pid):
+            continue  # old-shape temp: pid-only check (conservative)
         try:
             p.unlink()
         except FileNotFoundError:  # pragma: no cover - concurrent sweep

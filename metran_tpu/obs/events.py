@@ -88,6 +88,11 @@ EVENT_KINDS = (
     "spill_failure",
     "recovery_start",
     "recovery_complete",
+    "worker_start",
+    "worker_exit",
+    "worker_restart",
+    "snapshot_plane_publish",
+    "reader_fallback",
 )
 
 

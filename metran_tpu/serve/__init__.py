@@ -41,10 +41,17 @@ refilters history:
   telemetry, hard request deadlines, per-model circuit breakers, and
   per-slot failure isolation (``metran_tpu.reliability``).
 
+Past one process, :mod:`metran_tpu.cluster` splits this service into
+a single writer plus shared-memory read workers
+(``MetranService(cluster=ClusterSpec(...))``,
+``METRAN_TPU_SERVE_CLUSTER``) — same API, reads scaling with
+processes instead of queueing behind writes on one GIL.
+
 See the "Online assimilation & serving" and "Reliability &
 degradation" sections of docs/concepts.md.
 """
 
+from ..cluster.spec import ClusterSpec
 from ..reliability.policy import (
     ChainedRequestError,
     CircuitOpenError,
@@ -106,6 +113,7 @@ __all__ = [
     "ArenaUpdateAck",
     "ChainedRequestError",
     "CircuitOpenError",
+    "ClusterSpec",
     "CompiledFnCache",
     "DeadlineExceededError",
     "Decomposition",

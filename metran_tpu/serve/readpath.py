@@ -182,6 +182,14 @@ class SnapshotStore:
         self._lock = threading.Lock()  # writers only
         self._entries: Dict[str, SnapshotEntry] = {}
         self._latest: Dict[str, int] = {}  # last committed version
+        #: second publication sink (the cluster's shared-memory
+        #: :class:`~metran_tpu.cluster.snapplane.SnapshotPlane`): every
+        #: publish/forget is forwarded AFTER the in-process store
+        #: commits, so cross-process readers can never observe an
+        #: entry this process's own read path does not serve yet.
+        #: ``None`` (single-process serving) costs one ``is None``
+        #: check per publish batch.
+        self.mirror = None
         # unlocked telemetry (see class docstring)
         self.hits = 0
         self.misses = 0
@@ -252,6 +260,8 @@ class SnapshotStore:
         if not _already_stamped:
             now = float(self._clock())
             entries = [e._replace(published_at=now) for e in entries]
+        else:
+            entries = list(entries)
         n_pub = 0
         with self._lock:
             for entry in entries:
@@ -275,6 +285,21 @@ class SnapshotStore:
                 models=n_pub, horizons=len(self.horizons),
                 **({"bucket": _bucket} if _bucket is not None else {}),
             )
+        if n_pub and self.mirror is not None:
+            # cross-process sink: forwarded after the in-process store
+            # committed (mirror-before-store would let a cluster reader
+            # see an entry this process's read path does not).  Mirror
+            # failures are contained — the plane is an optimization
+            # sink, and the in-process publication already succeeded.
+            try:
+                self.mirror.publish_entries(entries)
+            except Exception:  # pragma: no cover - plane degraded
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "snapshot plane mirror publish failed (in-process "
+                    "store is committed; cluster readers fall through)"
+                )
         return n_pub
 
     def forget(self, model_id: str) -> None:
@@ -284,6 +309,11 @@ class SnapshotStore:
         with self._lock:
             self._entries.pop(model_id, None)
             self._latest.pop(model_id, None)
+        if self.mirror is not None:
+            try:
+                self.mirror.forget(model_id)
+            except Exception:  # pragma: no cover - plane degraded
+                pass
 
     # -- introspection ---------------------------------------------------
     def __len__(self) -> int:

@@ -603,6 +603,19 @@ class MetranService:
         f64 after a crash by replaying the WAL tail through the same
         incremental kernels.  See docs/concepts.md "Durability &
         recovery".
+    cluster : multi-process serving policy
+        (:class:`~metran_tpu.cluster.ClusterSpec`; default from
+        ``serve_defaults()`` — ``METRAN_TPU_SERVE_CLUSTER*``, shipped
+        off).  Enabled, THIS service is the cluster's single writer:
+        it creates the shared-memory snapshot plane
+        (:mod:`metran_tpu.cluster.snapplane`) and mirrors every
+        read-path publication into it, so read-worker processes
+        spawned by :class:`~metran_tpu.cluster.ClusterFrontend` serve
+        forecast hits with zero device traffic and zero writer locks.
+        Requires the materialized read path (``readpath=True`` with a
+        non-empty horizon set) — a cluster with nothing to publish is
+        the definition of an inert combo and is rejected.  See
+        docs/concepts.md "Multi-process serving".
     """
 
     def __init__(
@@ -623,6 +636,7 @@ class MetranService:
         detect: Optional[DetectSpec] = None,
         capacity=None,
         durability: Optional[DurabilitySpec] = None,
+        cluster=None,
     ):
         from ..config import obs_defaults, serve_defaults
 
@@ -765,6 +779,30 @@ class MetranService:
             SnapshotStore(self.horizons) if readpath and self.horizons
             else None
         )
+        # multi-process serving plane (metran_tpu.cluster; docs/
+        # concepts.md "Multi-process serving").  Validated HERE —
+        # before any background thread starts, like the other spec
+        # rejects — but the shared segment itself is created at the
+        # END of construction so its wal_anchored header bit can
+        # reflect the armed durability plane.  Shipped off.
+        from ..cluster.spec import ClusterSpec
+
+        self.cluster = (
+            cluster.validate() if cluster is not None
+            else ClusterSpec.from_defaults()
+        )
+        #: the writer-owned shared snapshot plane (None single-process)
+        self.cluster_plane = None
+        if self.cluster.enabled:
+            if self.readpath is None:
+                raise ValueError(
+                    "cluster serving requires the materialized read "
+                    "path: read workers serve commit-time snapshots, "
+                    "so a cluster without readpath=True (and a non-"
+                    "empty horizon set) publishes nothing and is "
+                    "inert — arm readpath or drop cluster"
+                )
+            self.cluster.validate_layout(self.horizons)
         on_transition = None
         if self.events is not None:
             events = self.events
@@ -903,6 +941,24 @@ class MetranService:
         if dur_spec.enabled:
             self._durability = DurabilityManager(self, dur_spec)
             self._register_durability_gauges()
+        # multi-process serving: armed, THIS process is the cluster's
+        # single writer — it owns the shared-memory snapshot plane and
+        # every read-path publication is mirrored into it at the same
+        # commit boundary the WAL frames are cut at (the plane's
+        # commit_seq IS the cross-process commit notification).  The
+        # spec was validated up with the read-path setup; the segment
+        # is created HERE so its wal_anchored header bit can reflect
+        # the armed durability plane.
+        if self.cluster.enabled:
+            from ..cluster.snapplane import SnapshotPlane
+
+            self.cluster_plane = SnapshotPlane.create(
+                self.horizons, self.cluster.max_series,
+                self.cluster.slots, self.cluster.shm_mb,
+                events=self.events,
+                wal_anchored=self._durability is not None,
+            )
+            self.readpath.mirror = self.cluster_plane
 
     def _register_durability_gauges(self) -> None:
         """Durability-lag gauges, registered once the manager exists
@@ -2681,6 +2737,13 @@ class MetranService:
             }
         if self.readpath is not None:
             report["readpath"] = self.readpath.stats()
+        if self.cluster_plane is not None:
+            # the writer-side cluster view: plane occupancy, publish/
+            # drop counters, and the fleet's reader telemetry
+            # aggregated from the shared worker table (one shm scan)
+            report["cluster"] = self.cluster_plane.stats(
+                heartbeat_s=self.cluster.heartbeat_s
+            )
         report.update(self._durability_health())
         return report
 
@@ -2994,6 +3057,18 @@ class MetranService:
             # registry outliving this service must not keep the store
             # alive or call into it after close
             self.registry.remove_commit_hook(self.readpath.note_commit)
+        if self.cluster_plane is not None:
+            # the writer owns the segment: drop the mirror hook first
+            # (a straggling publish must not write a released mapping)
+            # and unlink — attached readers keep their mappings until
+            # they unmap, so a racing read degrades to fallthrough
+            if self.readpath is not None:
+                self.readpath.mirror = None
+            try:
+                self.cluster_plane.close()
+            except Exception:  # pragma: no cover - shutdown only
+                logger.exception("snapshot plane close failed")
+            self.cluster_plane = None
         if self.registry.arena_enabled and self.persist_updates:
             # the arena's durability frontier without a WAL: updates
             # dirtied rows in place on device, and a clean shutdown

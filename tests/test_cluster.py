@@ -1,0 +1,515 @@
+"""Multi-process serving plane (`metran_tpu/cluster/`).
+
+Pins the subsystem's contracts:
+
+1. **seqlock integrity** — a cross-process torn-write storm (publisher
+   rewriting one slot as fast as it can) never yields a reader a mixed
+   buffer: every successful read satisfies the publisher's
+   ``means == version, variances == 2*version`` invariant, versions
+   observed are monotone, and contention degrades to a counted retry
+   miss, never a wrong answer;
+2. **single-writer split semantics** — a ``ClusterFrontend`` over a
+   spawned writer + read workers answers ``update``/``forecast``
+   bit-identically (f64) to an in-process ``MetranService`` on the
+   same fleet, and application exceptions (unknown model) cross the
+   socket as the same type;
+3. **supervision** — a SIGKILLed worker loses zero reads (transport
+   failover to the next worker/writer) and is respawned by the
+   monitor; a SIGKILLed writer keeps plane hits serving, then
+   ``restart_writer`` recovers every acked commit through the WAL
+   replay, bit-identically;
+4. **multi-host mesh** — a 2-process ``jax.distributed`` pod runs the
+   batched serve kernels over the batch-axis ``NamedSharding`` with
+   results bit-identical to a 1-process pod on the same 4-device
+   geometry (skip-guarded: CPU pods need the gloo collective
+   transport);
+5. **spec hygiene** — ``ClusterSpec`` rejects inert combos (no
+   workers, dead heartbeat, a segment too small for the bucket set),
+   and the service refuses a cluster without the materialized read
+   path;
+6. **pid-recycle sweep regression** — ``io.sweep_stale_tmps`` no
+   longer pins a dead writer's temp forever when the kernel recycles
+   its pid (the ``(pid, start_ticks)`` owner identity).
+"""
+
+import math
+import multiprocessing
+import os
+import signal
+import socket as socketlib
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from metran_tpu.cluster import ClusterFrontend, ClusterSpec, SnapshotPlane
+from metran_tpu.cluster import plane_bytes
+from metran_tpu.cluster._testing import (
+    make_states,
+    seed_root,
+    storm_publisher,
+    writer_service_factory,
+)
+from metran_tpu.io import _proc_start_ticks, sweep_stale_tmps
+from metran_tpu.serve import MetranService, ModelRegistry
+
+pytestmark = pytest.mark.cluster
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+#: fast supervision cadence for tests (liveness grace = 3x this)
+HEARTBEAT_S = 0.3
+
+
+def _spec(**kw):
+    base = dict(
+        enabled=True, workers=2, shm_mb=8.0, heartbeat_s=HEARTBEAT_S,
+        slots=64, max_series=8,
+    )
+    base.update(kw)
+    return ClusterSpec(**base)
+
+
+# ----------------------------------------------------------------------
+# 5. spec hygiene
+# ----------------------------------------------------------------------
+def test_cluster_spec_rejects_inert_combos(tmp_path, monkeypatch):
+    ClusterSpec().validate()  # disabled ships clean
+    # a disabled spec never validates its numbers (config-off is inert
+    # by choice, not by accident)
+    ClusterSpec(enabled=False, workers=0).validate()
+    with pytest.raises(ValueError, match="workers"):
+        _spec(workers=0).validate()
+    with pytest.raises(ValueError, match="heartbeat"):
+        _spec(heartbeat_s=0.0).validate()
+    with pytest.raises(ValueError, match="shm_mb"):
+        _spec(shm_mb=0.25).validate()
+    with pytest.raises(ValueError, match="slots"):
+        _spec(slots=0).validate()
+    with pytest.raises(ValueError, match="max_series"):
+        _spec(max_series=0).validate()
+    with pytest.raises(ValueError, match="socket_dir"):
+        _spec(socket_dir=str(tmp_path / "missing")).validate()
+    # the shm-too-small-for-the-bucket-set reject names the env knob
+    with pytest.raises(ValueError, match="SHM_MB"):
+        _spec(shm_mb=1.0, slots=4096, max_series=64).validate_layout(
+            "1-30"
+        )
+    # defaults self-consistency: flipping the env switch alone must
+    # never produce a spec whose own layout check rejects it
+    ClusterSpec(enabled=True).validate_layout("1-30")
+
+    monkeypatch.setenv("METRAN_TPU_SERVE_CLUSTER", "1")
+    monkeypatch.setenv("METRAN_TPU_SERVE_CLUSTER_WORKERS", "3")
+    monkeypatch.setenv("METRAN_TPU_SERVE_CLUSTER_HEARTBEAT_S", "0.5")
+    spec = ClusterSpec.from_defaults()
+    assert spec.enabled and spec.workers == 3
+    assert spec.heartbeat_s == 0.5
+    monkeypatch.setenv("METRAN_TPU_SERVE_CLUSTER_WORKERS", "0")
+    with pytest.raises(ValueError, match="workers"):
+        ClusterSpec.from_defaults()
+
+
+def test_service_refuses_cluster_without_readpath(tmp_path):
+    reg = ModelRegistry(root=None)
+    for st in make_states(n_models=1):
+        reg.put(st, persist=False)
+    with pytest.raises(ValueError, match="read path"):
+        MetranService(
+            reg, flush_deadline=None, persist_updates=False,
+            readpath=False, cluster=_spec(),
+        )
+    # and a layout the spec cannot hold is refused before any segment
+    # or thread exists
+    with pytest.raises(ValueError, match="SHM_MB"):
+        MetranService(
+            reg, flush_deadline=None, persist_updates=False,
+            readpath=True, horizons="1-30",
+            cluster=_spec(shm_mb=1.0, slots=4096, max_series=64),
+        )
+
+
+# ----------------------------------------------------------------------
+# snapshot plane: publish/read round-trip + capacity accounting
+# ----------------------------------------------------------------------
+def test_plane_publish_read_roundtrip(rng):
+    from metran_tpu.serve.readpath import SnapshotEntry
+
+    plane = SnapshotPlane.create("1-5", 8, 32, 4.0)
+    try:
+        entries = [
+            SnapshotEntry(
+                model_id=f"m{i}", version=i + 1,
+                names=tuple(f"s{j}" for j in range(5)),
+                means=rng.normal(size=(5, 5)),
+                variances=rng.uniform(0.1, 1.0, (5, 5)),
+                published_at=float(i),
+            )
+            for i in range(3)
+        ]
+        plane.publish_entries(entries)
+        assert plane.commit_seq == 1
+
+        reader = SnapshotPlane.attach(plane.name)
+        try:
+            reader.claim_worker()  # counters book into a claimed row
+            for e in entries:
+                got = reader.read(e.model_id, 5)
+                assert got is not None
+                assert got.version == e.version
+                assert got.names == e.names
+                assert np.array_equal(got.means, e.means)
+                assert np.array_equal(got.variances, e.variances)
+            # unknown model and uncovered horizon are counted misses,
+            # not errors
+            assert reader.read("nope", 5) is None
+            assert reader.read("m0", 6) is None
+            counts = reader.reader_counts()
+            assert counts["hits"] == 3
+            assert counts["misses"] == 2
+
+            # a republish at a newer version wins; forget() tombstones
+            e2 = entries[0]._replace(version=9)
+            plane.publish_entries([e2])
+            assert reader.read("m0", 5).version == 9
+            plane.forget("m0")
+            assert reader.read("m0", 5) is None
+            # the tombstoned slot is reusable and probing still finds
+            # the other live entries behind it
+            assert reader.read("m1", 5).version == 2
+        finally:
+            reader.close(unlink=False)
+
+        # an entry wider than the slot's padded width is dropped and
+        # counted — capacity degrades visibly, never silently
+        wide = SnapshotEntry(
+            model_id="wide", version=1,
+            names=tuple(f"s{j}" for j in range(9)),
+            means=np.zeros((5, 9)), variances=np.zeros((5, 9)),
+            published_at=0.0,
+        )
+        plane.publish_entries([wide])
+        assert plane.stats(heartbeat_s=1.0)["dropped"] >= 1
+        assert plane.read("wide", 5) is None
+    finally:
+        plane.close()
+    assert plane_bytes("1-5", 8, 64) > plane_bytes("1-5", 8, 32)
+
+
+# ----------------------------------------------------------------------
+# 1. seqlock torn-write storm
+# ----------------------------------------------------------------------
+def test_seqlock_storm_never_yields_torn_reads():
+    """A publisher process rewriting one slot at full speed races a
+    reader in this process: every successful read must satisfy the
+    publisher's invariant exactly — a single torn buffer fails."""
+    n_series, n_horizons, n_versions = 4, 3, 1200
+    plane = SnapshotPlane.create("1-3", n_series, 8, 2.0)
+    plane.claim_worker()  # hit counters book into a claimed row
+    ctx = multiprocessing.get_context("spawn")
+    proc = ctx.Process(
+        target=storm_publisher,
+        args=(plane.name, "m0", n_series, n_horizons, n_versions),
+        daemon=True,
+    )
+    try:
+        proc.start()
+        successes = 0
+        last_version = 0
+        deadline = time.monotonic() + 120.0
+        while (
+            proc.is_alive() or last_version < n_versions
+        ) and time.monotonic() < deadline:
+            entry = plane.read("m0", n_horizons)
+            if entry is None:
+                continue
+            v = entry.means.flat[0]
+            # the seqlock contract: the whole buffer is one
+            # publication, never a mix of two
+            assert np.all(entry.means == v), "torn means"
+            assert np.all(entry.variances == 2.0 * v), "torn variances"
+            assert entry.version == int(v), "version/buffer mismatch"
+            assert entry.version >= last_version, "went backwards"
+            last_version = entry.version
+            successes += 1
+        proc.join(timeout=30.0)
+        assert proc.exitcode == 0
+        assert successes > 0
+        assert last_version == n_versions
+        # contended retries are allowed, but they are *counted*, and
+        # they never surfaced as wrong answers above
+        counts = plane.reader_counts()
+        assert counts["hits"] == successes
+    finally:
+        if proc.is_alive():  # pragma: no cover - assertion bailout
+            proc.terminate()
+        plane.close()
+
+
+# ----------------------------------------------------------------------
+# 2 + 3. the single-writer split, end to end
+# ----------------------------------------------------------------------
+def test_frontend_split_semantics_and_crash_supervision(tmp_path):
+    """One topology spin-up covers the split's acceptance bars in
+    sequence: bit-identical parity with the single-process service,
+    exception-type parity, worker-kill -> zero failed reads + respawn,
+    writer-kill -> plane reads keep serving, then WAL recovery
+    reconstructs every acked commit bit-identically."""
+    n_models, steps, horizons = 3, 5, "1-5"
+    root = tmp_path / "fleet"
+    root.mkdir()
+    model_ids = seed_root(root, n_models=n_models)
+
+    # the in-process reference service on a bit-identical fleet
+    reg = ModelRegistry(root=None)
+    for st in make_states(n_models=n_models):
+        reg.put(st, persist=False)
+    svc = MetranService(
+        reg, flush_deadline=None, persist_updates=False,
+        readpath=True, horizons=horizons,
+    )
+
+    obs = np.random.default_rng(11).normal(size=(n_models, 2, 2, 5))
+    spec = _spec(socket_dir=str(tmp_path))
+    frontend = ClusterFrontend(
+        spec, writer_service_factory, (str(root), horizons, True),
+    )
+    try:
+        # -- parity: updates and forecasts, bit for bit at f64 -------
+        for i, mid in enumerate(model_ids):
+            st_c = frontend.update(mid, obs[i, 0])
+            st_l = svc.update(mid, obs[i, 0])
+            assert st_c.version == st_l.version == 1
+            assert np.array_equal(st_c.mean, st_l.mean)
+            assert np.array_equal(st_c.cov, st_l.cov)
+        forecasts = {}
+        for mid in model_ids:
+            f_c = frontend.forecast(mid, steps)
+            f_l = svc.forecast(mid, steps)
+            assert f_c.version == f_l.version
+            assert f_c.names == f_l.names
+            assert np.array_equal(f_c.means, f_l.means)
+            assert np.array_equal(f_c.variances, f_l.variances)
+            forecasts[mid] = f_c
+        assert frontend.plane.reader_counts()["hits"] >= n_models
+
+        # -- exception-type parity across the socket ------------------
+        with pytest.raises(KeyError):
+            svc.forecast("nope", steps)
+        with pytest.raises(KeyError):
+            frontend.forecast("nope", steps)
+
+        # -- worker SIGKILL: zero failed reads, then respawn ----------
+        victim = frontend._workers[0]
+        old_pid = victim.proc.pid
+        os.kill(old_pid, signal.SIGKILL)
+        for k in range(30):
+            mid = model_ids[k % n_models]
+            f = frontend.forecast(mid, steps)
+            assert np.array_equal(f.means, forecasts[mid].means)
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            w = frontend._workers[0]
+            if w.proc.pid != old_pid and w.proc.is_alive():
+                break
+            time.sleep(0.05)
+        else:  # pragma: no cover - supervision failure
+            pytest.fail("killed worker was not respawned")
+        kinds = [e["kind"] for e in frontend.events.tail(50)]
+        assert "worker_exit" in kinds
+        assert "worker_restart" in kinds
+        assert kinds.count("worker_start") >= spec.workers + 1
+        # the respawned worker serves plane hits again
+        f = frontend.forecast(model_ids[0], steps)
+        assert np.array_equal(f.means, forecasts[model_ids[0]].means)
+
+        # -- writer SIGKILL: hits keep serving, WAL recovery ----------
+        os.kill(frontend._writer_proc.pid, signal.SIGKILL)
+        frontend._writer_proc.join(timeout=30.0)
+        assert not frontend.writer_alive()
+        for mid in model_ids:  # shared-memory reads outlive the writer
+            f = frontend.forecast(mid, steps)
+            assert np.array_equal(f.means, forecasts[mid].means)
+
+        frontend.restart_writer()
+        assert frontend.writer_alive()
+        # every acked commit survived: replay reconstructed the same
+        # posteriors, so the republished plane serves the same bits
+        for mid in model_ids:
+            f = frontend.forecast(mid, steps)
+            assert f.version == 1
+            assert np.array_equal(f.means, forecasts[mid].means)
+            assert np.array_equal(
+                f.variances, forecasts[mid].variances
+            )
+        # and the recovered writer keeps bit-parity going forward
+        for i, mid in enumerate(model_ids):
+            st_c = frontend.update(mid, obs[i, 1])
+            st_l = svc.update(mid, obs[i, 1])
+            assert st_c.version == st_l.version == 2
+            assert np.array_equal(st_c.mean, st_l.mean)
+            f_c = frontend.forecast(mid, steps)
+            f_l = svc.forecast(mid, steps)
+            assert np.array_equal(f_c.means, f_l.means)
+
+        report = frontend.capacity_report()
+        assert report["cluster"]["workers"] == spec.workers
+        assert report["cluster"]["writer_alive"]
+
+        # gauges must survive the writer bounce: the recovered writer
+        # allocated a FRESH shm segment, so callbacks closed over the
+        # original plane would now scrape a released memoryview and
+        # render NaN (regression: scrape-time plane resolution)
+        if frontend.obs.metrics is not None:
+            for name in (
+                "metran_serve_cluster_workers_live",
+                "metran_serve_cluster_reader_hits_total",
+                "metran_serve_cluster_reader_stale_total",
+                "metran_serve_cluster_fallbacks_total",
+            ):
+                val = frontend.obs.metrics.get(name).value()
+                assert math.isfinite(val), name
+            live = frontend.obs.metrics.get(
+                "metran_serve_cluster_workers_live"
+            ).value()
+            assert live == spec.workers
+    finally:
+        frontend.close()
+        svc.close()
+
+
+# ----------------------------------------------------------------------
+# 4. multi-host arena mesh bit-identity (2-process jax.distributed)
+# ----------------------------------------------------------------------
+def _free_port() -> int:
+    s = socketlib.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_pod(num_processes, devices_per_proc, outdir, tag):
+    """Launch a ``python -m metran_tpu.cluster.mesh`` pod; returns the
+    per-process npz paths or None (with logs) when the pod failed."""
+    port = _free_port()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={devices_per_proc}"
+    )
+    procs, outs, logs = [], [], []
+    for i in range(num_processes):
+        out = outdir / f"{tag}{i}.npz"
+        log = outdir / f"{tag}{i}.log"
+        outs.append(out)
+        logs.append(log)
+        with open(log, "w") as fh:
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "metran_tpu.cluster.mesh",
+                 "--coordinator", f"localhost:{port}",
+                 "--num-processes", str(num_processes),
+                 "--process-id", str(i),
+                 "--out", str(out)],
+                cwd=REPO_ROOT, env=env, stdout=fh, stderr=fh,
+            ))
+    try:
+        for p in procs:
+            p.wait(timeout=300)
+    except subprocess.TimeoutExpired:  # pragma: no cover - hung pod
+        for p in procs:
+            p.kill()
+        return None, logs
+    if any(p.returncode != 0 for p in procs) or not all(
+        o.exists() for o in outs
+    ):
+        return None, logs
+    return outs, logs
+
+
+def _assemble(npz_paths, name):
+    parts = [np.load(p) for p in npz_paths]
+    n_rows = sum(len(d[f"{name}_rows"]) for d in parts)
+    first = parts[0][name]
+    out = np.empty((n_rows,) + first.shape[1:], first.dtype)
+    seen = np.zeros(n_rows, bool)
+    for d in parts:
+        rows = d[f"{name}_rows"]
+        out[rows] = d[name]
+        seen[rows] = True
+    assert seen.all(), f"{name}: processes did not cover all rows"
+    return out
+
+
+def test_distributed_mesh_bit_identity(tmp_path):
+    """A 2-process jax.distributed pod (2 devices each) and a
+    1-process pod on the same 4-device geometry run the batched serve
+    kernels bit-identically: extending the batch-axis NamedSharding
+    across processes changes nothing — the fleet axis inserts no
+    collectives (the single-process mesh == unsharded contract is
+    test_arena's)."""
+    two, logs2 = _run_pod(2, 2, tmp_path, "p")
+    if two is None:
+        tails = "; ".join(
+            log.read_text()[-300:].replace("\n", " | ")
+            for log in logs2 if log.exists()
+        )
+        pytest.skip(f"jax.distributed 2-process pod unavailable: {tails}")
+    one, logs1 = _run_pod(1, 4, tmp_path, "ref")
+    if one is None:  # pragma: no cover - 2-proc worked, 1-proc broke
+        tails = "; ".join(
+            log.read_text()[-300:].replace("\n", " | ")
+            for log in logs1 if log.exists()
+        )
+        pytest.fail(f"reference pod failed: {tails}")
+    for name in ("mean", "cov", "fmeans", "fvars"):
+        got = _assemble(two, name)
+        ref = _assemble(one, name)
+        assert got.dtype == np.float64
+        assert np.array_equal(got, ref), f"{name} diverged across hosts"
+
+
+# ----------------------------------------------------------------------
+# 6. pid-recycle sweep regression (io.sweep_stale_tmps)
+# ----------------------------------------------------------------------
+def test_sweep_stale_tmps_pid_recycle_regression(tmp_path):
+    """A temp whose recorded (pid, start_ticks) no longer names a live
+    process is swept even when the bare pid is alive again — the
+    pre-fix pid-only check pinned such temps forever once the kernel
+    recycled the pid to an unrelated long-lived process."""
+    pid = os.getpid()
+    ticks = _proc_start_ticks(pid)
+    assert ticks > 0  # /proc is available here by construction
+    # a genuinely dead pid: a child that has already exited
+    dead = subprocess.run(
+        [sys.executable, "-c", "import os; print(os.getpid())"],
+        capture_output=True, text=True, check=True,
+    )
+    dead_pid = int(dead.stdout)
+
+    keep_live = tmp_path / f".a.npz.{pid}-{ticks}-deadbeef.tmp.npz"
+    # same live pid, different start time: the "recycled pid" — the
+    # recorded owner is dead even though the pid is not
+    sweep_recycled = (
+        tmp_path / f".b.npz.{pid}-{ticks + 977}-deadbeef.tmp.npz"
+    )
+    keep_old_shape = tmp_path / f".c.npz.{pid}-deadbeef.tmp.npz"
+    sweep_dead = (
+        tmp_path / f".d.npz.{dead_pid}-{ticks}-deadbeef.tmp.npz"
+    )
+    sweep_dead_old = tmp_path / f".e.npz.{dead_pid}-deadbeef.tmp.npz"
+    not_a_tmp = tmp_path / "f.npz"
+    for p in (keep_live, sweep_recycled, keep_old_shape, sweep_dead,
+              sweep_dead_old, not_a_tmp):
+        p.write_bytes(b"x")
+
+    removed = {Path(p).name for p in sweep_stale_tmps(tmp_path)}
+    assert removed == {
+        sweep_recycled.name, sweep_dead.name, sweep_dead_old.name
+    }
+    assert keep_live.exists() and keep_old_shape.exists()
+    assert not_a_tmp.exists()
+    assert not sweep_recycled.exists()

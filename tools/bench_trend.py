@@ -63,6 +63,9 @@ HEADLINES: Dict[str, int] = {
     "durability_overhead_pct": -1,        # WAL-armed bulk update cost
     "durability_recovery_ms_per_1k": -1,  # recovery ms / 1k replayed
     "durability_replay_commits_per_s": +1,
+    "cluster_reads_per_s": +1,          # N-reader shared-memory plane
+    "cluster_read_scaling_x": +1,       # vs single-process ceiling
+    "cluster_mixed_p99_ms": -1,         # frontend 90/10 p99 (50ms SLO)
 }
 
 #: tail-fallback regexes for rounds with ``"parsed": null``: the raw

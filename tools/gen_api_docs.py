@@ -58,6 +58,13 @@ MODULES = [
     "metran_tpu.serve.refit",
     "metran_tpu.serve.service",
     "metran_tpu.serve.smoothing",
+    "metran_tpu.cluster.spec",
+    "metran_tpu.cluster.snapplane",
+    "metran_tpu.cluster.ipc",
+    "metran_tpu.cluster.worker",
+    "metran_tpu.cluster.writer",
+    "metran_tpu.cluster.frontend",
+    "metran_tpu.cluster.mesh",
     "metran_tpu.reliability.policy",
     "metran_tpu.reliability.health",
     "metran_tpu.reliability.faultinject",
