@@ -3671,6 +3671,305 @@ def run_serve_cluster_bench(out_path: str, budget_s: float) -> dict:
         shutil.rmtree(work, ignore_errors=True)
 
 
+def run_replication_bench(out_path: str, budget_s: float) -> dict:
+    """WAL-shipped replication scenario (`cluster/replication.py`,
+    ISSUE 17's measurement story).
+
+    Three measured claims (docs/concepts.md "Replication & failover"):
+
+    1. **steady-state ship lag** — the primary runs the flagship
+       batch-512 arena bulk tick with one SPAWNED standby in live ship
+       membership; every committed group is shipped synchronously
+       before its acks, and the standby's ship replies feed the
+       ack-to-applied lag samples.  Headline: ``repl_lag_p99_ms``
+       (bar: < 250 ms — replica reads stay fresh at the bulk rate);
+    2. **replica read fan-out** — the primary's in-process cached-read
+       rate alone, then the same loop concurrently with TWO spawned
+       standbys each running their own in-process ``read_loop`` off
+       their own snapshot stores.  Like the cluster bench, scaling is
+       reported against the core-capped ceiling (3 processes cannot
+       beat min(3, cores) on a core-starved host) next to the raw
+       ratio.  Headline: ``replica_read_scaling_x`` (bar: >= 2x total
+       with 2 replicas, cores permitting);
+    3. **failover RTO** — promote one standby (fence epoch bump +
+       persisted fence, apply-queue drain, durability re-armed over
+       its own log WITH the initial checkpoint) and serve a first
+       read from it; the wall from promote-call to first-served-read
+       is ``failover_rto_ms``.  The fenced ex-primary's next bulk
+       tick must raise ``PrimaryFencedError`` before any ack — the
+       zero-acked-loss half, asserted here and exhaustively by the
+       failover chaos matrix in ``tests/test_replication.py``.
+    """
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", JAX_CACHE + "-cpu")
+    import multiprocessing
+    import shutil
+    import tempfile
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from metran_tpu.cluster._testing import standby_service_factory
+    from metran_tpu.cluster.ipc import rpc_call
+    from metran_tpu.cluster.replication import (
+        ReplicationSpec, standby_main,
+    )
+    from metran_tpu.ops import dfm_statespace, kalman_filter
+    from metran_tpu.serve import (
+        DurabilitySpec, MetranService, ModelRegistry, PosteriorState,
+        PrimaryFencedError,
+    )
+
+    deadline = time.monotonic() + budget_s
+    # the durability bench's flagship bulk shape: batch 512, n=16
+    # series, 2 common factors, k=2 rows per tick — the lag bar is
+    # judged at the batch size whose ONE group-fdatasync the ship
+    # round-trip rides on
+    n_models, n, k_fct, k_rows, t_hist = 512, 16, 2, 2, 100
+    ticks, read_iters = 24, 6000
+    if os.environ.get("METRAN_TPU_BENCH_SMALL"):
+        n_models, t_hist, ticks, read_iters = 16, 30, 6, 600
+    horizons, steps = "1-5", 5
+    out = {
+        "platform": jax.default_backend(),
+        "n_models": n_models, "n_series": n, "n_factors": k_fct,
+    }
+
+    rng = np.random.default_rng(41)
+    alpha_sdf = rng.uniform(5.0, 40.0, (n_models, n))
+    alpha_cdf = rng.uniform(10.0, 60.0, (n_models, k_fct))
+    loadings = rng.uniform(0.3, 0.8, (n_models, n, k_fct)) / np.sqrt(k_fct)
+    y = rng.normal(size=(n_models, t_hist, n))
+    mask = np.ones(y.shape, bool)
+
+    def one(a_s, a_c, ld, yy, mm):
+        ss = dfm_statespace(a_s, a_c, ld, 1.0)
+        res = kalman_filter(ss, yy, mm, engine="joint", store=False)
+        return res.mean_f, res.cov_f
+
+    means, covs = jax.jit(jax.vmap(one))(
+        jnp.asarray(alpha_sdf), jnp.asarray(alpha_cdf),
+        jnp.asarray(loadings), jnp.asarray(y), jnp.asarray(mask),
+    )
+    means, covs = np.asarray(means), np.asarray(covs)
+    states = [
+        PosteriorState(
+            model_id=f"m{i}", version=0, t_seen=t_hist,
+            mean=means[i], cov=covs[i],
+            params=np.concatenate([alpha_sdf[i], alpha_cdf[i]]),
+            loadings=loadings[i], dt=1.0,
+            scaler_mean=np.zeros(n), scaler_std=np.ones(n),
+            names=tuple(f"s{j}" for j in range(n)),
+        )
+        for i in range(n_models)
+    ]
+    ids = [st.model_id for st in states]
+    work = tempfile.mkdtemp(prefix="metran-repl-")
+    primary = None
+    procs = []
+    try:
+        # persist the baseline once, then COPY it per standby — the
+        # documented shared-baseline contract (a copied checkpoint)
+        proot = os.path.join(work, "primary")
+        reg = ModelRegistry(root=proot)
+        for st in states:
+            reg.put(st, persist=True)
+        sroots = [os.path.join(work, f"standby{i}") for i in (1, 2)]
+        for sroot in sroots:
+            shutil.copytree(proot, sroot)
+
+        repl_spec = ReplicationSpec(enabled=True, standbys=2).validate()
+        primary = MetranService(
+            ModelRegistry(
+                root=proot, arena=True, arena_rows=n_models,
+                arena_mesh=0,
+            ),
+            flush_deadline=None, max_batch=4 * n_models,
+            persist_updates=False, readpath=True, horizons=horizons,
+            durability=DurabilitySpec(enabled=True, checkpoint_every=0),
+            replication=repl_spec,
+        )
+
+        ctx = multiprocessing.get_context("spawn")
+        socks = []
+        for i, sroot in enumerate(sroots, start=1):
+            sock = os.path.join(work, f"standby{i}.sock")
+            ready = os.path.join(work, f"standby{i}.ready")
+            proc = ctx.Process(
+                target=standby_main,
+                args=(repl_spec, sock, standby_service_factory,
+                      (sroot, horizons), ready),
+                name=f"metran-bench-standby{i}", daemon=True,
+            )
+            proc.start()
+            procs.append(proc)
+            t0 = time.monotonic()
+            while not os.path.exists(ready):
+                if not proc.is_alive():
+                    raise RuntimeError(f"standby{i} died during spawn")
+                if time.monotonic() - t0 > 180.0:
+                    raise RuntimeError(f"standby{i} never became ready")
+                time.sleep(0.1)
+            socks.append(sock)
+        hub = primary.repl_hub
+
+        # -- phase 1: steady-state ship lag at the bulk tick ----------
+        obs_rows = rng.normal(
+            size=(ticks + 2, n_models, k_rows, n)
+        ) * 0.2
+        attach1 = hub.add_standby(socks[0], name="standby1")
+        primary.update_batch(ids, obs_rows[0])  # compile + warm
+        primary.update_batch(ids, obs_rows[1])  # (standby compiles too)
+        warm_t0 = time.monotonic()
+        while hub.lag_seconds() > 0.0 \
+                and time.monotonic() - warm_t0 < 120.0:
+            hub.poll()  # wait out the standby's one-time XLA compile
+            time.sleep(0.05)
+        hub.lag_samples_s.clear()  # …and keep it out of the p99
+        tick_s = []
+        for t in range(ticks):
+            if time.monotonic() > deadline - 120:
+                out["truncated"] = "budget (lag laps)"
+                break
+            t0 = time.perf_counter()
+            primary.update_batch(ids, obs_rows[t + 2])
+            tick_s.append(time.perf_counter() - t0)
+        drain_t0 = time.monotonic()
+        while hub.lag_seconds() > 0.0 \
+                and time.monotonic() - drain_t0 < 60.0:
+            hub.poll()
+            time.sleep(0.05)
+        lag_ms = 1e3 * np.asarray(list(hub.lag_samples_s))
+        out["lag"] = {
+            "ticks": len(tick_s), "batch": n_models,
+            "attach_catch_up_commits": attach1["catch_up_commits"],
+            "shipped_commits": hub.shipped_commits,
+            "tick_p50_ms": round(
+                1e3 * float(np.median(tick_s)), 3
+            ) if tick_s else None,
+            "repl_lag_p50_ms": round(
+                float(np.percentile(lag_ms, 50)), 3
+            ) if lag_ms.size else None,
+            "repl_lag_p99_ms": round(
+                float(np.percentile(lag_ms, 99)), 3
+            ) if lag_ms.size else None,
+            "lag_samples": int(lag_ms.size),
+            "bar_lag_p99_ms": 250.0,
+        }
+        progress(
+            "repl_lag", p99_ms=out["lag"]["repl_lag_p99_ms"],
+            ticks=len(tick_s),
+        )
+        write_partial(out_path, out)
+
+        # -- phase 2: replica read fan-out (primary + 2 standbys) ------
+        hub.add_standby(socks[1], name="standby2")
+        for mid in ids[:8]:
+            primary.forecast(mid, steps)  # warm the primary read path
+        warm = {"model_ids": ids, "steps": steps, "iters": 64}
+        for sock in socks:  # compile each standby's forecast kernel
+            rpc_call(sock, "read_loop", warm, timeout_s=300.0)
+        t0 = time.perf_counter()
+        for i in range(read_iters):
+            primary.forecast(ids[i % n_models], steps)
+        primary_rps = read_iters / (time.perf_counter() - t0)
+        loop = {"model_ids": ids, "steps": steps, "iters": read_iters}
+        results = [None] * (1 + len(socks))
+
+        def _standby_loop(j, sock):
+            results[j] = rpc_call(sock, "read_loop", loop,
+                                  timeout_s=600.0)
+
+        threads = [
+            threading.Thread(target=_standby_loop, args=(j + 1, sock))
+            for j, sock in enumerate(socks)
+        ]
+        for th in threads:
+            th.start()
+        t0 = time.perf_counter()
+        for i in range(read_iters):
+            primary.forecast(ids[i % n_models], steps)
+        results[0] = {"iters": read_iters,
+                      "elapsed_s": time.perf_counter() - t0}
+        for th in threads:
+            th.join()
+        total_rps = sum(
+            r["iters"] / r["elapsed_s"] for r in results if r
+        )
+        try:
+            host_cores = len(os.sched_getaffinity(0))
+        except AttributeError:  # pragma: no cover - non-Linux
+            host_cores = os.cpu_count() or 1
+        ceiling = float(min(1 + len(socks), host_cores))
+        out["read_scaling"] = {
+            "reads_per_s_primary": round(primary_rps, 1),
+            "reads_per_s_total": round(total_rps, 1),
+            "replicas": len(socks),
+            "scaling_x_vs_primary": round(total_rps / primary_rps, 2),
+            "host_cores": host_cores,
+            "scaling_ceiling_x": ceiling,
+            "scaling_efficiency": round(
+                (total_rps / primary_rps) / ceiling, 2
+            ),
+            "bar_scaling_x": 2.0,
+        }
+        progress(
+            "repl_read_scaling", total=round(total_rps),
+            vs_primary=out["read_scaling"]["scaling_x_vs_primary"],
+            cores=host_cores,
+        )
+        write_partial(out_path, out)
+
+        # -- phase 3: failover RTO + the fence ------------------------
+        rpo_lag_s = hub.lag_seconds()
+        t0 = time.perf_counter()
+        report = rpc_call(
+            socks[0], "repl_promote", {"checkpoint": True},
+            timeout_s=600.0,
+        )
+        first = rpc_call(
+            socks[0], "forecast",
+            {"model_id": ids[0], "steps": steps}, timeout_s=300.0,
+        )
+        rto_ms = 1e3 * (time.perf_counter() - t0)
+        fenced = False
+        try:
+            primary.update_batch(ids, obs_rows[-1])
+        except PrimaryFencedError:
+            fenced = True
+        out["failover"] = {
+            "rto_ms": round(rto_ms, 3),
+            "promote_wall_ms": round(
+                1e3 * report["promote_wall_s"], 3
+            ),
+            "rpo_lag_s_at_promote": round(rpo_lag_s, 6),
+            "promoted_epoch": report["epoch"],
+            "applied_commits": report["applied_commits"],
+            "first_read_version": int(getattr(first, "version", 0)),
+            "fenced_ack_rejected": fenced,
+        }
+        progress(
+            "repl_failover", rto_ms=out["failover"]["rto_ms"],
+            fenced=fenced,
+        )
+        write_partial(out_path, out)
+        return out
+    finally:
+        if primary is not None:
+            primary.close()
+        for i, proc in enumerate(procs):
+            try:
+                rpc_call(os.path.join(work, f"standby{i + 1}.sock"),
+                         "shutdown", timeout_s=10.0)
+            except Exception:
+                pass
+            proc.join(timeout=15.0)
+            if proc.is_alive():
+                proc.terminate()
+                proc.join(timeout=5.0)
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def run_capacity_bench(out_path: str, budget_s: float) -> dict:
     """Capacity & cost plane scenario (`obs/capacity.py`, ISSUE 13).
 
@@ -4494,6 +4793,16 @@ def main() -> None:
             "cluster_mixed_p99_ms": g(
                 detail, "serve_cluster", "mixed", "p99_ms"
             ),
+            "repl_lag_p99_ms": g(
+                detail, "replication", "lag", "repl_lag_p99_ms"
+            ),
+            "failover_rto_ms": g(
+                detail, "replication", "failover", "rto_ms"
+            ),
+            "replica_read_scaling_x": g(
+                detail, "replication", "read_scaling",
+                "scaling_x_vs_primary"
+            ),
             "grad_backward_speedup": g(
                 detail, "grad", "backward_speedup"
             ),
@@ -4796,6 +5105,19 @@ def main() -> None:
         _wait(sc_proc, sc_budget + 15.0, "serve_cluster")
         serve_cluster = _read_json(sc_path) or {}
 
+    # WAL-shipped replication scenario (ISSUE 17's measurement story):
+    # ship-lag p99 at the batch-512 bulk tick, 2-replica read fan-out,
+    # failover RTO + the fenced ex-primary — CPU-pinned like the others
+    replication = {}
+    if budget - elapsed() > 150:
+        rp_path = os.path.join(CACHE_DIR, "bench_replication.json")
+        if os.path.exists(rp_path):
+            os.remove(rp_path)
+        rp_budget = max(min(240.0, budget - elapsed() - 60.0), 60.0)
+        rp_proc = _spawn("replicate", rp_path, rp_budget, cpu_env)
+        _wait(rp_proc, rp_budget + 15.0, "replication")
+        replication = _read_json(rp_path) or {}
+
     # gradient-engine scenario (ISSUE 10's measurement story): adjoint
     # vs autodiff backward wall time at the standard workload, the
     # flat-in-T backward-memory curve, and the anchored refit
@@ -4834,6 +5156,7 @@ def main() -> None:
               "capacity": capacity,
               "durability": durability,
               "serve_cluster": serve_cluster,
+              "replication": replication,
               "grad": grad,
               "workload": {"n_series": N_SERIES, "n_factors": N_FACTORS,
                            "t_steps": T_STEPS, "missing": MISSING,
@@ -4866,8 +5189,8 @@ if __name__ == "__main__":
                                  "obs", "robust-obs", "robust",
                                  "steady", "refit", "detect",
                                  "capacity", "durability",
-                                 "serve-cluster", "grad",
-                                 "grad-mem"])
+                                 "serve-cluster", "replicate",
+                                 "grad", "grad-mem"])
     parser.add_argument("--out", default=None)
     parser.add_argument("--budget", type=float, default=900.0)
     parser.add_argument(
@@ -5182,6 +5505,34 @@ if __name__ == "__main__":
                 "value": rs.get("reads_per_s_total", 0.0),
                 "unit": "reads/s", "vs_baseline": 0.0,
                 "detail": sc_out,
+            }), flush=True)
+    elif args.phase == "replicate":
+        out_path = args.out or os.path.join(
+            CACHE_DIR, "bench_replication.json"
+        )
+        os.makedirs(CACHE_DIR, exist_ok=True)
+        rp_out = run_replication_bench(out_path, args.budget)
+        if args.out is None:
+            # standalone run: emit the BENCH_r* result-line schema with
+            # the ship-lag p99 headline (bar: < 250 ms at batch-512)
+            # next to the failover RTO and the 2-replica read scaling
+            lg = rp_out.get("lag") or {}
+            rs = rp_out.get("read_scaling") or {}
+            fo = rp_out.get("failover") or {}
+            print(json.dumps({
+                "metric": (
+                    "replication ship-lag p99 (batch "
+                    f"{lg.get('batch')} bulk ticks, "
+                    f"{lg.get('lag_samples')} samples vs 250 ms bar; "
+                    f"failover RTO {fo.get('rto_ms')} ms, "
+                    f"{rs.get('scaling_x_vs_primary')}x reads with "
+                    f"{rs.get('replicas')} replicas on "
+                    f"{rs.get('host_cores')} core(s), fenced ack "
+                    f"rejected={fo.get('fenced_ack_rejected')})"
+                ),
+                "value": lg.get("repl_lag_p99_ms", 0.0),
+                "unit": "ms", "vs_baseline": 0.0,
+                "detail": rp_out,
             }), flush=True)
     elif args.phase == "grad":
         out_path = args.out or os.path.join(CACHE_DIR, "bench_grad.json")

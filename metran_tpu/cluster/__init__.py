@@ -17,7 +17,11 @@ breaks it, with three pieces:
   while preserving the single-process API and semantics;
 - :mod:`~metran_tpu.cluster.mesh` — ``jax.distributed`` batch-axis
   sharding that extends the arena's device mesh across processes,
-  bit-identical to single-process at f64.
+  bit-identical to single-process at f64;
+- :mod:`~metran_tpu.cluster.replication` — WAL frame shipping to
+  continuously-replaying hot standbys (ack-synchronous, so failover
+  loses zero acked commits), replica read fan-out, and epoch-fenced
+  promotion (docs/concepts.md "Replication & failover").
 
 Opt-in end to end: ``MetranService(cluster=ClusterSpec(...))`` arms
 the writer-side plane, :class:`~metran_tpu.cluster.frontend.
@@ -27,6 +31,13 @@ ClusterFrontend` runs the topology; shipped off
 
 from .frontend import ClusterFrontend
 from .ipc import RpcClient, RpcServer
+from .replication import (
+    ReplicaStandby,
+    ReplicationHub,
+    ReplicationSpec,
+    StaleEpochError,
+    standby_main,
+)
 from .snapplane import SnapshotPlane, plane_bytes
 from .spec import ClusterSpec
 from .worker import ReadWorker, worker_main
@@ -36,11 +47,16 @@ __all__ = [
     "ClusterFrontend",
     "ClusterSpec",
     "ReadWorker",
+    "ReplicaStandby",
+    "ReplicationHub",
+    "ReplicationSpec",
     "RpcClient",
     "RpcServer",
     "SnapshotPlane",
+    "StaleEpochError",
     "WriterHost",
     "plane_bytes",
+    "standby_main",
     "worker_main",
     "writer_main",
 ]

@@ -16,6 +16,7 @@ import numpy as np
 __all__ = [
     "make_states",
     "seed_root",
+    "standby_service_factory",
     "storm_publisher",
     "writer_service_factory",
 ]
@@ -69,14 +70,15 @@ def seed_root(root, seed=7, n_models=4, n=5, kf=1, t=60):
 
 
 def writer_service_factory(spec, recovering, root, horizons="1-5",
-                           durable=True):
+                           durable=True, repl=False):
     """The ``ClusterFrontend`` service factory used by tests and bench.
 
     Builds the writer's ``MetranService`` over the fleet persisted by
     :func:`seed_root`; ``recovering=True`` (a frontend
     ``restart_writer`` after a writer crash) routes through
     ``MetranService.recover`` so the WAL tail replays before serving
-    resumes.
+    resumes.  ``repl=True`` arms the replication hub (requires
+    ``durable``) so the frontend can ``attach_standby``.
     """
     import jax
 
@@ -84,12 +86,18 @@ def writer_service_factory(spec, recovering, root, horizons="1-5",
     # whose conftest enabled x64; this factory runs in a spawned child
     # where no conftest ever runs
     jax.config.update("jax_enable_x64", True)
+    from ..cluster.replication import ReplicationSpec
     from ..serve import DurabilitySpec, MetranService, ModelRegistry
 
+    replication = (
+        ReplicationSpec(enabled=True) if repl
+        else ReplicationSpec(enabled=False)
+    )
     if recovering:
         return MetranService.recover(
             root, flush_deadline=None, persist_updates=False,
             readpath=True, horizons=horizons, cluster=spec,
+            replication=replication,
         )
     durability = (
         DurabilitySpec(enabled=True, checkpoint_every=0)
@@ -99,7 +107,27 @@ def writer_service_factory(spec, recovering, root, horizons="1-5",
     return MetranService(
         reg, flush_deadline=None, persist_updates=False,
         readpath=True, horizons=horizons, durability=durability,
-        cluster=spec,
+        cluster=spec, replication=replication,
+    )
+
+
+def standby_service_factory(root, horizons="1-5"):
+    """A :func:`~metran_tpu.cluster.replication.standby_main` service
+    factory: the fleet persisted under ``root`` (its OWN root — the
+    same deterministic :func:`seed_root` seed as the primary's, or a
+    copied checkpoint), read path armed, durability NOT armed
+    (shipped frames land on the standby's log verbatim;
+    ``promote()`` re-arms durability over it)."""
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    from ..serve import DurabilitySpec, MetranService, ModelRegistry
+
+    reg = ModelRegistry(root=root)
+    return MetranService(
+        reg, flush_deadline=None, persist_updates=False,
+        readpath=True, horizons=horizons,
+        durability=DurabilitySpec(enabled=False),
     )
 
 

@@ -42,7 +42,7 @@ import time
 from logging import getLogger
 from typing import Callable, List, Optional, Tuple
 
-from .ipc import RpcClient
+from .ipc import RpcClient, rpc_call
 from .snapplane import SnapshotPlane
 from .spec import ClusterSpec
 from .worker import worker_main
@@ -134,6 +134,10 @@ class ClusterFrontend:
         self.writer = None  # RpcClient
         self.plane: Optional[SnapshotPlane] = None
         self._workers: List[_Worker] = []
+        #: replication standbys attached through this frontend — the
+        #: promotion candidates (socket paths, attach order preserved)
+        self.standby_sockets: List[str] = []
+        self.promoted_socket: Optional[str] = None
         try:
             self._spawn_writer(recovering=False)
             for i in range(self.spec.workers):
@@ -279,6 +283,102 @@ class ClusterFrontend:
                     self._restart_worker(worker)
         finally:
             self._restarting = False
+
+    # -- replication (docs/concepts.md "Replication & failover") ---------
+    def attach_standby(self, socket_path: str,
+                       name: Optional[str] = None) -> dict:
+        """Register a running standby (``cluster.replication.
+        standby_main``) with the writer's replication hub.  The writer
+        catches it up from its own WAL under the ship lock, then every
+        subsequent commit is shipped before its ack — the standby
+        becomes a promotion candidate and a read replica.  Returns the
+        writer's attach summary."""
+        out = self.writer.call(
+            "repl_attach",
+            {"socket_path": socket_path, "name": name},
+        )
+        if socket_path not in self.standby_sockets:
+            self.standby_sockets.append(socket_path)
+        return out
+
+    def promote_standby(self, socket_path: Optional[str] = None,
+                        checkpoint: bool = True) -> dict:
+        """Fail over onto a standby after writer death: fence (epoch
+        bump), drain its apply queue, re-arm durability over its log,
+        and re-point this frontend's write path at it.  RTO is this
+        call's wall-clock plus the first served read.
+
+        If the standby's hello reports its own snapshot plane, the
+        frontend swaps onto it and bounces the read workers (the
+        ``restart_writer`` plane-swap path); a plane-less standby still
+        serves — worker reads fall through to the promoted writer via
+        the ordinary transport-failure routing."""
+        if self.writer_alive():
+            raise RuntimeError(
+                "writer is alive; promote_standby is for failover — "
+                "use restart_writer for same-host crash recovery"
+            )
+        socket_path = socket_path or (
+            self.standby_sockets[0] if self.standby_sockets else None
+        )
+        if socket_path is None:
+            raise RuntimeError(
+                "no standby attached — nothing to promote"
+            )
+        t0 = time.monotonic()
+        self._restarting = True
+        try:
+            if self.writer is not None:
+                self.writer.close()
+            report = rpc_call(
+                socket_path, "repl_promote",
+                {"checkpoint": checkpoint},
+            )
+            self.writer = RpcClient(socket_path)
+            self.writer_socket = socket_path
+            self.promoted_socket = socket_path
+            if socket_path in self.standby_sockets:
+                self.standby_sockets.remove(socket_path)
+            hello = self.writer.call("hello")
+            new_plane = hello.get("plane")
+            old_plane = (
+                self.plane.name if self.plane is not None else None
+            )
+            if new_plane is not None and new_plane != old_plane:
+                if self.plane is not None:
+                    self.plane.close(unlink=False)
+                self.plane = SnapshotPlane.attach(new_plane)
+                if old_plane is not None:
+                    # the dead writer never unlinked its segment
+                    try:
+                        leaked = SnapshotPlane.attach(old_plane)
+                    except (FileNotFoundError, ValueError):
+                        pass
+                    else:
+                        leaked.close(unlink=True)
+                self.restarts += 1
+                for worker in list(self._workers):
+                    try:
+                        worker.client.call("shutdown")
+                    except Exception:
+                        pass
+                    worker.proc.join(timeout=10.0)
+                    if worker.proc.is_alive():
+                        worker.proc.terminate()
+                        worker.proc.join(timeout=5.0)
+                    self._restart_worker(worker)
+        finally:
+            self._restarting = False
+        report = dict(report)
+        report["failover_wall_s"] = round(time.monotonic() - t0, 6)
+        if self.events is not None:
+            self.events.emit(
+                "replica_promote", fault_point="cluster.frontend",
+                socket=socket_path,
+                epoch=report.get("epoch"),
+                failover_wall_s=report["failover_wall_s"],
+            )
+        return report
 
     # -- routing (the preserved MetranService surface) -------------------
     def update(self, model_id: str, new_obs):

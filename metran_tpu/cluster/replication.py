@@ -1,0 +1,873 @@
+"""WAL-shipped replication: continuously-replaying hot standbys,
+replica read fan-out, and zero-acked-loss failover.
+
+The durability plane already turns every acked commit into a
+CRC-framed WAL group (serve.durability) and reconstructs acked state
+bit-identically by replaying those frames through the live update
+kernels.  This module streams the **same frames** over the cluster
+plane's unix-socket RPC instead of reading them post-crash, which
+makes a second host's service a *continuously-replaying replica*:
+
+- :class:`ReplicationHub` (primary side) — attached as the
+  :class:`~metran_tpu.serve.durability.DurabilityManager`'s
+  ``shipper``.  Every committed group is pushed to all live standbys
+  **synchronously between the local fdatasync and the callers' acks**
+  (every WAL crash point fires at or before the local append, so any
+  commit a caller ever saw acked was already received — and locally
+  persisted — by every connected standby: zero acked commits can be
+  lost at failover).  A standby that cannot ack inside the ship
+  timeout is dropped (it re-attaches and catches up through
+  :func:`~metran_tpu.serve.durability.iter_frames` over the primary's
+  own log), never allowed to block acks; per-standby ack-to-applied
+  lag is tracked from the ship replies.
+- :class:`ReplicaStandby` (standby side) — wraps a fully-constructed
+  ``MetranService`` seeded from the same baseline as the primary.
+  Shipped frames are CRC-verified at the receiving edge, appended
+  **verbatim** to the standby's own WAL
+  (:meth:`~metran_tpu.serve.durability.WriteAheadLog.append_encoded`)
+  before the ship RPC is acked, then applied on a dedicated thread
+  through :func:`~metran_tpu.serve.durability.replay_wal` — the SAME
+  replay engine recovery uses, so the standby is **bit-identical at
+  f64** to the primary at every replicated version, and a torn or
+  short tail is never applied.  The standby publishes its own
+  ``SnapshotStore`` (and shared-memory plane when armed), so replica
+  read capacity scales with replicas.
+- **Promotion with epoch fencing** — every ship carries the stream
+  epoch in its header.  :meth:`ReplicaStandby.promote` bumps the
+  epoch (persisted next to the standby's log), drains the apply
+  queue, and re-arms a full ``DurabilityManager`` over the standby's
+  log — the promoted service is immediately a durable primary.  The
+  old primary's next ship is answered with :class:`StaleEpochError`,
+  which fences its hub: the commit that discovered the fence and
+  every commit after it fail **before any ack resolves**
+  (:class:`~metran_tpu.serve.durability.PrimaryFencedError` re-raised
+  by ``_wal_commit`` like a process death), so a fenced old primary
+  can never ack a commit after promotion.
+
+RPO/RTO contract (measured by ``bench.py --phase replicate`` and the
+failover chaos matrix in ``reliability.scenarios``): RPO is the
+replication lag at kill — **0 acked commits** by construction, since
+shipping is ack-synchronous; RTO is the promotion wall-clock to the
+first served read.  See docs/concepts.md "Replication & failover".
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import zlib
+from collections import deque
+from logging import getLogger
+from time import perf_counter
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+from ..serve.durability import (
+    _FRAME_HEAD,
+    REC_MAGIC,
+    DurabilityManager,
+    DurabilitySpec,
+    PrimaryFencedError,
+    RecoveryError,
+    WalRecord,
+    WriteAheadLog,
+    decode_group,
+    encode_group,
+    iter_frames,
+    list_segments,
+    replay_wal,
+)
+from .ipc import RpcClient, RpcServer
+
+logger = getLogger(__name__)
+
+__all__ = [
+    "PrimaryFencedError",
+    "ReplicaStandby",
+    "ReplicationHub",
+    "ReplicationSpec",
+    "StaleEpochError",
+    "decode_frame",
+    "standby_main",
+]
+
+#: epoch fence file kept next to the standby's WAL segments — a
+#: restarted standby must come back at (at least) its promoted epoch,
+#: or a zombie primary could re-ship into it
+EPOCH_FILE = "repl-epoch"
+
+
+class StaleEpochError(RuntimeError):
+    """A ship/hello carried an epoch older than the standby's — the
+    sender is a fenced ex-primary.  Crosses the RPC boundary pickled
+    (``args`` holds only the epoch so unpickling reconstructs it);
+    the hub converts it into a sticky
+    :class:`~metran_tpu.serve.durability.PrimaryFencedError`."""
+
+    def __init__(self, epoch: int):
+        super().__init__(int(epoch))
+        self.epoch = int(epoch)
+
+    def __str__(self) -> str:
+        return (
+            "stale replication epoch: a standby was promoted to "
+            f"epoch {self.epoch}"
+        )
+
+
+class ReplicationSpec(NamedTuple):
+    """WAL-shipping replication policy (``MetranService(replication=
+    ...)``; defaults from :func:`metran_tpu.config.serve_defaults` —
+    ``METRAN_TPU_SERVE_REPL*``, shipped off).
+
+    ``standbys`` is the expected standby count (capacity planning +
+    the ``replicas_live`` gauge's denominator — attaching more is
+    allowed); ``ack_timeout_s`` bounds each synchronous ship
+    round-trip (a standby that cannot ack inside it is dropped and
+    must re-attach, so a wedged replica degrades redundancy instead
+    of stalling primary acks); ``lag_warn_records`` is the standby
+    apply backlog that books a ``replica_lag`` event (with
+    half-backlog hysteresis)."""
+
+    enabled: bool = False
+    standbys: int = 1
+    ack_timeout_s: float = 30.0
+    lag_warn_records: int = 1024
+    socket_dir: str = ""  # "" = a per-run tempfile directory
+
+    @classmethod
+    def from_defaults(cls) -> "ReplicationSpec":
+        from ..config import serve_defaults
+
+        d = serve_defaults()
+        return cls(
+            enabled=bool(d["repl"]),
+            standbys=int(d["repl_standbys"]),
+            ack_timeout_s=float(d["repl_ack_timeout_s"]),
+            lag_warn_records=int(d["repl_lag_warn"]),
+            socket_dir=str(d["repl_socket_dir"]),
+        ).validate()
+
+    def validate(self) -> "ReplicationSpec":
+        """Reject inert or broken combinations at construction."""
+        if not self.enabled:
+            return self
+        if self.standbys < 1:
+            raise ValueError(
+                f"replication standbys must be >= 1, got "
+                f"{self.standbys} — replication with no standby ships "
+                "nowhere and protects nothing"
+            )
+        if not self.ack_timeout_s > 0.0:
+            raise ValueError(
+                f"replication ack_timeout_s must be > 0, got "
+                f"{self.ack_timeout_s} — every commit waits on the "
+                "ship ack for at most this long"
+            )
+        if self.lag_warn_records < 1:
+            raise ValueError(
+                f"replication lag_warn_records must be >= 1, got "
+                f"{self.lag_warn_records}"
+            )
+        if self.socket_dir and not os.path.isdir(self.socket_dir):
+            raise ValueError(
+                f"replication socket_dir {self.socket_dir!r} does not "
+                "exist — primaries and standbys rendezvous on unix "
+                "sockets under it"
+            )
+        return self
+
+    def resolve_socket_dir(self) -> str:
+        """The rendezvous directory, creating a private one when the
+        spec leaves it to us."""
+        if self.socket_dir:
+            return self.socket_dir
+        import tempfile
+
+        return tempfile.mkdtemp(prefix="metran_repl_")
+
+
+def decode_frame(frame: bytes) -> List[WalRecord]:
+    """Verify + decode one raw CRC-framed unit (``b"WR"`` + header +
+    payload) — the receiving edge's defense: a shipped frame is
+    re-verified against its own CRC before it is appended to the
+    standby's log or queued for apply, so a corrupted transport can
+    never plant a frame the recovery readers would later reject."""
+    head_len = len(REC_MAGIC) + _FRAME_HEAD.size
+    if len(frame) < head_len or frame[: len(REC_MAGIC)] != REC_MAGIC:
+        raise ValueError("bad replication frame magic")
+    length, crc = _FRAME_HEAD.unpack_from(frame, len(REC_MAGIC))
+    payload = frame[head_len:]
+    if len(payload) != length:
+        raise ValueError("replication frame length mismatch")
+    if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+        raise ValueError("replication frame CRC mismatch")
+    return decode_group(payload)
+
+
+class _Standby:
+    """One live standby from the hub's point of view."""
+
+    def __init__(self, name: str, socket_path: str, client: RpcClient):
+        self.name = name
+        self.socket_path = socket_path
+        self.client = client
+        self.shipped_group = 0
+        self.received_group = 0
+        self.applied_group = 0
+        self.backlog = 0
+        self.failures = 0
+        self.lag_warned = False
+        #: (group, ship-monotonic) of groups shipped but not yet seen
+        #: applied — the ack-to-applied lag sample source
+        self.pending: deque = deque()
+
+
+class ReplicationHub:
+    """Primary-side WAL frame shipper (the durability manager's
+    ``shipper`` hook).
+
+    ``ship(groups)`` runs on the dispatch thread between the local
+    WAL fdatasync and the callers' acks; ``add_standby`` holds the
+    same lock while it catches a new standby up from the primary's
+    own log, so a commit can never fall between catch-up and the live
+    stream.  Ordinary standby failures degrade (drop + re-attach); a
+    :class:`StaleEpochError` reply fences the hub permanently."""
+
+    def __init__(self, service, spec: ReplicationSpec):
+        self.service = service
+        self.spec = spec
+        self._lock = threading.RLock()
+        self._standbys: Dict[str, _Standby] = {}
+        self.epoch = 1
+        self.fenced = False
+        self.fenced_epoch: Optional[int] = None
+        self.shipped_groups = 0
+        self.shipped_commits = 0
+        self.drops = 0
+        #: recent ack-to-applied lag samples in seconds (the
+        #: ``repl_lag_p99_ms`` bench headline's source)
+        self.lag_samples_s: deque = deque(maxlen=8192)
+
+    # -- the ack-path hooks (called by DurabilityManager) ---------------
+    def raise_if_fenced(self) -> None:
+        if self.fenced:
+            raise PrimaryFencedError(
+                f"primary (epoch {self.epoch}) is fenced: a standby "
+                f"was promoted to epoch {self.fenced_epoch}; this "
+                "process can never ack a commit again"
+            )
+
+    def ship(self, groups) -> None:
+        """Push one committed dispatch's group frames to every live
+        standby, synchronously.  Called BEFORE any caller's ack
+        resolves; raising here fails the round un-acked."""
+        groups = [g for g in groups if g.n_records]
+        if not groups:
+            return
+        frames = [encode_group(g) for g in groups]
+        group = int(groups[0].group)
+        n_records = sum(g.n_records for g in groups)
+        with self._lock:
+            self.raise_if_fenced()
+            if not self._standbys:
+                return
+            t0 = time.monotonic()
+            for sb in list(self._standbys.values()):
+                self._push(sb, frames, group, n_records, t0)
+            self.shipped_groups += 1
+            self.shipped_commits += n_records
+
+    def _push(self, sb: _Standby, frames, group: int, n_records: int,
+              t0: float) -> None:
+        try:
+            reply = sb.client.call("repl_frames", {
+                "epoch": self.epoch, "group": group,
+                "n_records": n_records, "frames": frames,
+            })
+        except StaleEpochError as exc:
+            self.fenced = True
+            self.fenced_epoch = exc.epoch
+            logger.error(
+                "standby %s is at epoch %d > our %d: this primary is "
+                "fenced and will never ack again", sb.name, exc.epoch,
+                self.epoch,
+            )
+            raise PrimaryFencedError(
+                f"standby {sb.name} was promoted to epoch "
+                f"{exc.epoch}; this primary (epoch {self.epoch}) is "
+                "fenced — the commit was NOT acked"
+            ) from exc
+        except Exception:
+            # an unreachable/broken standby must degrade redundancy,
+            # not block or fail primary acks: drop it (it re-attaches
+            # and catches up from the primary's log)
+            sb.failures += 1
+            self.drops += 1
+            logger.exception(
+                "standby %s failed a ship and was dropped (it can "
+                "re-attach and catch up)", sb.name,
+            )
+            self._standbys.pop(sb.name, None)
+            try:
+                sb.client.close()
+            except Exception:  # pragma: no cover - teardown
+                pass
+            return
+        sb.shipped_group = group
+        sb.pending.append((group, t0))
+        self._harvest(sb, reply, time.monotonic())
+
+    def _harvest(self, sb: _Standby, reply: dict, now: float) -> None:
+        """Fold one standby reply into the lag books."""
+        applied = int(reply.get("applied", sb.applied_group))
+        while sb.pending and sb.pending[0][0] <= applied:
+            _g, t_ship = sb.pending.popleft()
+            self.lag_samples_s.append(now - t_ship)
+        sb.applied_group = applied
+        sb.received_group = int(reply.get("received", sb.received_group))
+        sb.backlog = int(reply.get("backlog", 0))
+        events = self.service.events
+        if sb.backlog >= self.spec.lag_warn_records:
+            if not sb.lag_warned and events is not None:
+                sb.lag_warned = True
+                events.emit(
+                    "replica_lag", fault_point="cluster.replication",
+                    standby=sb.name, backlog=sb.backlog,
+                    applied_group=sb.applied_group,
+                    shipped_group=sb.shipped_group,
+                )
+        elif sb.backlog < max(1, self.spec.lag_warn_records // 2):
+            sb.lag_warned = False
+
+    # -- membership -----------------------------------------------------
+    def add_standby(self, socket_path: str,
+                    name: Optional[str] = None) -> dict:
+        """Attach one standby: epoch handshake, catch-up from the
+        primary's own WAL (under the ship lock, so no commit falls
+        between catch-up and the live stream), then live membership.
+        Returns the handshake summary."""
+        name = name or os.path.basename(socket_path)
+        client = RpcClient(
+            socket_path, timeout_s=self.spec.ack_timeout_s
+        )
+        with self._lock:
+            self.raise_if_fenced()
+            try:
+                hello = client.call(
+                    "repl_hello",
+                    {"epoch": self.epoch, "pid": os.getpid()},
+                )
+            except StaleEpochError as exc:
+                client.close()
+                self.fenced = True
+                self.fenced_epoch = exc.epoch
+                raise PrimaryFencedError(
+                    f"standby {name} is already at epoch {exc.epoch}; "
+                    f"this primary (epoch {self.epoch}) is fenced"
+                ) from exc
+            except Exception:
+                client.close()
+                raise
+            sb = _Standby(name, socket_path, client)
+            caught_up = self._catch_up(sb)
+            self._standbys[name] = sb
+            events = self.service.events
+            if events is not None:
+                events.emit(
+                    "replica_connect",
+                    fault_point="cluster.replication",
+                    standby=name, catch_up_commits=caught_up,
+                    epoch=self.epoch,
+                )
+            return {
+                "standby": name, "epoch": self.epoch,
+                "catch_up_commits": caught_up,
+                "replicas": len(self._standbys),
+            }
+
+    def _catch_up(self, sb: _Standby) -> int:
+        """Re-ship every intact frame of the primary's own log (the
+        follower API — commits since the last checkpoint; the standby
+        skips anything its versions already cover).  Failures here
+        raise: an attach that cannot catch up must not join live
+        membership with a hole behind it."""
+        dur = self.service._durability
+        if dur is None:  # pragma: no cover - hub always armed with WAL
+            return 0
+        shipped = 0
+        batch: List[bytes] = []
+        batch_records: List[WalRecord] = []
+        batch_group: Optional[int] = None
+
+        def flush() -> None:
+            nonlocal shipped
+            if not batch:
+                return
+            reply = sb.client.call("repl_frames", {
+                "epoch": self.epoch, "group": int(batch_group or 0),
+                "n_records": len(batch_records),
+                "frames": list(batch),
+            })
+            shipped += len(batch_records)
+            sb.shipped_group = int(batch_group or 0)
+            self._harvest(sb, reply, time.monotonic())
+
+        for frame in iter_frames(dur.dir, since_seq=1):
+            if not frame.records:
+                continue
+            g = int(frame.records[0].group)
+            if batch and g != batch_group:
+                flush()
+                batch, batch_records = [], []
+            batch_group = g
+            batch.append(frame.data)
+            batch_records.extend(frame.records)
+        flush()
+        return shipped
+
+    # -- reporting ------------------------------------------------------
+    def poll(self) -> None:
+        """Refresh per-standby applied/backlog books off the ship path
+        (the bench drain + gauge scrapes between quiet stretches)."""
+        with self._lock:
+            for sb in list(self._standbys.values()):
+                try:
+                    reply = sb.client.call("repl_status")
+                except Exception:
+                    sb.failures += 1
+                    continue
+                self._harvest(sb, reply, time.monotonic())
+
+    def replicas_live(self) -> int:
+        return len(self._standbys)
+
+    def lag_seconds(self) -> float:
+        """Worst ack-to-applied lag across standbys right now (0 when
+        every shipped group is applied everywhere)."""
+        now = time.monotonic()
+        worst = 0.0
+        with self._lock:
+            for sb in self._standbys.values():
+                if sb.pending:
+                    worst = max(worst, now - sb.pending[0][1])
+        return worst
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "epoch": self.epoch,
+                "fenced": self.fenced,
+                "replicas": len(self._standbys),
+                "shipped_groups": self.shipped_groups,
+                "shipped_commits": self.shipped_commits,
+                "drops": self.drops,
+                "lag_s": round(self.lag_seconds(), 6),
+                "standbys": {
+                    sb.name: {
+                        "shipped_group": sb.shipped_group,
+                        "received_group": sb.received_group,
+                        "applied_group": sb.applied_group,
+                        "backlog": sb.backlog,
+                        "failures": sb.failures,
+                    }
+                    for sb in self._standbys.values()
+                },
+            }
+
+    def close(self) -> None:
+        with self._lock:
+            for sb in self._standbys.values():
+                try:
+                    sb.client.close()
+                except Exception:  # pragma: no cover - teardown
+                    pass
+            self._standbys.clear()
+
+
+def _to_host(obj):
+    from .writer import _to_host as impl
+
+    return impl(obj)
+
+
+class ReplicaStandby:
+    """Continuously-replaying standby host around one seeded
+    ``MetranService``.
+
+    The wrapped service must share the primary's baseline (same
+    states at the same versions — a copied checkpoint or the same
+    deterministic seeding) and must NOT arm its own durability plane:
+    shipped frames are appended to the standby's log **verbatim**, and
+    :meth:`promote` re-arms a full durability manager over that log.
+    Reads (``forecast``/``read_loop``) serve immediately from the
+    standby's own ``SnapshotStore``/plane — the replica read fan-out;
+    writes are refused until promotion."""
+
+    def __init__(self, service, spec: ReplicationSpec,
+                 socket_path: str, wal_dir=None):
+        from pathlib import Path
+
+        if service._durability is not None:
+            raise ValueError(
+                "standby service must not arm its own durability "
+                "plane while replicating — shipped frames land on the "
+                "standby's log verbatim, and promote() re-arms "
+                "durability over it"
+            )
+        if service.registry.root is None:
+            raise ValueError(
+                "a standby needs a registry with a storage root (its "
+                "local WAL and post-promotion checkpoints live there)"
+            )
+        self.service = service
+        self.spec = spec
+        self.socket_path = socket_path
+        self.wal_dir = (
+            Path(wal_dir) if wal_dir else service.registry.root / "wal"
+        )
+        self.wal_dir.mkdir(parents=True, exist_ok=True)
+        self.epoch = self._load_epoch()
+        existing = list_segments(self.wal_dir)
+        next_seq = (existing[-1][0] + 1) if existing else 1
+        self.log = WriteAheadLog(self.wal_dir, next_seq, fsync=True)
+        self._cv = threading.Condition()
+        self._queue: deque = deque()  # (group, [WalRecord, ...])
+        self._applying = False
+        self._apply_error: Optional[BaseException] = None
+        self._stop = False
+        self.promoted = False
+        self.received_group = 0
+        self.applied_group = 0
+        self.received_commits = 0
+        self.applied_commits = 0
+        self.skipped_commits = 0
+        self.last_promote: Optional[dict] = None
+        self._shutdown = threading.Event()
+        self._apply_thread = threading.Thread(
+            target=self._apply_loop, name="metran-repl-apply",
+            daemon=True,
+        )
+        self._apply_thread.start()
+        self.rpc = RpcServer(socket_path, self._handlers())
+
+    # -- epoch fence persistence ---------------------------------------
+    def _load_epoch(self) -> int:
+        try:
+            return max(
+                1, int((self.wal_dir / EPOCH_FILE).read_text())
+            )
+        except (OSError, ValueError):
+            return 1
+
+    def _persist_epoch(self) -> None:
+        tmp = self.wal_dir / f".{EPOCH_FILE}.{os.getpid()}.tmp"
+        tmp.write_text(str(self.epoch))
+        os.replace(tmp, self.wal_dir / EPOCH_FILE)
+
+    # -- RPC surface ----------------------------------------------------
+    def _handlers(self) -> dict:
+        svc = self.service
+        return {
+            "hello": self._hello,
+            "ping": lambda _p: "pong",
+            "repl_hello": self._repl_hello,
+            "repl_frames": self._repl_frames,
+            "repl_status": lambda _p: self.status(),
+            "repl_promote": lambda p: self.promote(
+                epoch=(p or {}).get("epoch"),
+                checkpoint=(p or {}).get("checkpoint", True),
+            ),
+            "forecast": lambda p: _to_host(
+                svc.forecast(p["model_id"], p["steps"])
+            ),
+            "meta": lambda p: _to_host(svc.registry.meta(p["model_id"])),
+            "read_loop": self._read_loop,
+            "stats": lambda _p: self.status(),
+            "update": self._update,
+            "put": self._put,
+            "flush": lambda _p: svc.flush(),
+            "capacity_report": lambda _p: svc.capacity_report(),
+            "shutdown": lambda _p: self._shutdown.set(),
+        }
+
+    def _hello(self, _payload) -> dict:
+        plane = getattr(self.service, "cluster_plane", None)
+        return {
+            "pid": os.getpid(),
+            "plane": plane.name if plane is not None else None,
+            "promoted": self.promoted,
+            "epoch": self.epoch,
+        }
+
+    def _repl_hello(self, payload) -> dict:
+        epoch = int((payload or {}).get("epoch", 1))
+        with self._cv:
+            if self.promoted or epoch < self.epoch:
+                raise StaleEpochError(self.epoch)
+            if epoch > self.epoch:
+                self.epoch = epoch
+                self._persist_epoch()
+            return {
+                "epoch": self.epoch,
+                "received": self.received_group,
+                "applied": self.applied_group,
+                "backlog": sum(len(r) for _, r in self._queue),
+                "pid": os.getpid(),
+            }
+
+    def _repl_frames(self, payload) -> dict:
+        epoch = int(payload["epoch"])
+        with self._cv:
+            if self.promoted or epoch < self.epoch:
+                raise StaleEpochError(self.epoch)
+            if self._apply_error is not None:
+                raise RecoveryError(
+                    "standby apply halted: "
+                    f"{self._apply_error!r}"
+                )
+            if epoch > self.epoch:
+                self.epoch = epoch
+                self._persist_epoch()
+        group = int(payload["group"])
+        records: List[WalRecord] = []
+        for buf in payload["frames"]:
+            # CRC re-verified at the receiving edge, then appended
+            # VERBATIM — the standby's log is byte-identical to the
+            # primary's stream, so the same readers replay it
+            recs = decode_frame(buf)
+            self.log.append_encoded(buf, len(recs))
+            records.extend(recs)
+        with self._cv:
+            if records:
+                self._queue.append((group, records))
+                self.received_group = max(self.received_group, group)
+                self.received_commits += len(records)
+                self._cv.notify_all()
+            return {
+                "received": self.received_group,
+                "applied": self.applied_group,
+                "backlog": sum(len(r) for _, r in self._queue),
+                "epoch": self.epoch,
+            }
+
+    def _update(self, payload):
+        if not self.promoted:
+            raise RuntimeError(
+                "standby is read-only until promoted — updates go to "
+                "the primary (promote() turns this replica into one)"
+            )
+        return _to_host(self.service.update(
+            payload["model_id"], payload["new_obs"]
+        ))
+
+    def _put(self, payload):
+        if not self.promoted:
+            raise RuntimeError(
+                "standby is read-only until promoted"
+            )
+        return self.service.registry.put(
+            payload["state"], persist=payload.get("persist", False)
+        )
+
+    def _read_loop(self, payload) -> dict:
+        """Bench surface: tight in-process forecast reads off the
+        standby's own snapshot store — the quantity that scales with
+        replicas (per the cluster worker's ``read_loop`` contract)."""
+        model_ids = payload["model_ids"]
+        steps = int(payload["steps"])
+        iters = int(payload["iters"])
+        svc = self.service
+        n_models = len(model_ids)
+        hits = 0
+        t0 = perf_counter()
+        for i in range(iters):
+            svc.forecast(model_ids[i % n_models], steps)
+            hits += 1
+        elapsed = perf_counter() - t0
+        return {"iters": iters, "hits": hits, "elapsed_s": elapsed,
+                "pid": os.getpid()}
+
+    # -- the apply engine ------------------------------------------------
+    def _apply_loop(self) -> None:
+        """Dedicated replay thread: drain the received-group queue
+        through :func:`replay_wal` — the same kernels, the same group
+        batching, the same version-landing checks as recovery, so the
+        applied state is bit-identical at f64.  An apply failure
+        (version gap, landing mismatch) halts replication on this
+        standby — served reads stay available at the last applied
+        version, promotion refuses."""
+        while True:
+            with self._cv:
+                while not self._queue and not self._stop:
+                    self._cv.wait(0.2)
+                if self._stop:
+                    return
+                batch = list(self._queue)
+                self._queue.clear()
+                self._applying = True
+            records = [r for _, recs in batch for r in recs]
+            try:
+                report = replay_wal(self.service, records)
+            except BaseException as exc:  # noqa: BLE001 - halts apply
+                logger.exception(
+                    "standby apply failed — replication halted on "
+                    "this standby (reads stay available at version "
+                    "%d)", self.applied_group,
+                )
+                with self._cv:
+                    self._apply_error = exc
+                    self._applying = False
+                    self._cv.notify_all()
+                return
+            with self._cv:
+                self.applied_group = max(
+                    self.applied_group, batch[-1][0]
+                )
+                self.applied_commits += int(report.get("replayed", 0))
+                self.skipped_commits += int(report.get("skipped", 0))
+                self._applying = False
+                self._cv.notify_all()
+
+    # -- promotion -------------------------------------------------------
+    def promote(self, epoch: Optional[int] = None,
+                checkpoint: bool = True) -> dict:
+        """Promote this standby to primary: bump + persist the fence
+        epoch FIRST (an in-flight ship from the old primary now
+        answers :class:`StaleEpochError`), drain the apply queue
+        through the replay engine, then re-arm a full
+        :class:`~metran_tpu.serve.durability.DurabilityManager` over
+        the standby's own log (``checkpoint=True`` cuts the baseline
+        immediately, so the promoted primary is durable on its own).
+        Returns the promotion report; RTO is this wall plus the first
+        served read (measured by the caller)."""
+        t0 = time.monotonic()
+        with self._cv:
+            if self.promoted:
+                raise RuntimeError("standby is already promoted")
+            self.epoch = (
+                int(epoch) if epoch is not None else self.epoch + 1
+            )
+            self._persist_epoch()
+            # drain: everything received must be applied before this
+            # replica serves as primary
+            while (self._queue or self._applying) \
+                    and self._apply_error is None:
+                self._cv.wait(0.2)
+            if self._apply_error is not None:
+                raise RecoveryError(
+                    "standby apply halted before promotion: "
+                    f"{self._apply_error!r}"
+                )
+            self.promoted = True
+            self._stop = True
+            self._cv.notify_all()
+        self._apply_thread.join(timeout=10.0)
+        self.log.close()
+        svc = self.service
+        svc._durability = DurabilityManager(
+            svc,
+            DurabilitySpec(enabled=True, dir=str(self.wal_dir)),
+            recovered=True,
+            initial_checkpoint=checkpoint,
+        )
+        svc._register_durability_gauges()
+        report = {
+            "epoch": self.epoch,
+            "applied_group": self.applied_group,
+            "applied_commits": self.applied_commits,
+            "skipped_commits": self.skipped_commits,
+            "received_commits": self.received_commits,
+            "checkpointed": bool(checkpoint),
+            "promote_wall_s": round(time.monotonic() - t0, 6),
+        }
+        self.last_promote = report
+        if svc.events is not None:
+            svc.events.emit(
+                "replica_promote", fault_point="cluster.replication",
+                **report,
+            )
+        return report
+
+    # -- reporting / lifecycle -------------------------------------------
+    def status(self) -> dict:
+        with self._cv:
+            return {
+                "epoch": self.epoch,
+                "promoted": self.promoted,
+                "received": self.received_group,
+                "applied": self.applied_group,
+                "backlog": sum(len(r) for _, r in self._queue),
+                "received_commits": self.received_commits,
+                "applied_commits": self.applied_commits,
+                "skipped_commits": self.skipped_commits,
+                "apply_error": (
+                    repr(self._apply_error)
+                    if self._apply_error is not None else None
+                ),
+                "pid": os.getpid(),
+            }
+
+    def serve(self) -> None:
+        """Block until a ``shutdown`` RPC arrives (the process-entry
+        idle loop; RPC and apply run on their own threads)."""
+        while not self._shutdown.wait(0.5):
+            pass
+
+    def close(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        self._apply_thread.join(timeout=5.0)
+        self.rpc.close()
+        if not self.promoted:
+            try:
+                self.log.close()
+            except Exception:  # pragma: no cover - teardown
+                pass
+
+
+def standby_main(
+    spec: ReplicationSpec,
+    socket_path: str,
+    service_factory,
+    factory_args: Tuple = (),
+    ready_path: Optional[str] = None,
+) -> int:
+    """Process entry for a spawned standby (the writer_main twin).
+
+    ``service_factory(*factory_args)`` must be a picklable
+    module-level callable returning the standby's seeded
+    ``MetranService`` (durability NOT armed) — it runs inside this
+    process; jax state never crosses a fork.  Writes ``ready_path``
+    once RPC is up, then serves until a ``shutdown`` RPC."""
+    import traceback
+
+    service = None
+    standby = None
+    try:
+        service = service_factory(*factory_args)
+        standby = ReplicaStandby(service, spec, socket_path)
+        if ready_path:
+            tmp = f"{ready_path}.{os.getpid()}.tmp"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                fh.write(str(os.getpid()))
+            os.replace(tmp, ready_path)
+        standby.serve()
+        return 0
+    except Exception:
+        logger.error(
+            "standby process failed:\n%s", traceback.format_exc()
+        )
+        return 1
+    finally:
+        if standby is not None:
+            try:
+                standby.close()
+            except Exception:  # pragma: no cover - teardown
+                pass
+        if service is not None:
+            try:
+                service.close()
+            except Exception:  # pragma: no cover - teardown
+                logger.exception("standby service close failed")

@@ -101,8 +101,33 @@ class WriterHost:
             "stats": lambda _p: self.plane.stats(
                 heartbeat_s=self.spec.heartbeat_s
             ),
+            "repl_attach": self._repl_attach,
+            "repl_status": self._repl_status,
             "shutdown": lambda _p: self._shutdown.set(),
         }
+
+    def _repl_attach(self, payload) -> dict:
+        """Attach a standby to this writer's replication hub (the
+        frontend's ``attach_standby`` lands here — membership belongs
+        to the process that owns the ship path)."""
+        hub = getattr(self.service, "repl_hub", None)
+        if hub is None:
+            raise RuntimeError(
+                "writer service has no replication hub armed — "
+                "construct it with replication=ReplicationSpec("
+                "enabled=True, ...) and a WAL"
+            )
+        return hub.add_standby(
+            payload["socket_path"], name=payload.get("name")
+        )
+
+    def _repl_status(self, _payload) -> dict:
+        hub = getattr(self.service, "repl_hub", None)
+        if hub is None:
+            return {"enabled": False, "replicas": 0}
+        out = hub.status()
+        out["enabled"] = True
+        return out
 
     def _hello(self, _payload) -> dict:
         return {

@@ -295,6 +295,25 @@ SERVE_CLUSTER_SOCKET_DIR = ""  # unix-socket rendezvous dir ("" = a
 SERVE_CLUSTER_HEARTBEAT_S = 2.0  # worker/writer liveness cadence
 #                                  (restart + writer-alive checks use
 #                                  a 3x grace multiple)
+# WAL-shipped replication (metran_tpu.cluster.replication;
+# docs/concepts.md "Replication & failover").  Ships OFF: every
+# committed group adds one synchronous ship round-trip per standby
+# before its callers ack — a topology decision (and the primary needs
+# standby endpoints to ship to).  Armed, each standby holds every
+# acked commit in its own log before the ack resolves, replays it
+# through the recovery kernels (bit-identical at f64), and can be
+# promoted with epoch fencing — the old primary can never ack again.
+SERVE_REPL = 0  # 1 = ship committed WAL frames to standbys
+SERVE_REPL_STANDBYS = 1  # standby endpoints the hub expects (>= 1)
+SERVE_REPL_ACK_TIMEOUT_S = 30.0  # per-ship RPC round-trip budget; a
+#                                  standby that cannot ack inside it
+#                                  is dropped (it re-attaches and
+#                                  catches up), never blocks acks
+SERVE_REPL_LAG_WARN = 1024  # standby apply backlog (records) that
+#                             books a replica_lag event (hysteresis:
+#                             one event per excursion)
+SERVE_REPL_SOCKET_DIR = ""  # standby rendezvous dir ("" = a private
+#                             per-run temp dir)
 # observability defaults (metran_tpu.obs wired into MetranService)
 OBS_TRACE = 0  # request-scoped span tracing (metrics/events stay on)
 OBS_TRACE_BUFFER = 4096  # finished spans kept in the tracer ring
@@ -528,6 +547,20 @@ def serve_defaults() -> dict:
         "cluster_heartbeat_s": _env(
             "METRAN_TPU_SERVE_CLUSTER_HEARTBEAT_S", float,
             SERVE_CLUSTER_HEARTBEAT_S,
+        ),
+        "repl": _env("METRAN_TPU_SERVE_REPL", int, SERVE_REPL),
+        "repl_standbys": _env(
+            "METRAN_TPU_SERVE_REPL_STANDBYS", int, SERVE_REPL_STANDBYS
+        ),
+        "repl_ack_timeout_s": _env(
+            "METRAN_TPU_SERVE_REPL_ACK_TIMEOUT_S", float,
+            SERVE_REPL_ACK_TIMEOUT_S,
+        ),
+        "repl_lag_warn": _env(
+            "METRAN_TPU_SERVE_REPL_LAG_WARN", int, SERVE_REPL_LAG_WARN
+        ),
+        "repl_socket_dir": os.environ.get(
+            "METRAN_TPU_SERVE_REPL_SOCKET_DIR", SERVE_REPL_SOCKET_DIR
         ),
         "wal": _env("METRAN_TPU_SERVE_WAL", int, SERVE_WAL),
         "wal_dir": os.environ.get(

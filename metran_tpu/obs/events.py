@@ -93,6 +93,10 @@ EVENT_KINDS = (
     "worker_restart",
     "snapshot_plane_publish",
     "reader_fallback",
+    "replica_connect",
+    "replica_lag",
+    "replica_promote",
+    "primary_fenced",
 )
 
 

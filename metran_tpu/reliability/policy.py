@@ -104,6 +104,8 @@ def is_retryable(exc: BaseException) -> bool:
     """
     from concurrent.futures import CancelledError
 
+    from ..serve.durability import PrimaryFencedError
+
     if not isinstance(exc, Exception):
         return False
     return not isinstance(
@@ -116,6 +118,9 @@ def is_retryable(exc: BaseException) -> bool:
             CancelledError,  # someone chose to cancel; honor it
             ValueError,
             KeyError,
+            # the fence is permanent: a standby was promoted, and this
+            # process must never ack again — retrying cannot succeed
+            PrimaryFencedError,
         ),
     )
 

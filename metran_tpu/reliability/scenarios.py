@@ -31,6 +31,8 @@ so the harness doubles as an end-to-end wiring check.
 
 from __future__ import annotations
 
+import os
+
 from typing import Optional
 
 import numpy as np
@@ -43,6 +45,7 @@ __all__ = [
     "run_crash_recovery_scenario",
     "run_detection_delay_scenario",
     "run_drift_recovery_scenario",
+    "run_failover_scenario",
     "run_robust_fault_scenario",
     "run_sensor_fault_scenario",
     "simulate_dfm_panel",
@@ -897,6 +900,270 @@ def run_crash_recovery_scenario(
     finally:
         if tmp is not None:
             shutil.rmtree(tmp, ignore_errors=True)
+
+
+def run_failover_scenario(
+    mode: str = "arena",
+    kill_point: Optional[str] = None,
+    n_models: int = 4,
+    n_series: int = 3,
+    n_factors: int = 1,
+    t_hist: int = 30,
+    n_ticks: int = 8,
+    attach_tick: int = 2,
+    pre_ticks: int = 4,
+    checkpoint_every: int = 0,
+    seed: int = 0,
+    engine: str = "sqrt",
+    kill_match: Optional[str] = None,
+) -> dict:
+    """Primary-kill failover chaos for the replication plane
+    (docs/concepts.md "Replication & failover").
+
+    Builds a synthetic fleet on a WAL-armed, replication-armed primary
+    :class:`~metran_tpu.serve.MetranService` (``"dict"`` registry or
+    ``"arena"`` + materialized read path) and an identically-seeded
+    :class:`~metran_tpu.cluster.replication.ReplicaStandby` (its own
+    root, its own log), streams ``attach_tick`` ticks BEFORE attaching
+    (so the attach exercises the catch-up path), then live-ships until
+    a :class:`SimulatedCrash` kills the primary at ``kill_point`` (one
+    of :data:`CRASH_POINTS`; ``None`` streams to completion — the
+    plain kill -9 row).  The standby is then **promoted** and the
+    verdict is taken against a crash-free control:
+
+    - **zero acked commits lost**: every model's version on the
+      promoted standby is at least its last acked version (the RPO
+      contract — shipping is ack-synchronous, so this holds at EVERY
+      kill point, including mid-WAL-record);
+    - **bit-identical**: each model's promoted posterior (f64) equals
+      the control's at the same version exactly (the standby applied
+      the shipped frames through the recovery replay kernels);
+    - **the fence holds**: the zombie primary's post-promotion ack
+      attempt raises
+      :class:`~metran_tpu.serve.PrimaryFencedError` (booked as a
+      ``primary_fenced`` event) — a fenced old primary can never ack
+      again, even with a poisoned local log.
+
+    Also measured: ``rpo_lag_s_at_kill`` (replication lag when the
+    primary died) and ``rto_s`` (promotion wall-clock to the first
+    served read).  Returns the verdict dict the ``replication``-marked
+    tests and ``bench.py --phase replicate`` assert on.
+    """
+    import shutil
+    import tempfile
+    import time as _time
+
+    from ..cluster.replication import ReplicaStandby, ReplicationSpec
+    from ..ops import dfm_statespace, kalman_filter, sqrt_kalman_filter
+    from ..serve import (
+        DurabilitySpec,
+        MetranService,
+        ModelRegistry,
+        PosteriorState,
+        PrimaryFencedError,
+    )
+
+    if mode not in ("dict", "arena"):
+        raise ValueError(f"unknown failover mode {mode!r}")
+    if kill_point is not None and kill_point not in CRASH_POINTS:
+        raise ValueError(
+            f"unknown kill point {kill_point!r}; expected one of "
+            f"{CRASH_POINTS}"
+        )
+    rng = np.random.default_rng(seed)
+    loadings = rng.uniform(0.4, 0.7, (n_series, n_factors))
+    loadings /= np.sqrt(n_factors)
+    alpha_sdf = rng.uniform(5.0, 40.0, n_series)
+    alpha_cdf = rng.uniform(10.0, 60.0, n_factors)
+    ss = dfm_statespace(alpha_sdf, alpha_cdf, loadings, 1.0)
+    _, y_all, _ = simulate_dfm_panel(ss, t_hist + n_ticks, rng)
+    y_hist = y_all[:t_hist]
+    mask_hist = np.ones(y_hist.shape, bool)
+    if engine in ("sqrt", "sqrt_parallel"):
+        filt = sqrt_kalman_filter(ss, y_hist, mask_hist)
+        chol0 = np.asarray(filt.chol_f[-1])
+        cov0 = chol0 @ chol0.T
+    else:
+        filt = kalman_filter(ss, y_hist, mask_hist, engine=engine)
+        chol0, cov0 = None, np.asarray(filt.cov_f[-1])
+    ids = [f"fm{i}" for i in range(n_models)]
+
+    def make_state(mid):
+        return PosteriorState(
+            model_id=mid, version=0, t_seen=t_hist,
+            mean=np.asarray(filt.mean_f[-1]), cov=cov0,
+            params=np.concatenate([alpha_sdf, alpha_cdf]),
+            loadings=loadings, dt=1.0,
+            scaler_mean=np.zeros(n_series),
+            scaler_std=np.ones(n_series),
+            names=tuple(f"s{j}" for j in range(n_series)),
+            chol=chol0,
+        )
+
+    obs = y_all[t_hist:][:, None, None, :] + (
+        rng.normal(size=(n_ticks, n_models, 1, n_series)) * 0.1
+    )
+    feature_kwargs = dict(
+        flush_deadline=None,
+        persist_updates=False,
+        readpath=mode == "arena",
+    )
+    registry_kwargs = dict(
+        engine=engine,
+        arena=mode != "dict",
+        arena_rows=n_models + 4,
+    )
+    repl_spec = ReplicationSpec(enabled=True, standbys=1).validate()
+
+    tmp = tempfile.mkdtemp(prefix="metran-failover-")
+    primary = standby = standby_svc = ctrl = None
+    try:
+        # ---- topology: primary (WAL + shipper) + seeded standby -------
+        preg = ModelRegistry(
+            root=os.path.join(tmp, "primary"), **registry_kwargs
+        )
+        sreg = ModelRegistry(
+            root=os.path.join(tmp, "standby"), **registry_kwargs
+        )
+        for mid in ids:
+            preg.put(make_state(mid), persist=False)
+            sreg.put(make_state(mid), persist=False)
+        primary = MetranService(
+            preg,
+            durability=DurabilitySpec(
+                enabled=True, checkpoint_every=checkpoint_every
+            ),
+            replication=repl_spec,
+            **feature_kwargs,
+        )
+        standby_svc = MetranService(
+            sreg,
+            durability=DurabilitySpec(enabled=False),
+            **feature_kwargs,
+        )
+        standby = ReplicaStandby(
+            standby_svc, repl_spec,
+            os.path.join(tmp, "standby.sock"),
+        )
+
+        def tick(t) -> None:
+            for mid, res in zip(ids, primary.update_batch(ids, obs[t])):
+                if not isinstance(res, BaseException):
+                    acked[mid] = int(res.version)
+
+        acked = {mid: 0 for mid in ids}
+        crashed_at = None
+        attach = None
+        try:
+            for t in range(min(attach_tick, n_ticks)):
+                tick(t)
+            attach = primary.repl_hub.add_standby(
+                str(standby.socket_path), name="sb0"
+            )
+            for t in range(attach_tick, min(pre_ticks, n_ticks)):
+                tick(t)
+            if kill_point is not None:
+                with faultinject.active() as inj:
+                    inj.add(
+                        kill_point, error=SimulatedCrash,
+                        match=kill_match, times=1,
+                    )
+                    for t in range(pre_ticks, n_ticks):
+                        tick(t)
+            else:
+                for t in range(pre_ticks, n_ticks):
+                    tick(t)
+        except SimulatedCrash:
+            crashed_at = "injected"
+        # the primary is now DEAD (abandoned un-closed); measure the
+        # replication lag the moment it died — the RPO numerator
+        rpo_lag_s = primary.repl_hub.lag_seconds()
+
+        # ---- failover -------------------------------------------------
+        t0 = _time.perf_counter()
+        promote_report = standby.promote()
+        first_read = standby_svc.forecast(ids[0], 1)
+        rto_s = _time.perf_counter() - t0
+        assert first_read is not None
+
+        # ---- the fence: zombie primary can never ack again ------------
+        fenced_rejected = False
+        try:
+            primary.update(ids[0], obs[0][0])
+        except PrimaryFencedError:
+            fenced_rejected = True
+        except Exception:
+            # any OTHER failure is not the fence doing its job
+            fenced_rejected = False
+        fence_booked = any(
+            e["kind"] == "primary_fenced"
+            for e in (primary.events.tail(64) if primary.events else [])
+        )
+
+        # ---- crash-free control --------------------------------------
+        creg = ModelRegistry(root=None, **registry_kwargs)
+        for mid in ids:
+            creg.put(make_state(mid), persist=False)
+        ctrl = MetranService(creg, **feature_kwargs)
+        snapshots: list = []
+        for t in range(n_ticks):
+            ctrl.update_batch(ids, obs[t])
+            snapshots.append({mid: creg.get(mid) for mid in ids})
+
+        # ---- verdict --------------------------------------------------
+        standby_versions = {
+            mid: int(standby_svc.registry.get(mid).version)
+            for mid in ids
+        }
+        lost = {
+            mid: acked[mid] - standby_versions[mid]
+            for mid in ids if standby_versions[mid] < acked[mid]
+        }
+        max_diff = 0.0
+        bit_identical = True
+        for mid in ids:
+            v = standby_versions[mid]
+            if v == 0:
+                continue
+            got = standby_svc.registry.get(mid)
+            want = snapshots[v - 1][mid]
+            for leg in ("mean", "cov"):
+                a = np.asarray(getattr(got, leg))
+                b = np.asarray(getattr(want, leg))
+                max_diff = max(max_diff, float(np.abs(a - b).max()))
+                if not np.array_equal(a, b):
+                    bit_identical = False
+            if got.t_seen != want.t_seen:
+                bit_identical = False
+        return {
+            "mode": mode,
+            "engine": engine,
+            "kill_point": kill_point,
+            "crashed": crashed_at is not None,
+            "n_ticks": n_ticks,
+            "acked": acked,
+            "standby_versions": standby_versions,
+            "acked_lost": lost,          # MUST be empty
+            "no_acked_loss": not lost,
+            "bit_identical": bit_identical,
+            "max_posterior_diff": max_diff,
+            "rpo_lag_s_at_kill": rpo_lag_s,
+            "rto_s": rto_s,
+            "promote_report": promote_report,
+            "catch_up_commits": (
+                attach["catch_up_commits"] if attach else None
+            ),
+            "fenced_ack_rejected": fenced_rejected,
+            "fenced_event_booked": fence_booked,
+        }
+    finally:
+        for closer in (standby, standby_svc, ctrl, primary):
+            if closer is not None:
+                try:
+                    closer.close()
+                except Exception:
+                    pass
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def run_robust_fault_scenario(

@@ -62,6 +62,7 @@ from .batching import MicroBatcher
 from .durability import (
     DurabilityManager,
     DurabilitySpec,
+    PrimaryFencedError,
     RecoveryError,
     WalRecord,
     WriteAheadLog,
@@ -131,6 +132,7 @@ __all__ = [
     "ModelRegistry",
     "ObservationTail",
     "PosteriorState",
+    "PrimaryFencedError",
     "RecoveryError",
     "RefitSpec",
     "RefitWorker",

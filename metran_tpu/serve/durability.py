@@ -80,15 +80,20 @@ logger = getLogger(__name__)
 __all__ = [
     "DurabilityManager",
     "DurabilitySpec",
+    "PrimaryFencedError",
     "RecoveryError",
+    "WalFollower",
+    "WalFrame",
     "WalGroup",
     "WalRecord",
     "WriteAheadLog",
+    "iter_frames",
     "load_latest_manifest",
     "promote_stage",
     "replay_wal",
     "restore_sidecar",
     "scan_segment",
+    "scan_segment_frames",
     "scan_wal",
     "write_manifest",
 ]
@@ -105,6 +110,16 @@ class RecoveryError(RuntimeError):
     (a torn record before live segments, a version gap between the
     checkpoint and the WAL tail, or a replayed record that failed to
     apply).  The directory is left untouched for forensics."""
+
+
+class PrimaryFencedError(RuntimeError):
+    """A newer replication epoch exists — a standby was promoted past
+    this primary.  Raised on the commit path BEFORE any caller's ack
+    resolves (``_wal_commit`` re-raises it like a process death rather
+    than degrading), so a fenced old primary can never ack a commit
+    after promotion: the split-brain half of the failover contract.
+    Lives here (not in ``cluster.replication``) so the serve layer's
+    ack path can catch it without importing the cluster plane."""
 
 
 class WalRecord(NamedTuple):
@@ -446,8 +461,25 @@ class WriteAheadLog:
         if not frames:
             return 0
         n_records = sum(g.n_records for g in groups)
-        buf = b"".join(frames)
         fire("durability.wal.pre_commit", str(self.path))
+        return self._append(frames, n_records, primary=True)
+
+    def append_encoded(self, frame: bytes, n_records: int) -> int:
+        """Append one pre-framed, already-CRC-verified frame buffer
+        verbatim — the replication standby's local persistence path:
+        shipped frames land on the standby's own log byte-identical to
+        the primary's, so the standby's log replays (and re-ships,
+        after promotion) through the exact same readers.  Same
+        rollback-on-partial-append and leader/follower group sync as
+        :meth:`commit`; the primary-path fault points do not fire
+        here (the chaos matrix kills primaries, not standbys)."""
+        if not frame:
+            return 0
+        return self._append([bytes(frame)], int(n_records),
+                            primary=False)
+
+    def _append(self, frames, n_records: int, *, primary: bool) -> int:
+        buf = b"".join(frames)
         with self._append_lock:
             if self._broken:
                 raise OSError(
@@ -458,7 +490,7 @@ class WriteAheadLog:
             fh = self._fh
             start = self._written
             try:
-                if faultinject.corrupting():
+                if primary and faultinject.corrupting():
                     # chaos path only (an injector is active): flush
                     # the first half of the records PLUS a partial
                     # frame of the next before the mid-record crash
@@ -501,7 +533,8 @@ class WriteAheadLog:
             seg = self.seq
             self.records_total += n_records
             self.bytes_total += len(buf)
-        fire("durability.wal.pre_sync", str(self.path))
+        if primary:
+            fire("durability.wal.pre_sync", str(self.path))
         if self.fsync:
             with self._sync_lock:
                 # leader/follower: someone else's fdatasync may already
@@ -551,42 +584,131 @@ class WriteAheadLog:
                     self._fh = None
 
 
-def scan_segment(path) -> Tuple[List[WalRecord], bool, Optional[str]]:
-    """Read every intact record of one segment.
+class WalFrame(NamedTuple):
+    """One intact, CRC-verified group frame as it sits on disk.
 
-    Returns ``(records, torn, reason)``: ``torn`` is True when the
+    ``data`` is the raw framed unit exactly as the writer appended it
+    (``b"WR"`` + length/crc header + payload) — the replication wire
+    and re-append unit (:meth:`WriteAheadLog.append_encoded` lands it
+    on a standby's log byte-identically); ``records`` are its decoded
+    :class:`WalRecord`\\ s; ``seg_seq``/``offset`` locate it (the
+    follower resume cursor)."""
+
+    seg_seq: int
+    offset: int
+    data: bytes
+    records: List[WalRecord]
+
+
+def scan_segment_frames(
+    path, seg_seq: Optional[int] = None,
+) -> Tuple[List[WalFrame], bool, Optional[str]]:
+    """Frame-level scan of one segment with per-frame CRC verification.
+
+    Returns ``(frames, torn, reason)``: ``torn`` is True when the
     scan stopped before end-of-file (partial frame, bad record magic,
     CRC mismatch — the signature of a writer killed mid-append).
-    Nothing after the torn point is returned: **a torn record is never
-    replayed**, and neither is anything behind it."""
-    records: List[WalRecord] = []
-    data = Path(path).read_bytes()
+    Nothing at or after the torn point is returned: **a torn frame is
+    never replayed or shipped**, and neither is anything behind it."""
+    path = Path(path)
+    if seg_seq is None:
+        seg_seq = _segment_seq(path.name) or 0
+    frames: List[WalFrame] = []
+    data = path.read_bytes()
     if len(data) < len(SEG_MAGIC):
-        return records, True, "segment shorter than its header"
+        return frames, True, "segment shorter than its header"
     if data[: len(SEG_MAGIC)] != SEG_MAGIC:
-        return records, True, "bad segment magic"
+        return frames, True, "bad segment magic"
     off = len(SEG_MAGIC)
     head_len = len(REC_MAGIC) + _FRAME_HEAD.size
     while off < len(data):
         if off + head_len > len(data):
-            return records, True, "partial frame header"
+            return frames, True, "partial frame header"
         if data[off: off + len(REC_MAGIC)] != REC_MAGIC:
-            return records, True, "bad record magic"
+            return frames, True, "bad record magic"
         length, crc = _FRAME_HEAD.unpack_from(
             data, off + len(REC_MAGIC)
         )
         body_off = off + head_len
         if body_off + length > len(data):
-            return records, True, "partial record payload"
+            return frames, True, "partial record payload"
         payload = data[body_off: body_off + length]
         if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
-            return records, True, "record CRC mismatch"
+            return frames, True, "record CRC mismatch"
         try:
-            records.extend(decode_group(payload))
+            records = decode_group(payload)
         except Exception:  # noqa: BLE001 - framed but undecodable
-            return records, True, "record payload undecodable"
+            return frames, True, "record payload undecodable"
+        frames.append(WalFrame(
+            int(seg_seq), off, data[off: body_off + length], records,
+        ))
         off = body_off + length
-    return records, False, None
+    return frames, False, None
+
+
+def scan_segment(path) -> Tuple[List[WalRecord], bool, Optional[str]]:
+    """Record-level view of :func:`scan_segment_frames`: every intact
+    record of one segment as ``(records, torn, reason)``."""
+    frames, torn, reason = scan_segment_frames(path)
+    return (
+        [rec for f in frames for rec in f.records], torn, reason,
+    )
+
+
+class WalFollower:
+    """Reusable frame-level WAL reader: the recovery scan, the
+    replication shipper's catch-up feed, and a promoted standby's
+    bootstrap all walk the same CRC-verified frames through here.
+
+    Iterating yields a :class:`WalFrame` for every intact frame in
+    segments ``>= since_seq``, in order.  The stop condition is
+    torn-tail-tolerant: a torn frame at the tail of the FINAL segment
+    ends iteration cleanly — the killed-writer signature; ``.torn`` /
+    ``.torn_reason`` report it and the torn bytes are never yielded —
+    while a tear anywhere BEFORE later segments raises
+    :class:`RecoveryError` (a hole in front of acked records).  Single
+    pass over a quiescent log: follow a live log by re-issuing with a
+    higher ``since_seq`` (segments are append-only and rotate whole)."""
+
+    def __init__(self, directory, since_seq: int = 1):
+        self.dir = Path(directory)
+        self.since_seq = int(since_seq)
+        self.torn = False
+        self.torn_reason: Optional[str] = None
+        self.frames_read = 0
+
+    def __iter__(self):
+        segs = [(s, p) for s, p in list_segments(self.dir)
+                if s >= self.since_seq]
+        for i, (seq, path) in enumerate(segs):
+            frames, torn, reason = scan_segment_frames(path, seq)
+            if torn and i < len(segs) - 1:
+                raise RecoveryError(
+                    f"WAL segment {path.name} is torn ({reason}) but "
+                    "later segments exist — the log has a hole before "
+                    "acked records; refusing to read past it"
+                )
+            for frame in frames:
+                self.frames_read += 1
+                yield frame
+            if torn:
+                self.torn = True
+                self.torn_reason = reason
+                logger.warning(
+                    "WAL %s has a torn tail (%s): %d intact frame(s) "
+                    "read from it, the torn one is NOT replayed",
+                    path.name, reason, len(frames),
+                )
+
+
+def iter_frames(directory, since_seq: int = 1) -> WalFollower:
+    """Follower API over a WAL directory (see :class:`WalFollower`):
+    ``for frame in iter_frames(dir, since_seq=...)`` walks every
+    intact frame with per-frame CRC verification and a torn-tail-
+    tolerant stop.  :func:`scan_wal` (and so ``recover()``), the
+    replication shipper's standby catch-up, and promotion bootstrap
+    are all callers."""
+    return WalFollower(directory, since_seq=since_seq)
 
 
 def repair_segment_tail(path) -> bool:
@@ -889,6 +1011,11 @@ class DurabilityManager:
         self.checkpoints_total = 0
         self.checkpoint_failures = 0
         self.sync_failures = 0
+        #: replication hook (:class:`metran_tpu.cluster.replication.
+        #: ReplicationHub`): when set, every committed group is shipped
+        #: synchronously between the local fdatasync and the callers'
+        #: acks — the zero-acked-loss half of the failover contract
+        self.shipper = None
         #: commits whose durability is UNKNOWN (a WAL append/sync
         #: failed since the last successful one) — the honest half of
         #: ``durability_lag``
@@ -912,7 +1039,38 @@ class DurabilityManager:
         keeping serving available while the durability lag is honestly
         reported."""
         n = sum(g.n_records for g in groups)
-        self.wal.commit(groups)
+        shipper = self.shipper
+        if shipper is not None:
+            # a fenced primary fails BEFORE the local append: nothing
+            # lands on its log after promotion except the one commit
+            # whose ship discovered the fence (never acked either way)
+            shipper.raise_if_fenced()
+        try:
+            self.wal.commit(groups)
+        except Exception:
+            # a failed LOCAL append must still attempt the ship: (a) a
+            # commit the service acks through the degraded-durability
+            # path stays covered by the standbys (zero-acked-loss even
+            # while the local log is broken), and (b) a zombie primary
+            # with a poisoned log still DISCOVERS the fence — the ship
+            # is the only channel a promotion announces itself on.  A
+            # PrimaryFencedError from ship() outranks the local error.
+            # Non-Exception BaseExceptions (a SimulatedCrash = process
+            # death, KeyboardInterrupt) propagate without shipping: a
+            # dead process runs nothing after its kill point.
+            if shipper is not None:
+                shipper.ship(groups)
+            raise
+        if shipper is not None:
+            # ship-before-ack: every WAL crash point fires at or
+            # before the local append above, so any commit that
+            # reaches a caller's ack was already received (and locally
+            # persisted) by every live standby — zero acked commits
+            # can be lost at failover.  A fenced hub raises here
+            # (:class:`~metran_tpu.cluster.replication.
+            # PrimaryFencedError`) and the round fails UN-acked;
+            # ordinary standby failures degrade inside ship().
+            shipper.ship(groups)
         now = time.monotonic()
         with self._stats_lock:
             self._last_sync_at = now
@@ -1157,27 +1315,11 @@ def scan_wal(directory, from_seq: int = 1):
     at the tail of the FINAL segment (the killed-writer signature);
     anywhere earlier it means later acked records exist beyond a hole,
     and :class:`RecoveryError` refuses to silently lose them."""
-    segs = [(s, p) for s, p in list_segments(directory)
-            if s >= int(from_seq)]
-    records: List[WalRecord] = []
-    torn_tail = False
-    for i, (seq, path) in enumerate(segs):
-        recs, torn, reason = scan_segment(path)
-        if torn and i < len(segs) - 1:
-            raise RecoveryError(
-                f"WAL segment {path.name} is torn ({reason}) but "
-                "later segments exist — the log has a hole before "
-                "acked records; refusing to recover past it"
-            )
-        records.extend(recs)
-        if torn:
-            torn_tail = True
-            logger.warning(
-                "WAL %s has a torn tail (%s): %d intact record(s) "
-                "recovered from it, the torn one is NOT replayed",
-                path.name, reason, len(recs),
-            )
-    return records, torn_tail
+    follower = iter_frames(directory, since_seq=from_seq)
+    records: List[WalRecord] = [
+        rec for frame in follower for rec in frame.records
+    ]
+    return records, follower.torn
 
 
 def _split_groups(records) -> Tuple[List[List[WalRecord]], int]:

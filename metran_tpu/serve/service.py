@@ -88,6 +88,7 @@ from .batching import MicroBatcher
 from .durability import (
     DurabilityManager,
     DurabilitySpec,
+    PrimaryFencedError,
     WalGroup,
     load_latest_manifest,
     load_sidecar,
@@ -637,6 +638,7 @@ class MetranService:
         capacity=None,
         durability: Optional[DurabilitySpec] = None,
         cluster=None,
+        replication=None,
     ):
         from ..config import obs_defaults, serve_defaults
 
@@ -959,6 +961,67 @@ class MetranService:
                 wal_anchored=self._durability is not None,
             )
             self.readpath.mirror = self.cluster_plane
+        # WAL-shipped replication (cluster.replication; docs/
+        # concepts.md "Replication & failover"): armed, every committed
+        # group frame is shipped to the connected standbys between the
+        # local fdatasync and the callers' acks — so no acked commit
+        # can be lost at failover.  Requires the WAL (the shipper rides
+        # the durability manager's ack path and catch-up reads the
+        # primary's own log).  Shipped off (METRAN_TPU_SERVE_REPL).
+        if replication is None:
+            from ..cluster.replication import ReplicationSpec
+
+            replication = ReplicationSpec.from_defaults()
+        else:
+            replication = replication.validate()
+        self.replication = replication
+        self.repl_hub = None
+        if replication.enabled:
+            self._arm_replication(replication)
+
+    def _arm_replication(self, spec) -> None:
+        """Attach a :class:`~metran_tpu.cluster.replication.
+        ReplicationHub` as the durability manager's shipper (normal
+        construction arms it after the plane; :meth:`recover` re-arms
+        it after replay, like durability itself)."""
+        from ..cluster.replication import ReplicationHub
+
+        if self._durability is None:
+            raise ValueError(
+                "replication requires the durability plane: standbys "
+                "replay the primary's WAL frames, so there must be a "
+                "WAL to ship (set METRAN_TPU_SERVE_WAL=1 or pass "
+                "durability=DurabilitySpec(enabled=True, ...))"
+            )
+        hub = ReplicationHub(self, spec)
+        self.repl_hub = hub
+        self._durability.shipper = hub
+        self._register_replication_gauges()
+
+    def _register_replication_gauges(self) -> None:
+        hub = self.repl_hub
+        if hub is None or self.obs.metrics is None:
+            return
+        m = self.obs.metrics
+        m.gauge(
+            "metran_serve_repl_lag_seconds",
+            "worst ack-to-applied replication lag across live "
+            "standbys right now (0 when every shipped group is "
+            "applied everywhere — the replica-side RPO estimate)",
+            callback=lambda: float(hub.lag_seconds()),
+        )
+        m.gauge(
+            "metran_serve_repl_shipped_commits_total",
+            "commits shipped to every live standby before their acks "
+            "resolved (the zero-acked-loss invariant's numerator)",
+            callback=lambda: float(hub.shipped_commits),
+        )
+        m.gauge(
+            "metran_serve_repl_replicas_live",
+            "standbys currently in live ship membership (dropped "
+            "standbys re-attach and catch up from the primary's log)",
+            callback=lambda: float(hub.replicas_live()),
+        )
 
     def _register_durability_gauges(self) -> None:
         """Durability-lag gauges, registered once the manager exists
@@ -2780,6 +2843,19 @@ class MetranService:
             self.metrics.wal_total.increment("records", total)
         except SimulatedCrash:
             raise
+        except PrimaryFencedError:
+            # a standby was promoted: this primary must NEVER ack
+            # again.  Propagate like a process death (the dispatch
+            # fails, no caller's future resolves) instead of the
+            # degrade-and-continue path below.
+            self.metrics.wal_total.increment("fenced_commits")
+            if self.events is not None:
+                self.events.emit(
+                    "primary_fenced",
+                    fault_point="cluster.replication",
+                    commits=total,
+                )
+            raise
         except Exception:
             dur.note_failed_commits(total)
             self.metrics.wal_total.increment("sync_failures")
@@ -2959,9 +3035,16 @@ class MetranService:
                 rkw.setdefault("engine", manifest.get("engine"))
                 rkw.setdefault("arena", bool(manifest.get("arena")))
             registry = ModelRegistry(root=directory, **rkw)
+        # replication arms AFTER the durability re-arm below (the hub
+        # is the durability manager's shipper; during replay there is
+        # neither a WAL nor anything to ship)
+        repl_spec = service_kwargs.pop("replication", None)
+        from ..cluster.replication import ReplicationSpec
+
         svc = cls(
             registry,
             durability=DurabilitySpec(enabled=False),
+            replication=ReplicationSpec(enabled=False),
             **service_kwargs,
         )
         report: dict = {
@@ -3017,6 +3100,13 @@ class MetranService:
             initial_checkpoint=checkpoint_after,
         )
         svc._register_durability_gauges()
+        if repl_spec is None:
+            repl_spec = ReplicationSpec.from_defaults()
+        else:
+            repl_spec = repl_spec.validate()
+        if repl_spec.enabled:
+            svc.replication = repl_spec
+            svc._arm_replication(repl_spec)
         svc.last_recovery = report
         if svc.events is not None:
             svc.events.emit(
@@ -3045,6 +3135,14 @@ class MetranService:
         # updates that only enqueue from done-callbacks mid-drain —
         # before it starts refusing submissions
         self.batcher.close()
+        if self.repl_hub is not None:
+            # ship links close before the final checkpoint: nothing
+            # commits after the drain above, so there is nothing left
+            # to ship — but a standby poll must not race the WAL close
+            try:
+                self.repl_hub.close()
+            except Exception:  # pragma: no cover - shutdown only
+                logger.exception("replication hub close failed")
         if self._durability is not None:
             # final checkpoint: the WAL truncates to (near) nothing and
             # the next process recovers from the manifest alone

@@ -1,0 +1,418 @@
+"""WAL-shipped replication: frame shipper / standby apply
+bit-identity, epoch-fenced promotion, and the primary-kill failover
+chaos matrix (cluster/replication.py; docs/concepts.md "Replication &
+failover").
+
+The tier-1 subset covers the mechanics (spec validation, receiving-
+edge CRC verification, pickle-safe fencing errors, ship/apply/catch-up
+bit-identity, promotion + the sticky fence, the spawned-standby
+frontend failover) plus two representative chaos cells; the FULL
+kill-point x mode matrix rides the ``slow`` marker
+(``pytest -m 'replication and slow'``)."""
+
+import os
+import pickle
+import time
+
+import numpy as np
+import pytest
+
+from metran_tpu.cluster._testing import seed_root, standby_service_factory
+from metran_tpu.cluster.ipc import rpc_call
+from metran_tpu.cluster.replication import (
+    ReplicaStandby,
+    ReplicationSpec,
+    StaleEpochError,
+    decode_frame,
+    standby_main,
+)
+from metran_tpu.reliability.scenarios import (
+    CRASH_POINTS,
+    run_failover_scenario,
+)
+from metran_tpu.serve import (
+    DurabilitySpec,
+    MetranService,
+    ModelRegistry,
+    PrimaryFencedError,
+)
+from metran_tpu.serve.durability import WalGroup, WalRecord, encode_group
+
+pytestmark = pytest.mark.replication
+
+
+# ----------------------------------------------------------------------
+# spec validation
+# ----------------------------------------------------------------------
+def test_replication_spec_validation(tmp_path):
+    ReplicationSpec(enabled=False, standbys=0).validate()  # inert: ok
+    ReplicationSpec(enabled=True, socket_dir=str(tmp_path)).validate()
+    with pytest.raises(ValueError, match="standbys"):
+        ReplicationSpec(enabled=True, standbys=0).validate()
+    with pytest.raises(ValueError, match="ack_timeout_s"):
+        ReplicationSpec(enabled=True, ack_timeout_s=0.0).validate()
+    with pytest.raises(ValueError, match="lag_warn_records"):
+        ReplicationSpec(enabled=True, lag_warn_records=0).validate()
+    with pytest.raises(ValueError, match="socket_dir"):
+        ReplicationSpec(
+            enabled=True, socket_dir=str(tmp_path / "missing")
+        ).validate()
+
+
+def test_replication_spec_from_defaults(monkeypatch):
+    assert not ReplicationSpec.from_defaults().enabled  # shipped off
+    monkeypatch.setenv("METRAN_TPU_SERVE_REPL", "1")
+    monkeypatch.setenv("METRAN_TPU_SERVE_REPL_STANDBYS", "3")
+    monkeypatch.setenv("METRAN_TPU_SERVE_REPL_ACK_TIMEOUT_S", "5.5")
+    spec = ReplicationSpec.from_defaults()
+    assert spec.enabled and spec.standbys == 3
+    assert spec.ack_timeout_s == 5.5
+    monkeypatch.setenv("METRAN_TPU_SERVE_REPL_STANDBYS", "0")
+    with pytest.raises(ValueError, match="standbys"):
+        ReplicationSpec.from_defaults()
+
+
+# ----------------------------------------------------------------------
+# wire mechanics
+# ----------------------------------------------------------------------
+def _one_frame():
+    rec = WalRecord(
+        "m0", version=1, t_seen=10, y=np.array([[0.5, -1.5, np.nan]]),
+        group=1, group_size=1,
+    )
+    return encode_group(WalGroup.of([rec]))
+
+
+def test_decode_frame_verifies_crc_at_receiving_edge():
+    frame = _one_frame()
+    recs = decode_frame(frame)
+    assert len(recs) == 1 and recs[0].model_id == "m0"
+    np.testing.assert_array_equal(
+        recs[0].y, np.array([[0.5, -1.5, np.nan]])
+    )
+    # flipped payload byte -> CRC mismatch, frame refused
+    corrupt = bytearray(frame)
+    corrupt[-1] ^= 0xFF
+    with pytest.raises(ValueError, match="CRC"):
+        decode_frame(bytes(corrupt))
+    with pytest.raises(ValueError, match="magic"):
+        decode_frame(b"XX" + frame[2:])
+    with pytest.raises(ValueError, match="length"):
+        decode_frame(frame[:-1])
+
+
+def test_stale_epoch_error_pickles_across_ipc():
+    """The fencing error crosses the RPC boundary pickled and must
+    reconstruct with its epoch intact (``cls(*args)`` on unpickle)."""
+    exc = pickle.loads(pickle.dumps(StaleEpochError(7)))
+    assert isinstance(exc, StaleEpochError)
+    assert exc.epoch == 7
+    assert "epoch 7" in str(exc)
+
+
+# ----------------------------------------------------------------------
+# construction guards
+# ----------------------------------------------------------------------
+def test_replication_requires_wal(tmp_path):
+    seed_root(str(tmp_path), n_models=1)
+    with pytest.raises(ValueError, match="durability"):
+        MetranService(
+            ModelRegistry(root=str(tmp_path)),
+            flush_deadline=None, persist_updates=False,
+            durability=DurabilitySpec(enabled=False),
+            replication=ReplicationSpec(enabled=True),
+        )
+
+
+def test_standby_refuses_armed_durability(tmp_path):
+    seed_root(str(tmp_path), n_models=1)
+    svc = MetranService(
+        ModelRegistry(root=str(tmp_path)),
+        flush_deadline=None, persist_updates=False,
+        durability=DurabilitySpec(enabled=True, checkpoint_every=0),
+    )
+    try:
+        with pytest.raises(ValueError, match="durability"):
+            ReplicaStandby(
+                svc, ReplicationSpec(enabled=True),
+                str(tmp_path / "s.sock"),
+            )
+    finally:
+        svc.close()
+
+
+# ----------------------------------------------------------------------
+# ship / apply / catch-up / promote (in-process pair)
+# ----------------------------------------------------------------------
+def _pair(tmp_path, horizons="1-3"):
+    """A primary (WAL + shipper) and an identically-seeded standby."""
+    proot, sroot = str(tmp_path / "p"), str(tmp_path / "s")
+    ids = seed_root(proot, seed=7)
+    seed_root(sroot, seed=7)
+    spec = ReplicationSpec(enabled=True).validate()
+    primary = MetranService(
+        ModelRegistry(root=proot), flush_deadline=None,
+        persist_updates=False, readpath=True, horizons=horizons,
+        durability=DurabilitySpec(enabled=True, checkpoint_every=0),
+        replication=spec,
+    )
+    standby_svc = MetranService(
+        ModelRegistry(root=sroot), flush_deadline=None,
+        persist_updates=False, readpath=True, horizons=horizons,
+        durability=DurabilitySpec(enabled=False),
+    )
+    standby = ReplicaStandby(
+        standby_svc, spec, str(tmp_path / "standby.sock")
+    )
+    return primary, standby, standby_svc, ids
+
+
+def _drain(primary, standby, want, timeout_s=30.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        primary.repl_hub.poll()
+        st = standby.status()
+        if st["backlog"] == 0 and (
+            st["applied_commits"] + st["skipped_commits"] >= want
+        ):
+            return st
+        time.sleep(0.02)
+    raise AssertionError(f"standby never drained: {standby.status()}")
+
+
+def test_ship_apply_catch_up_bit_identity(tmp_path):
+    primary, standby, standby_svc, ids = _pair(tmp_path)
+    try:
+        rng = np.random.default_rng(0)
+        # commits BEFORE attach ride the catch-up path
+        for mid in ids:
+            primary.update(mid, rng.normal(size=(1, 5)))
+        out = primary.repl_hub.add_standby(
+            str(standby.socket_path), name="sb0"
+        )
+        assert out["catch_up_commits"] == len(ids)
+        # live-shipped commits after attach
+        for _ in range(2):
+            for mid in ids:
+                primary.update(mid, rng.normal(size=(1, 5)))
+        _drain(primary, standby, want=3 * len(ids))
+        # bit-identical at f64 at every replicated version
+        for mid in ids:
+            a = primary.registry.get(mid)
+            b = standby_svc.registry.get(mid)
+            assert a.version == b.version == 3
+            assert np.array_equal(np.asarray(a.mean), np.asarray(b.mean))
+            assert np.array_equal(np.asarray(a.cov), np.asarray(b.cov))
+        # the replica read surface serves from its OWN snapshot store
+        f = standby_svc.forecast(ids[0], 2)
+        assert np.asarray(f.means).shape[0] == 2
+        # reads are allowed pre-promotion, writes are not
+        with pytest.raises(RuntimeError, match="read-only"):
+            rpc_call(
+                str(standby.socket_path), "update",
+                {"model_id": ids[0], "new_obs": np.zeros((1, 5))},
+            )
+        # replication telemetry callbacks
+        hub = primary.repl_hub
+        assert hub.replicas_live() == 1
+        assert hub.shipped_commits == 2 * len(ids)
+        assert hub.lag_seconds() == 0.0
+        ev = [e["kind"] for e in primary.events.tail(64)]
+        assert "replica_connect" in ev
+    finally:
+        standby.close()
+        standby_svc.close()
+        primary.close()
+
+
+def test_promote_fences_primary_and_arms_durability(tmp_path):
+    primary, standby, standby_svc, ids = _pair(tmp_path)
+    try:
+        rng = np.random.default_rng(1)
+        primary.repl_hub.add_standby(str(standby.socket_path))
+        for mid in ids:
+            primary.update(mid, rng.normal(size=(1, 5)))
+        _drain(primary, standby, want=len(ids))
+
+        report = standby.promote()
+        assert report["epoch"] == 2
+        assert standby.promoted
+        # the promoted standby is immediately a durable primary
+        assert standby_svc._durability is not None
+        st = standby_svc.update(ids[0], rng.normal(size=(1, 5)))
+        assert st.version == 2
+
+        # the zombie primary can never ack again — and the rejection
+        # is booked
+        with pytest.raises(PrimaryFencedError):
+            primary.update(ids[0], rng.normal(size=(1, 5)))
+        with pytest.raises(PrimaryFencedError):
+            primary.update(ids[1], rng.normal(size=(1, 5)))
+        ev = [e["kind"] for e in primary.events.tail(64)]
+        assert ev.count("primary_fenced") >= 2
+        assert primary.repl_hub.fenced
+        # the standby answers any old-epoch ship with StaleEpochError
+        with pytest.raises(StaleEpochError):
+            rpc_call(
+                str(standby.socket_path), "repl_hello", {"epoch": 1}
+            )
+        # the fence epoch survives a standby restart (persisted file)
+        assert standby._load_epoch() == 2
+    finally:
+        standby.close()
+        standby_svc.close()
+        primary.close()
+
+
+def test_replication_gauges_registered(tmp_path):
+    primary, standby, standby_svc, ids = _pair(tmp_path)
+    try:
+        text = primary.obs.metrics.render_prometheus()
+        for name in (
+            "metran_serve_repl_lag_seconds",
+            "metran_serve_repl_shipped_commits_total",
+            "metran_serve_repl_replicas_live",
+        ):
+            assert name in text, name
+    finally:
+        standby.close()
+        standby_svc.close()
+        primary.close()
+
+
+# ----------------------------------------------------------------------
+# spawned standby + frontend failover (the full promotion wiring)
+# ----------------------------------------------------------------------
+@pytest.mark.cluster
+def test_frontend_failover_to_spawned_standby(tmp_path):
+    """The acceptance path end to end, cross-process: a spawned
+    standby catches up and follows a spawned writer through the
+    frontend, the writer is SIGKILLed, ``promote_standby`` re-points
+    the write path, and no acked commit is lost."""
+    import multiprocessing
+
+    from metran_tpu.cluster import ClusterFrontend, ClusterSpec
+    from metran_tpu.cluster._testing import writer_service_factory
+    from metran_tpu.cluster.frontend import _wait_ready
+
+    proot, sroot = str(tmp_path / "p"), str(tmp_path / "s")
+    ids = seed_root(proot, seed=7)
+    seed_root(sroot, seed=7)
+    spec = ClusterSpec(
+        enabled=True, workers=1, shm_mb=8.0, heartbeat_s=0.3,
+        slots=64, max_series=8, socket_dir=str(tmp_path),
+    )
+    repl_spec = ReplicationSpec(enabled=True).validate()
+    sock = os.path.join(str(tmp_path), "standby.sock")
+    ready = os.path.join(str(tmp_path), "standby.ready")
+    ctx = multiprocessing.get_context("spawn")
+    standby_proc = ctx.Process(
+        target=standby_main,
+        args=(repl_spec, sock, standby_service_factory, (sroot,),
+              ready),
+        name="metran-standby", daemon=True,
+    )
+    frontend = ClusterFrontend(
+        spec, writer_service_factory, (proot, "1-5", True, True),
+    )
+    try:
+        standby_proc.start()
+        _wait_ready(ready, standby_proc)
+        out = frontend.attach_standby(sock, name="sb0")
+        assert out["replicas"] == 1
+
+        rng = np.random.default_rng(3)
+        acked = {}
+        for t in range(3):
+            for mid in ids:
+                st = frontend.update(mid, rng.normal(size=(1, 5)))
+                acked[mid] = int(st.version)
+
+        # SIGKILL the primary writer — the hard failover case
+        frontend._writer_proc.kill()
+        frontend._writer_proc.join(timeout=10.0)
+        assert not frontend.writer_alive()
+
+        report = frontend.promote_standby()
+        assert report["epoch"] >= 2
+        assert report["failover_wall_s"] > 0.0
+        # zero acked commits lost: the promoted standby serves every
+        # acked version (and accepts new writes)
+        for mid in ids:
+            meta = frontend.meta(mid)
+            assert int(meta.version) >= acked[mid], (mid, meta)
+        st = frontend.update(ids[0], rng.normal(size=(1, 5)))
+        assert int(st.version) == acked[ids[0]] + 1
+        # reads still answer (a plane-less standby serves worker reads
+        # through the ordinary transport-failure fall-through)
+        f = frontend.forecast(ids[0], 2)
+        assert np.asarray(f.means).shape[0] == 2
+    finally:
+        frontend.close()
+        standby_proc.join(timeout=10.0)
+        if standby_proc.is_alive():
+            standby_proc.terminate()
+            standby_proc.join(timeout=5.0)
+
+
+# ----------------------------------------------------------------------
+# chaos cells (two representative ones in tier-1; full matrix = slow)
+# ----------------------------------------------------------------------
+def _assert_failover_cell(out):
+    assert out["no_acked_loss"], out["acked_lost"]
+    assert out["bit_identical"], out["max_posterior_diff"]
+    assert out["fenced_ack_rejected"], out
+    assert out["fenced_event_booked"], out
+    assert out["rto_s"] > 0.0
+
+
+@pytest.mark.faults
+def test_failover_arena_readpath_torn_record():
+    """The richest cell: arena + read path, primary killed MID-WAL-
+    RECORD — the torn frame was never shipped (and never acked), the
+    promoted standby is bit-identical to a crash-free run, and the
+    fenced zombie (with its poisoned local log) still cannot ack."""
+    out = run_failover_scenario(
+        mode="arena", kill_point="durability.wal.mid_record",
+        n_models=3, n_series=3, t_hist=30, n_ticks=6, pre_ticks=3,
+    )
+    assert out["crashed"]
+    _assert_failover_cell(out)
+
+
+@pytest.mark.faults
+def test_failover_dict_post_ack():
+    """Dict mode, killed after the previous dispatch's acks and before
+    the next WAL byte: everything acked reached the standby first."""
+    out = run_failover_scenario(
+        mode="dict", kill_point="durability.wal.pre_commit",
+        n_models=3, n_series=3, t_hist=30, n_ticks=5, pre_ticks=3,
+    )
+    assert out["crashed"]
+    _assert_failover_cell(out)
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+@pytest.mark.parametrize("mode", ["dict", "arena"])
+@pytest.mark.parametrize("kill_point", list(CRASH_POINTS) + [None])
+def test_failover_matrix(mode, kill_point):
+    """The full failover chaos matrix: the primary killed at every
+    named kill point x {dict, arena+readpath} (plus the plain kill -9
+    row) must promote a bit-identical standby with zero acked loss
+    and a fenced old primary."""
+    ckpt = (
+        24 if kill_point in (
+            "durability.spill.model", "durability.manifest.rotate"
+        ) else 0
+    )
+    out = run_failover_scenario(
+        mode=mode, kill_point=kill_point,
+        kill_match=("fm1" if kill_point == "durability.spill.model"
+                    else None),
+        n_models=3, n_series=3, t_hist=30, n_ticks=8, pre_ticks=4,
+        checkpoint_every=ckpt,
+    )
+    if kill_point is not None and ckpt == 0:
+        assert out["crashed"]
+    _assert_failover_cell(out)

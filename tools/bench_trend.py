@@ -66,6 +66,9 @@ HEADLINES: Dict[str, int] = {
     "cluster_reads_per_s": +1,          # N-reader shared-memory plane
     "cluster_read_scaling_x": +1,       # vs single-process ceiling
     "cluster_mixed_p99_ms": -1,         # frontend 90/10 p99 (50ms SLO)
+    "repl_lag_p99_ms": -1,              # ship ack-to-applied (250ms bar)
+    "failover_rto_ms": -1,              # promote wall to first read
+    "replica_read_scaling_x": +1,       # primary + 2 standbys fan-out
 }
 
 #: tail-fallback regexes for rounds with ``"parsed": null``: the raw

@@ -64,6 +64,7 @@ MODULES = [
     "metran_tpu.cluster.worker",
     "metran_tpu.cluster.writer",
     "metran_tpu.cluster.frontend",
+    "metran_tpu.cluster.replication",
     "metran_tpu.cluster.mesh",
     "metran_tpu.reliability.policy",
     "metran_tpu.reliability.health",
