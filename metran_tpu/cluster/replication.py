@@ -58,7 +58,9 @@ import threading
 import time
 import zlib
 from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 from logging import getLogger
+from pathlib import Path
 from time import perf_counter
 from typing import Dict, List, NamedTuple, Optional, Tuple
 
@@ -75,6 +77,7 @@ from ..serve.durability import (
     encode_group,
     iter_frames,
     list_segments,
+    load_latest_manifest,
     replay_wal,
 )
 from .ipc import RpcClient, RpcServer
@@ -83,11 +86,13 @@ logger = getLogger(__name__)
 
 __all__ = [
     "PrimaryFencedError",
+    "ReplicaBaselineError",
     "ReplicaStandby",
     "ReplicationHub",
     "ReplicationSpec",
     "StaleEpochError",
     "decode_frame",
+    "load_epoch",
     "standby_main",
 ]
 
@@ -95,6 +100,22 @@ __all__ = [
 #: restarted standby must come back at (at least) its promoted epoch,
 #: or a zombie primary could re-ship into it
 EPOCH_FILE = "repl-epoch"
+
+
+def load_epoch(wal_dir) -> int:
+    """The persisted fence epoch next to a WAL directory (>= 1; 1 when
+    no fence was ever written).  Written by
+    :meth:`ReplicaStandby.promote` (and epoch adoptions in the ship
+    handshake); read back by a restarted standby AND by
+    :class:`ReplicationHub` at construction — a promoted standby that
+    later arms replication as the new primary must announce its real
+    epoch, not restart the stream at 1 (which a surviving standby at
+    the promoted epoch would answer with :class:`StaleEpochError`,
+    permanently fencing the legitimate primary on a mere attach)."""
+    try:
+        return max(1, int((Path(wal_dir) / EPOCH_FILE).read_text()))
+    except (OSError, ValueError):
+        return 1
 
 
 class StaleEpochError(RuntimeError):
@@ -113,6 +134,17 @@ class StaleEpochError(RuntimeError):
             "stale replication epoch: a standby was promoted to "
             f"epoch {self.epoch}"
         )
+
+
+class ReplicaBaselineError(RuntimeError):
+    """A standby's baseline cannot be caught up from the primary's
+    WAL: checkpoints truncate the log, so the commits between the
+    standby's versions and the oldest surviving frame are gone.
+    Raised by ``add_standby`` at ATTACH time (the version vectors are
+    exchanged in ``repl_hello``) instead of letting the standby's
+    apply thread halt asynchronously after the attach already looked
+    healthy — the remedy is always to reseed the standby from the
+    primary's latest checkpoint."""
 
 
 class ReplicationSpec(NamedTuple):
@@ -228,10 +260,14 @@ class ReplicationHub:
     ``shipper`` hook).
 
     ``ship(groups)`` runs on the dispatch thread between the local
-    WAL fdatasync and the callers' acks; ``add_standby`` holds the
-    same lock while it catches a new standby up from the primary's
-    own log, so a commit can never fall between catch-up and the live
-    stream.  Ordinary standby failures degrade (drop + re-attach); a
+    WAL fdatasync and the callers' acks — pushes to N >= 2 standbys
+    fan out concurrently, so one commit's ship wall is bounded by ONE
+    ``ack_timeout_s`` regardless of standby count.  ``add_standby``
+    holds the hub lock through validation + catch-up; the live-stream
+    handoff is still seamless because every shipped frame is on the
+    primary's own WAL before ``ship`` is called (a catch-up that
+    misses a frame's live ship window reads it from the log instead).
+    Ordinary standby failures degrade (drop + re-attach); a
     :class:`StaleEpochError` reply fences the hub permanently."""
 
     def __init__(self, service, spec: ReplicationSpec):
@@ -239,7 +275,12 @@ class ReplicationHub:
         self.spec = spec
         self._lock = threading.RLock()
         self._standbys: Dict[str, _Standby] = {}
-        self.epoch = 1
+        # the stream epoch resumes from the persisted fence next to
+        # the service's own WAL: a promoted standby re-armed as the
+        # new primary (promote() wrote the file before re-arming
+        # durability over the same directory) must NOT restart at 1
+        dur = getattr(service, "_durability", None)
+        self.epoch = load_epoch(dur.dir) if dur is not None else 1
         self.fenced = False
         self.fenced_epoch: Optional[int] = None
         self.shipped_groups = 0
@@ -248,6 +289,11 @@ class ReplicationHub:
         #: recent ack-to-applied lag samples in seconds (the
         #: ``repl_lag_p99_ms`` bench headline's source)
         self.lag_samples_s: deque = deque(maxlen=8192)
+        #: lazy fan-out pool (only with >= 2 standbys): pushes run
+        #: concurrently so one commit's total ship wall is bounded by
+        #: ONE ack timeout, not standby-count many
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._pool_workers = 0
 
     # -- the ack-path hooks (called by DurabilityManager) ---------------
     def raise_if_fenced(self) -> None:
@@ -261,33 +307,87 @@ class ReplicationHub:
     def ship(self, groups) -> None:
         """Push one committed dispatch's group frames to every live
         standby, synchronously.  Called BEFORE any caller's ack
-        resolves; raising here fails the round un-acked."""
+        resolves; raising here fails the round un-acked.
+
+        With one standby the push runs inline; with N >= 2 the pushes
+        fan out on the hub's pool so the total ship wall-clock per
+        commit is bounded by ONE ``ack_timeout_s`` regardless of
+        standby count (the RPC itself happens OUTSIDE the hub lock —
+        only membership snapshot and bookkeeping hold it, so an
+        attach's catch-up is the only thing a ship ever waits behind).
+        The frames were appended to the primary's own WAL before this
+        call, so a standby attaching concurrently can never miss them:
+        either its catch-up read them from the log, or it joined
+        membership before the snapshot below and gets them live."""
         groups = [g for g in groups if g.n_records]
         if not groups:
             return
         frames = [encode_group(g) for g in groups]
-        group = int(groups[0].group)
+        # label the dispatch with its LAST (max) group id: the standby
+        # acks applied-up-to this id only after every group in the
+        # dispatch applied, so lag samples and backlog hysteresis stay
+        # honest when one dispatch carries several commit groups
+        group = int(groups[-1].group)
         n_records = sum(g.n_records for g in groups)
         with self._lock:
             self.raise_if_fenced()
-            if not self._standbys:
-                return
-            t0 = time.monotonic()
-            for sb in list(self._standbys.values()):
-                self._push(sb, frames, group, n_records, t0)
+            targets = list(self._standbys.values())
+        if not targets:
+            return
+        t0 = time.monotonic()
+        if len(targets) == 1:
+            self._push(targets[0], frames, group, n_records, t0)
+        else:
+            fence: Optional[PrimaryFencedError] = None
+            pool = self._ship_pool(len(targets))
+            futures = [
+                pool.submit(
+                    self._push, sb, frames, group, n_records, t0
+                )
+                for sb in targets
+            ]
+            for fut in futures:
+                try:
+                    fut.result()
+                except PrimaryFencedError as exc:
+                    fence = exc
+            if fence is not None:
+                raise fence
+        with self._lock:
+            # a concurrent dispatch's push may have discovered the
+            # fence while ours was in flight — never book (or ack) a
+            # commit past that point
+            self.raise_if_fenced()
             self.shipped_groups += 1
             self.shipped_commits += n_records
 
+    def _ship_pool(self, n: int) -> ThreadPoolExecutor:
+        with self._lock:
+            if self._pool is None or self._pool_workers < n:
+                old = self._pool
+                self._pool_workers = max(4, n)
+                self._pool = ThreadPoolExecutor(
+                    max_workers=self._pool_workers,
+                    thread_name_prefix="metran-repl-ship",
+                )
+                if old is not None:
+                    old.shutdown(wait=False)
+            return self._pool
+
     def _push(self, sb: _Standby, frames, group: int, n_records: int,
               t0: float) -> None:
+        """One standby's ship RPC + bookkeeping.  The RPC runs outside
+        the hub lock (pushes to different standbys are concurrent;
+        ``RpcClient`` serializes per socket); only the books take it."""
         try:
             reply = sb.client.call("repl_frames", {
                 "epoch": self.epoch, "group": group,
                 "n_records": n_records, "frames": frames,
             })
         except StaleEpochError as exc:
-            self.fenced = True
-            self.fenced_epoch = exc.epoch
+            with self._lock:
+                self.fenced = True
+                self.fenced_epoch = exc.epoch
             logger.error(
                 "standby %s is at epoch %d > our %d: this primary is "
                 "fenced and will never ack again", sb.name, exc.epoch,
@@ -302,24 +402,27 @@ class ReplicationHub:
             # an unreachable/broken standby must degrade redundancy,
             # not block or fail primary acks: drop it (it re-attaches
             # and catches up from the primary's log)
-            sb.failures += 1
-            self.drops += 1
+            with self._lock:
+                sb.failures += 1
+                self.drops += 1
+                self._standbys.pop(sb.name, None)
             logger.exception(
                 "standby %s failed a ship and was dropped (it can "
                 "re-attach and catch up)", sb.name,
             )
-            self._standbys.pop(sb.name, None)
             try:
                 sb.client.close()
             except Exception:  # pragma: no cover - teardown
                 pass
             return
-        sb.shipped_group = group
-        sb.pending.append((group, t0))
-        self._harvest(sb, reply, time.monotonic())
+        with self._lock:
+            sb.shipped_group = max(sb.shipped_group, group)
+            sb.pending.append((group, t0))
+            self._harvest(sb, reply, time.monotonic())
 
     def _harvest(self, sb: _Standby, reply: dict, now: float) -> None:
-        """Fold one standby reply into the lag books."""
+        """Fold one standby reply into the lag books (caller holds
+        ``_lock``)."""
         applied = int(reply.get("applied", sb.applied_group))
         while sb.pending and sb.pending[0][0] <= applied:
             _g, t_ship = sb.pending.popleft()
@@ -343,10 +446,16 @@ class ReplicationHub:
     # -- membership -----------------------------------------------------
     def add_standby(self, socket_path: str,
                     name: Optional[str] = None) -> dict:
-        """Attach one standby: epoch handshake, catch-up from the
-        primary's own WAL (under the ship lock, so no commit falls
-        between catch-up and the live stream), then live membership.
-        Returns the handshake summary."""
+        """Attach one standby: epoch handshake (the hello also drains
+        the standby's apply queue and returns its version vector),
+        baseline validation against the primary's checkpoint cut + WAL
+        (a standby whose versions the surviving log cannot reach is
+        refused HERE with :class:`ReplicaBaselineError` — reseed it
+        from the latest checkpoint — instead of halting its apply
+        thread asynchronously after the attach looked healthy), then
+        catch-up from the primary's own WAL (under the ship lock, so
+        no commit falls between catch-up and the live stream), then
+        live membership.  Returns the handshake summary."""
         name = name or os.path.basename(socket_path)
         client = RpcClient(
             socket_path, timeout_s=self.spec.ack_timeout_s
@@ -370,7 +479,17 @@ class ReplicationHub:
                 client.close()
                 raise
             sb = _Standby(name, socket_path, client)
-            caught_up = self._catch_up(sb)
+            try:
+                self._validate_baseline(name, hello.get("versions"))
+                caught_up = self._catch_up(sb)
+            except BaseException:
+                # an attach that cannot be validated or caught up must
+                # not leak its connection (and never joined membership)
+                try:
+                    client.close()
+                except Exception:  # pragma: no cover - teardown
+                    pass
+                raise
             self._standbys[name] = sb
             events = self.service.events
             if events is not None:
@@ -385,6 +504,71 @@ class ReplicationHub:
                 "catch_up_commits": caught_up,
                 "replicas": len(self._standbys),
             }
+
+    def _validate_baseline(self, name: str, versions) -> None:
+        """Attach-time reseed gate (caller holds ``_lock``).
+
+        Checkpoints truncate the WAL, so catch-up can only bridge a
+        standby whose versions reach the oldest surviving frame.  Two
+        checks against the standby's post-drain version vector (from
+        ``repl_hello``): every model in the latest checkpoint cut must
+        be at least at its cut version (the frames below the cut are
+        gone), and walking the surviving WAL from the vector must stay
+        contiguous per model.  Either failing raises
+        :class:`ReplicaBaselineError` — the replica needs a reseed
+        from the primary's latest checkpoint, and saying so NOW beats
+        an asynchronous apply halt after the attach returned success."""
+        dur = self.service._durability
+        if dur is None:  # pragma: no cover - hub always armed with WAL
+            return
+        if versions is None:
+            # a pre-vector standby: the legacy behavior (gaps surface
+            # as an apply halt on the first broken ship)
+            logger.warning(
+                "standby %s reported no version vector; baseline "
+                "validation skipped", name,
+            )
+            return
+        v = {str(m): int(ver) for m, ver in versions.items()}
+        man = load_latest_manifest(dur.dir)
+        cut = (man or {}).get("versions") or {}
+        for mid, cut_v in cut.items():
+            have = v.get(str(mid))
+            if have is None or have < int(cut_v):
+                raise ReplicaBaselineError(
+                    f"standby {name} baseline predates the primary's "
+                    f"checkpoint cut: model {mid!r} is at version "
+                    f"{have if have is not None else 'ABSENT'} on the "
+                    f"standby but the cut is at {int(cut_v)} and the "
+                    "WAL below it was truncated — reseed the standby "
+                    "from the primary's latest checkpoint"
+                )
+        # per-model contiguity over the surviving frames (frames a
+        # concurrent dispatch appends mid-walk are a contiguous tail,
+        # so a partial view can only pass conservatively)
+        for frame in iter_frames(dur.dir, since_seq=1):
+            for rec in frame.records:
+                have = v.get(rec.model_id)
+                if have is None:
+                    raise ReplicaBaselineError(
+                        f"standby {name} has no state for model "
+                        f"{rec.model_id!r} but the primary's WAL "
+                        "holds commits for it — reseed the standby "
+                        "from the primary's latest checkpoint"
+                    )
+                if rec.version <= have:
+                    continue
+                if rec.version == have + 1:
+                    v[rec.model_id] = rec.version
+                else:
+                    raise ReplicaBaselineError(
+                        f"standby {name} baseline has a WAL gap for "
+                        f"model {rec.model_id!r}: standby at version "
+                        f"{have}, oldest unapplied surviving frame is "
+                        f"{rec.version} — the commits between were "
+                        "checkpoint-truncated; reseed the standby "
+                        "from the primary's latest checkpoint"
+                    )
 
     def _catch_up(self, sb: _Standby) -> int:
         """Re-ship every intact frame of the primary's own log (the
@@ -429,14 +613,19 @@ class ReplicationHub:
     # -- reporting ------------------------------------------------------
     def poll(self) -> None:
         """Refresh per-standby applied/backlog books off the ship path
-        (the bench drain + gauge scrapes between quiet stretches)."""
+        (the bench drain + gauge scrapes between quiet stretches).
+        The status RPCs run outside the hub lock so a slow standby
+        never stalls a concurrent ship's bookkeeping."""
         with self._lock:
-            for sb in list(self._standbys.values()):
-                try:
-                    reply = sb.client.call("repl_status")
-                except Exception:
+            targets = list(self._standbys.values())
+        for sb in targets:
+            try:
+                reply = sb.client.call("repl_status")
+            except Exception:
+                with self._lock:
                     sb.failures += 1
-                    continue
+                continue
+            with self._lock:
                 self._harvest(sb, reply, time.monotonic())
 
     def replicas_live(self) -> int:
@@ -483,6 +672,9 @@ class ReplicationHub:
                 except Exception:  # pragma: no cover - teardown
                     pass
             self._standbys.clear()
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
 
 
 def _to_host(obj):
@@ -506,8 +698,6 @@ class ReplicaStandby:
 
     def __init__(self, service, spec: ReplicationSpec,
                  socket_path: str, wal_dir=None):
-        from pathlib import Path
-
         if service._durability is not None:
             raise ValueError(
                 "standby service must not arm its own durability "
@@ -534,6 +724,12 @@ class ReplicaStandby:
         self._cv = threading.Condition()
         self._queue: deque = deque()  # (group, [WalRecord, ...])
         self._applying = False
+        #: frame RPCs past the epoch check but not yet re-checked
+        #: after their append — promote() fences the epoch first, then
+        #: waits this out before draining and closing the log, so a
+        #: racing ship can neither enqueue past the drain nor append
+        #: to a closed log
+        self._frames_inflight = 0
         self._apply_error: Optional[BaseException] = None
         self._stop = False
         self.promoted = False
@@ -553,12 +749,7 @@ class ReplicaStandby:
 
     # -- epoch fence persistence ---------------------------------------
     def _load_epoch(self) -> int:
-        try:
-            return max(
-                1, int((self.wal_dir / EPOCH_FILE).read_text())
-            )
-        except (OSError, ValueError):
-            return 1
+        return load_epoch(self.wal_dir)
 
     def _persist_epoch(self) -> None:
         tmp = self.wal_dir / f".{EPOCH_FILE}.{os.getpid()}.tmp"
@@ -608,11 +799,35 @@ class ReplicaStandby:
             if epoch > self.epoch:
                 self.epoch = epoch
                 self._persist_epoch()
+            # drain before reporting: the version vector must reflect
+            # every frame already on this standby's log (a re-attach
+            # with a backlog would otherwise look staler than it is),
+            # and a halted apply must refuse the attach HERE — a
+            # silently-broken replica never rejoins live membership
+            while ((self._queue or self._applying
+                    or self._frames_inflight)
+                    and self._apply_error is None):
+                self._cv.wait(0.2)
+            if self._apply_error is not None:
+                raise RecoveryError(
+                    "standby apply halted: "
+                    f"{self._apply_error!r}"
+                )
+            # the version vector must cover the WHOLE baseline —
+            # including states still on disk — so warm first (the
+            # standby replays into them anyway; current_versions alone
+            # only sees loaded/arena-resident states)
+            reg = self.service.registry
+            reg.warm()
             return {
                 "epoch": self.epoch,
                 "received": self.received_group,
                 "applied": self.applied_group,
                 "backlog": sum(len(r) for _, r in self._queue),
+                "versions": {
+                    m: int(ver)
+                    for m, ver in reg.current_versions().items()
+                },
                 "pid": os.getpid(),
             }
 
@@ -629,16 +844,37 @@ class ReplicaStandby:
             if epoch > self.epoch:
                 self.epoch = epoch
                 self._persist_epoch()
+            self._frames_inflight += 1
         group = int(payload["group"])
         records: List[WalRecord] = []
-        for buf in payload["frames"]:
-            # CRC re-verified at the receiving edge, then appended
-            # VERBATIM — the standby's log is byte-identical to the
-            # primary's stream, so the same readers replay it
-            recs = decode_frame(buf)
-            self.log.append_encoded(buf, len(recs))
-            records.extend(recs)
+        try:
+            for buf in payload["frames"]:
+                # CRC re-verified at the receiving edge, then appended
+                # VERBATIM — the standby's log is byte-identical to
+                # the primary's stream, so the same readers replay it
+                recs = decode_frame(buf)
+                self.log.append_encoded(buf, len(recs))
+                records.extend(recs)
+        except BaseException:
+            with self._cv:
+                self._frames_inflight -= 1
+                self._cv.notify_all()
+            raise
         with self._cv:
+            self._frames_inflight -= 1
+            self._cv.notify_all()
+            # re-check under the lock: promote() may have fenced the
+            # epoch while it was released for the append above.
+            # Refusing HERE — before the enqueue — keeps the
+            # zero-acked-loss contract: the frames sit on our log but
+            # the primary is answered StaleEpochError, so the commit
+            # is never acked (and promotion's checkpoint cut is free
+            # to truncate the never-applied tail).  Without this, a
+            # ship racing promotion could enqueue after the drain with
+            # the apply thread stopped and be ACKED without ever being
+            # applied on the promoted timeline.
+            if self.promoted or epoch < self.epoch:
+                raise StaleEpochError(self.epoch)
             if records:
                 self._queue.append((group, records))
                 self.received_group = max(self.received_group, group)
@@ -745,14 +981,23 @@ class ReplicaStandby:
         with self._cv:
             if self.promoted:
                 raise RuntimeError("standby is already promoted")
+            # the fence must be strictly monotonic: an explicit epoch
+            # below (or at) the current one would let a zombie at the
+            # same epoch keep shipping
             self.epoch = (
-                int(epoch) if epoch is not None else self.epoch + 1
+                max(self.epoch + 1, int(epoch))
+                if epoch is not None else self.epoch + 1
             )
             self._persist_epoch()
+            # the fence is up: new frame RPCs refuse at entry.  Wait
+            # out any ship already past the entry check (mid-append —
+            # it will refuse at its post-append re-check instead of
+            # enqueueing, so the old primary is never acked), then
             # drain: everything received must be applied before this
             # replica serves as primary
-            while (self._queue or self._applying) \
-                    and self._apply_error is None:
+            while ((self._frames_inflight or self._queue
+                    or self._applying)
+                    and self._apply_error is None):
                 self._cv.wait(0.2)
             if self._apply_error is not None:
                 raise RecoveryError(
@@ -818,6 +1063,11 @@ class ReplicaStandby:
         with self._cv:
             self._stop = True
             self._cv.notify_all()
+            # let a mid-append frame RPC finish before the log closes
+            # under it (bounded: appends are short)
+            deadline = time.monotonic() + 5.0
+            while self._frames_inflight and time.monotonic() < deadline:
+                self._cv.wait(0.2)
         self._apply_thread.join(timeout=5.0)
         self.rpc.close()
         if not self.promoted:

@@ -12,6 +12,7 @@ kill-point x mode matrix rides the ``slow`` marker
 
 import os
 import pickle
+import threading
 import time
 
 import numpy as np
@@ -20,10 +21,14 @@ import pytest
 from metran_tpu.cluster._testing import seed_root, standby_service_factory
 from metran_tpu.cluster.ipc import rpc_call
 from metran_tpu.cluster.replication import (
+    ReplicaBaselineError,
     ReplicaStandby,
+    ReplicationHub,
     ReplicationSpec,
     StaleEpochError,
+    _Standby,
     decode_frame,
+    load_epoch,
     standby_main,
 )
 from metran_tpu.reliability.scenarios import (
@@ -274,6 +279,238 @@ def test_replication_gauges_registered(tmp_path):
             "metran_serve_repl_replicas_live",
         ):
             assert name in text, name
+    finally:
+        standby.close()
+        standby_svc.close()
+        primary.close()
+
+
+# ----------------------------------------------------------------------
+# hardened edges: ship/promote race, epoch resume, reseed gate,
+# multi-group lag labels, concurrent fan-out
+# ----------------------------------------------------------------------
+def test_ship_racing_promotion_refused_before_enqueue(tmp_path):
+    """A frame RPC past the entry epoch check when promote() fences
+    must refuse at the post-append re-check — the frames land on the
+    standby's log but the primary is answered StaleEpochError, so the
+    commit is never acked and nothing is enqueued past the drain
+    (zero-acked-loss under the ship/promote race)."""
+    seed_root(str(tmp_path), n_models=1)
+    svc = MetranService(
+        ModelRegistry(root=str(tmp_path)), flush_deadline=None,
+        persist_updates=False, durability=DurabilitySpec(enabled=False),
+    )
+    standby = ReplicaStandby(
+        svc, ReplicationSpec(enabled=True).validate(),
+        str(tmp_path / "s.sock"),
+    )
+    try:
+        promo = {}
+        real_append = standby.log.append_encoded
+
+        def racing_append(buf, n_records):
+            # the append happens with the lock released — promote()
+            # lands mid-append and must fence, then wait us out
+            out = real_append(buf, n_records)
+            t = threading.Thread(
+                target=lambda: promo.update(report=standby.promote()),
+                daemon=True,
+            )
+            t.start()
+            promo["thread"] = t
+            deadline = time.monotonic() + 10.0
+            while standby.epoch == 1 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert standby.epoch == 2, "promotion never fenced"
+            return out
+
+        standby.log.append_encoded = racing_append
+        with pytest.raises(StaleEpochError):
+            standby._repl_frames({
+                "epoch": 1, "group": 1, "n_records": 1,
+                "frames": [_one_frame()],
+            })
+        promo["thread"].join(timeout=10.0)
+        assert not promo["thread"].is_alive()
+        # promotion completed cleanly AND the raced frames were never
+        # enqueued or applied (the never-applied log tail is truncated
+        # by the promotion checkpoint, not replayed)
+        assert standby.promoted and promo["report"]["epoch"] == 2
+        st = standby.status()
+        assert st["backlog"] == 0
+        assert st["received_commits"] == 0
+        assert st["applied_commits"] == 0
+    finally:
+        standby.close()
+        svc.close()
+
+
+def test_hub_epoch_resumes_from_persisted_fence(tmp_path):
+    """A hub armed over a WAL dir with a persisted fence file resumes
+    that epoch (restarted / promoted-then-re-armed primary) instead of
+    restarting the stream at 1 — which a surviving standby at the
+    promoted epoch would answer with StaleEpochError, permanently
+    fencing the legitimate new primary on a mere attach."""
+    primary, standby, standby_svc, ids = _pair(tmp_path)
+    try:
+        spec = ReplicationSpec(enabled=True).validate()
+        (primary._durability.dir / "repl-epoch").write_text("5")
+        assert load_epoch(primary._durability.dir) == 5
+        assert ReplicationHub(primary, spec).epoch == 5
+        # end to end: promote the standby, then arm a hub over the
+        # promoted service — it must announce the PROMOTED epoch
+        primary.repl_hub.add_standby(str(standby.socket_path))
+        rng = np.random.default_rng(2)
+        for mid in ids:
+            primary.update(mid, rng.normal(size=(1, 5)))
+        _drain(primary, standby, want=len(ids))
+        standby.promote()
+        hub = ReplicationHub(standby_svc, spec)
+        assert hub.epoch == 2
+        assert not hub.fenced
+    finally:
+        standby.close()
+        standby_svc.close()
+        primary.close()
+
+
+def test_attach_refuses_checkpoint_truncated_baseline(tmp_path):
+    """A standby whose baseline predates the primary's checkpoint cut
+    is refused AT ATTACH with the reseed error — the commits between
+    its versions and the surviving WAL are gone, and the old behavior
+    (apply halting asynchronously after add_standby returned success)
+    left a silently-broken replica in live membership."""
+    primary, standby, standby_svc, ids = _pair(tmp_path)
+    try:
+        rng = np.random.default_rng(4)
+        for _ in range(2):
+            for mid in ids:
+                primary.update(mid, rng.normal(size=(1, 5)))
+        primary.checkpoint()  # truncates the WAL past the baseline
+        with pytest.raises(ReplicaBaselineError, match="reseed"):
+            primary.repl_hub.add_standby(
+                str(standby.socket_path), name="sb0"
+            )
+        # the refused standby never joined membership
+        assert primary.repl_hub.replicas_live() == 0
+        assert standby.status()["received_commits"] == 0
+    finally:
+        standby.close()
+        standby_svc.close()
+        primary.close()
+
+
+def test_attach_refuses_standby_missing_a_model(tmp_path):
+    """A standby with no state at all for a model the primary commits
+    to can never be caught up — refused at attach, not discovered as
+    an asynchronous apply halt later."""
+    proot, sroot = str(tmp_path / "p"), str(tmp_path / "s")
+    ids = seed_root(proot, seed=7)
+    seed_root(sroot, seed=7)
+    os.remove(os.path.join(sroot, f"{ids[-1]}.npz"))
+    spec = ReplicationSpec(enabled=True).validate()
+    primary = MetranService(
+        ModelRegistry(root=proot), flush_deadline=None,
+        persist_updates=False,
+        durability=DurabilitySpec(enabled=True, checkpoint_every=0),
+        replication=spec,
+    )
+    standby_svc = MetranService(
+        ModelRegistry(root=sroot), flush_deadline=None,
+        persist_updates=False, durability=DurabilitySpec(enabled=False),
+    )
+    standby = ReplicaStandby(
+        standby_svc, spec, str(tmp_path / "standby.sock")
+    )
+    try:
+        rng = np.random.default_rng(6)
+        primary.update(ids[-1], rng.normal(size=(1, 5)))
+        with pytest.raises(ReplicaBaselineError, match="reseed"):
+            primary.repl_hub.add_standby(
+                str(standby.socket_path), name="sb0"
+            )
+        assert primary.repl_hub.replicas_live() == 0
+    finally:
+        standby.close()
+        standby_svc.close()
+        primary.close()
+
+
+def test_multi_group_dispatch_labeled_with_last_group(tmp_path):
+    """One ship() call carrying SEVERAL commit groups must label the
+    dispatch with the last (max) group id, so the lag books only
+    settle once every group in the dispatch is applied."""
+    primary, standby, standby_svc, ids = _pair(tmp_path)
+    try:
+        hub = primary.repl_hub
+        hub.add_standby(str(standby.socket_path), name="sb0")
+        rng = np.random.default_rng(5)
+        groups = [
+            WalGroup.of([WalRecord(
+                ids[i], version=1, t_seen=1,
+                y=rng.normal(size=(1, 5)), group=i + 1, group_size=1,
+            )])
+            for i in range(2)
+        ]
+        hub.ship(groups)
+        assert hub.shipped_groups == 1
+        assert hub.shipped_commits == 2
+        _drain(primary, standby, want=2)
+        st = standby.status()
+        assert st["received"] == 2 and st["applied"] == 2
+        books = hub.status()["standbys"]["sb0"]
+        assert books["shipped_group"] == 2
+        assert books["applied_group"] == 2
+        # every lag entry harvested: nothing pending at group 1
+        assert not hub._standbys["sb0"].pending
+        assert hub.lag_seconds() == 0.0
+        for mid in ids[:2]:
+            assert standby_svc.registry.get(mid).version == 1
+    finally:
+        standby.close()
+        standby_svc.close()
+        primary.close()
+
+
+def test_fanout_ship_is_concurrent_across_standbys(tmp_path):
+    """With N >= 2 standbys the pushes must overlap (one commit's ship
+    wall is bounded by ONE ack timeout): each fake standby's ack only
+    returns after the OTHER push started, so sequential shipping
+    would time out the first push and book a drop."""
+    primary, standby, standby_svc, ids = _pair(tmp_path)
+    try:
+        hub = primary.repl_hub
+        started = [threading.Event(), threading.Event()]
+
+        class _LockstepClient:
+            def __init__(self, i):
+                self.i = i
+
+            def call(self, op, payload=None):
+                started[self.i].set()
+                if not started[1 - self.i].wait(15.0):
+                    raise AssertionError("pushes were serialized")
+                g = int(payload["group"])
+                return {"received": g, "applied": g, "backlog": 0}
+
+            def close(self):
+                pass
+
+        for i in (0, 1):
+            hub._standbys[f"f{i}"] = _Standby(
+                f"f{i}", f"fake{i}.sock", _LockstepClient(i)
+            )
+        rec = WalRecord(
+            ids[0], version=1, t_seen=1, y=np.zeros((1, 5)),
+            group=1, group_size=1,
+        )
+        hub.ship([WalGroup.of([rec])])
+        assert hub.drops == 0
+        assert hub.replicas_live() == 2
+        assert hub.shipped_groups == 1 and hub.shipped_commits == 1
+        for i in (0, 1):
+            sb = hub._standbys[f"f{i}"]
+            assert sb.applied_group == 1 and not sb.pending
     finally:
         standby.close()
         standby_svc.close()
