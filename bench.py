@@ -3671,6 +3671,170 @@ def run_serve_cluster_bench(out_path: str, budget_s: float) -> dict:
         shutil.rmtree(work, ignore_errors=True)
 
 
+def run_obs_fleet_bench(out_path: str, budget_s: float) -> dict:
+    """Fleet observability overhead scenario (`metran_tpu/obs/fleet.py`
+    + the traced RPC envelope in `cluster/ipc.py`, ISSUE 19's
+    measurement story; docs/concepts.md "Fleet observability").
+
+    Two paired claims, each measured between TWO live clusters — one
+    spawned with ``METRAN_TPU_OBS_TRACE=1`` in the environment (so the
+    frontend, writer and every worker arm tracers and every frontend
+    RPC carries the 3-tuple traced envelope) and one spawned with
+    tracing off (the 2-tuple wire format, byte-identical to PR 16):
+
+    1. **traced cluster RPC** (``rpc_overhead_pct``, bar <= 5%): the
+       frontend update path — span begin/finish on both sides of the
+       socket plus ~40 bytes of pickled context per request — against
+       the identical untraced path, paired-interleaved lap ratios
+       exactly like ``--phase obs`` (AB/BA order so host drift
+       cancels);
+    2. **shared-memory read path** (``read_overhead_pct``, bar ~0%):
+       the workers' in-process ``read_loop`` plane reads.  Trace
+       propagation rides the RPC *envelope* and the plane read path
+       has no RPC per read by construction — this leg measures that
+       the claim survives contact with a live fleet.
+
+    A ``fleet_collect`` sample rides along (merge wall, process lane
+    count, exposition size) so the artifact also records what the
+    observability you are paying for actually buys.
+    """
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", JAX_CACHE + "-cpu")
+    import shutil
+    import tempfile
+
+    import jax
+
+    from metran_tpu.cluster import ClusterFrontend, ClusterSpec
+    from metran_tpu.cluster._testing import (
+        make_states, seed_root, writer_service_factory,
+    )
+
+    deadline = time.monotonic() + budget_s
+    workers, n_models = 2, 16
+    upd_rounds, read_iters, read_rounds = 40, 20_000, 8
+    if os.environ.get("METRAN_TPU_BENCH_SMALL"):
+        n_models, upd_rounds, read_iters, read_rounds = 8, 8, 2_000, 4
+    horizons, steps = "1-5", 5
+    out = {
+        "platform": jax.default_backend(),
+        "workers": workers, "n_models": n_models,
+    }
+    work = tempfile.mkdtemp(prefix="metran-obsfleet-")
+    clusters = {}
+    trace_env_before = os.environ.get("METRAN_TPU_OBS_TRACE")
+    try:
+        states = make_states(seed=7, n_models=n_models)
+        ids = [st.model_id for st in states]
+        rng = np.random.default_rng(23)
+        obs_warm = rng.normal(size=(n_models, 1, 5)) * 0.2
+        # spawn order matters: the env var crosses the spawn via
+        # os.environ, arming (or not) every child's tracer at build
+        for mode, armed in (("plain", "0"), ("traced", "1")):
+            os.environ["METRAN_TPU_OBS_TRACE"] = armed
+            root = os.path.join(work, mode)
+            seed_root(root, seed=7, n_models=n_models)
+            spec = ClusterSpec(
+                enabled=True, workers=workers, shm_mb=16.0,
+                heartbeat_s=1.0, slots=4 * n_models, max_series=8,
+            )
+            clusters[mode] = ClusterFrontend(
+                spec, writer_service_factory, (root, horizons, True),
+            )
+            for i, mid in enumerate(ids):  # warm kernels + plane
+                clusters[mode].update(mid, obs_warm[i])
+        progress("obs_fleet_spawned", clusters=len(clusters))
+
+        def upd_lap(frontend) -> float:
+            t0 = time.perf_counter()
+            for i, mid in enumerate(ids):
+                frontend.update(mid, obs_warm[i])
+            return time.perf_counter() - t0
+
+        def read_lap(frontend) -> float:
+            results = frontend.read_loop(ids, steps, read_iters)
+            return max(r["elapsed_s"] for r in results)
+
+        names = ("plain", "traced")
+        upd_ratios, upd_laps = [], {m: [] for m in names}
+        for r in range(upd_rounds):
+            if time.monotonic() > deadline - 60:
+                break
+            order = names if r % 2 == 0 else names[::-1]
+            pair = {m: upd_lap(clusters[m]) for m in order}
+            for m, dt in pair.items():
+                upd_laps[m].append(dt)
+            upd_ratios.append(pair["traced"] / pair["plain"])
+        read_ratios, read_laps = [], {m: [] for m in names}
+        for r in range(read_rounds):
+            if time.monotonic() > deadline - 30:
+                break
+            order = names if r % 2 == 0 else names[::-1]
+            pair = {m: read_lap(clusters[m]) for m in order}
+            for m, dt in pair.items():
+                read_laps[m].append(dt)
+            read_ratios.append(pair["traced"] / pair["plain"])
+        # overhead from the MEDIAN PAIRED ratio (not ratio of
+        # medians), the same drift-immune methodology as --phase obs
+        u_ratio = float(np.median(upd_ratios)) if upd_ratios else 1.0
+        r_ratio = float(np.median(read_ratios)) if read_ratios else 1.0
+        out["overhead"] = {
+            "rpc_overhead_pct": round(100.0 * (1.0 - 1.0 / u_ratio), 2),
+            "read_overhead_pct": round(100.0 * (1.0 - 1.0 / r_ratio), 2),
+            "update_laps": len(upd_ratios),
+            "read_laps": len(read_ratios),
+            "update_rps_plain": (
+                round(n_models / float(np.median(upd_laps["plain"])), 1)
+                if upd_laps["plain"] else 0.0
+            ),
+            "update_rps_traced": (
+                round(n_models / float(np.median(upd_laps["traced"])), 1)
+                if upd_laps["traced"] else 0.0
+            ),
+            "bar_rpc_pct": 5.0,
+        }
+        progress("obs_fleet_overhead", **{
+            k: out["overhead"][k]
+            for k in ("rpc_overhead_pct", "read_overhead_pct")
+        })
+        write_partial(out_path, out)
+
+        # what the armed fleet actually buys: one merged collection
+        fe = clusters["traced"]
+        t0 = time.perf_counter()
+        exposition = fe.fleet_report()
+        report_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        trace = fe.fleet_trace_export()
+        trace_s = time.perf_counter() - t0
+        lanes = {
+            ev.get("pid") for ev in trace.get("traceEvents", ())
+            if ev.get("ph") == "X"
+        }
+        out["fleet_sample"] = {
+            "report_wall_ms": round(1e3 * report_s, 2),
+            "exposition_bytes": len(exposition),
+            "exposition_processes": len({
+                ln.split('process="')[1].split('"')[0]
+                for ln in exposition.splitlines()
+                if 'process="' in ln
+            }),
+            "trace_wall_ms": round(1e3 * trace_s, 2),
+            "trace_span_lanes": len(lanes),
+            "trace_events": len(trace.get("traceEvents", ())),
+        }
+        progress("obs_fleet_sample", **out["fleet_sample"])
+        write_partial(out_path, out)
+        return out
+    finally:
+        if trace_env_before is None:
+            os.environ.pop("METRAN_TPU_OBS_TRACE", None)
+        else:
+            os.environ["METRAN_TPU_OBS_TRACE"] = trace_env_before
+        for fe in clusters.values():
+            fe.close()
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def run_replication_bench(out_path: str, budget_s: float) -> dict:
     """WAL-shipped replication scenario (`cluster/replication.py`,
     ISSUE 17's measurement story).
@@ -4793,6 +4957,12 @@ def main() -> None:
             "cluster_mixed_p99_ms": g(
                 detail, "serve_cluster", "mixed", "p99_ms"
             ),
+            "obs_fleet_rpc_overhead_pct": g(
+                detail, "obs_fleet", "overhead", "rpc_overhead_pct"
+            ),
+            "obs_fleet_read_overhead_pct": g(
+                detail, "obs_fleet", "overhead", "read_overhead_pct"
+            ),
             "repl_lag_p99_ms": g(
                 detail, "replication", "lag", "repl_lag_p99_ms"
             ),
@@ -5118,6 +5288,19 @@ def main() -> None:
         _wait(rp_proc, rp_budget + 15.0, "replication")
         replication = _read_json(rp_path) or {}
 
+    # fleet observability overhead scenario (ISSUE 19's measurement
+    # story): traced-vs-plain cluster RPC paired ratios + the
+    # shared-memory read path's 0% claim — CPU-pinned like the others
+    obs_fleet = {}
+    if budget - elapsed() > 120:
+        of_path = os.path.join(CACHE_DIR, "bench_obs_fleet.json")
+        if os.path.exists(of_path):
+            os.remove(of_path)
+        of_budget = max(min(180.0, budget - elapsed() - 60.0), 60.0)
+        of_proc = _spawn("obs-fleet", of_path, of_budget, cpu_env)
+        _wait(of_proc, of_budget + 15.0, "obs_fleet")
+        obs_fleet = _read_json(of_path) or {}
+
     # gradient-engine scenario (ISSUE 10's measurement story): adjoint
     # vs autodiff backward wall time at the standard workload, the
     # flat-in-T backward-memory curve, and the anchored refit
@@ -5157,6 +5340,7 @@ def main() -> None:
               "durability": durability,
               "serve_cluster": serve_cluster,
               "replication": replication,
+              "obs_fleet": obs_fleet,
               "grad": grad,
               "workload": {"n_series": N_SERIES, "n_factors": N_FACTORS,
                            "t_steps": T_STEPS, "missing": MISSING,
@@ -5190,7 +5374,7 @@ if __name__ == "__main__":
                                  "steady", "refit", "detect",
                                  "capacity", "durability",
                                  "serve-cluster", "replicate",
-                                 "grad", "grad-mem"])
+                                 "obs-fleet", "grad", "grad-mem"])
     parser.add_argument("--out", default=None)
     parser.add_argument("--budget", type=float, default=900.0)
     parser.add_argument(
@@ -5533,6 +5717,30 @@ if __name__ == "__main__":
                 "value": lg.get("repl_lag_p99_ms", 0.0),
                 "unit": "ms", "vs_baseline": 0.0,
                 "detail": rp_out,
+            }), flush=True)
+    elif args.phase == "obs-fleet":
+        out_path = args.out or os.path.join(
+            CACHE_DIR, "bench_obs_fleet.json"
+        )
+        os.makedirs(CACHE_DIR, exist_ok=True)
+        of_out = run_obs_fleet_bench(out_path, args.budget)
+        if args.out is None:
+            # standalone run: emit the BENCH_r* result-line schema with
+            # the traced-RPC overhead headline (bar: <= 5%) next to
+            # the read path's 0%-by-construction claim
+            ov = of_out.get("overhead") or {}
+            fs = of_out.get("fleet_sample") or {}
+            print(json.dumps({
+                "metric": (
+                    "traced cluster RPC overhead (paired, "
+                    f"{ov.get('update_laps')} update laps vs 5% bar; "
+                    f"plane read path {ov.get('read_overhead_pct')}%, "
+                    f"{fs.get('trace_span_lanes')} merged process "
+                    "lanes)"
+                ),
+                "value": ov.get("rpc_overhead_pct", 0.0),
+                "unit": "%", "vs_baseline": 0.0,
+                "detail": of_out,
             }), flush=True)
     elif args.phase == "grad":
         out_path = args.out or os.path.join(CACHE_DIR, "bench_grad.json")

@@ -42,6 +42,14 @@ import time
 from logging import getLogger
 from typing import Callable, List, Optional, Tuple
 
+from ..obs.fleet import (
+    ChildTelemetry,
+    ClockAlign,
+    FleetScrapeServer,
+    merge_chrome,
+    merge_events,
+    render_fleet_prometheus,
+)
 from .ipc import RpcClient, rpc_call
 from .snapplane import SnapshotPlane
 from .spec import ClusterSpec
@@ -122,6 +130,14 @@ class ClusterFrontend:
             else Observability.default()
         )
         self.events = self.obs.events
+        self.tracer = self.obs.tracer
+        # fleet observability (docs/concepts.md "Fleet observability"):
+        # the frontend is both the collector and a telemetry part of
+        # its own; offsets refine per collection (ClockAlign)
+        self._telemetry = ChildTelemetry(self.obs, "frontend")
+        self._fleet_clock = ClockAlign()
+        self._fleet_gaps = None  # counter, set by _register_metrics
+        self._scrape: Optional[FleetScrapeServer] = None
         self._ctx = multiprocessing.get_context("spawn")
         self._lock = threading.Lock()
         self._closed = False
@@ -151,6 +167,9 @@ class ClusterFrontend:
             daemon=True,
         )
         self._monitor.start()
+        port = self.spec.resolve_fleet_port()
+        if port:
+            self._scrape = FleetScrapeServer(self.fleet_report, port)
 
     # -- spawning --------------------------------------------------------
     def _spawn_writer(self, recovering: bool) -> None:
@@ -383,17 +402,32 @@ class ClusterFrontend:
     # -- routing (the preserved MetranService surface) -------------------
     def update(self, model_id: str, new_obs):
         """Route to the writer's serialized update dispatch; the
-        returned posterior crossed the socket as host numpy."""
-        return self.writer.call(
-            "update", {"model_id": model_id, "new_obs": new_obs}
-        )
+        returned posterior crossed the socket as host numpy.
+
+        With a tracer armed the call runs inside a ``cluster.update``
+        span, whose context rides the RPC envelope — the writer's
+        ``rpc.update`` lane (and the dispatch stages, WAL commit,
+        replication ship and standby apply under it) all join this
+        span's correlation id."""
+        payload = {"model_id": model_id, "new_obs": new_obs}
+        if self.tracer is None:
+            return self.writer.call("update", payload)
+        with self.tracer.span("cluster.update", model_id=model_id):
+            return self.writer.call("update", payload)
 
     def forecast(self, model_id: str, steps: int):
         """Route to a read worker (round-robin); a TRANSPORT failure
         moves to the next worker and finally the writer — zero failed
         reads under worker death.  Application exceptions re-raise
         unchanged (retrying a breaker/deadline would change
-        semantics)."""
+        semantics).  Traced like :meth:`update` (``cluster.forecast``
+        → the serving worker's ``rpc.forecast`` lane)."""
+        if self.tracer is None:
+            return self._forecast(model_id, steps)
+        with self.tracer.span("cluster.forecast", model_id=model_id):
+            return self._forecast(model_id, steps)
+
+    def _forecast(self, model_id: str, steps: int):
         payload = {"model_id": model_id, "steps": int(steps)}
         with self._lock:
             workers = list(self._workers)
@@ -419,11 +453,39 @@ class ClusterFrontend:
         return self.writer.call("flush")
 
     def capacity_report(self) -> dict:
-        """The writer service's report — its ``cluster`` section is the
-        plane's writer-side view; this side grafts the frontend's
-        aggregate so one call answers for the whole topology."""
+        """The writer service's report, with a ``cluster`` section
+        covering the WHOLE fleet this frontend supervises: the plane's
+        frontend-side aggregate, every read worker's own reader ledger
+        (its ``stats`` RPC — per-process hit/stale/fallback view of
+        the shared plane), the writer's replication-hub status, and
+        every attached standby's apply progress.  An unreachable child
+        reports as such instead of silently vanishing from the fleet
+        it is still part of."""
         report = self.writer.call("capacity_report")
-        report["cluster"] = self.stats()
+        cluster = self.stats()
+        workers = []
+        for w in list(self._workers):
+            try:
+                workers.append(dict(w.client.call("stats"),
+                                    worker=w.index))
+            except Exception as exc:
+                workers.append({"worker": w.index,
+                                "error": repr(exc)})
+        cluster["worker_reports"] = workers
+        try:
+            cluster["replication"] = self.writer.call("repl_status")
+        except Exception as exc:
+            cluster["replication"] = {"enabled": False,
+                                      "error": repr(exc)}
+        standbys = []
+        for sock in list(self.standby_sockets):
+            try:
+                standbys.append(dict(rpc_call(sock, "repl_status"),
+                                     socket=sock))
+            except Exception as exc:
+                standbys.append({"socket": sock, "error": repr(exc)})
+        cluster["standbys"] = standbys
+        report["cluster"] = cluster
         return report
 
     def stats(self) -> dict:
@@ -453,6 +515,90 @@ class ClusterFrontend:
         for t in threads:
             t.join()
         return [r for r in results if r is not None]
+
+    # -- fleet observability (docs/concepts.md "Fleet observability") ----
+    def fleet_collect(self, metrics: bool = True, events: bool = True,
+                      spans: bool = True) -> List[dict]:
+        """One telemetry part per live fleet process, frontend first.
+
+        Fans the ``telemetry`` RPC over the writer, every read worker
+        and every attached standby, labels each part (``frontend`` /
+        ``writer`` / ``worker<i>`` / ``standby<i>``) and folds a
+        fresh clock-offset estimate per child into the frontend's
+        :class:`~metran_tpu.obs.fleet.ClockAlign` (the RPC round-trip
+        brackets the child's anchor — Cristian's method, min-RTT
+        retained).  A child that fails to answer is booked
+        (``fleet_telemetry_gap`` event + gap counter) and skipped —
+        one dead process must not blind the pane to the rest.
+        """
+        payload = {"metrics": bool(metrics), "events": bool(events),
+                   "spans": bool(spans)}
+        own = self._telemetry.collect(payload)
+        own["process"] = "frontend"
+        own["clock"] = {"offset": 0.0, "rtt_s": 0.0}
+        parts: List[dict] = [own]
+        targets: List[Tuple[str, Callable]] = [
+            ("writer", lambda p: self.writer.call("telemetry", p)),
+        ]
+        with self._lock:
+            workers = list(self._workers)
+        for w in workers:
+            targets.append((
+                f"worker{w.index}",
+                lambda p, c=w.client: c.call("telemetry", p),
+            ))
+        for i, sock in enumerate(list(self.standby_sockets)):
+            targets.append((
+                f"standby{i}",
+                lambda p, s=sock: rpc_call(s, "telemetry", p),
+            ))
+        for label, caller in targets:
+            t_send = time.monotonic()
+            try:
+                part = caller(payload)
+            except Exception as exc:
+                if self._fleet_gaps is not None:
+                    self._fleet_gaps.inc()
+                if self.events is not None:
+                    self.events.emit(
+                        "fleet_telemetry_gap",
+                        fault_point="cluster.frontend",
+                        process=label, error=repr(exc),
+                    )
+                continue
+            t_recv = time.monotonic()
+            part["process"] = label
+            anchor = part.get("anchor") or {}
+            off, rtt = self._fleet_clock.observe(
+                label, anchor.get("mono", t_recv), t_send, t_recv
+            )
+            part["clock"] = {"offset": off, "rtt_s": rtt}
+            parts.append(part)
+        return parts
+
+    def fleet_report(self) -> str:
+        """The merged fleet Prometheus exposition: every process's
+        registry under a ``process`` label (one scrape answers for the
+        whole topology — the optional HTTP endpoint serves exactly
+        this)."""
+        return render_fleet_prometheus(
+            self.fleet_collect(events=False, spans=False)
+        )
+
+    def fleet_events(self) -> List[dict]:
+        """Every process's event records on one clock-aligned
+        timeline, oldest first (``fleet_ts`` + ``process`` added; see
+        :func:`~metran_tpu.obs.fleet.merge_events`) — the input
+        ``tools/failover_timeline.py`` reconstructs a failover from."""
+        return merge_events(self.fleet_collect(metrics=False,
+                                               spans=False))
+
+    def fleet_trace_export(self) -> dict:
+        """One Chrome trace over the whole fleet, one process lane per
+        pid, clock-aligned — a propagated correlation id renders as a
+        frontend span containing the writer's and standby's lanes."""
+        return merge_chrome(self.fleet_collect(metrics=False,
+                                               events=False))
 
     # -- observability ---------------------------------------------------
     def _plane_stat(self, fn: Callable, default: float = 0.0) -> float:
@@ -508,12 +654,35 @@ class ClusterFrontend:
                 lambda p: p.reader_counts()["fallbacks"]
             ),
         )
+        m.gauge(
+            "metran_serve_fleet_processes",
+            "fleet processes the frontend would fan telemetry over "
+            "(itself + live writer + read workers + attached standbys)",
+            callback=lambda: float(
+                1
+                + (1 if self.writer_alive() else 0)
+                + len(self._workers)
+                + len(self.standby_sockets)
+            ),
+        )
+        self._fleet_gaps = m.counter(
+            "metran_serve_fleet_telemetry_gaps_total",
+            "fleet telemetry fan-outs where a child failed to answer "
+            "its telemetry RPC and was skipped from the merged pane "
+            "(each gap also books a fleet_telemetry_gap event)",
+        )
 
     # -- lifecycle -------------------------------------------------------
     def close(self) -> None:
         """Shut down workers, then the writer (whose service close
         unlinks the plane), then local views and the rendezvous dir."""
         self._closed = True
+        if self._scrape is not None:
+            try:
+                self._scrape.close()
+            except Exception:
+                pass
+            self._scrape = None
         for worker in list(self._workers):
             try:
                 worker.client.call("shutdown")

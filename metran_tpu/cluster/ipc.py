@@ -5,9 +5,17 @@ a shared-memory ``SnapshotPlane`` probe) — RPC carries only the cold
 paths: update routing to the single writer, reader fallthrough on
 miss/stale, admin (register/meta/stats), and the bench driver's
 ``read_loop``.  Framing is a 4-byte little-endian length prefix over a
-pickled ``(op, payload)`` request and a pickled ``(ok, value)``
-response; errors cross the boundary as the raised exception object, so
-a frontend re-raises the writer's actual ``BreakerOpen`` /
+pickled ``(op, payload)`` request — or ``(op, payload, ctx)`` when the
+caller thread has an active trace, where ``ctx = (trace_id, span_id,
+origin_pid)`` is the serialized :class:`~metran_tpu.obs.tracing.
+SpanContext`; servers re-attach it so the handler's spans and events
+join the originating correlation id (the fleet observability plane,
+docs/concepts.md "Fleet observability").  Untraced calls still send
+the 2-tuple, and servers accept both, so the envelope change costs
+nothing when tracing is off and old/new processes interoperate during
+a rolling restart.  The response stays a pickled ``(ok, value)``;
+errors cross the boundary as the raised exception object, so a
+frontend re-raises the writer's actual ``BreakerOpen`` /
 ``DeadlineExceeded`` / ``ValueError`` and the single-process semantics
 survive the process split (tests/test_cluster.py parity suite).
 
@@ -27,6 +35,8 @@ import struct
 import threading
 from logging import getLogger
 from typing import Any, Callable, Optional, Tuple
+
+from ..obs.tracing import SpanContext, attach_context, current_context
 
 logger = getLogger(__name__)
 
@@ -67,11 +77,14 @@ class _Handler(socketserver.BaseRequestHandler):
         # one connection, many requests: clients hold the socket open
         while True:
             try:
-                op, payload = _recv_frame(self.request)
+                req = _recv_frame(self.request)
             except (ConnectionError, EOFError, OSError):
                 return
+            # 2-tuple (untraced / pre-PR-19 peer) or 3-tuple with ctx
+            op, payload = req[0], req[1]
+            ctx = req[2] if len(req) > 2 else None
             try:
-                value = self.server.dispatch(op, payload)  # type: ignore
+                value = self.server.dispatch(op, payload, ctx)  # type: ignore
                 reply = (True, value)
             except BaseException as exc:  # noqa: BLE001 - crossed to caller
                 if isinstance(exc, (KeyboardInterrupt, SystemExit)):
@@ -93,15 +106,22 @@ class _ThreadedUnixServer(
 class RpcServer:
     """Serve ``(op, payload)`` requests on a unix socket.
 
-    ``dispatch(op, payload)`` routes into the handler table; unknown
-    ops raise (and the error crosses back to the caller).  Runs its
-    accept loop on a daemon thread — ``close()`` shuts it down and
-    unlinks the socket path.
+    ``dispatch(op, payload, ctx)`` routes into the handler table;
+    unknown ops raise (and the error crosses back to the caller).
+    When the request carried a trace ``ctx`` and the server was built
+    with a ``tracer``, the handler runs inside an ``rpc.<op>`` span
+    parented on the propagated context — the child-process lane of the
+    fleet trace; with no tracer the context is still attached (events
+    emitted by the handler join the correlation id).  Runs its accept
+    loop on a daemon thread — ``close()`` shuts it down and unlinks
+    the socket path.
     """
 
     def __init__(self, path: str,
-                 handlers: dict[str, Callable[[Any], Any]]):
+                 handlers: dict[str, Callable[[Any], Any]],
+                 tracer=None):
         self.path = path
+        self.tracer = tracer
         self._handlers = dict(handlers)
         if os.path.exists(path):
             os.unlink(path)
@@ -116,11 +136,21 @@ class RpcServer:
         )
         self._thread.start()
 
-    def dispatch(self, op: str, payload: Any) -> Any:
+    def dispatch(self, op: str, payload: Any,
+                 ctx: Optional[Tuple[int, int, int]] = None) -> Any:
         handler = self._handlers.get(op)
         if handler is None:
             raise ValueError(f"unknown rpc op {op!r}")
-        return handler(payload)
+        if ctx is None:
+            return handler(payload)
+        parent = SpanContext(int(ctx[0]), int(ctx[1]))
+        tracer = self.tracer
+        if tracer is not None:
+            with tracer.span(f"rpc.{op}", parent=parent,
+                             origin_pid=int(ctx[2])):
+                return handler(payload)
+        with attach_context(parent):
+            return handler(payload)
 
     def close(self) -> None:
         self._server.shutdown()
@@ -154,13 +184,32 @@ class RpcClient:
         sock.connect(self.path)
         return sock
 
-    def call(self, op: str, payload: Any = None) -> Any:
+    def call(self, op: str, payload: Any = None,
+             ctx: Any = "current") -> Any:
+        """Round-trip one request.
+
+        ``ctx`` is the trace context to propagate: the default
+        ``"current"`` reads the caller thread's active
+        :class:`SpanContext` (one contextvar read — nothing when
+        tracing is off, which is why untraced RPCs still send the
+        2-tuple envelope); an explicit ``(trace_id, span_id,
+        origin_pid)`` tuple propagates a context the caller carried
+        across a thread boundary itself (the replication hub's ship
+        pool); ``None`` forces an untraced call.
+        """
+        if ctx == "current":
+            sc = current_context()
+            ctx = (
+                None if sc is None
+                else (sc.trace_id, sc.span_id, os.getpid())
+            )
+        req = (op, payload) if ctx is None else (op, payload, ctx)
         with self._lock:
             for attempt in (0, 1):
                 if self._sock is None:
                     self._sock = self._connect()
                 try:
-                    _send_frame(self._sock, (op, payload))
+                    _send_frame(self._sock, req)
                     ok, value = _recv_frame(self._sock)
                     break
                 except (ConnectionError, OSError, EOFError):
@@ -185,10 +234,10 @@ class RpcClient:
 
 
 def rpc_call(path: str, op: str, payload: Any = None,
-             timeout_s: float = 30.0) -> Any:
+             timeout_s: float = 30.0, ctx: Any = "current") -> Any:
     """One-shot convenience call (connect, request, close)."""
     client = RpcClient(path, timeout_s=timeout_s)
     try:
-        return client.call(op, payload)
+        return client.call(op, payload, ctx=ctx)
     finally:
         client.close()

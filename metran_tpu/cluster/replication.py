@@ -80,6 +80,8 @@ from ..serve.durability import (
     load_latest_manifest,
     replay_wal,
 )
+from ..obs.fleet import ChildTelemetry
+from ..obs.tracing import current_context
 from .ipc import RpcClient, RpcServer
 
 logger = getLogger(__name__)
@@ -329,6 +331,20 @@ class ReplicationHub:
         # honest when one dispatch carries several commit groups
         group = int(groups[-1].group)
         n_records = sum(g.n_records for g in groups)
+        # the commit's rider SpanContexts (set by the dispatch thread
+        # under the update lock, serve.service) attribute the ship to
+        # every request in the round AND ride the envelope to the
+        # standby; pool threads have no contextvar, so the envelope
+        # ctx is explicit — first rider carries the correlation id
+        tracer = getattr(self.service, "tracer", None)
+        traces = (
+            getattr(self.service, "_commit_traces", None)
+            if tracer is not None else None
+        )
+        ship_ctx = (
+            (int(traces[0][0]), int(traces[0][1]), os.getpid())
+            if traces else None
+        )
         with self._lock:
             self.raise_if_fenced()
             targets = list(self._standbys.values())
@@ -336,13 +352,15 @@ class ReplicationHub:
             return
         t0 = time.monotonic()
         if len(targets) == 1:
-            self._push(targets[0], frames, group, n_records, t0)
+            self._push(targets[0], frames, group, n_records, t0,
+                       ship_ctx)
         else:
             fence: Optional[PrimaryFencedError] = None
             pool = self._ship_pool(len(targets))
             futures = [
                 pool.submit(
-                    self._push, sb, frames, group, n_records, t0
+                    self._push, sb, frames, group, n_records, t0,
+                    ship_ctx,
                 )
                 for sb in targets
             ]
@@ -360,6 +378,12 @@ class ReplicationHub:
             self.raise_if_fenced()
             self.shipped_groups += 1
             self.shipped_commits += n_records
+        if tracer is not None and traces:
+            tracer.record_shared(
+                "repl.ship", traces, t0, time.monotonic(),
+                {"group": group, "commits": n_records,
+                 "standbys": len(targets)},
+            )
 
     def _ship_pool(self, n: int) -> ThreadPoolExecutor:
         with self._lock:
@@ -375,15 +399,17 @@ class ReplicationHub:
             return self._pool
 
     def _push(self, sb: _Standby, frames, group: int, n_records: int,
-              t0: float) -> None:
+              t0: float, ship_ctx=None) -> None:
         """One standby's ship RPC + bookkeeping.  The RPC runs outside
         the hub lock (pushes to different standbys are concurrent;
-        ``RpcClient`` serializes per socket); only the books take it."""
+        ``RpcClient`` serializes per socket); only the books take it.
+        ``ship_ctx`` is the explicit trace envelope (propagated
+        correlation id) — ``None`` ships untraced."""
         try:
             reply = sb.client.call("repl_frames", {
                 "epoch": self.epoch, "group": group,
                 "n_records": n_records, "frames": frames,
-            })
+            }, ctx=ship_ctx)
         except StaleEpochError as exc:
             with self._lock:
                 self.fenced = True
@@ -722,7 +748,8 @@ class ReplicaStandby:
         next_seq = (existing[-1][0] + 1) if existing else 1
         self.log = WriteAheadLog(self.wal_dir, next_seq, fsync=True)
         self._cv = threading.Condition()
-        self._queue: deque = deque()  # (group, [WalRecord, ...])
+        #: (group, [WalRecord, ...], propagated SpanContext or None)
+        self._queue: deque = deque()
         self._applying = False
         #: frame RPCs past the epoch check but not yet re-checked
         #: after their append — promote() fences the epoch first, then
@@ -745,7 +772,13 @@ class ReplicaStandby:
             daemon=True,
         )
         self._apply_thread.start()
-        self.rpc = RpcServer(socket_path, self._handlers())
+        self._telemetry = ChildTelemetry(
+            getattr(service, "obs", None), "standby"
+        )
+        self.rpc = RpcServer(
+            socket_path, self._handlers(),
+            tracer=getattr(service, "tracer", None),
+        )
 
     # -- epoch fence persistence ---------------------------------------
     def _load_epoch(self) -> int:
@@ -779,6 +812,7 @@ class ReplicaStandby:
             "put": self._put,
             "flush": lambda _p: svc.flush(),
             "capacity_report": lambda _p: svc.capacity_report(),
+            "telemetry": self._telemetry.collect,
             "shutdown": lambda _p: self._shutdown.set(),
         }
 
@@ -823,7 +857,7 @@ class ReplicaStandby:
                 "epoch": self.epoch,
                 "received": self.received_group,
                 "applied": self.applied_group,
-                "backlog": sum(len(r) for _, r in self._queue),
+                "backlog": sum(len(q[1]) for q in self._queue),
                 "versions": {
                     m: int(ver)
                     for m, ver in reg.current_versions().items()
@@ -876,14 +910,17 @@ class ReplicaStandby:
             if self.promoted or epoch < self.epoch:
                 raise StaleEpochError(self.epoch)
             if records:
-                self._queue.append((group, records))
+                # the ipc layer attached the ship's propagated trace
+                # context to this handler thread; carry it with the
+                # batch so the apply thread can attribute the replay
+                self._queue.append((group, records, current_context()))
                 self.received_group = max(self.received_group, group)
                 self.received_commits += len(records)
                 self._cv.notify_all()
             return {
                 "received": self.received_group,
                 "applied": self.applied_group,
-                "backlog": sum(len(r) for _, r in self._queue),
+                "backlog": sum(len(q[1]) for q in self._queue),
                 "epoch": self.epoch,
             }
 
@@ -942,7 +979,8 @@ class ReplicaStandby:
                 batch = list(self._queue)
                 self._queue.clear()
                 self._applying = True
-            records = [r for _, recs in batch for r in recs]
+            records = [r for _, recs, _ctx in batch for r in recs]
+            t_apply0 = time.monotonic()
             try:
                 report = replay_wal(self.service, records)
             except BaseException as exc:  # noqa: BLE001 - halts apply
@@ -964,6 +1002,18 @@ class ReplicaStandby:
                 self.skipped_commits += int(report.get("skipped", 0))
                 self._applying = False
                 self._cv.notify_all()
+            tracer = getattr(self.service, "tracer", None)
+            if tracer is not None:
+                ctxs = [c for _, _, c in batch if c is not None]
+                if ctxs:
+                    # one shared-interval span per propagated ship
+                    # context: the standby lane's "repl.apply" closes
+                    # the frontend → writer → standby chain
+                    tracer.record_shared(
+                        "repl.apply", ctxs, t_apply0, time.monotonic(),
+                        {"group": int(batch[-1][0]),
+                         "commits": len(records)},
+                    )
 
     # -- promotion -------------------------------------------------------
     def promote(self, epoch: Optional[int] = None,
@@ -1042,7 +1092,7 @@ class ReplicaStandby:
                 "promoted": self.promoted,
                 "received": self.received_group,
                 "applied": self.applied_group,
-                "backlog": sum(len(r) for _, r in self._queue),
+                "backlog": sum(len(q[1]) for q in self._queue),
                 "received_commits": self.received_commits,
                 "applied_commits": self.applied_commits,
                 "skipped_commits": self.skipped_commits,

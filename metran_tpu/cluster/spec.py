@@ -63,6 +63,11 @@ class ClusterSpec(NamedTuple):
     #: wider than this publish nowhere and their reads fall through —
     #: counted (``dropped``), never silent
     max_series: int = 64
+    #: fleet-metrics scrape port: ``None`` defers to
+    #: ``METRAN_TPU_OBS_FLEET_PORT`` (via ``obs_defaults``), ``0``
+    #: ships the endpoint off, ``>0`` binds a loopback HTTP server
+    #: serving ``ClusterFrontend.fleet_report()`` on that port
+    fleet_port: Optional[int] = None
 
     @classmethod
     def from_defaults(cls) -> "ClusterSpec":
@@ -111,6 +116,13 @@ class ClusterSpec(NamedTuple):
                 f"cluster max_series must be >= 1, got "
                 f"{self.max_series}"
             )
+        if self.fleet_port is not None and not (
+            0 <= int(self.fleet_port) <= 65535
+        ):
+            raise ValueError(
+                f"cluster fleet_port must be 0 (off) or a valid TCP "
+                f"port, got {self.fleet_port}"
+            )
         if self.socket_dir and not os.path.isdir(self.socket_dir):
             raise ValueError(
                 f"cluster socket_dir {self.socket_dir!r} does not "
@@ -145,6 +157,16 @@ class ClusterSpec(NamedTuple):
                 "METRAN_TPU_SERVE_HORIZONS"
             )
         return self
+
+    def resolve_fleet_port(self) -> int:
+        """The fleet-scrape port to bind, ``0`` meaning off: the
+        spec's explicit ``fleet_port`` when set, else the
+        ``METRAN_TPU_OBS_FLEET_PORT`` env default."""
+        if self.fleet_port is not None:
+            return int(self.fleet_port)
+        from ..config import obs_defaults
+
+        return int(obs_defaults()["fleet_port"])
 
     def resolve_socket_dir(self) -> str:
         """The rendezvous directory, creating a private one when the

@@ -29,6 +29,7 @@ from logging import getLogger
 from time import perf_counter
 from typing import Optional
 
+from ..obs.fleet import ChildTelemetry
 from .ipc import RpcClient, RpcServer
 from .snapplane import SnapshotPlane
 
@@ -38,17 +39,28 @@ __all__ = ["ReadWorker", "worker_main"]
 
 
 class ReadWorker:
-    """One read process's serving state (plane view + writer client)."""
+    """One read process's serving state (plane view + writer client).
+
+    ``observability`` is the worker's own bundle (each read process is
+    a fleet lane of its own — metrics registry, event ring, optional
+    tracer); when given, it supplies ``events`` unless one was passed
+    explicitly, and arms the ``telemetry`` RPC op plus traced-RPC
+    re-attachment on the server.
+    """
 
     def __init__(self, plane_name: str, socket_path: str,
                  writer_socket: str, heartbeat_s: float = 2.0,
-                 events=None):
+                 events=None, observability=None):
+        self.obs = observability
+        if events is None and observability is not None:
+            events = observability.events
         self.plane = SnapshotPlane.attach(plane_name, events=events)
         self.plane.claim_worker()
         self.heartbeat_s = heartbeat_s
         self.events = events
         self._writer = RpcClient(writer_socket)
         self._shutdown = threading.Event()
+        self._telemetry = ChildTelemetry(observability, "worker")
         self.rpc = RpcServer(socket_path, {
             "ping": lambda _p: "pong",
             "forecast": self._forecast,
@@ -56,8 +68,9 @@ class ReadWorker:
             "stats": lambda _p: self.plane.stats(
                 heartbeat_s=self.heartbeat_s
             ),
+            "telemetry": self._telemetry.collect,
             "shutdown": lambda _p: self._shutdown.set(),
-        })
+        }, tracer=getattr(observability, "tracer", None))
 
     def _forecast(self, payload):
         """One forecast read: plane hit, else writer fallthrough."""
@@ -117,12 +130,20 @@ class ReadWorker:
 def worker_main(plane_name: str, socket_path: str, writer_socket: str,
                 heartbeat_s: float = 2.0,
                 ready_path: Optional[str] = None) -> int:
-    """Process entry (spawn-friendly module-level function)."""
+    """Process entry (spawn-friendly module-level function).
+
+    Builds the worker's own ``Observability.default()`` bundle (env
+    knobs crossed the spawn via ``os.environ``), so every read process
+    is a first-class fleet-telemetry lane.
+    """
+    from ..obs import Observability
+
     worker = None
+    obs = Observability.default()
     try:
         worker = ReadWorker(
             plane_name, socket_path, writer_socket,
-            heartbeat_s=heartbeat_s,
+            heartbeat_s=heartbeat_s, observability=obs,
         )
         if ready_path:
             tmp = f"{ready_path}.{os.getpid()}.tmp"
@@ -140,3 +161,5 @@ def worker_main(plane_name: str, socket_path: str, writer_socket: str,
                 worker.close()
             except Exception:  # pragma: no cover - teardown best-effort
                 pass
+        if obs.events is not None:
+            obs.events.close()

@@ -36,6 +36,7 @@ from typing import Callable, Optional, Tuple
 
 import numpy as np
 
+from ..obs.fleet import ChildTelemetry
 from .ipc import RpcServer
 from .spec import ClusterSpec
 
@@ -64,7 +65,9 @@ class WriterHost:
 
     Ops mirror the service API the frontend preserves: ``update`` /
     ``forecast`` / ``flush`` / ``put`` / ``meta`` / ``capacity_report``
-    / ``stats``; ``hello`` hands readers the plane's segment name.
+    / ``stats``; ``hello`` hands readers the plane's segment name;
+    ``telemetry`` serves this process's fleet-observability part
+    (metrics/events/spans + clock anchor, obs/fleet.py).
     Exceptions cross the socket as objects and re-raise frontend-side,
     so breaker/deadline/gate semantics survive the split.
     """
@@ -79,7 +82,13 @@ class WriterHost:
                 "with cluster=ClusterSpec(enabled=True)"
             )
         self._shutdown = threading.Event()
-        self.rpc = RpcServer(socket_path, self._handlers())
+        self._telemetry = ChildTelemetry(
+            getattr(service, "obs", None), "writer"
+        )
+        self.rpc = RpcServer(
+            socket_path, self._handlers(),
+            tracer=getattr(service, "tracer", None),
+        )
 
     def _handlers(self) -> dict:
         svc = self.service
@@ -103,6 +112,7 @@ class WriterHost:
             ),
             "repl_attach": self._repl_attach,
             "repl_status": self._repl_status,
+            "telemetry": self._telemetry.collect,
             "shutdown": lambda _p: self._shutdown.set(),
         }
 

@@ -332,6 +332,11 @@ OBS_CAPACITY_SAMPLE = 1  # record every Nth dispatch (sampled-subset
 #                          per-dispatch stamps matter)
 OBS_SLO_MS = 50.0  # the serve-latency SLO the burn rate measures
 #                    against (p99 < OBS_SLO_MS, 1% violation budget)
+OBS_FLEET_PORT = 0  # fleet-metrics scrape endpoint: ClusterFrontend
+#                     binds a loopback HTTP server on this port
+#                     serving fleet_report() (merged multi-process
+#                     Prometheus exposition); 0 = off (the default —
+#                     an open port is an operator opt-in)
 
 
 def _env(name, cast, default):
@@ -609,6 +614,9 @@ def obs_defaults() -> dict:
         ),
         "slo_ms": _env(
             "METRAN_TPU_OBS_SLO_MS", float, OBS_SLO_MS
+        ),
+        "fleet_port": _env(
+            "METRAN_TPU_OBS_FLEET_PORT", int, OBS_FLEET_PORT
         ),
     }
 

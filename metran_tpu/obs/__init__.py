@@ -25,6 +25,11 @@ argument, arXiv:2405.08971):
 - :mod:`~metran_tpu.obs.telemetry` — :class:`FitTelemetry`: per-fit
   optimizer trajectory (deviance curve, gradient norms, stop reason)
   surfaced in ``fit_report()``.
+- :mod:`~metran_tpu.obs.fleet` — the multi-process merge layer:
+  :class:`ChildTelemetry` parts served over the cluster RPC plane,
+  clock alignment (:class:`ClockAlign`), and the merged fleet
+  exposition / event timeline / Chrome trace renderers behind
+  ``ClusterFrontend.fleet_report()`` and friends.
 
 :class:`Observability` bundles the three serving-side pieces for
 injection into :class:`~metran_tpu.serve.MetranService`; defaults come
@@ -44,7 +49,16 @@ from .capacity import (
     CapacityTracker,
     ModelCostLedger,
 )
-from .events import EVENT_KINDS, EventLog
+from .events import EVENT_KINDS, EventLog, read_sink
+from .fleet import (
+    ChildTelemetry,
+    ClockAlign,
+    FleetScrapeServer,
+    clock_anchor,
+    merge_chrome,
+    merge_events,
+    render_fleet_prometheus,
+)
 from .metrics import (
     DEFAULT_LATENCY_BUCKETS,
     DEFAULT_SIZE_BUCKETS,
@@ -57,7 +71,14 @@ from .metrics import (
     OccupancyCounter,
 )
 from .telemetry import FitTelemetry
-from .tracing import Span, SpanContext, Tracer, current_trace_id
+from .tracing import (
+    Span,
+    SpanContext,
+    Tracer,
+    attach_context,
+    current_context,
+    current_trace_id,
+)
 
 
 @dataclass
@@ -112,6 +133,8 @@ class Observability:
 __all__ = [
     "BurnRateMonitor",
     "CapacityTracker",
+    "ChildTelemetry",
+    "ClockAlign",
     "Counter",
     "DEFAULT_LATENCY_BUCKETS",
     "DEFAULT_SIZE_BUCKETS",
@@ -119,6 +142,7 @@ __all__ = [
     "EventCounters",
     "EventLog",
     "FitTelemetry",
+    "FleetScrapeServer",
     "Gauge",
     "Histogram",
     "LatencyRecorder",
@@ -130,5 +154,12 @@ __all__ = [
     "Span",
     "SpanContext",
     "Tracer",
+    "attach_context",
+    "clock_anchor",
+    "current_context",
     "current_trace_id",
+    "merge_chrome",
+    "merge_events",
+    "read_sink",
+    "render_fleet_prometheus",
 ]

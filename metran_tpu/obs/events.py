@@ -18,6 +18,12 @@ joinable against traces and metrics: a model's outage reconstructs
 from ``log.for_model("m7")`` alone — breaker opened after N rejected
 updates at the integrity gate, cooled down, probe succeeded, closed.
 
+Every record also carries the emitting ``pid`` and a ``mono``
+(monotonic-clock) stamp alongside the wall ``ts``: the fleet merge in
+:mod:`metran_tpu.obs.fleet` orders events from many processes by
+aligning each process's monotonic timeline against a (wall, mono)
+anchor, which wall clocks alone (settable, skewable) cannot provide.
+
 Storage is a bounded ring buffer (memory-safe for long-lived services)
 plus an optional append-only JSON-lines **file sink** flushed per
 event, so a crash loses nothing that was emitted.  A sink write
@@ -40,6 +46,11 @@ from logging import getLogger
 from typing import Dict, List, Optional
 
 logger = getLogger(__name__)
+
+#: JSON-lines sink schema version.  v1 (PR 4..18) had no ``v`` key and
+#: no ``pid``/``mono`` fields; v2 lines carry ``"v": 2`` plus both.
+#: :func:`read_sink` reads either — old sinks stay post-mortem-able.
+SINK_SCHEMA_VERSION = 2
 
 #: The canonical event-kind catalogue.  Every ``kind`` the package
 #: emits must be listed here AND documented in the event-schema table
@@ -97,6 +108,7 @@ EVENT_KINDS = (
     "replica_lag",
     "replica_promote",
     "primary_fenced",
+    "fleet_telemetry_gap",
 )
 
 
@@ -110,6 +122,8 @@ class EventLog:
         event is written as one JSON line and flushed.  ``None``
         disables the sink (ring buffer only).
     clock : epoch-seconds time source (injectable for tests).
+    mono_clock : monotonic time source stamped as ``mono`` on every
+        record (injectable for tests); the fleet merge orders on this.
     max_sink_mb : bound the on-disk sink by size (``METRAN_TPU_OBS_
         EVENT_SINK_MAX_MB``; ``None``/0 = unbounded, the historical
         behavior).  A **path-constructed** sink reaching the bound is
@@ -123,10 +137,13 @@ class EventLog:
     """
 
     def __init__(self, maxlen: int = 2048, sink=None,
-                 clock=time.time, max_sink_mb: Optional[float] = None):
+                 clock=time.time, max_sink_mb: Optional[float] = None,
+                 mono_clock=time.monotonic):
         self._events: "deque[dict]" = deque(maxlen=int(maxlen))
         self._lock = threading.Lock()
         self._clock = clock
+        self._mono = mono_clock
+        self._pid = os.getpid()
         self._counts: Dict[str, int] = {}
         self.dropped = 0  # events pushed out of the ring (lifetime)
         self.rotations = 0  # sink files rotated to the .1 suffix
@@ -169,6 +186,8 @@ class EventLog:
             request_id = current_trace_id()
         event = {
             "ts": float(self._clock()),
+            "mono": float(self._mono()),
+            "pid": self._pid,
             "kind": str(kind),
             "model_id": model_id,
             "request_id": request_id,
@@ -185,10 +204,11 @@ class EventLog:
             )
             sink = self._sink
             if sink is not None:
+                versioned = dict(event, v=SINK_SCHEMA_VERSION)
                 try:
-                    line = json.dumps(event, default=repr)
+                    line = json.dumps(versioned, default=repr)
                 except (TypeError, ValueError):  # exotic detail payload
-                    safe = dict(event, detail=repr(detail))
+                    safe = dict(versioned, detail=repr(detail))
                     line = json.dumps(safe)
         if sink is not None and line is not None:
             try:
@@ -308,4 +328,38 @@ class EventLog:
         self.close()
 
 
-__all__ = ["EVENT_KINDS", "EventLog"]
+def read_sink(path) -> List[dict]:
+    """Parse a JSON-lines sink back into event records, any schema
+    version.
+
+    v1 lines (no ``v`` key — sinks written before PR 19) are upgraded
+    in place with ``pid=None, mono=None`` so consumers see one shape;
+    the ``v`` marker itself is stripped (it describes the line, not
+    the event).  Malformed lines are skipped, not fatal: a sink that
+    caught a crash mid-write must still be readable past the tear —
+    the whole point of flushing per event.
+    """
+    records: List[dict] = []
+    try:
+        fh = open(path, "r", encoding="utf-8")
+    except OSError:
+        return records
+    with fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail line
+            if not isinstance(rec, dict) or "kind" not in rec:
+                continue
+            rec.pop("v", None)
+            rec.setdefault("pid", None)
+            rec.setdefault("mono", None)
+            records.append(rec)
+    return records
+
+
+__all__ = ["EVENT_KINDS", "EventLog", "SINK_SCHEMA_VERSION", "read_sink"]

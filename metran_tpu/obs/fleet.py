@@ -1,0 +1,452 @@
+"""Fleet observability: merge per-process telemetry into one pane.
+
+PRs 16/17 split the service into a frontend + writer + N read workers
++ hot standbys, but the PR 4/12 observability stack stayed
+process-local: each process has its own ``MetricsRegistry``,
+``EventLog`` ring and tracer, unreachable from the routing side.  This
+module is the merge layer: every cluster child serves a ``telemetry``
+RPC op (a :class:`ChildTelemetry` bound to its ``Observability``
+bundle) returning one **telemetry part** — pid, role, a (wall,
+monotonic) clock anchor, structured metric samples
+(:meth:`~metran_tpu.obs.metrics.MetricsRegistry.export_samples`),
+event records and finished spans — and the frontend merges parts into:
+
+- one Prometheus exposition with a ``process`` label distinguishing
+  the emitting process (:func:`render_fleet_prometheus`),
+- one clock-aligned event timeline (:func:`merge_events`),
+- one Chrome trace with a process lane per pid
+  (:func:`merge_chrome`), where a propagated correlation id
+  (``cluster/ipc.py`` envelope) stitches frontend → writer → standby
+  spans into a single tree.
+
+**Clock alignment.**  Wall clocks across processes are settable and
+skewable; monotonic clocks are well-ordered but have per-process
+arbitrary epochs (on Linux the raw readings are system-wide, but the
+merge must not depend on that).  Each part therefore carries an
+anchor pairing the two clocks read back-to-back
+(:func:`clock_anchor`), and :class:`ClockAlign` refines it with a
+Cristian-style estimate per telemetry round-trip: the child's anchor
+monotonic reading is assumed to coincide with the midpoint of the
+collector's request/response monotonic stamps, and the estimate with
+the smallest round-trip time wins.  Merged timestamps (``fleet_ts``)
+live on the collector's monotonic timeline; :func:`fleet_wall` maps
+them back to wall time for human rendering.
+
+The ``process`` label is **reserved**: package code must not register
+metrics carrying it (``tools/check_metrics.py`` gates this), because
+the fleet merge stamps it on every sample and a pre-existing value
+would be silently overwritten.
+
+Stdlib-only, like the rest of ``obs``; no cluster imports (the
+cluster frontend imports *this*, never the reverse).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from logging import getLogger
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .metrics import _escape_help, _escape_label, _format_value
+
+logger = getLogger(__name__)
+
+__all__ = [
+    "ChildTelemetry",
+    "ClockAlign",
+    "FleetScrapeServer",
+    "clock_anchor",
+    "fleet_wall",
+    "merge_chrome",
+    "merge_events",
+    "render_fleet_prometheus",
+]
+
+#: telemetry-part schema version (forward-compat marker on the wire)
+PART_VERSION = 1
+
+
+def clock_anchor() -> Dict[str, float]:
+    """A (wall, monotonic) clock pairing read back-to-back.
+
+    The wall stamp is the midpoint of two reads bracketing the
+    monotonic read, so the pairing error is bounded by half the
+    three-call window (sub-microsecond in practice) rather than one
+    full scheduler preemption.
+    """
+    w0 = time.time()
+    mono = time.monotonic()
+    w1 = time.time()
+    return {"wall": (w0 + w1) / 2.0, "mono": mono}
+
+
+def fleet_wall(ref_anchor: Dict[str, float], fleet_ts: float) -> float:
+    """Map a merged (collector-monotonic) timestamp to wall seconds
+    using the collector's own anchor."""
+    return float(ref_anchor["wall"]) + (
+        float(fleet_ts) - float(ref_anchor["mono"])
+    )
+
+
+class ClockAlign:
+    """Per-process clock-offset estimates, best round-trip wins.
+
+    ``observe()`` is called once per telemetry collection with the
+    child's anchor monotonic reading and the collector's monotonic
+    stamps bracketing the RPC; the offset maps child-monotonic values
+    onto the collector's monotonic timeline
+    (``ref_mono = child_mono + offset``).  Estimates accumulate across
+    periodic collections — the minimum-RTT one is kept, so alignment
+    *improves* over a fleet's lifetime instead of jittering with load.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        #: key -> (offset_s, rtt_s)
+        self._est: Dict[str, Tuple[float, float]] = {}
+
+    def observe(self, key: str, child_mono: float,
+                ref_mono_send: float,
+                ref_mono_recv: float) -> Tuple[float, float]:
+        """Fold one round-trip into the estimate for ``key``; returns
+        the retained ``(offset_s, rtt_s)``."""
+        rtt = max(0.0, float(ref_mono_recv) - float(ref_mono_send))
+        off = (
+            (float(ref_mono_send) + float(ref_mono_recv)) / 2.0
+            - float(child_mono)
+        )
+        with self._lock:
+            cur = self._est.get(key)
+            if cur is None or rtt <= cur[1]:
+                self._est[key] = (off, rtt)
+            return self._est[key]
+
+    def offset(self, key: str) -> Optional[float]:
+        with self._lock:
+            est = self._est.get(key)
+        return est[0] if est is not None else None
+
+    def snapshot(self) -> Dict[str, Tuple[float, float]]:
+        with self._lock:
+            return dict(self._est)
+
+
+class ChildTelemetry:
+    """One process's ``telemetry`` RPC handler: its whole
+    ``Observability`` bundle as a single mergeable part.
+
+    Every cluster process (frontend included — the collector is a
+    process too) holds one, bound to its bundle and role.  Registers
+    the child-side fleet metrics on the bundle's registry when there
+    is one: a process-uptime callback gauge and a serves counter that
+    doubles as evidence the telemetry plane is actually being scraped.
+    """
+
+    def __init__(self, obs, role: str):
+        self.obs = obs
+        self.role = str(role)
+        self._t0 = time.monotonic()
+        self._serves = None
+        m = getattr(obs, "metrics", None) if obs is not None else None
+        if m is not None:
+            m.gauge(
+                "metran_cluster_process_uptime_seconds",
+                "seconds since this process's telemetry handler was "
+                "armed (one per fleet process, merged under the "
+                "process label)",
+                callback=lambda: time.monotonic() - self._t0,
+            )
+            self._serves = m.counter(
+                "metran_cluster_telemetry_serves_total",
+                "telemetry collections served by this process — zero "
+                "on a live fleet means nobody is scraping the pane",
+            )
+
+    def collect(self, payload: Optional[dict] = None) -> dict:
+        """Build the telemetry part.  ``payload`` (the RPC payload)
+        may disable sections: ``{"metrics": False, "events": False,
+        "spans": False}`` — a metrics-only scrape should not drag a
+        2048-event ring over the socket every 15 seconds."""
+        payload = payload or {}
+        if self._serves is not None:
+            self._serves.inc()
+        obs = self.obs
+        part: Dict[str, Any] = {
+            "v": PART_VERSION,
+            "pid": os.getpid(),
+            "role": self.role,
+            "anchor": clock_anchor(),
+            "uptime_s": time.monotonic() - self._t0,
+        }
+        m = getattr(obs, "metrics", None) if obs is not None else None
+        part["metrics"] = (
+            m.export_samples()
+            if (m is not None and payload.get("metrics", True))
+            else None
+        )
+        ev = getattr(obs, "events", None) if obs is not None else None
+        part["events"] = (
+            ev.snapshot()
+            if (ev is not None and payload.get("events", True))
+            else []
+        )
+        tr = getattr(obs, "tracer", None) if obs is not None else None
+        part["spans"] = (
+            tr.spans()
+            if (tr is not None and payload.get("spans", True))
+            else []
+        )
+        return part
+
+
+# ----------------------------------------------------------------------
+# merge layer
+
+
+def _ref_anchor(parts: List[dict]) -> Dict[str, float]:
+    for part in parts:
+        anchor = part.get("anchor")
+        if isinstance(anchor, dict) and "wall" in anchor:
+            return anchor
+    return clock_anchor()
+
+
+def _part_offset(part: dict, ref_anchor: Dict[str, float]) -> float:
+    """child-monotonic -> collector-monotonic offset for one part:
+    the collector's min-RTT estimate when it attached one
+    (``part["clock"]["offset"]``), else the anchor-wall fallback
+    (exact when wall clocks agree — always, same-host)."""
+    clock = part.get("clock") or {}
+    off = clock.get("offset")
+    if isinstance(off, (int, float)):
+        return float(off)
+    anchor = part.get("anchor") or {}
+    try:
+        return (
+            float(anchor["wall"]) - float(anchor["mono"])
+        ) - (
+            float(ref_anchor["wall"]) - float(ref_anchor["mono"])
+        )
+    except (KeyError, TypeError, ValueError):
+        return 0.0
+
+
+def _part_label(part: dict, index: int) -> str:
+    label = part.get("process") or part.get("role")
+    if not label:
+        pid = part.get("pid")
+        label = f"pid{pid}" if pid is not None else f"part{index}"
+    return str(label)
+
+
+def render_fleet_prometheus(parts: List[dict]) -> str:
+    """One Prometheus exposition over many parts, every sample gaining
+    a ``process`` label.
+
+    One ``# HELP``/``# TYPE`` pair per family (first part to carry the
+    family wins the metadata); families sorted by name, samples in
+    part order then each part's own sample order — which keeps every
+    process's histogram buckets in cumulative ``le`` order, as the
+    grammar requires per label subgroup.  A family re-registered with
+    a *different type* by another process is a telemetry bug; its
+    conflicting samples are dropped and logged rather than emitting an
+    exposition Prometheus would reject wholesale.
+    """
+    families: Dict[str, dict] = {}
+    order: List[str] = []
+    for index, part in enumerate(parts):
+        label = _part_label(part, index)
+        for fam in part.get("metrics") or []:
+            name = str(fam.get("name", ""))
+            if not name:
+                continue
+            entry = families.get(name)
+            if entry is None:
+                entry = {
+                    "type": fam.get("type", "untyped"),
+                    "help": fam.get("help", ""),
+                    "rows": [],
+                }
+                families[name] = entry
+                order.append(name)
+            elif entry["type"] != fam.get("type", "untyped"):
+                logger.warning(
+                    "fleet metric %r: process %r reports type %r but "
+                    "family is %r; dropping its samples", name, label,
+                    fam.get("type"), entry["type"],
+                )
+                continue
+            for sample in fam.get("samples") or []:
+                sname = str(sample[0])
+                labels = dict(sample[1])
+                labels.pop("process", None)  # reserved (module doc)
+                labels["process"] = label
+                entry["rows"].append((sname, labels, float(sample[2])))
+    lines: List[str] = []
+    for name in sorted(order):
+        entry = families[name]
+        lines.append(f"# HELP {name} {_escape_help(entry['help'])}")
+        lines.append(f"# TYPE {name} {entry['type']}")
+        for sname, labels, value in entry["rows"]:
+            inner = ",".join(
+                f'{k}="{_escape_label(str(v))}"'
+                for k, v in sorted(labels.items())
+            )
+            lines.append(f"{sname}{{{inner}}} {_format_value(value)}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def merge_events(parts: List[dict]) -> List[dict]:
+    """All parts' event records on one timeline, oldest first.
+
+    Each record gains ``process`` (the part label) and ``fleet_ts``
+    (collector-monotonic seconds, see module doc); v1 records without
+    a ``mono`` stamp fall back to mapping their wall ``ts`` through
+    the collector's anchor — coarser, but still ordered.  Input
+    records are not mutated.
+    """
+    ref = _ref_anchor(parts)
+    wall_to_ref = float(ref["mono"]) - float(ref["wall"])
+    out: List[dict] = []
+    for index, part in enumerate(parts):
+        offset = _part_offset(part, ref)
+        label = _part_label(part, index)
+        for event in part.get("events") or []:
+            rec = dict(event)
+            mono = rec.get("mono")
+            if isinstance(mono, (int, float)):
+                fleet_ts = float(mono) + offset
+            else:
+                fleet_ts = float(rec.get("ts", 0.0)) + wall_to_ref
+            rec["fleet_ts"] = fleet_ts
+            rec["process"] = label
+            out.append(rec)
+    out.sort(key=lambda r: r["fleet_ts"])
+    return out
+
+
+def merge_chrome(parts: List[dict]) -> dict:
+    """All parts' finished spans as one Chrome trace (``chrome://
+    tracing``, Perfetto), one process lane per pid.
+
+    Span timestamps are clock-aligned onto the collector's monotonic
+    timeline then re-based to the earliest span, so lanes overlay
+    truthfully: a writer span propagated from a frontend RPC renders
+    *inside* the frontend's span.  ``args`` keeps the correlation
+    ``trace_id``/``span_id``/``parent_id`` (plus the part label as
+    ``process``), so one update's tree reassembles across lanes by
+    querying the propagated trace id.  Metadata events name each lane
+    ``<label> (pid N)`` and sort lanes in part order.
+    """
+    ref = _ref_anchor(parts)
+    rows: List[Tuple[float, float, int, dict, str]] = []
+    lanes: List[Tuple[int, str]] = []
+    seen_pids = set()
+    for index, part in enumerate(parts):
+        offset = _part_offset(part, ref)
+        label = _part_label(part, index)
+        pid = int(part.get("pid") or 0)
+        if pid not in seen_pids and part.get("spans"):
+            seen_pids.add(pid)
+            lanes.append((pid, label))
+        for span in part.get("spans") or []:
+            rows.append((
+                float(span["ts"]) + offset,
+                float(span["dur"]),
+                pid,
+                span,
+                label,
+            ))
+    t0 = min((ts for ts, *_ in rows), default=0.0)
+    events: List[dict] = []
+    for sort_index, (pid, label) in enumerate(lanes):
+        events.append({
+            "name": "process_name", "ph": "M", "pid": pid,
+            "args": {"name": f"{label} (pid {pid})"},
+        })
+        events.append({
+            "name": "process_sort_index", "ph": "M", "pid": pid,
+            "args": {"sort_index": sort_index},
+        })
+    for ts, dur, pid, span, label in rows:
+        args = dict(span.get("args") or {})
+        args["trace_id"] = span["trace_id"]
+        args["span_id"] = span["span_id"]
+        if span.get("parent_id") is not None:
+            args["parent_id"] = span["parent_id"]
+        args["process"] = label
+        events.append({
+            "name": span["name"],
+            "cat": span["name"].split(".", 1)[0],
+            "ph": "X",
+            "ts": (ts - t0) * 1e6,
+            "dur": dur * 1e6,
+            "pid": pid,
+            "tid": span.get("tid", 0),
+            "args": args,
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# ----------------------------------------------------------------------
+# optional scrape endpoint
+
+
+class FleetScrapeServer:
+    """Minimal stdlib HTTP endpoint serving the merged exposition.
+
+    Shipped **off** (``METRAN_TPU_OBS_FLEET_PORT=0``); when armed the
+    frontend binds it on localhost and ``GET /metrics`` runs the
+    supplied zero-argument ``collect`` callable (which performs the
+    fleet telemetry fan-out — a scrape is a collection, there is no
+    cache to go stale).  A collection failure answers 500 with the
+    error text instead of killing the listener: the pane must not be
+    torn down by one dead child.
+    """
+
+    def __init__(self, collect: Callable[[], str], port: int,
+                 host: str = "127.0.0.1"):
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - stdlib API
+                if self.path.split("?", 1)[0] not in ("/", "/metrics"):
+                    self.send_error(404)
+                    return
+                try:
+                    body = outer._collect().encode("utf-8")
+                except Exception as exc:  # degrade, never die
+                    body = f"# fleet collection failed: {exc!r}\n".encode()
+                    self.send_response(500)
+                else:
+                    self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # quiet; we have our own log
+                pass
+
+        self._collect = collect
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            kwargs={"poll_interval": 0.2},
+            name=f"metran-fleet-scrape[{self.port}]",
+            daemon=True,
+        )
+        self._thread.start()
+        logger.info("fleet scrape endpoint on %s:%d", host, self.port)
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)

@@ -445,6 +445,49 @@ class MetricsRegistry:
             out[name] = entry
         return out
 
+    def export_samples(self) -> List[dict]:
+        """Every metric family as structured, losslessly mergeable
+        samples: ``[{"name", "type", "help", "samples": [(sample_name,
+        {label: value}, value), ...]}, ...]``, families sorted by name.
+
+        This is the fleet-telemetry wire format
+        (:mod:`metran_tpu.obs.fleet`): unlike :meth:`snapshot`, whose
+        labelled values are keyed by a rendered ``"k=v,k2=v2"`` string
+        (ambiguous to parse back when a label VALUE contains ``=`` or
+        ``,``), each sample here keeps its label dict intact, so a
+        frontend can re-render a merged exposition with a ``process``
+        label added without ever parsing anything.  Histograms expand
+        to their exposition triplet (cumulative ``_bucket`` rows with
+        a string ``le`` label, then ``_sum``/``_count``).
+        """
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+        out: List[dict] = []
+        for name, m in metrics:
+            if isinstance(m, Histogram):
+                data = m.collect()
+                samples = [
+                    (
+                        f"{name}_bucket",
+                        {"le": ("+Inf" if math.isinf(b["le"])
+                                else _format_value(b["le"]))},
+                        float(b["count"]),
+                    )
+                    for b in data["buckets"]
+                ]
+                samples.append((f"{name}_sum", {}, float(data["sum"])))
+                samples.append(
+                    (f"{name}_count", {}, float(data["count"]))
+                )
+            else:
+                samples = [
+                    (sname, dict(labels), float(v))
+                    for sname, labels, v in m._samples()
+                ]
+            out.append({"name": name, "type": m.kind, "help": m.help,
+                        "samples": samples})
+        return out
+
     def render_prometheus(self) -> str:
         """The Prometheus text exposition format (version 0.0.4).
 

@@ -521,9 +521,40 @@ def current_trace_id() -> Optional[int]:
     return ctx.trace_id if ctx is not None else None
 
 
+def current_context() -> Optional[SpanContext]:
+    """The caller thread's active :class:`SpanContext`, if any — what
+    the cluster RPC client serializes into the envelope so a child
+    process can parent its spans onto the caller's trace."""
+    return _current.get()
+
+
+@contextlib.contextmanager
+def attach_context(ctx: Optional[SpanContext]) -> Iterator[None]:
+    """Install a foreign :class:`SpanContext` (e.g. deserialized from
+    an RPC envelope) as the caller thread's current context for the
+    duration of the block.
+
+    Unlike :meth:`Tracer.span` this opens NO span and touches no ring:
+    it only re-parents — spans and events emitted inside join the
+    originating trace (``current_trace_id()`` returns the propagated
+    correlation id).  ``ctx=None`` is a no-op, so call sites need no
+    branch on whether a context actually arrived.
+    """
+    if ctx is None:
+        yield
+        return
+    token = _current.set(ctx)
+    try:
+        yield
+    finally:
+        _current.reset(token)
+
+
 __all__ = [
     "Span",
     "SpanContext",
     "Tracer",
+    "attach_context",
+    "current_context",
     "current_trace_id",
 ]

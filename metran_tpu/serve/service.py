@@ -64,6 +64,7 @@ import numpy as np
 
 from ..obs import Observability
 from ..obs.capacity import CapacityTracker, window_label
+from ..obs.tracing import current_context
 from ..ops.implicit_map import ROBUST_MAP, ROBUST_NONCONV
 from ..ops.kalman import GATE_DOWNWEIGHTED, GATE_REJECTED
 from ..reliability.faultinject import (
@@ -669,6 +670,13 @@ class MetranService:
         #: commit-group sequence for WAL records (one id per
         #: _wal_commit call — the replay batching unit)
         self._wal_group_seq = itertools.count(1)
+        #: the current dispatch round's rider SpanContexts — written
+        #: by the dispatch thread under the update lock, read by
+        #: ``_wal_commit`` and the replication hub's ``ship`` on the
+        #: same thread, so durability/replication spans (and the
+        #: shipped envelope's correlation id) attribute to every
+        #: request whose commit they carry.  Empty when tracing is off.
+        self._commit_traces: tuple = ()
         #: the last :meth:`recover` replay report (None on a
         #: normally-constructed service)
         self.last_recovery: Optional[dict] = None
@@ -2214,6 +2222,14 @@ class MetranService:
             t_r0 = time.monotonic()
             if acc is not None:
                 cap.observe_stage("lock", t_r0 - t_lock0)
+            # bulk updates carry no rider requests: clear the previous
+            # dispatch round's contexts so this tick's commit spans
+            # (and shipped envelope) are not mis-attributed to it
+            if self.tracer is not None:
+                self._commit_traces = (
+                    (current_context(),) if current_context() is not None
+                    else ()
+                )
             hits, errs = self.registry.rows_for(ids, pin=True)
             live, pinned = [], []
             for i, err in enumerate(errs):
@@ -2871,6 +2887,11 @@ class MetranService:
             )
         if acc is not None and self.capacity is not None:
             self.capacity.observe_stage("wal", time.monotonic() - t0)
+        if self.tracer is not None and self._commit_traces:
+            self.tracer.record_shared(
+                "durability.wal_commit", self._commit_traces, t0,
+                time.monotonic(), {"group": grp, "commits": total},
+            )
 
     @staticmethod
     def _wal_group(ids, y, m, versions, t_seens, n_series,
@@ -3262,6 +3283,14 @@ class MetranService:
                 if acc is not None:
                     cap.observe_stage(
                         "lock", time.monotonic() - t_lock0
+                    )
+                # stamp the round's rider contexts for the commit-side
+                # spans (_wal_commit, repl ship/apply attribution);
+                # only this thread, under this lock, reads or writes it
+                if self.tracer is not None:
+                    self._commit_traces = tuple(
+                        req.trace for req in requests
+                        if req.trace is not None
                     )
                 failed = None
                 broken: set = set()  # models whose per-slot chain broke

@@ -25,6 +25,7 @@ Select alone with `pytest -m obs`; everything here is inside tier-1.
 
 import json
 import math
+import os
 import re
 import subprocess
 import sys
@@ -42,6 +43,7 @@ from metran_tpu.obs import (
     Observability,
     Tracer,
 )
+from metran_tpu.obs.events import SINK_SCHEMA_VERSION, read_sink
 from metran_tpu.reliability import (
     ChainedRequestError,
     ReliabilityPolicy,
@@ -72,6 +74,29 @@ _SAMPLE = re.compile(
 _LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
 _TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
 _HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def unescape_label_value(raw: str) -> str:
+    """Decode a Prometheus label value, asserting every escape is one
+    of the THREE the text format defines (``\\\\``, ``\\"``, ``\\n``)
+    and no raw quote/newline leaked through unescaped — the validator
+    verifies escape sequences instead of merely tolerating them."""
+    out, i = [], 0
+    while i < len(raw):
+        ch = raw[i]
+        if ch == "\\":
+            assert i + 1 < len(raw), f"dangling backslash in {raw!r}"
+            nxt = raw[i + 1]
+            assert nxt in ('\\', '"', 'n'), \
+                f"invalid escape \\{nxt} in {raw!r}"
+            out.append("\n" if nxt == "n" else nxt)
+            i += 2
+        else:
+            assert ch not in ('"', '\n'), \
+                f"unescaped {ch!r} in label value {raw!r}"
+            out.append(ch)
+            i += 1
+    return "".join(out)
 
 
 def validate_prometheus(text: str) -> dict:
@@ -124,7 +149,8 @@ def validate_prometheus(text: str) -> dict:
             if m["labels"]:
                 for ln_, lv in _LABEL.findall(m["labels"]):
                     assert ln_ not in labels, f"duplicate label ({where})"
-                    labels[ln_] = lv
+                    # decoded, with every escape sequence verified
+                    labels[ln_] = unescape_label_value(lv)
             value = float(m["value"])  # accepts +Inf/-Inf/NaN
             families[family]["samples"].append((sname, labels, value))
 
@@ -132,26 +158,41 @@ def validate_prometheus(text: str) -> dict:
         assert info["type"] is not None, f"{family}: HELP without TYPE"
         if info["type"] != "histogram":
             continue
-        buckets = [
-            (labels, v) for sname, labels, v in info["samples"]
-            if sname == family + "_bucket"
-        ]
-        sums = [v for sname, _, v in info["samples"]
-                if sname == family + "_sum"]
-        counts = [v for sname, _, v in info["samples"]
-                  if sname == family + "_count"]
-        assert buckets and len(sums) == 1 and len(counts) == 1, \
-            f"{family}: incomplete histogram triplet"
-        prev, bounds = -1.0, []
-        for labels, v in buckets:
-            assert set(labels) == {"le"}, f"{family}: bucket labels"
-            bounds.append(float(labels["le"]))
-            assert v >= prev, f"{family}: bucket counts not cumulative"
-            prev = v
-        assert bounds == sorted(bounds), f"{family}: le not sorted"
-        assert math.isinf(bounds[-1]), f"{family}: missing +Inf bucket"
-        assert buckets[-1][1] == counts[0], \
-            f"{family}: +Inf bucket != _count"
+        # one triplet per non-le label subset: a single-process
+        # histogram has exactly one (the empty subset); a fleet-merged
+        # exposition carries one per ``process`` value, each checked
+        # independently against the same cumulative grammar
+        series: dict = {}
+        for sname, labels, v in info["samples"]:
+            key = tuple(sorted(
+                (k, lv) for k, lv in labels.items() if k != "le"
+            ))
+            g = series.setdefault(
+                key, {"bucket": [], "sum": [], "count": []}
+            )
+            if sname == family + "_bucket":
+                g["bucket"].append((labels, v))
+            elif sname == family + "_sum":
+                g["sum"].append(v)
+            elif sname == family + "_count":
+                g["count"].append(v)
+        for key, g in series.items():
+            who = f"{family}{dict(key) or ''}"
+            assert (g["bucket"] and len(g["sum"]) == 1
+                    and len(g["count"]) == 1), \
+                f"{who}: incomplete histogram triplet"
+            prev, bounds = -1.0, []
+            for labels, v in g["bucket"]:
+                assert "le" in labels, f"{who}: bucket without le"
+                bounds.append(float(labels["le"]))
+                assert v >= prev, \
+                    f"{who}: bucket counts not cumulative"
+                prev = v
+            assert bounds == sorted(bounds), f"{who}: le not sorted"
+            assert math.isinf(bounds[-1]), \
+                f"{who}: missing +Inf bucket"
+            assert g["bucket"][-1][1] == g["count"][0], \
+                f"{who}: +Inf bucket != _count"
     return families
 
 
@@ -180,12 +221,25 @@ def test_render_prometheus_grammar_unit():
              families["metran_test_events_total"]["samples"]
              if lbl.get("kind") == "breaker_open"][0]
     assert total == 3
-    # label values with quotes/newlines/backslashes stay parseable
+    # label values with quotes/newlines/backslashes stay parseable AND
+    # round-trip: the validator decodes every escape sequence, so the
+    # recovered value must equal the exact value that was set
     c.inc(kind="weird")
+    weird = 'a"b\\c\nd'
     g = reg.gauge("metran_test_labelled", "escapes",
                   label_names=("path",))
-    g.set(1, path='a"b\\c\nd')
-    validate_prometheus(reg.render_prometheus())
+    g.set(1, path=weird)
+    families = validate_prometheus(reg.render_prometheus())
+    (path_val,) = [
+        lbl["path"]
+        for _, lbl, _ in families["metran_test_labelled"]["samples"]
+    ]
+    assert path_val == weird  # escape round-trip, not just tolerated
+    # the raw exposition line carries the escaped form (the grammar's
+    # three escapes), never a literal quote/newline inside the value
+    raw = [ln for ln in reg.render_prometheus().splitlines()
+           if ln.startswith("metran_test_labelled")][0]
+    assert '\\"' in raw and "\\n" in raw and "\\\\" in raw
 
 
 def test_registry_registration_semantics():
@@ -442,7 +496,8 @@ def test_retry_attempts_share_one_correlation_id(rng):
 # ----------------------------------------------------------------------
 def test_event_log_schema_ring_and_file_sink(tmp_path):
     sink = tmp_path / "events.jsonl"
-    log = EventLog(maxlen=4, sink=sink, clock=lambda: 1000.0)
+    log = EventLog(maxlen=4, sink=sink, clock=lambda: 1000.0,
+                   mono_clock=lambda: 12.5)
     for i in range(6):
         log.emit("breaker_open", model_id=f"m{i}",
                  fault_point="breaker", previous="closed")
@@ -454,10 +509,39 @@ def test_event_log_schema_ring_and_file_sink(tmp_path):
     lines = sink.read_text().strip().splitlines()
     assert len(lines) == 6  # the sink saw every emit, evicted or not
     rec = json.loads(lines[0])
+    # v2 record schema: pid + monotonic stamp ride every record so the
+    # fleet merge can clock-align and attribute without guessing
     assert set(rec) == {
-        "ts", "kind", "model_id", "request_id", "fault_point", "detail"
+        "ts", "mono", "pid", "kind", "model_id", "request_id",
+        "fault_point", "detail", "v",
     }
     assert rec["ts"] == 1000.0 and rec["fault_point"] == "breaker"
+    assert rec["v"] == SINK_SCHEMA_VERSION == 2
+    assert rec["mono"] == 12.5 and rec["pid"] == os.getpid()
+
+
+def test_event_sink_read_back_and_v1_compat(tmp_path):
+    """``read_sink`` returns ring-shaped records from a v2 sink, still
+    reads v1 lines (pre-PR-19 sinks: no pid/mono/v) and skips torn
+    tails instead of raising."""
+    sink = tmp_path / "mixed.jsonl"
+    log = EventLog(sink=sink, clock=lambda: 7.0)
+    log.emit("retry", model_id="m1", attempt=2)
+    log.close()
+    with open(sink, "a", encoding="utf-8") as fh:
+        # a v1 line (old schema, no version/pid/mono) and a torn line
+        fh.write(json.dumps({
+            "ts": 3.0, "kind": "breaker_open", "model_id": "m9",
+            "request_id": None, "fault_point": "breaker", "detail": {},
+        }) + "\n")
+        fh.write('{"ts": 9.0, "kind": "tor')  # torn mid-write
+    records = read_sink(sink)
+    assert [r["kind"] for r in records] == ["retry", "breaker_open"]
+    v2, v1 = records
+    assert v2["pid"] == os.getpid() and v2["mono"] is not None
+    assert "v" not in v2  # version is transport framing, not payload
+    assert v1["pid"] is None and v1["mono"] is None  # back-filled
+    assert v1["ts"] == 3.0 and v1["model_id"] == "m9"
 
 
 def test_event_log_sink_failure_degrades_not_raises(tmp_path):

@@ -1,0 +1,688 @@
+"""Fleet observability plane (`metran_tpu.obs.fleet` + cluster wiring).
+
+Pins the merged-pane contracts PR 19 introduced:
+
+1. **cross-process trace propagation** — one correlation ID spans
+   frontend submit → writer dispatch → replication ship → standby
+   receive across a REAL spawned cluster, and the merged Chrome
+   export renders the writer's RPC span *inside* the frontend's
+   update span (ts/dur containment across process lanes);
+2. **fleet metrics merge** — `fleet_report()` renders every live
+   process's registry under a `process` label in one exposition that
+   passes the test_obs line-grammar validator (per-process histogram
+   triplets included);
+3. **clock-aligned event merge** — `merge_events` orders records from
+   processes with wildly skewed monotonic origins correctly, and
+   `tools/failover_timeline.py::build_timeline` reconstructs the
+   replication failover story (connect → promote → fence, joined on
+   epoch) from merged telemetry alone;
+4. **wire-format compatibility** — the traced 3-tuple RPC envelope
+   degrades to the historical 2-tuple when untraced, in both
+   directions (old client → new server, traced client → tracerless
+   server).
+
+Select alone with `pytest -m obs`; everything here is inside tier-1.
+"""
+
+import json
+import multiprocessing
+import os
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from metran_tpu.obs import (
+    EventLog, MetricsRegistry, Observability, Tracer,
+)
+from metran_tpu.obs.fleet import (
+    ChildTelemetry,
+    ClockAlign,
+    FleetScrapeServer,
+    clock_anchor,
+    merge_chrome,
+    merge_events,
+    render_fleet_prometheus,
+)
+from metran_tpu.obs.tracing import current_context
+
+from test_obs import validate_prometheus
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO / "tools"))
+
+from failover_timeline import build_timeline, render  # noqa: E402
+
+pytestmark = pytest.mark.obs
+
+
+def _bundle(trace=True):
+    return Observability(
+        metrics=MetricsRegistry(),
+        tracer=Tracer() if trace else None,
+        events=EventLog(),
+    )
+
+
+# ----------------------------------------------------------------------
+# clock alignment
+# ----------------------------------------------------------------------
+def test_clock_align_retains_min_rtt_estimate():
+    ca = ClockAlign()
+    # a noisy round-trip: child answered its mono=100 between our
+    # 50.0 and 50.8 -> offset ~= -49.6, rtt 0.8
+    off, rtt = ca.observe("writer", 100.0, 50.0, 50.8)
+    assert rtt == pytest.approx(0.8)
+    assert off == pytest.approx(50.4 - 100.0)
+    # a later, tighter round-trip replaces it
+    off2, rtt2 = ca.observe("writer", 200.0, 150.0, 150.1)
+    assert rtt2 == pytest.approx(0.1)
+    assert ca.offset("writer") == pytest.approx(off2)
+    # a WORSE one does not regress the retained estimate
+    off3, rtt3 = ca.observe("writer", 300.0, 240.0, 242.0)
+    assert (off3, rtt3) == (off2, rtt2)
+    assert ca.offset("missing") is None
+    assert set(ca.snapshot()) == {"writer"}
+
+
+def test_clock_anchor_pairs_wall_and_monotonic():
+    a = clock_anchor()
+    assert set(a) == {"wall", "mono"}
+    assert abs(a["wall"] - time.time()) < 5.0
+    assert abs(a["mono"] - time.monotonic()) < 5.0
+
+
+# ----------------------------------------------------------------------
+# fleet metrics merge
+# ----------------------------------------------------------------------
+def test_render_fleet_prometheus_merges_under_process_label():
+    parts = []
+    for role in ("frontend", "writer", "worker0"):
+        obs = _bundle()
+        tele = ChildTelemetry(obs, role)
+        c = obs.metrics.counter(
+            "metran_test_requests_total", "requests", ("kind",)
+        )
+        c.inc(2, kind="update")
+        h = obs.metrics.histogram(
+            "metran_test_latency_seconds", "latency",
+            buckets=(0.01, 0.1),
+        )
+        h.observe(0.005)
+        h.observe(0.5)
+        part = tele.collect({"events": False, "spans": False})
+        part["process"] = role
+        parts.append(part)
+    text = render_fleet_prometheus(parts)
+    families = validate_prometheus(text)
+    # every sample of every family carries the part's process label
+    procs = {
+        lbl.get("process")
+        for fam in families.values()
+        for _, lbl, _ in fam["samples"]
+    }
+    assert procs == {"frontend", "writer", "worker0"}
+    # one family header, three per-process series
+    reqs = families["metran_test_requests_total"]["samples"]
+    assert len(reqs) == 3
+    assert all(lbl["kind"] == "update" and v == 2.0
+               for _, lbl, v in reqs)
+    # merged histograms: one grammar-valid triplet per process
+    # (validate_prometheus already asserted cumulativity per subgroup)
+    counts = [
+        (lbl["process"], v)
+        for n, lbl, v in
+        families["metran_test_latency_seconds"]["samples"]
+        if n.endswith("_count")
+    ]
+    assert sorted(counts) == [
+        ("frontend", 2.0), ("worker0", 2.0), ("writer", 2.0)
+    ]
+    # the child-side fleet metrics ride every part
+    assert "metran_cluster_process_uptime_seconds" in families
+    assert "metran_cluster_telemetry_serves_total" in families
+
+
+def test_render_fleet_prometheus_child_process_label_is_reserved():
+    obs = _bundle()
+    g = obs.metrics.gauge(
+        "metran_test_sneaky", "tries to self-label",
+        label_names=("process",),
+    )
+    g.set(1.0, process="imposter")
+    part = ChildTelemetry(obs, "writer").collect(
+        {"events": False, "spans": False}
+    )
+    part["process"] = "writer"
+    families = validate_prometheus(render_fleet_prometheus([part]))
+    (sample,) = families["metran_test_sneaky"]["samples"]
+    assert sample[1]["process"] == "writer"  # merge wins, always
+
+
+def test_fleet_part_sections_are_gateable():
+    obs = _bundle()
+    obs.events.emit("retry", model_id="m0")
+    tele = ChildTelemetry(obs, "writer")
+    full = tele.collect()
+    assert full["v"] == 1 and full["pid"] == os.getpid()
+    assert full["role"] == "writer"
+    assert full["metrics"] and full["events"]
+    lean = tele.collect({"events": False, "spans": False})
+    assert lean["metrics"] is not None
+    assert lean["events"] == [] and lean["spans"] == []
+    # the serves counter booked both collections
+    serves = [
+        s for fam in lean["metrics"]
+        if fam["name"] == "metran_cluster_telemetry_serves_total"
+        for s in fam["samples"]
+    ]
+    assert serves[0][2] == 2.0
+
+
+# ----------------------------------------------------------------------
+# clock-aligned event + span merge (synthetic skewed processes)
+# ----------------------------------------------------------------------
+def _skewed_parts():
+    """Two synthetic parts whose monotonic origins differ by ~1000s
+    but whose true wall-time order interleaves: A's events at wall
+    100.0/100.2, B's at wall 100.1/100.3."""
+    ref_wall = 1_000_000.0
+    a = {
+        "pid": 11, "role": "writer",
+        "anchor": {"wall": ref_wall, "mono": 50.0},
+        "events": [
+            {"ts": ref_wall + 100.0, "mono": 150.0, "pid": 11,
+             "kind": "retry", "model_id": "m0", "request_id": None,
+             "fault_point": None, "detail": {}},
+            {"ts": ref_wall + 100.2, "mono": 150.2, "pid": 11,
+             "kind": "checkpoint", "model_id": None,
+             "request_id": None, "fault_point": None, "detail": {}},
+        ],
+        "spans": [
+            {"name": "rpc.update", "trace_id": 7, "span_id": 1,
+             "parent_id": None, "ts": 150.0, "dur": 0.2, "tid": 0,
+             "args": {}},
+        ],
+    }
+    b = {
+        "pid": 22, "role": "standby",
+        # same wall epoch, monotonic clock started ~1000s earlier
+        "anchor": {"wall": ref_wall, "mono": 1050.0},
+        "events": [
+            {"ts": ref_wall + 100.1, "mono": 1150.1, "pid": 22,
+             "kind": "replica_connect", "model_id": None,
+             "request_id": None, "fault_point": None,
+             "detail": {"epoch": 1}},
+            # a v1 record: no mono stamp -> wall fallback
+            {"ts": ref_wall + 100.3, "mono": None, "pid": None,
+             "kind": "replica_promote", "model_id": None,
+             "request_id": None, "fault_point": None,
+             "detail": {"epoch": 2}},
+        ],
+        "spans": [
+            {"name": "repl.apply", "trace_id": 7, "span_id": 9,
+             "parent_id": 1, "ts": 1150.1, "dur": 0.05, "tid": 0,
+             "args": {"group": 3}},
+        ],
+    }
+    return [a, b]
+
+
+def test_merge_events_orders_across_skewed_monotonic_origins():
+    merged = merge_events(_skewed_parts())
+    assert [e["kind"] for e in merged] == [
+        "retry", "replica_connect", "checkpoint", "replica_promote",
+    ]
+    assert [e["process"] for e in merged] == [
+        "writer", "standby", "writer", "standby",
+    ]
+    ts = [e["fleet_ts"] for e in merged]
+    assert ts == sorted(ts)
+    # true wall spacing (100ms steps) survives the alignment
+    assert ts[1] - ts[0] == pytest.approx(0.1, abs=1e-6)
+    assert ts[3] - ts[2] == pytest.approx(0.1, abs=1e-6)
+
+
+def test_merge_events_prefers_collector_rtt_offset():
+    parts = _skewed_parts()
+    # the collector measured standby's offset directly (min-RTT
+    # Cristian estimate): mono 1050 on the child was collector-mono
+    # 50, i.e. offset -1000 — same answer the anchors imply, but the
+    # explicit estimate must take precedence when present
+    parts[1]["clock"] = {"offset": -1000.0, "rtt_s": 0.001}
+    merged = merge_events(parts)
+    assert [e["kind"] for e in merged] == [
+        "retry", "replica_connect", "checkpoint", "replica_promote",
+    ]
+
+
+def test_merge_chrome_one_lane_per_pid_with_correlation_args():
+    trace = merge_chrome(_skewed_parts())
+    events = trace["traceEvents"]
+    meta = [e for e in events if e["ph"] == "M"
+            and e["name"] == "process_name"]
+    assert {m["args"]["name"] for m in meta} == {
+        "writer (pid 11)", "standby (pid 22)",
+    }
+    spans = [e for e in events if e["ph"] == "X"]
+    assert {e["pid"] for e in spans} == {11, 22}
+    by_name = {e["name"]: e for e in spans}
+    # correlation id survives the merge in args, on both lanes
+    assert by_name["rpc.update"]["args"]["trace_id"] == 7
+    assert by_name["repl.apply"]["args"]["trace_id"] == 7
+    assert by_name["repl.apply"]["args"]["parent_id"] == 1
+    # aligned: standby's 1150.1 is 0.1s after writer's 150.0 despite
+    # the 1000s monotonic-origin skew; export is µs rebased to t0=0
+    assert by_name["rpc.update"]["ts"] == pytest.approx(0.0)
+    assert by_name["repl.apply"]["ts"] == pytest.approx(1e5, rel=1e-3)
+    json.dumps(trace)  # loadable by chrome://tracing
+
+
+# ----------------------------------------------------------------------
+# RPC envelope compatibility
+# ----------------------------------------------------------------------
+def test_rpc_envelope_traced_and_untraced_interop(tmp_path):
+    from metran_tpu.cluster.ipc import RpcClient, RpcServer
+
+    server_tracer = Tracer()
+    seen = []
+
+    def echo(payload):
+        ctx = current_context()
+        seen.append(None if ctx is None else
+                    (ctx.trace_id, ctx.span_id))
+        return payload
+
+    server = RpcServer(
+        str(tmp_path / "s.sock"), {"echo": echo}, tracer=server_tracer
+    )
+    client = RpcClient(str(tmp_path / "s.sock"))
+    client_tracer = Tracer()
+    try:
+        # 1. untraced caller -> 2-tuple on the wire -> handler runs
+        #    with NO context (the pre-PR-19 behavior, bit-compatible)
+        assert client.call("echo", {"x": 1}, ctx=None) == {"x": 1}
+        assert seen[-1] is None
+        # 2. traced caller: the handler inherits the caller's ids
+        with client_tracer.span("client.op"):
+            sc = current_context()
+            assert client.call("echo", {"x": 2}) == {"x": 2}
+        # the handler ran INSIDE the server's rpc.echo span: same
+        # trace id as the caller, fresh span id
+        assert seen[-1] is not None
+        tid, _sid = seen[-1]
+        assert tid == sc.trace_id
+        # the server booked an rpc.echo span UNDER the caller's trace
+        (srv_span,) = server_tracer.spans(name="rpc.echo")
+        assert srv_span["trace_id"] == sc.trace_id
+        assert srv_span["parent_id"] == sc.span_id
+        assert srv_span["args"]["origin_pid"] == os.getpid()
+        # 3. explicit ctx tuple (the replication ship path's form)
+        assert client.call(
+            "echo", {"x": 3}, ctx=(99, 7, 1234)
+        ) == {"x": 3}
+        assert seen[-1][0] == 99
+        shipped = server_tracer.spans(trace_id=99)
+        assert shipped[-1]["parent_id"] == 7
+        assert shipped[-1]["args"]["origin_pid"] == 1234
+    finally:
+        client.close()
+        server.close()
+
+
+def test_rpc_traced_envelope_against_tracerless_server(tmp_path):
+    """A traced client against a server with no tracer: the context
+    still re-attaches (events/record_shared join the caller's trace),
+    nothing breaks — the rolling-restart mix."""
+    from metran_tpu.cluster.ipc import RpcClient, RpcServer
+
+    got = []
+    server = RpcServer(
+        str(tmp_path / "p.sock"),
+        {"probe": lambda _p: got.append(current_context()) or "ok"},
+    )
+    client = RpcClient(str(tmp_path / "p.sock"))
+    tracer = Tracer()
+    try:
+        with tracer.span("client.probe"):
+            sc = current_context()
+            assert client.call("probe") == "ok"
+        assert got[-1].trace_id == sc.trace_id
+    finally:
+        client.close()
+        server.close()
+
+
+# ----------------------------------------------------------------------
+# scrape endpoint
+# ----------------------------------------------------------------------
+def test_fleet_scrape_server_serves_and_survives_failure():
+    import urllib.error
+    import urllib.request
+
+    payloads = ["# HELP metran_x x\n# TYPE metran_x gauge\n"
+                'metran_x{process="writer"} 1.0\n']
+
+    def collect():
+        if not payloads:
+            raise RuntimeError("child died")
+        return payloads[0]
+
+    srv = FleetScrapeServer(collect, port=0)  # 0 = ephemeral bind
+    try:
+        url = f"http://127.0.0.1:{srv.port}/metrics"
+        with urllib.request.urlopen(url) as resp:
+            body = resp.read().decode()
+            assert resp.headers["Content-Type"].startswith("text/plain")
+        validate_prometheus(body)
+        assert 'process="writer"' in body
+        payloads.clear()  # a collection failure answers 500, not death
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(url)
+        assert err.value.code == 500
+    finally:
+        srv.close()
+
+
+def test_cluster_spec_fleet_port_validation_and_resolution(monkeypatch):
+    from metran_tpu.cluster import ClusterSpec
+
+    with pytest.raises(ValueError, match="fleet_port"):
+        ClusterSpec(enabled=True, fleet_port=-1).validate()
+    with pytest.raises(ValueError, match="fleet_port"):
+        ClusterSpec(enabled=True, fleet_port=70000).validate()
+    assert ClusterSpec(enabled=True, fleet_port=9464).validate() \
+        .resolve_fleet_port() == 9464
+    monkeypatch.setenv("METRAN_TPU_OBS_FLEET_PORT", "9470")
+    assert ClusterSpec(enabled=True).validate() \
+        .resolve_fleet_port() == 9470  # None defers to the env knob
+    assert ClusterSpec(enabled=True, fleet_port=0).validate() \
+        .resolve_fleet_port() == 0  # explicit off beats the env
+
+
+# ----------------------------------------------------------------------
+# failover audit timeline (tools/failover_timeline.py)
+# ----------------------------------------------------------------------
+def test_failover_timeline_from_merged_replication_telemetry(tmp_path):
+    """ISSUE 19 acceptance: the PR 17 failover scenario — attach,
+    replicate, promote, fence — reconstructed from merged telemetry
+    ALONE, with the audit's join checks green."""
+    from metran_tpu.serve import PrimaryFencedError
+
+    from test_replication import _drain, _pair
+
+    primary, standby, standby_svc, ids = _pair(tmp_path)
+    try:
+        rng = np.random.default_rng(5)
+        primary.repl_hub.add_standby(str(standby.socket_path),
+                                     name="sb0")
+        for mid in ids:
+            primary.update(mid, rng.normal(size=(1, 5)))
+        _drain(primary, standby, want=len(ids))
+
+        report = standby.promote()
+        assert report["epoch"] == 2
+        with pytest.raises(PrimaryFencedError):
+            primary.update(ids[0], rng.normal(size=(1, 5)))
+
+        # merge the two processes' telemetry (same host process here,
+        # but distinct parts — the merge only sees parts)
+        parts = [
+            {"pid": os.getpid(), "process": "primary",
+             "anchor": clock_anchor(),
+             "events": primary.events.snapshot()},
+            {"pid": os.getpid(), "process": "standby",
+             "anchor": clock_anchor(),
+             "events": standby_svc.events.snapshot()},
+        ]
+        merged = merge_events(parts)
+        timeline = build_timeline(merged)
+        assert timeline["ok"], timeline["checks"]
+        by_name = {c["check"]: c for c in timeline["checks"]}
+        assert by_name["promotion observed"]["ok"]
+        assert by_name["fence epoch bumped past attach epoch"]["ok"]
+        assert by_name["old primary fenced after promotion"]["ok"]
+        assert by_name["events span more than one process"]["ok"]
+        phases = [e["phase"] for e in timeline["entries"]]
+        assert phases.index("connect") < phases.index("promote") \
+            < phases.index("fence")
+        # the renderer tells the story without raising
+        text = "\n".join(render(timeline))
+        assert "consistent failover" in text
+        assert "replica_promote" in text
+    finally:
+        standby.close()
+        standby_svc.close()
+        primary.close()
+
+
+def test_failover_timeline_flags_fence_without_promotion():
+    events = [
+        {"kind": "replica_connect", "mono": 1.0, "pid": 1,
+         "process": "primary", "fleet_ts": 1.0,
+         "detail": {"epoch": 1}},
+        {"kind": "primary_fenced", "mono": 2.0, "pid": 1,
+         "process": "primary", "fleet_ts": 2.0,
+         "detail": {"commits": 4}},
+    ]
+    timeline = build_timeline(events)
+    assert not timeline["ok"]
+    bad = [c for c in timeline["checks"] if not c["ok"]]
+    assert any("fenced after promotion" in c["check"] for c in bad)
+
+
+def test_failover_timeline_cli_reads_jsonl_sinks(tmp_path):
+    """The CLI path: per-process JSONL event sinks in, rendered audit
+    out (exit 0 on a consistent story)."""
+    import subprocess
+
+    p_sink, s_sink = tmp_path / "p.jsonl", tmp_path / "s.jsonl"
+    plog = EventLog(sink=p_sink)
+    plog.emit("replica_connect", fault_point="cluster.replication",
+              standby="sb0", catch_up_commits=4, epoch=1)
+    plog.emit("primary_fenced", fault_point="serve.dispatch", commits=1)
+    plog.close()
+    slog = EventLog(sink=s_sink)
+    slog.emit("replica_promote", fault_point="cluster.replication",
+              epoch=2, applied_group=7, applied_commits=4)
+    slog.close()
+    # fenced emit above happened BEFORE promote in real time; rewrite
+    # its mono so the story orders correctly (sinks are test-authored)
+    lines = [json.loads(ln) for ln in
+             p_sink.read_text().splitlines()]
+    lines[1]["mono"] = json.loads(
+        s_sink.read_text().splitlines()[0]
+    )["mono"] + 1.0
+    p_sink.write_text("".join(json.dumps(ln) + "\n" for ln in lines))
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "failover_timeline.py"),
+         str(p_sink), str(s_sink)],
+        capture_output=True, text=True, cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "consistent failover" in out.stdout
+    assert "replica_promote" in out.stdout
+
+
+# ----------------------------------------------------------------------
+# the spawned cluster: correlation + merged pane end to end
+# ----------------------------------------------------------------------
+def test_spawned_cluster_one_correlation_id_and_merged_pane(
+    tmp_path, monkeypatch
+):
+    """ISSUE 19 acceptance, cross-process for real: one update's
+    correlation ID spans frontend → writer → standby in the merged
+    Chrome export (with writer-span containment inside the frontend
+    span), `fleet_report()` merges ≥3 live processes under the
+    grammar validator, and `capacity_report()` carries the worker
+    reader ledgers and attached standbys."""
+    from metran_tpu.cluster import ClusterFrontend, ClusterSpec
+    from metran_tpu.cluster._testing import (
+        seed_root, standby_service_factory, writer_service_factory,
+    )
+    from metran_tpu.cluster.frontend import _wait_ready
+    from metran_tpu.cluster.replication import (
+        ReplicationSpec, standby_main,
+    )
+
+    # arm tracers in THIS process and every spawned child (the env
+    # crosses the spawn via os.environ)
+    monkeypatch.setenv("METRAN_TPU_OBS_TRACE", "1")
+    proot, sroot = str(tmp_path / "p"), str(tmp_path / "s")
+    ids = seed_root(proot, seed=7)
+    seed_root(sroot, seed=7)
+    spec = ClusterSpec(
+        enabled=True, workers=2, shm_mb=8.0, heartbeat_s=0.5,
+        slots=64, max_series=8, socket_dir=str(tmp_path),
+    )
+    repl_spec = ReplicationSpec(enabled=True).validate()
+    sock = os.path.join(str(tmp_path), "standby.sock")
+    ready = os.path.join(str(tmp_path), "standby.ready")
+    ctx = multiprocessing.get_context("spawn")
+    standby_proc = ctx.Process(
+        target=standby_main,
+        args=(repl_spec, sock, standby_service_factory, (sroot,),
+              ready),
+        name="metran-standby", daemon=True,
+    )
+    frontend = ClusterFrontend(
+        spec, writer_service_factory, (proot, "1-5", True, True),
+    )
+    try:
+        standby_proc.start()
+        _wait_ready(ready, standby_proc)
+        frontend.attach_standby(sock, name="sb0")
+
+        rng = np.random.default_rng(3)
+        for mid in ids:
+            frontend.update(mid, rng.normal(size=(1, 5)))
+        frontend.forecast(ids[0], 5)
+
+        # -- satellite 1: capacity_report covers the whole fleet -----
+        report = frontend.capacity_report()
+        cluster = report["cluster"]
+        assert {w["worker"] for w in cluster["worker_reports"]} \
+            == {0, 1}
+        assert all("error" not in w
+                   for w in cluster["worker_reports"])
+        assert cluster["replication"]["enabled"]
+        assert cluster["replication"]["replicas"] == 1
+        (sb,) = cluster["standbys"]
+        assert sb["socket"] == sock and sb["received_commits"] >= 4
+
+        # -- one correlation id across >= 3 process lanes ------------
+        trace = frontend.fleet_trace_export()
+        spans = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+        fe_updates = [
+            e for e in spans
+            if e["name"] == "cluster.update"
+            and e["args"]["process"] == "frontend"
+        ]
+        assert len(fe_updates) == len(ids)
+        joined = None
+        for fe in fe_updates:
+            tid = fe["args"]["trace_id"]
+            lanes = {
+                e["pid"] for e in spans
+                if e["args"].get("trace_id") == tid
+            }
+            if len(lanes) >= 3:
+                joined = (fe, tid, lanes)
+                break
+        assert joined is not None, "no trace id joined 3 process lanes"
+        fe, tid, lanes = joined
+        assert os.getpid() in lanes and len(lanes) >= 3
+        # containment: the writer's rpc.update span renders INSIDE the
+        # frontend's cluster.update span on the aligned timeline
+        (wr,) = [
+            e for e in spans
+            if e["name"] == "rpc.update"
+            and e["args"].get("trace_id") == tid
+        ]
+        assert wr["pid"] != fe["pid"]
+        slack = 2_000.0  # µs of alignment tolerance
+        assert wr["ts"] >= fe["ts"] - slack
+        assert wr["ts"] + wr["dur"] <= fe["ts"] + fe["dur"] + slack
+        # the standby lane joined via the ship envelope
+        standby_spans = [
+            e for e in spans
+            if e["args"].get("trace_id") == tid
+            and e["pid"] not in (fe["pid"], wr["pid"])
+        ]
+        assert any(e["name"] == "rpc.repl_frames"
+                   for e in standby_spans)
+
+        # -- fleet_report: >= 3 processes, grammar-valid -------------
+        exposition = frontend.fleet_report()
+        families = validate_prometheus(exposition)
+        procs = {
+            lbl["process"]
+            for fam in families.values()
+            for _, lbl, _ in fam["samples"]
+            if "process" in lbl
+        }
+        assert {"frontend", "writer", "worker0", "worker1",
+                "standby0"} <= procs
+        uptime = families["metran_cluster_process_uptime_seconds"]
+        assert len(uptime["samples"]) >= 5  # one lane per process
+        # the writer's serve histograms merged with process labels
+        assert any(
+            lbl.get("process") == "writer"
+            for _, lbl, _ in families[
+                "metran_serve_update_latency_seconds"]["samples"]
+        )
+
+        # -- fleet_events: one aligned, attributed timeline ----------
+        merged = frontend.fleet_events()
+        assert all("fleet_ts" in e and "process" in e for e in merged)
+        ts = [e["fleet_ts"] for e in merged]
+        assert ts == sorted(ts)
+        assert {"writer", "frontend"} <= {e["process"] for e in merged}
+        # the writer's plane publishes are visible from the frontend
+        assert any(
+            e["kind"] == "snapshot_plane_publish"
+            and e["process"] == "writer" for e in merged
+        )
+    finally:
+        frontend.close()
+        if standby_proc.is_alive():
+            standby_proc.terminate()
+            standby_proc.join(timeout=5.0)
+
+
+def test_fleet_collect_books_gap_for_dead_child(tmp_path, monkeypatch):
+    """One dead process must not blind the pane: the fan-out skips it,
+    books the gap counter and emits fleet_telemetry_gap."""
+    from metran_tpu.cluster import ClusterFrontend, ClusterSpec
+    from metran_tpu.cluster._testing import (
+        seed_root, writer_service_factory,
+    )
+
+    seed_root(str(tmp_path / "f"), seed=7)
+    spec = ClusterSpec(
+        enabled=True, workers=1, shm_mb=8.0, heartbeat_s=0.5,
+        slots=64, max_series=8, socket_dir=str(tmp_path),
+    )
+    frontend = ClusterFrontend(
+        spec, writer_service_factory, (str(tmp_path / "f"), "1-5", True),
+    )
+    try:
+        # a standby socket that nobody serves
+        frontend.standby_sockets.append(
+            os.path.join(str(tmp_path), "ghost.sock")
+        )
+        parts = frontend.fleet_collect(events=False, spans=False)
+        labels = [p["process"] for p in parts]
+        assert "standby0" not in labels  # skipped, not fatal
+        assert {"frontend", "writer", "worker0"} <= set(labels)
+        gaps = [
+            e for e in frontend.events.snapshot()
+            if e["kind"] == "fleet_telemetry_gap"
+        ]
+        assert gaps and gaps[-1]["detail"]["process"] == "standby0"
+        text = frontend.fleet_report()
+        assert 'process="writer"' in text  # live lanes still render
+    finally:
+        frontend.close()

@@ -486,7 +486,7 @@ def test_fanout_ship_is_concurrent_across_standbys(tmp_path):
             def __init__(self, i):
                 self.i = i
 
-            def call(self, op, payload=None):
+            def call(self, op, payload=None, ctx=None):
                 started[self.i].set()
                 if not started[1 - self.i].wait(15.0):
                     raise AssertionError("pushes were serialized")

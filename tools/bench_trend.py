@@ -69,6 +69,8 @@ HEADLINES: Dict[str, int] = {
     "repl_lag_p99_ms": -1,              # ship ack-to-applied (250ms bar)
     "failover_rto_ms": -1,              # promote wall to first read
     "replica_read_scaling_x": +1,       # primary + 2 standbys fan-out
+    "obs_fleet_rpc_overhead_pct": -1,   # traced cluster update RPC cost
+    "obs_fleet_read_overhead_pct": -1,  # plane read path (0% by constr.)
 }
 
 #: tail-fallback regexes for rounds with ``"parsed": null``: the raw

@@ -33,7 +33,11 @@ Failures:
    result is discarded with no ``callback=`` — nothing can ever
    ``inc``/``set``/``observe`` it — or whose bound variable is never
    used with an update method (``inc``/``dec``/``set``/``observe``/
-   ``labels``) nor re-aliased in its file.
+   ``labels``) nor re-aliased in its file;
+4. **reserved label**: a registration declaring a ``label_names``
+   entry the fleet merge layer owns (``process`` — stamped on every
+   sample by ``obs/fleet.py``; a child's own value would be silently
+   overwritten at merge time).
 
 **Event-kind drift gate.**  The same pass also keeps the structured
 event log's schema honest: every literal ``kind`` passed to an
@@ -78,6 +82,10 @@ CONCEPTS_DOC = REPO / "docs" / "concepts.md"
 NAME_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
 REGISTRY_METHODS = {"counter", "gauge", "histogram"}
 UPDATE_METHODS = ("inc", "dec", "set", "observe", "labels")
+#: label names the fleet merge layer stamps on every sample
+#: (obs/fleet.py) — package code must never register a metric with
+#: one, or a child's own label collides with the merge's attribution
+RESERVED_LABELS = ("process",)
 
 
 @dataclass
@@ -90,6 +98,7 @@ class Registration:
     has_callback: bool = False
     target: Optional[str] = None  # bound identifier, when assigned
     discarded: bool = False  # bare-statement registration
+    label_names: tuple = ()  # literal label_names=(...) elements
 
 
 @dataclass
@@ -187,6 +196,15 @@ class _FileScanner(ast.NodeVisitor):
                         ),
                         target=self._bound.get(id(node)),
                         discarded=id(node) in self._stmt_exprs,
+                        label_names=tuple(
+                            el.value
+                            for kw in node.keywords
+                            if kw.arg == "label_names"
+                            and isinstance(kw.value, (ast.Tuple, ast.List))
+                            for el in kw.value.elts
+                            if isinstance(el, ast.Constant)
+                            and isinstance(el.value, str)
+                        ),
                     ))
             if func.attr == "emit" and node.args:
                 arg = node.args[0]
@@ -441,10 +459,23 @@ def scan(verbose: bool = False) -> Report:
                     f"({'/'.join(UPDATE_METHODS)}) in {reg.file}"
                 )
 
-    # 4. event-kind drift (declared vs emitted vs documented)
+    # 4. reserved labels: the fleet merge (obs/fleet.py) stamps
+    #    `process` on every sample; a child registering its own
+    #    `process` label would be silently overwritten at merge time
+    for reg in report.registrations:
+        for label in reg.label_names:
+            if label in RESERVED_LABELS:
+                report.violations.append(
+                    f"{reg.file}:{reg.lineno}: {reg.kind} {reg.name!r} "
+                    f"declares reserved label {label!r} — the fleet "
+                    "merge layer owns it (docs/concepts.md \"Fleet "
+                    "observability\")"
+                )
+
+    # 5. event-kind drift (declared vs emitted vs documented)
     check_event_kinds(report)
 
-    # 5. stage-name drift (recorded vs declared vs documented)
+    # 6. stage-name drift (recorded vs declared vs documented)
     check_stages(report)
 
     if verbose:
@@ -476,8 +507,8 @@ def main() -> int:
         f"checked {len(report.registrations)} metric registration(s), "
         f"{len(report.emits)} event emit site(s) and "
         f"{len(report.stages)} stage-label site(s): no duplicate, "
-        "non-snake_case, or never-updated metrics; all event kinds "
-        "and capacity stages declared and documented"
+        "non-snake_case, never-updated, or reserved-label metrics; "
+        "all event kinds and capacity stages declared and documented"
     )
     return 0
 

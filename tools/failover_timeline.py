@@ -1,0 +1,284 @@
+"""Reconstruct a replication failover from merged fleet telemetry.
+
+The fleet observability plane (``metran_tpu/obs/fleet.py``,
+docs/concepts.md "Fleet observability") merges every process's event
+records onto one clock-aligned timeline.  This CLI — and its testable
+core :func:`build_timeline` — reads that merged stream and renders the
+replication audit: the ordered story of a failover
+
+    ship -> ack -> replica_lag -> promote -> fence
+
+joining the primary's and the standby's records on the WAL group id
+and fence epoch, so an operator can answer "what happened, in what
+order, and was any acked commit at risk" from telemetry alone — no
+process logs, no WAL surgery.
+
+Inputs, either shape::
+
+    # a JSON dump of ClusterFrontend.fleet_events() (merged list)
+    python tools/failover_timeline.py fleet_events.json
+
+    # one or more per-process JSONL event sinks
+    # (METRAN_TPU_OBS_EVENT_SINK files; merged here by mono+pid)
+    python tools/failover_timeline.py primary.jsonl standby.jsonl
+
+Stdlib + in-repo imports only; ``build_timeline(events)`` is the
+testable core and is what the tier-1 failover-audit test drives.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+#: event kinds that narrate a replication lifecycle, in the order the
+#: phases are expected to unfold (used for both filtering and the
+#: consistency checks)
+REPLICATION_KINDS = (
+    "replica_connect",
+    "replica_lag",
+    "replica_promote",
+    "primary_fenced",
+    "wal_sync_failure",
+)
+
+
+def _order_key(ev: dict):
+    """Sort key tolerant of every input shape: prefer the merged
+    ``fleet_ts`` (clock-aligned), then raw ``mono``, then wall."""
+    ts = ev.get("fleet_ts")
+    if ts is None:
+        ts = ev.get("mono")
+    if ts is None:
+        ts = ev.get("ts", 0.0)
+    return (float(ts), str(ev.get("kind", "")))
+
+
+def _detail(ev: dict) -> dict:
+    d = ev.get("detail")
+    return d if isinstance(d, dict) else {}
+
+
+def build_timeline(events: List[dict]) -> dict:
+    """The replication audit from a merged event stream.
+
+    Filters ``events`` (any iterable of EventLog-shaped dicts, merged
+    or single-process) to the replication kinds, orders them on the
+    aligned timeline, groups them into lifecycle phases and runs the
+    join checks an auditor would:
+
+    - **ship**: the latest ``shipped_group`` the primary booked
+      (``replica_lag`` carries both sides' group cursors) and the
+      acked coverage at attach (``replica_connect.catch_up_commits``).
+    - **promote**: the standby's promotion report — its ``epoch`` must
+      exceed every epoch seen at connect (the fence is a bump), and
+      its ``applied_group`` must cover the last shipped group known
+      before promotion or the audit flags possible acked loss.
+    - **fence**: ``primary_fenced`` records from the old primary must
+      order AFTER the promotion that raised the epoch — a fence with
+      no preceding promote is an ordering anomaly worth flagging.
+
+    Returns ``{"entries", "phases", "checks", "ok"}`` where
+    ``entries`` is the ordered filtered stream (each with a ``phase``
+    tag), ``checks`` is a list of ``{"check", "ok", "note"}`` rows and
+    ``ok`` is their conjunction.  Raises nothing on weird input —
+    an un-reconstructable timeline is a report full of failed checks,
+    not a traceback.
+    """
+    kept = sorted(
+        (ev for ev in events if ev.get("kind") in REPLICATION_KINDS),
+        key=_order_key,
+    )
+    phases: Dict[str, List[dict]] = {
+        "connect": [], "lag": [], "promote": [], "fence": [],
+        "sync_failure": [],
+    }
+    phase_of = {
+        "replica_connect": "connect",
+        "replica_lag": "lag",
+        "replica_promote": "promote",
+        "primary_fenced": "fence",
+        "wal_sync_failure": "sync_failure",
+    }
+    entries: List[dict] = []
+    for ev in kept:
+        row = dict(ev)
+        row["phase"] = phase_of[ev["kind"]]
+        phases[row["phase"]].append(row)
+        entries.append(row)
+
+    checks: List[dict] = []
+
+    def check(name: str, ok: bool, note: str) -> None:
+        checks.append({"check": name, "ok": bool(ok), "note": note})
+
+    # -- join: epochs ----------------------------------------------------
+    connect_epochs = [
+        int(_detail(e)["epoch"]) for e in phases["connect"]
+        if "epoch" in _detail(e)
+    ]
+    promote_epochs = [
+        int(_detail(e)["epoch"]) for e in phases["promote"]
+        if "epoch" in _detail(e)
+    ]
+    check(
+        "promotion observed", bool(phases["promote"]),
+        f"{len(phases['promote'])} replica_promote record(s)",
+    )
+    if connect_epochs and promote_epochs:
+        check(
+            "fence epoch bumped past attach epoch",
+            min(promote_epochs) > max(connect_epochs),
+            f"attach epoch(s) {sorted(set(connect_epochs))} -> "
+            f"promote epoch(s) {sorted(set(promote_epochs))}",
+        )
+
+    # -- join: WAL group coverage ---------------------------------------
+    shipped = [
+        int(_detail(e)["shipped_group"]) for e in phases["lag"]
+        if "shipped_group" in _detail(e)
+    ]
+    applied_at_promote = [
+        int(_detail(e)["applied_group"]) for e in phases["promote"]
+        if "applied_group" in _detail(e)
+    ]
+    if shipped and applied_at_promote:
+        check(
+            "promoted replica covered the shipped WAL groups",
+            max(applied_at_promote) >= max(shipped),
+            f"shipped through group {max(shipped)}, promoted at "
+            f"applied_group {max(applied_at_promote)}",
+        )
+
+    # -- ordering: promote precedes fence --------------------------------
+    if phases["fence"]:
+        if phases["promote"]:
+            ok = _order_key(phases["promote"][0]) <= _order_key(
+                phases["fence"][0]
+            )
+            check(
+                "old primary fenced after promotion",
+                ok,
+                "first fence at/after first promote on the aligned "
+                "timeline" if ok else
+                "primary_fenced ordered BEFORE any replica_promote — "
+                "clock skew or a fence from an unrelated epoch",
+            )
+        else:
+            check(
+                "old primary fenced after promotion", False,
+                "primary_fenced with no replica_promote in the stream",
+            )
+
+    # -- cross-process evidence -----------------------------------------
+    pids = {e.get("pid") for e in kept if e.get("pid") is not None}
+    procs = {
+        e.get("process") for e in kept if e.get("process") is not None
+    }
+    check(
+        "events span more than one process",
+        len(pids) > 1 or len(procs) > 1,
+        f"pids={sorted(pids)} processes={sorted(procs)}"
+        if (pids or procs) else "no pid/process attribution at all",
+    )
+
+    return {
+        "entries": entries,
+        "phases": {k: len(v) for k, v in phases.items()},
+        "checks": checks,
+        "ok": all(c["ok"] for c in checks) and bool(checks),
+    }
+
+
+def render(timeline: dict) -> List[str]:
+    """The audit as terminal lines: the ordered story, then the
+    verdict table."""
+    out: List[str] = ["failover timeline (clock-aligned)", ""]
+    t0: Optional[float] = None
+    for ev in timeline["entries"]:
+        ts = _order_key(ev)[0]
+        if t0 is None:
+            t0 = ts
+        who = ev.get("process") or (
+            f"pid{ev['pid']}" if ev.get("pid") is not None else "?"
+        )
+        d = _detail(ev)
+        extra = ", ".join(
+            f"{k}={d[k]}" for k in (
+                "epoch", "shipped_group", "applied_group", "backlog",
+                "catch_up_commits", "applied_commits", "commits",
+            ) if k in d
+        )
+        out.append(
+            f"  +{ts - t0:9.4f}s  {who:<12} {ev['phase']:<12} "
+            f"{ev['kind']}" + (f"  [{extra}]" if extra else "")
+        )
+    out.append("")
+    for c in timeline["checks"]:
+        out.append(
+            f"  [{'ok' if c['ok'] else 'FAIL'}] {c['check']}: "
+            f"{c['note']}"
+        )
+    out.append("")
+    out.append(
+        "verdict: "
+        + ("consistent failover, no acked-loss indicators"
+           if timeline["ok"] else "ANOMALIES FLAGGED above")
+    )
+    return out
+
+
+def load_events(paths: List[str]) -> List[dict]:
+    """Events from either input shape: a JSON list dump (one file) or
+    JSONL event sinks (any number, merged).  A merged dump already
+    carries ``process`` attribution; each sink file is one process's
+    log, so its records inherit the file stem as their ``process``
+    label (v1 sinks predate pid stamps entirely)."""
+    from metran_tpu.obs.events import read_sink
+
+    events: List[dict] = []
+    for path in paths:
+        with open(path, "r", encoding="utf-8") as fh:
+            head = fh.read(1)
+        if head == "[":
+            with open(path, "r", encoding="utf-8") as fh:
+                events.extend(json.load(fh))
+        else:
+            label = os.path.splitext(os.path.basename(path))[0]
+            for rec in read_sink(path):
+                rec.setdefault("process", label)
+                events.append(rec)
+    return events
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="replication failover audit from merged telemetry"
+    )
+    ap.add_argument(
+        "paths", nargs="+",
+        help="fleet_events() JSON dump or per-process JSONL sinks",
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="emit the structured timeline instead of tables",
+    )
+    args = ap.parse_args(argv)
+    timeline = build_timeline(load_events(args.paths))
+    if args.json:
+        json.dump(timeline, sys.stdout, indent=2, default=str)
+        sys.stdout.write("\n")
+    else:
+        sys.stdout.write("\n".join(render(timeline)) + "\n")
+    return 0 if timeline["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

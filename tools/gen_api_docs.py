@@ -74,6 +74,7 @@ MODULES = [
     "metran_tpu.obs.metrics",
     "metran_tpu.obs.tracing",
     "metran_tpu.obs.events",
+    "metran_tpu.obs.fleet",
     "metran_tpu.obs.telemetry",
     "metran_tpu.data",
     "metran_tpu.diagnostics",
